@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY.md §4 tier-2 analog: a
+deterministic in-process multi-"node" runtime). Real-TPU behavior is covered
+by bench.py / __graft_entry__.py on hardware.
+
+Note: the environment's TPU plugin forces its own platform selection via a
+sitecustomize hook, so setting JAX_PLATFORMS in the environment is not
+enough — we must override the jax config *after* import, before any backend
+initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

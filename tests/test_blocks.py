@@ -1,0 +1,113 @@
+"""Block model + Arrow bridge tests (mirror of formats/arrow ut coverage)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks import Dictionary, DictionarySet, TableBlock
+from ydb_tpu.blocks.arrow_bridge import (
+    block_to_record_batch,
+    record_batch_to_block,
+    schema_from_arrow,
+)
+
+
+def test_block_roundtrip_numpy():
+    sch = dtypes.schema(("a", dtypes.INT32), ("b", dtypes.DOUBLE))
+    blk = TableBlock.from_numpy(
+        {"a": np.arange(10, dtype=np.int32), "b": np.linspace(0, 1, 10)}, sch
+    )
+    assert blk.capacity == 1024
+    assert int(blk.length) == 10
+    out = blk.to_numpy()
+    np.testing.assert_array_equal(out["a"], np.arange(10))
+    assert np.asarray(blk.row_mask()).sum() == 10
+
+
+def test_block_is_pytree():
+    import jax
+
+    sch = dtypes.schema(("a", dtypes.INT64))
+    blk = TableBlock.from_numpy({"a": np.arange(5, dtype=np.int64)}, sch)
+    leaves = jax.tree_util.tree_leaves(blk)
+    assert len(leaves) == 3  # data, validity, length
+
+    def f(b):
+        return b.columns["a"].data.sum()
+
+    assert int(jax.jit(f)(blk)) == 10
+
+
+def test_dictionary_predicates():
+    d = Dictionary()
+    ids = d.encode([b"AIR", b"MAIL", b"AIR", b"SHIP"])
+    np.testing.assert_array_equal(ids, [0, 1, 0, 2])
+    assert d.eq_id(b"MAIL") == 1
+    assert d.eq_id(b"TRUCK") == -1
+    np.testing.assert_array_equal(d.like_mask("%AI%"), [True, True, False])
+    np.testing.assert_array_equal(d.prefix_mask(b"A"), [True, False, False])
+    rank = d.sort_rank()
+    # AIR < MAIL < SHIP
+    assert rank[0] < rank[1] < rank[2]
+
+
+def test_arrow_roundtrip_with_nulls_strings_decimals():
+    import decimal as pydec
+
+    batch = pa.record_batch(
+        {
+            "k": pa.array([1, 2, None, 4], type=pa.int64()),
+            "s": pa.array(["x", None, "y", "x"], type=pa.string()),
+            "d": pa.array(
+                [pydec.Decimal("1.25"), pydec.Decimal("-2.50"), None,
+                 pydec.Decimal("0.01")],
+                type=pa.decimal128(12, 2),
+            ),
+        }
+    )
+    sch = schema_from_arrow(batch.schema)
+    assert sch.field("s").type.is_string
+    assert sch.field("d").type.scale == 2
+
+    dicts = DictionarySet()
+    blk = record_batch_to_block(batch, dicts)
+    data = blk.to_numpy()
+    valid = blk.validity_numpy()
+    np.testing.assert_array_equal(valid["k"], [True, True, False, True])
+    np.testing.assert_array_equal(data["d"], [125, -250, 0, 1])
+    # same string -> same id
+    assert data["s"][0] == data["s"][3]
+
+    back = block_to_record_batch(blk, dicts)
+    assert back.column("k").to_pylist() == [1, 2, None, 4]
+    assert back.column("s").to_pylist() == [b"x", None, b"y", b"x"]
+    assert [str(x) if x is not None else None for x in back.column("d").to_pylist()] == [
+        "1.25", "-2.50", None, "0.01"
+    ]
+
+
+def test_arrow_dictionary_array_remap():
+    dicts = DictionarySet()
+    b1 = pa.record_batch(
+        {"s": pa.array(["b", "a"]).dictionary_encode()}
+    )
+    b2 = pa.record_batch(
+        {"s": pa.array(["a", "c"]).dictionary_encode()}
+    )
+    sch = schema_from_arrow(b1.schema)
+    blk1 = record_batch_to_block(b1, dicts, sch)
+    blk2 = record_batch_to_block(b2, dicts, sch)
+    d = dicts["s"]
+    assert d.decode(blk1.to_numpy()["s"]) == [b"b", b"a"]
+    assert d.decode(blk2.to_numpy()["s"]) == [b"a", b"c"]
+
+
+def test_capacity_quantization_and_overflow():
+    sch = dtypes.schema(("a", dtypes.INT32))
+    blk = TableBlock.from_numpy({"a": np.arange(1500, dtype=np.int32)}, sch)
+    assert blk.capacity == 2048
+    with pytest.raises(ValueError):
+        TableBlock.from_numpy(
+            {"a": np.arange(10, dtype=np.int32)}, sch, capacity=5
+        )

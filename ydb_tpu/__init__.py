@@ -1,0 +1,32 @@
+"""ydb_tpu — a TPU-native distributed SQL data framework.
+
+A ground-up rebuild of the capabilities of YDB (reference: rohankumardubey/ydb)
+designed TPU-first on JAX/XLA: columnar SSA programs execute as fused XLA
+kernels over fixed-shape device column blocks, inter-shard shuffles map onto
+``all_to_all``/``psum`` over the ICI mesh, and the host runtime (tablets,
+transactions, control plane) stays on CPU where it belongs.
+
+Planes (see SURVEY.md §7.0):
+  * ``ydb_tpu.blocks``   — Arrow ⇄ device column-block bridge
+  * ``ydb_tpu.ssa``      — SSA scan program model + JAX kernel registry
+                           (reference: ydb/core/protos/ssa.proto,
+                           ydb/core/formats/arrow/program.h)
+  * ``ydb_tpu.engine``   — column engine: portions, granules, MVCC snapshots,
+                           insert/compaction/TTL (reference:
+                           ydb/core/tx/columnshard/engines/)
+  * ``ydb_tpu.dq``       — distributed dataflow: tasks, channels, runners
+                           (reference: ydb/library/yql/dq/)
+  * ``ydb_tpu.parallel`` — mesh, shardings, collective shuffle/aggregate
+  * ``ydb_tpu.sql``      — SQL frontend + planner (reference: ydb/core/kqp)
+  * ``ydb_tpu.runtime``  — actor shim, counters, tracing, config knobs
+"""
+
+import jax
+
+# Decimal columns are scaled int64; aggregate accumulators must not silently
+# truncate to 32 bits (reference keeps exact i64/i128 decimal sums —
+# ydb/library/yql/minikql/comp_nodes/mkql_block_agg.cpp). TPU emulates int64
+# on the VPU; hot kernels opt back into int32 pairs explicitly where measured.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from ydb_tpu.blocks.block import Column, TableBlock  # noqa: F401
+from ydb_tpu.blocks.dictionary import Dictionary, DictionarySet  # noqa: F401

@@ -1,0 +1,177 @@
+"""Fixed-shape device column blocks — the unit of TPU columnar execution.
+
+The reference's execution unit is an Arrow RecordBatch flowing through block
+operators (ydb/library/yql/minikql/comp_nodes/mkql_blocks.cpp, block infra
+computation/mkql_block_impl.h). XLA wants static shapes, so the TPU analog is
+a ``TableBlock``: every column padded to a common ``capacity`` with an int32
+``length`` scalar giving the live row count. Rows in [length, capacity) are
+padding; kernels mask them out via ``row_mask``.
+
+TableBlock is a pytree, so it flows through jit / vmap / shard_map / psum
+directly. The schema and capacity are static (part of the treedef): changing
+either triggers recompilation, matching the compiled-pattern-cache design
+(reference: mkql_computation_pattern_cache.h — here the XLA compile cache).
+
+NULLs: each column carries a validity bitmask (bool array). Kernels follow
+Arrow/Kleene semantics where the reference does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydb_tpu import dtypes
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+# Pad capacities to a lane-friendly multiple; keeps layouts tileable on the
+# VPU (8x128 lanes) and stabilizes jit cache keys across slightly different
+# batch sizes.
+DEFAULT_CAPACITY_QUANTUM = 1024
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """One device column: physical values + validity mask.
+
+    ``data`` is the physical representation per ydb_tpu.dtypes (strings are
+    int32 dictionary ids, decimals scaled int64). ``validity`` is True for
+    non-null rows; padding rows have validity False.
+    """
+
+    data: jax.Array
+    validity: jax.Array
+
+    def tree_flatten(self):
+        return (self.data, self.validity), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TableBlock:
+    """A batch of rows as named device columns, padded to ``capacity``.
+
+    Dynamic leaves: per-column data/validity arrays + ``length`` scalar.
+    Static treedef: schema (names + logical types) and capacity.
+    """
+
+    columns: dict[str, Column]
+    length: jax.Array  # int32 scalar: live rows
+    schema: dtypes.Schema
+
+    def tree_flatten(self):
+        names = tuple(self.columns.keys())
+        children = tuple(self.columns[n] for n in names) + (self.length,)
+        return children, (names, self.schema)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        names, schema = aux
+        cols = dict(zip(names, children[:-1]))
+        return cls(cols, children[-1], schema)
+
+    # ---- construction ----
+
+    @staticmethod
+    def from_numpy(
+        arrays: Mapping[str, np.ndarray],
+        schema: dtypes.Schema,
+        validity: Mapping[str, np.ndarray] | None = None,
+        capacity: int | None = None,
+    ) -> "TableBlock":
+        """Build a block from host numpy arrays (already physically encoded)."""
+        names = schema.names
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        cap = capacity if capacity is not None else _round_up(
+            max(n, 1), DEFAULT_CAPACITY_QUANTUM
+        )
+        if cap < n:
+            raise ValueError(f"capacity {cap} < rows {n}")
+        cols = {}
+        for name in names:
+            f = schema.field(name)
+            a = np.asarray(arrays[name], dtype=f.type.physical)
+            v = None if validity is None else validity.get(name)
+            if v is None:
+                v = np.ones(n, dtype=np.bool_)
+            data = np.zeros(cap, dtype=f.type.physical)
+            data[:n] = a
+            valid = np.zeros(cap, dtype=np.bool_)
+            valid[:n] = v
+            cols[name] = Column(jnp.asarray(data), jnp.asarray(valid))
+        return TableBlock(cols, jnp.asarray(n, dtype=jnp.int32), schema)
+
+    # ---- views ----
+
+    @property
+    def capacity(self) -> int:
+        return next(iter(self.columns.values())).capacity if self.columns else 0
+
+    def row_mask(self) -> jax.Array:
+        """bool[capacity]: True for live (non-padding) rows."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.length
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def select(self, names) -> "TableBlock":
+        return TableBlock(
+            {n: self.columns[n] for n in names},
+            self.length,
+            self.schema.select(names),
+        )
+
+    def with_column(
+        self, name: str, col: Column, typ: dtypes.LogicalType
+    ) -> "TableBlock":
+        cols = dict(self.columns)
+        cols[name] = col
+        sch = self.schema
+        if name not in sch:
+            sch = sch.with_field(dtypes.Field(name, typ))
+        return TableBlock(cols, self.length, sch)
+
+    # ---- host materialization (tests / result delivery) ----
+
+    def to_numpy(self) -> dict[str, np.ndarray]:
+        """Live rows only, as physical numpy arrays (nulls not decoded)."""
+        n = int(self.length)
+        return {k: np.asarray(c.data)[:n] for k, c in self.columns.items()}
+
+    def validity_numpy(self) -> dict[str, np.ndarray]:
+        n = int(self.length)
+        return {k: np.asarray(c.validity)[:n] for k, c in self.columns.items()}
+
+
+def concat_blocks(blocks: list[TableBlock], capacity: int | None = None) -> TableBlock:
+    """Host-side concat of live rows into one block (used by readers/tests)."""
+    if not blocks:
+        raise ValueError("concat of no blocks")
+    schema = blocks[0].schema
+    arrays: dict[str, np.ndarray] = {}
+    validity: dict[str, np.ndarray] = {}
+    for name in schema.names:
+        arrays[name] = np.concatenate(
+            [b.to_numpy()[name] for b in blocks]
+        )
+        validity[name] = np.concatenate(
+            [b.validity_numpy()[name] for b in blocks]
+        )
+    return TableBlock.from_numpy(arrays, schema, validity, capacity=capacity)

@@ -1,0 +1,168 @@
+"""Logical column types and their device representations.
+
+The reference models column types with NScheme type ids and Arrow types
+(ydb/core/formats/arrow/arrow_helpers.cpp). On TPU every column must be a
+fixed-shape numeric array, so each logical type maps to a *physical* jnp dtype
+plus optional side metadata (decimal scale, string dictionary):
+
+  INT8/16/32/64, UINT*        -> same-width ints (device)
+  FLOAT, DOUBLE               -> float32 / float64
+  BOOL                        -> bool_
+  DATE                        -> int32 (days since epoch)
+  TIMESTAMP                   -> int64 (microseconds since epoch)
+  DECIMAL(p, s)               -> int64 scaled by 10**s   (exact arithmetic)
+  STRING / UTF8               -> int32 dictionary ids; the dictionary itself
+                                 stays on host (ydb_tpu.blocks.dictionary)
+
+This file has no jax dependency at import time beyond dtype names; it is the
+schema vocabulary shared by host (Arrow) and device (blocks) code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+
+class Kind(enum.Enum):
+    INT8 = "int8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    FLOAT = "float32"
+    DOUBLE = "float64"
+    BOOL = "bool"
+    DATE = "date"            # int32 days
+    TIMESTAMP = "timestamp"  # int64 micros
+    DECIMAL = "decimal"      # int64 scaled
+    STRING = "string"        # int32 dict id
+
+
+_PHYSICAL = {
+    Kind.INT8: np.int8,
+    Kind.INT16: np.int16,
+    Kind.INT32: np.int32,
+    Kind.INT64: np.int64,
+    Kind.UINT8: np.uint8,
+    Kind.UINT16: np.uint16,
+    Kind.UINT32: np.uint32,
+    Kind.UINT64: np.uint64,
+    Kind.FLOAT: np.float32,
+    Kind.DOUBLE: np.float64,
+    Kind.BOOL: np.bool_,
+    Kind.DATE: np.int32,
+    Kind.TIMESTAMP: np.int64,
+    Kind.DECIMAL: np.int64,
+    Kind.STRING: np.int32,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class LogicalType:
+    """A logical column type. Hashable; used as static jit metadata."""
+
+    kind: Kind
+    # DECIMAL scale: value = unscaled / 10**scale. Ignored otherwise.
+    scale: int = 0
+
+    @property
+    def physical(self) -> np.dtype:
+        return np.dtype(_PHYSICAL[self.kind])
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind == Kind.STRING
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.kind == Kind.DECIMAL
+
+    @property
+    def is_floating(self) -> bool:
+        return self.kind in (Kind.FLOAT, Kind.DOUBLE)
+
+    @property
+    def is_integer(self) -> bool:
+        return self.kind in (
+            Kind.INT8, Kind.INT16, Kind.INT32, Kind.INT64,
+            Kind.UINT8, Kind.UINT16, Kind.UINT32, Kind.UINT64,
+            Kind.DATE, Kind.TIMESTAMP,
+        )
+
+    def __repr__(self) -> str:
+        if self.kind == Kind.DECIMAL:
+            return f"decimal(s={self.scale})"
+        return self.kind.value
+
+
+INT8 = LogicalType(Kind.INT8)
+INT16 = LogicalType(Kind.INT16)
+INT32 = LogicalType(Kind.INT32)
+INT64 = LogicalType(Kind.INT64)
+UINT8 = LogicalType(Kind.UINT8)
+UINT16 = LogicalType(Kind.UINT16)
+UINT32 = LogicalType(Kind.UINT32)
+UINT64 = LogicalType(Kind.UINT64)
+FLOAT = LogicalType(Kind.FLOAT)
+DOUBLE = LogicalType(Kind.DOUBLE)
+BOOL = LogicalType(Kind.BOOL)
+DATE = LogicalType(Kind.DATE)
+TIMESTAMP = LogicalType(Kind.TIMESTAMP)
+STRING = LogicalType(Kind.STRING)
+
+
+def decimal(scale: int) -> LogicalType:
+    return LogicalType(Kind.DECIMAL, scale=scale)
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    type: LogicalType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    """Ordered, hashable column schema (static under jit)."""
+
+    fields: tuple[Field, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "fields", tuple(self.fields))
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(f.name for f in self.fields)
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(f"no column {name!r} in schema {self.names}")
+
+    def __contains__(self, name: str) -> bool:
+        return any(f.name == name for f in self.fields)
+
+    def select(self, names) -> "Schema":
+        return Schema(tuple(self.field(n) for n in names))
+
+    def with_field(self, f: Field) -> "Schema":
+        return Schema(self.fields + (f,))
+
+
+def schema(*cols: tuple) -> Schema:
+    """schema(("a", INT32), ("b", STRING, False), ...)"""
+    fields = []
+    for c in cols:
+        if len(c) == 2:
+            fields.append(Field(c[0], c[1]))
+        else:
+            fields.append(Field(c[0], c[1], c[2]))
+    return Schema(tuple(fields))
