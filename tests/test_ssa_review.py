@@ -1,0 +1,130 @@
+"""Regression tests for review findings on the SSA layer."""
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks import DictionarySet, TableBlock
+from ydb_tpu.ssa import (
+    Agg,
+    AggSpec,
+    AssignStep,
+    Call,
+    Col,
+    FilterStep,
+    GroupByStep,
+    Op,
+    Program,
+    SortStep,
+    compile_program,
+)
+from ydb_tpu.ssa.program import lit
+
+
+def _block(**cols):
+    sch = []
+    arrays = {}
+    validity = {}
+    for name, spec in cols.items():
+        arr, t = spec[0], spec[1]
+        sch.append((name, t))
+        arrays[name] = np.asarray(arr)
+        if len(spec) > 2:
+            validity[name] = np.asarray(spec[2])
+    return TableBlock.from_numpy(arrays, dtypes.schema(*sch), validity or None)
+
+
+def test_decimal_vs_float_literal_compare():
+    blk = _block(price=([4, 6, 100], dtypes.decimal(2)))  # 0.04,0.06,1.00
+    prog = Program((FilterStep(Call(Op.LT, Col("price"), lit(0.05))),))
+    out = compile_program(prog, blk.schema)(blk)
+    np.testing.assert_array_equal(out.to_numpy()["price"], [4])
+
+
+def test_min_max_string_by_rank_not_id():
+    dicts = DictionarySet()
+    ids = dicts.for_column("s").encode([b"zebra", b"apple", b"zebra"])
+    blk = _block(s=(ids, dtypes.STRING), g=([1, 1, 1], dtypes.INT64))
+    prog = Program((
+        GroupByStep(keys=("g",), aggs=(
+            AggSpec(Agg.MIN, "s", "lo"),
+            AggSpec(Agg.MAX, "s", "hi"),
+        )),
+    ))
+    out = compile_program(prog, blk.schema, dicts, key_spaces={"g": 2})(blk)
+    res = out.to_numpy()
+    assert dicts["s"].values[int(res["lo"][0])] == b"apple"
+    assert dicts["s"].values[int(res["hi"][0])] == b"zebra"
+
+
+def test_sort_desc_nulls_last():
+    blk = _block(x=([5, 0, 3, 7], dtypes.INT64, [True, False, True, True]))
+    prog = Program((SortStep(keys=("x",), descending=(True,)),))
+    out = compile_program(prog, blk.schema)(blk)
+    res = out.to_numpy()
+    valid = out.validity_numpy()
+    np.testing.assert_array_equal(res["x"][:3], [7, 5, 3])
+    assert not valid["x"][3]
+
+
+def test_sort_desc_bool_key():
+    blk = _block(b=([True, False, True], dtypes.BOOL))
+    prog = Program((SortStep(keys=("b",), descending=(True,)),))
+    out = compile_program(prog, blk.schema)(blk)
+    np.testing.assert_array_equal(out.to_numpy()["b"], [True, True, False])
+
+
+def test_null_group_not_split_by_garbage():
+    # nullable computed column: garbage under invalid slots must not split
+    # the NULL group
+    blk = _block(
+        a=([10, 20, 7], dtypes.INT64),
+        b=([0, 0, 7], dtypes.INT64),
+    )
+    prog = Program((
+        AssignStep("q", Call(Op.DIV, Col("a"), Col("b"))),  # null, null, 1
+        GroupByStep(keys=("q",), aggs=(AggSpec(Agg.COUNT_ALL, None, "n"),)),
+    ))
+    out = compile_program(prog, blk.schema)(blk)
+    assert int(out.length) == 2
+    res = out.to_numpy()
+    assert sorted(res["n"].tolist()) == [1, 2]
+
+
+def test_group_by_computed_column():
+    blk = _block(d=([0, 18262, 18300], dtypes.DATE))
+    prog = Program((
+        AssignStep("y", Call(Op.YEAR, Col("d"))),
+        GroupByStep(keys=("y",), aggs=(AggSpec(Agg.COUNT_ALL, None, "n"),)),
+    ))
+    out = compile_program(prog, blk.schema)(blk)
+    res = out.to_numpy()
+    assert int(out.length) == 2
+    np.testing.assert_array_equal(sorted(res["y"].tolist()), [1970, 2020])
+
+
+def test_sorted_groupby_no_silent_drop():
+    n = 100  # 100 distinct keys, no explicit cap: all must survive
+    blk = _block(k=(np.arange(n) * 13 % 997, dtypes.INT64))
+    prog = Program((
+        GroupByStep(keys=("k",), aggs=(AggSpec(Agg.COUNT_ALL, None, "n"),)),
+    ))
+    out = compile_program(prog, blk.schema)(blk)
+    assert int(out.length) == n
+
+
+def test_keyless_aggregate_on_empty_selection():
+    blk = _block(v=([1, 2, 3], dtypes.INT64))
+    prog = Program((
+        FilterStep(Call(Op.GT, Col("v"), lit(100))),
+        GroupByStep(keys=(), aggs=(
+            AggSpec(Agg.COUNT_ALL, None, "n"),
+            AggSpec(Agg.COUNT, "v", "c"),
+            AggSpec(Agg.SUM, "v", "s"),
+        )),
+    ))
+    out = compile_program(prog, blk.schema)(blk)
+    assert int(out.length) == 1
+    res, valid = out.to_numpy(), out.validity_numpy()
+    assert res["n"][0] == 0 and valid["n"][0]
+    assert res["c"][0] == 0 and valid["c"][0]
+    assert not valid["s"][0]  # SUM over empty => NULL

@@ -1,0 +1,15 @@
+from ydb_tpu.ssa.ops import Op, Agg  # noqa: F401
+from ydb_tpu.ssa.program import (  # noqa: F401
+    AggSpec,
+    AssignStep,
+    Call,
+    Col,
+    Const,
+    DictPredicate,
+    FilterStep,
+    GroupByStep,
+    ProjectStep,
+    Program,
+    SortStep,
+)
+from ydb_tpu.ssa.compiler import compile_program  # noqa: F401

@@ -1,0 +1,688 @@
+"""SSA program → one traced JAX function over a TableBlock.
+
+The analog of the reference's program parse + apply pipeline
+(ydb/core/tx/program/program.cpp:553 TProgramContainer::Init;
+TProgramStep::Apply formats/arrow/program.h:394) — except here "apply" is a
+*trace*: the whole step list lowers into a single XLA computation (assigns,
+filters, group-by, sort fused into one HBM pass wherever XLA can).
+
+Compilation resolves string predicates against host dictionaries into small
+device lookup tables ("aux inputs"), picks dense vs sort-based group-id
+assignment from key cardinalities, and fixes the output schema. The result
+is pure: ``run(block, aux) -> block`` — jit it, vmap it, shard_map it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.block import Column, TableBlock
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.ssa import kernels
+from ydb_tpu.ssa.ops import Agg, Op
+from ydb_tpu.ssa.program import (
+    AggSpec,
+    AssignStep,
+    Call,
+    Col,
+    Const,
+    DictPredicate,
+    Expr,
+    FilterStep,
+    GroupByStep,
+    ProjectStep,
+    Program,
+    SortStep,
+    agg_result_type,
+    infer_type,
+)
+
+
+@dataclasses.dataclass
+class CompiledProgram:
+    """A lowered program plus its plan-time inputs."""
+
+    run: Callable  # (TableBlock, dict[str, jax.Array]) -> TableBlock
+    aux: dict[str, np.ndarray]  # plan-time tables (dict masks etc.)
+    out_schema: dtypes.Schema
+    in_schema: dtypes.Schema
+
+    def __call__(self, block: TableBlock) -> TableBlock:
+        aux = {k: jnp.asarray(v) for k, v in self.aux.items()}
+        return self.run(block, aux)
+
+
+class _Lowering:
+    """Single-pass lowering context (types + aux tables + trace builder)."""
+
+    def __init__(self, schema: dtypes.Schema, dicts: DictionarySet | None,
+                 key_spaces: dict[str, int] | None):
+        self.schema = schema
+        self.dicts = dicts
+        self.key_spaces = dict(key_spaces or {})
+        self.types: dict[str, dtypes.LogicalType] = {
+            f.name: f.type for f in schema.fields
+        }
+        self.aux: dict[str, np.ndarray] = {}
+        self._aux_n = 0
+
+    def add_aux(self, prefix: str, table: np.ndarray) -> str:
+        key = f"{prefix}#{self._aux_n}"
+        self._aux_n += 1
+        self.aux[key] = table
+        return key
+
+    def key_bound(self, name: str, t: dtypes.LogicalType) -> int | None:
+        """Static cardinality bound for a group-by key column, if known.
+
+        ``t`` is the column's *current* type (assigned columns included)."""
+        if t.kind == dtypes.Kind.BOOL:
+            return 2
+        if t.is_string and self.dicts is not None and name in self.dicts:
+            return len(self.dicts[name])
+        return self.key_spaces.get(name)
+
+
+def compile_program(
+    program: Program,
+    schema: dtypes.Schema,
+    dicts: DictionarySet | None = None,
+    key_spaces: dict[str, int] | None = None,
+) -> CompiledProgram:
+    ctx = _Lowering(schema, dicts, key_spaces)
+
+    # ---- static pass: resolve plan, types, aux tables, output schema ----
+    plan: list = []  # (kind, payload) closures prepared statically
+    cur_types = dict(ctx.types)
+    cur_names = list(schema.names)
+
+    def resolve_expr(expr: Expr):
+        """Return (lower_fn(env, aux) -> Column, LogicalType)."""
+        if isinstance(expr, Col):
+            t = cur_types[expr.name]
+            name = expr.name
+            return (lambda env, aux: env[name]), t
+        if isinstance(expr, Const):
+            t = expr.type
+            val = expr.value
+
+            def lower_const(env, aux, _t=t, _v=val):
+                any_col = next(iter(env.values()))
+                n = any_col.data.shape[0]
+                data = jnp.full((n,), _v, dtype=_t.physical)
+                return Column(data, jnp.ones((n,), dtype=bool))
+
+            return lower_const, t
+        if isinstance(expr, DictPredicate):
+            return _resolve_dict_predicate(ctx, expr, cur_types)
+        assert isinstance(expr, Call)
+        return _resolve_call(ctx, expr, cur_types, resolve_expr)
+
+    for step in program.steps:
+        if isinstance(step, AssignStep):
+            fn, t = resolve_expr(step.expr)
+            cur_types[step.name] = t
+            if step.name not in cur_names:
+                cur_names.append(step.name)
+            plan.append(("assign", (step.name, fn)))
+        elif isinstance(step, FilterStep):
+            fn, t = resolve_expr(step.expr)
+            if t.kind != dtypes.Kind.BOOL:
+                raise TypeError(f"filter predicate must be bool, got {t}")
+            plan.append(("filter", fn))
+        elif isinstance(step, GroupByStep):
+            lowered = _resolve_group_by(ctx, step, cur_types)
+            plan.append(("group_by", lowered))
+            cur_names = list(lowered.out_names)
+            cur_types = dict(lowered.out_types)
+        elif isinstance(step, ProjectStep):
+            missing = [n for n in step.names if n not in cur_types]
+            if missing:
+                raise KeyError(f"projection of unknown columns {missing}")
+            cur_names = list(step.names)
+            plan.append(("project", tuple(step.names)))
+        elif isinstance(step, SortStep):
+            desc = step.descending or (False,) * len(step.keys)
+            # string keys order by dictionary *rank*, not id: ship a
+            # plan-time rank table per string key (ydb_tpu.blocks.dictionary)
+            ranks = []
+            for k in step.keys:
+                t = cur_types[k]
+                if t.is_string and dicts is not None and k in dicts:
+                    ranks.append(ctx.add_aux(
+                        f"rank.{k}", dicts[k].sort_rank()))
+                elif t.is_string:
+                    raise ValueError(
+                        f"ORDER BY on string column {k} needs its dictionary")
+                else:
+                    ranks.append(None)
+            plan.append(
+                ("sort", (tuple(step.keys), tuple(desc), step.limit,
+                          tuple(ranks))))
+        else:
+            raise NotImplementedError(f"step {step}")
+
+    out_schema = dtypes.Schema(
+        tuple(dtypes.Field(n, cur_types[n]) for n in cur_names)
+    )
+
+    # ---- trace-time pass ----
+    def run(block: TableBlock, aux: dict[str, jax.Array]) -> TableBlock:
+        env: dict[str, Column] = dict(block.columns)
+        mask = block.row_mask()
+        length = block.length
+        names = list(block.columns.keys())
+
+        for kind, payload in plan:
+            if kind == "assign":
+                name, fn = payload
+                env[name] = fn(env, aux)
+                if name not in names:
+                    names.append(name)
+            elif kind == "filter":
+                # mask-only (late materialization); `length` keeps the live
+                # range until a compaction point (group_by/sort/output)
+                pred = payload(env, aux)
+                mask = mask & kernels.pred_mask(pred)
+            elif kind == "project":
+                names = list(payload)
+                env = {n: env[n] for n in names}
+            elif kind == "group_by":
+                gb = payload
+                env, length = gb.lower(env, aux, mask)
+                names = list(gb.out_names)
+                mask = (
+                    jnp.arange(next(iter(env.values())).data.shape[0],
+                               dtype=jnp.int32) < length
+                )
+            elif kind == "sort":
+                keys, desc, limit, ranks = payload
+                cols = {n: env[n] for n in names}
+                sort_cols = []
+                for k, rk in zip(keys, ranks):
+                    c = cols[k] if k in cols else env[k]
+                    if rk is not None:
+                        c = kernels.dict_gather(aux[rk], c)
+                    sort_cols.append(c)
+                tmp_names = list(names)
+                for i, c in enumerate(sort_cols):
+                    cols[f"__sort{i}"] = c
+                    tmp_names.append(f"__sort{i}")
+                blk = TableBlock(
+                    cols, length,
+                    dtypes.Schema(tuple(
+                        dtypes.Field(n, cur_types.get(n, dtypes.INT64))
+                        for n in tmp_names)),
+                )
+                blk = kernels.compact(blk, mask)
+                blk = kernels.sort_block(
+                    blk, [f"__sort{i}" for i in range(len(keys))],
+                    list(desc), limit)
+                env = {n: blk.columns[n] for n in names}
+                length = blk.length
+                mask = blk.row_mask()
+        out_cols = {n: env[n] for n in out_schema.names}
+        blk = TableBlock(out_cols, length, out_schema)
+        return kernels.compact(blk, mask)
+
+    return CompiledProgram(run=run, aux=ctx.aux, out_schema=out_schema,
+                           in_schema=schema)
+
+
+# ---------------- expression lowering helpers ----------------
+
+
+def _resolve_dict_predicate(ctx: _Lowering, p: DictPredicate, cur_types):
+    t = cur_types[p.column]
+    if not t.is_string:
+        raise TypeError(f"dict predicate on non-string column {p.column}")
+    if ctx.dicts is None or p.column not in ctx.dicts:
+        raise ValueError(f"no dictionary for column {p.column}")
+    d = ctx.dicts[p.column]
+    if p.kind in ("eq", "ne"):
+        want = d.eq_id(p.pattern)
+        table = np.zeros(max(len(d), 1), dtype=np.bool_)
+        if want >= 0:
+            table[want] = True
+        if p.kind == "ne":
+            table = ~table
+    elif p.kind == "like":
+        table = d.like_mask(p.pattern)
+    elif p.kind == "prefix":
+        table = d.prefix_mask(p.pattern)
+    elif p.kind in ("in_set", "not_in_set"):
+        table = np.zeros(max(len(d), 1), dtype=np.bool_)
+        for v in p.pattern:
+            i = d.eq_id(v)
+            if i >= 0:
+                table[i] = True
+        if p.kind == "not_in_set":
+            table = ~table
+    else:
+        raise NotImplementedError(f"dict predicate kind {p.kind}")
+    if table.size == 0:
+        table = np.zeros(1, dtype=np.bool_)
+    key = ctx.add_aux(f"dict.{p.column}.{p.kind}", table)
+    col = p.column
+
+    def lower(env, aux, _key=key, _col=col):
+        return kernels.dict_gather(aux[_key], env[_col])
+
+    return lower, dtypes.BOOL
+
+
+_SIMPLE_BINOPS = {
+    Op.EQ: lambda a, b: a == b,
+    Op.NE: lambda a, b: a != b,
+    Op.LT: lambda a, b: a < b,
+    Op.LE: lambda a, b: a <= b,
+    Op.GT: lambda a, b: a > b,
+    Op.GE: lambda a, b: a >= b,
+    Op.ADD: lambda a, b: a + b,
+    Op.SUB: lambda a, b: a - b,
+    Op.MUL: lambda a, b: a * b,
+    Op.XOR: lambda a, b: a ^ b,
+}
+
+_SIMPLE_UNOPS = {
+    Op.NOT: lambda a: ~a,
+    Op.NEG: lambda a: -a,
+    Op.ABS: jnp.abs,
+    Op.SQRT: jnp.sqrt,
+    Op.EXP: jnp.exp,
+    Op.LN: jnp.log,
+    Op.FLOOR: jnp.floor,
+    Op.CEIL: jnp.ceil,
+    Op.ROUND: jnp.round,
+}
+
+
+def _resolve_call(ctx: _Lowering, call: Call, cur_types, resolve_expr):
+    op = call.op
+    resolved = [resolve_expr(a) for a in call.args]
+    fns = [r[0] for r in resolved]
+    ts = [r[1] for r in resolved]
+    out_t = infer_type(call, ctx.schema, cur_types)
+
+    # rescale decimal operands to a common scale for add/sub/compare
+    if op in (Op.ADD, Op.SUB, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE,
+              Op.MOD):
+        fns, ts = _align_decimals(op, call, fns, ts)
+
+    if op in _SIMPLE_BINOPS and len(fns) == 2:
+        f = _SIMPLE_BINOPS[op]
+        fa, fb = fns
+
+        def lower(env, aux, _f=f, _fa=fa, _fb=fb):
+            return kernels.binop(_f, _fa(env, aux), _fb(env, aux))
+
+        return lower, out_t
+    if op in _SIMPLE_UNOPS and len(fns) == 1:
+        f = _SIMPLE_UNOPS[op]
+        fa = fns[0]
+
+        def lower(env, aux, _f=f, _fa=fa):
+            return kernels.unop(_f, _fa(env, aux))
+
+        return lower, out_t
+    if op is Op.AND:
+        fa, fb = fns
+
+        def lower(env, aux, _fa=fa, _fb=fb):
+            return kernels.kleene_and(_fa(env, aux), _fb(env, aux))
+
+        return lower, out_t
+    if op is Op.OR:
+        fa, fb = fns
+
+        def lower(env, aux, _fa=fa, _fb=fb):
+            return kernels.kleene_or(_fa(env, aux), _fb(env, aux))
+
+        return lower, out_t
+    if op is Op.DIV:
+        fa, fb = fns
+        ta, tb = ts[0], ts[1]
+        as_float = out_t.is_floating
+        sa = 10.0 ** ta.scale if ta.is_decimal else 1.0
+        sb = 10.0 ** tb.scale if tb.is_decimal else 1.0
+
+        def lower(env, aux, _fa=fa, _fb=fb, _sa=sa, _sb=sb, _ff=as_float):
+            a, b = _fa(env, aux), _fb(env, aux)
+            if _ff and (_sa != 1.0 or _sb != 1.0):
+                a = Column(a.data.astype(jnp.float64) / _sa, a.validity)
+                b = Column(b.data.astype(jnp.float64) / _sb, b.validity)
+            elif _ff:
+                a = Column(a.data.astype(jnp.float64), a.validity)
+            return kernels.safe_div(a, b, _ff)
+
+        return lower, out_t
+    if op is Op.MOD:
+        fa, fb = fns
+
+        def lower(env, aux, _fa=fa, _fb=fb):
+            a, b = _fa(env, aux), _fb(env, aux)
+            zero = b.data == 0
+            denom = jnp.where(zero, jnp.ones_like(b.data), b.data)
+            return Column(a.data % denom, a.validity & b.validity & ~zero)
+
+        return lower, out_t
+    if op is Op.POW:
+        fa, fb = fns
+
+        def lower(env, aux, _fa=fa, _fb=fb):
+            a, b = _fa(env, aux), _fb(env, aux)
+            return Column(
+                jnp.power(a.data.astype(jnp.float64),
+                          b.data.astype(jnp.float64)),
+                a.validity & b.validity,
+            )
+
+        return lower, out_t
+    if op is Op.IS_NULL:
+        fa = fns[0]
+
+        def lower(env, aux, _fa=fa):
+            a = _fa(env, aux)
+            return Column(~a.validity, jnp.ones_like(a.validity))
+
+        return lower, out_t
+    if op is Op.IS_NOT_NULL:
+        fa = fns[0]
+
+        def lower(env, aux, _fa=fa):
+            a = _fa(env, aux)
+            return Column(a.validity, jnp.ones_like(a.validity))
+
+        return lower, out_t
+    if op is Op.COALESCE:
+        def lower(env, aux, _fns=tuple(fns)):
+            cols = [f(env, aux) for f in _fns]
+            data = cols[-1].data
+            valid = cols[-1].validity
+            for c in reversed(cols[:-1]):
+                data = jnp.where(c.validity, c.data, data)
+                valid = c.validity | valid
+            return Column(data, valid)
+
+        return lower, out_t
+    if op is Op.IF:
+        fc, fa, fb = fns
+
+        def lower(env, aux, _fc=fc, _fa=fa, _fb=fb):
+            c, a, b = _fc(env, aux), _fa(env, aux), _fb(env, aux)
+            take_a = kernels.pred_mask(c)
+            return Column(
+                jnp.where(take_a, a.data, b.data),
+                c.validity & jnp.where(take_a, a.validity, b.validity),
+            )
+
+        return lower, out_t
+    if op in (Op.CAST_INT32, Op.CAST_INT64, Op.CAST_FLOAT, Op.CAST_DOUBLE):
+        fa = fns[0]
+        ta = ts[0]
+        scale = 10.0 ** ta.scale if ta.is_decimal else None
+        target = out_t.physical
+
+        def lower(env, aux, _fa=fa, _sc=scale, _tp=target):
+            a = _fa(env, aux)
+            d = a.data
+            if _sc is not None:
+                if np.issubdtype(_tp, np.floating):
+                    d = d.astype(jnp.float64) / _sc
+                else:
+                    d = d // int(_sc)
+            return Column(d.astype(_tp), a.validity)
+
+        return lower, out_t
+    if op in (Op.YEAR, Op.MONTH):
+        fa = fns[0]
+        ta = ts[0]
+        is_ts = ta.kind == dtypes.Kind.TIMESTAMP
+        part = 0 if op is Op.YEAR else 1
+
+        def lower(env, aux, _fa=fa, _ts=is_ts, _p=part):
+            a = _fa(env, aux)
+            days = a.data // 86_400_000_000 if _ts else a.data
+            parts = kernels.civil_from_days(days)
+            return Column(parts[_p], a.validity)
+
+        return lower, out_t
+    if op is Op.IN_SET:
+        # IN over numeric literals: OR of equalities
+        fa = fns[0]
+        consts = call.args[1:]
+
+        def lower(env, aux, _fa=fa, _cs=tuple(c.value for c in consts)):
+            a = _fa(env, aux)
+            hit = jnp.zeros_like(a.validity)
+            for v in _cs:
+                hit = hit | (a.data == v)
+            return Column(hit, a.validity)
+
+        return lower, out_t
+    raise NotImplementedError(f"lowering for op {op}")
+
+
+def _align_decimals(op, call, fns, ts):
+    """Rescale decimal operands to a common scale (exact, compile-time)."""
+    if len(ts) != 2:
+        return fns, ts
+    a, b = ts
+    if not (a.is_decimal or b.is_decimal):
+        return fns, ts
+    sa = a.scale if a.is_decimal else 0
+    sb = b.scale if b.is_decimal else 0
+    if sa == sb:
+        return fns, ts
+    target = max(sa, sb)
+
+    def rescaled(fn, frm, to):
+        mult = 10 ** (to - frm)
+
+        def lower(env, aux, _fn=fn, _m=mult):
+            c = _fn(env, aux)
+            if jnp.issubdtype(c.data.dtype, jnp.floating):
+                # float operand meeting a decimal: scale FIRST, then round
+                # to the integer grid (casting first would truncate to 0)
+                d = jnp.round(c.data * _m).astype(jnp.int64)
+            else:
+                d = c.data.astype(jnp.int64) * _m
+            return Column(d, c.validity)
+
+        return lower
+
+    out = list(fns)
+    t_out = [dtypes.decimal(target), dtypes.decimal(target)]
+    if sa < target:
+        out[0] = rescaled(fns[0], sa, target)
+    if sb < target:
+        out[1] = rescaled(fns[1], sb, target)
+    return out, t_out
+
+
+# ---------------- group-by lowering ----------------
+
+
+@dataclasses.dataclass
+class _GroupByLowered:
+    lower: Callable  # (env, aux, live_mask) -> (env, length)
+    out_names: tuple[str, ...]
+    out_types: dict[str, dtypes.LogicalType]
+
+
+#: Dense group-id path cap: above this many key combinations the sorted
+#: path wins (scatter target arrays stay small).
+_DENSE_GROUP_LIMIT = 65536
+
+
+def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
+    keys = step.keys
+    bounds = []
+    dense = len(keys) > 0
+    for k in keys:
+        if k not in cur_types:
+            raise KeyError(f"group-by key {k} not in scope")
+        b = ctx.key_bound(k, cur_types[k])
+        if b is None:
+            dense = False
+            break
+        bounds.append(b)
+    num_groups = 0
+    if dense:
+        num_groups = 1
+        for b in bounds:
+            num_groups *= b + 1
+        if num_groups > _DENSE_GROUP_LIMIT:
+            dense = False
+
+    out_types: dict[str, dtypes.LogicalType] = {}
+    for k in keys:
+        out_types[k] = cur_types[k]
+    specs: list[tuple[AggSpec, dtypes.LogicalType]] = []
+    # MIN/MAX over a string column must order by dictionary *rank*; ship
+    # the rank table and reduce over (rank << 32 | id) packed keys.
+    str_rank_aux: dict[str, str] = {}
+    for spec in step.aggs:
+        t = agg_result_type(spec, ctx.schema, cur_types)
+        out_types[spec.out_name] = t
+        specs.append((spec, t))
+        if (
+            spec.func in (Agg.MIN, Agg.MAX)
+            and cur_types[spec.column].is_string
+        ):
+            if ctx.dicts is None or spec.column not in ctx.dicts:
+                raise ValueError(
+                    f"MIN/MAX over string column {spec.column} needs its"
+                    " dictionary"
+                )
+            if spec.column not in str_rank_aux:
+                str_rank_aux[spec.column] = ctx.add_aux(
+                    f"rank.{spec.column}", ctx.dicts[spec.column].sort_rank()
+                )
+    out_names = tuple(keys) + tuple(s.out_name for s, _ in specs)
+
+    key_names = tuple(keys)
+    use_dense = dense
+    b_tuple = tuple(bounds) if dense else ()
+    explicit_cap = step.max_groups
+
+    def lower(env, aux, live):
+        kcols = [env[k] for k in key_names]
+        capacity = next(iter(env.values())).data.shape[0]
+        if key_names:
+            if use_dense:
+                gid, ng = kernels.group_ids_dense(kcols, list(b_tuple), live)
+            else:
+                # a block of N rows has at most N groups: default the group
+                # capacity to the block capacity so nothing is ever
+                # silently dropped; an explicit max_groups caps it.
+                ng = (
+                    min(explicit_cap, capacity)
+                    if explicit_cap is not None
+                    else capacity
+                )
+                gid, ng_scalar = kernels.group_ids_sorted(kcols, live, ng)
+                ng_scalar = jnp.minimum(ng_scalar, jnp.int32(ng))
+        else:
+            # global aggregate: one group
+            gid = jnp.where(live, 0, 1).astype(jnp.int32)
+            ng = 1
+
+        live_count = kernels.scatter_sum(
+            jnp.ones_like(gid, dtype=jnp.int64), live, gid, ng
+        )
+        group_live = live_count > 0
+
+        new_env: dict[str, Column] = {}
+        for k, c in zip(key_names, kcols):
+            kd = kernels.scatter_first(c.data, live, gid, ng)
+            kv = kernels.scatter_first(c.validity, live, gid, ng)
+            new_env[k] = Column(kd, kv & group_live)
+
+        for spec, t in specs:
+            if spec.func is Agg.COUNT_ALL:
+                data = live_count
+                # keyless COUNT over zero rows is 0, not NULL
+                valid = (
+                    jnp.ones_like(group_live) if not key_names else group_live
+                )
+            else:
+                c = env[spec.column]
+                vrow = live & c.validity
+                nn = kernels.scatter_sum(
+                    jnp.ones_like(gid, dtype=jnp.int64), vrow, gid, ng
+                )
+                if spec.func is Agg.COUNT:
+                    data = nn
+                    valid = (
+                        jnp.ones_like(group_live)
+                        if not key_names
+                        else group_live
+                    )
+                elif spec.func is Agg.SUM:
+                    data = kernels.scatter_sum(
+                        c.data, vrow, gid, ng, dtype=t.physical
+                    )
+                    valid = nn > 0
+                elif spec.func in (Agg.MIN, Agg.MAX):
+                    vals = c.data
+                    packed = spec.column in str_rank_aux
+                    if packed:
+                        rank = kernels.dict_gather(
+                            aux[str_rank_aux[spec.column]], c
+                        ).data
+                        vals = (
+                            rank.astype(jnp.int64) << 32
+                        ) | c.data.astype(jnp.int64)
+                    if spec.func is Agg.MIN:
+                        data = kernels.scatter_min(vals, vrow, gid, ng)
+                    else:
+                        data = kernels.scatter_max(vals, vrow, gid, ng)
+                    if packed:
+                        data = (data & 0xFFFFFFFF).astype(jnp.int32)
+                    valid = nn > 0
+                elif spec.func is Agg.AVG:
+                    src_t = cur_types[spec.column]
+                    s = kernels.scatter_sum(
+                        c.data, vrow, gid, ng, dtype=jnp.float64
+                    )
+                    if src_t.is_decimal:
+                        s = s / (10.0 ** src_t.scale)
+                    data = s / jnp.maximum(nn, 1)
+                    valid = nn > 0
+                elif spec.func is Agg.SOME:
+                    data = kernels.scatter_first(c.data, vrow, gid, ng)
+                    valid = nn > 0
+                else:
+                    raise NotImplementedError(spec.func)
+            new_env[spec.out_name] = Column(data, valid)
+
+        if key_names and not use_dense:
+            # sorted path: groups already dense [0, n); length = ng_scalar
+            length = ng_scalar
+        elif not key_names:
+            # keyless aggregate always yields exactly one row (SQL:
+            # SELECT COUNT(*) ... WHERE false => one row with 0)
+            length = jnp.int32(1)
+        else:
+            length = jnp.sum(group_live).astype(jnp.int32)
+            if key_names:
+                # dense path: compact scattered group slots to the front
+                blk = TableBlock(
+                    new_env, jnp.int32(ng),
+                    dtypes.Schema(tuple(
+                        dtypes.Field(n, out_types[n]) for n in out_names)),
+                )
+                blk = kernels.compact(blk, group_live)
+                new_env = dict(blk.columns)
+                length = blk.length
+        return new_env, length
+
+    return _GroupByLowered(lower=lower, out_names=out_names,
+                           out_types=out_types)

@@ -1,0 +1,272 @@
+"""Device kernel primitives for SSA programs (pure jnp — XLA fuses these).
+
+TPU analog of the reference's block operators:
+  * masked elementwise ops with Arrow null semantics
+    (arrow compute + ydb/library/arrow_kernels/operations.h)
+  * ``compact`` — BlockCompress (mkql_block_compress.h): row compaction by
+    stable-partition permutation, applied only at block boundaries
+  * ``grouped_aggregate`` — BlockCombineHashed / ch.group_by
+    (mkql_block_agg.cpp:1637, arrow_clickhouse/Aggregator.h:568): dense or
+    sort-derived group ids + scatter-reduce with a *static* group capacity;
+    invalid rows scatter to an out-of-bounds index in 'drop' mode instead
+    of branching
+  * ``sort_block`` / top-k — WideTopSort / BlockTop (mkql_block_top.cpp)
+
+All primitives keep static shapes; "how many" results there are is always a
+traced int32 scalar, never a shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ydb_tpu.blocks.block import Column, TableBlock
+
+# ---------------- null-propagating elementwise ----------------
+
+
+def binop(fn, a: Column, b: Column) -> Column:
+    return Column(fn(a.data, b.data), a.validity & b.validity)
+
+
+def unop(fn, a: Column) -> Column:
+    return Column(fn(a.data), a.validity)
+
+
+def kleene_and(a: Column, b: Column) -> Column:
+    data = a.data & b.data
+    # false AND anything = false (valid); else valid iff both valid
+    valid = (
+        (~a.data & a.validity) | (~b.data & b.validity) | (a.validity & b.validity)
+    )
+    return Column(data, valid)
+
+
+def kleene_or(a: Column, b: Column) -> Column:
+    data = a.data | b.data
+    valid = (
+        (a.data & a.validity) | (b.data & b.validity) | (a.validity & b.validity)
+    )
+    return Column(data, valid)
+
+
+def safe_div(a: Column, b: Column, float_result: bool) -> Column:
+    zero = b.data == 0
+    denom = jnp.where(zero, jnp.ones_like(b.data), b.data)
+    if float_result:
+        data = a.data / denom
+    else:
+        data = a.data // denom
+    return Column(data, a.validity & b.validity & ~zero)
+
+
+def pred_mask(col: Column) -> jax.Array:
+    """Boolean predicate -> selection mask (NULL counts as False)."""
+    return col.data & col.validity
+
+
+def dict_gather(table: jax.Array, ids: Column) -> Column:
+    """Lookup a plan-time table (dictionary mask/rank) by string ids."""
+    safe = jnp.clip(ids.data, 0, table.shape[0] - 1)
+    return Column(table[safe], ids.validity)
+
+
+# ---------------- calendar (branchless civil-from-days) ----------------
+
+
+def civil_from_days(days):
+    """days since 1970-01-01 -> (year, month, day), vectorized int32 math."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+# ---------------- filter / compact ----------------
+
+
+def apply_filter(block: TableBlock, mask: jax.Array) -> TableBlock:
+    """Late-materialization filter: fold mask into live length accounting by
+    compacting. Cheap alternative when no compaction is needed: callers keep
+    the mask and pass it to aggregation/sort directly."""
+    return compact(block, mask)
+
+
+def compact(block: TableBlock, selected: jax.Array) -> TableBlock:
+    """Move selected live rows to the front (stable), update length.
+
+    selected: bool[capacity]; rows outside the live range must be False
+    (callers AND with block.row_mask()).
+    """
+    keep = selected & block.row_mask()
+    # stable partition: sort by (not kept); ties keep original order
+    perm = jnp.argsort(~keep, stable=True)
+    cols = {
+        n: Column(c.data[perm], c.validity[perm] & keep[perm])
+        for n, c in block.columns.items()
+    }
+    n = jnp.sum(keep).astype(jnp.int32)
+    return TableBlock(cols, n, block.schema)
+
+
+# ---------------- grouped aggregation ----------------
+
+
+def group_ids_dense(
+    keys: list[Column],
+    bounds: list[int],
+    live: jax.Array,
+) -> tuple[jax.Array, int]:
+    """Dense group ids from small-cardinality keys (dict ids / bounded ints).
+
+    NULL key values get their own slot per key (SQL GROUP BY semantics), so
+    each key contributes (bound + 1) values; id 0 means NULL.
+    Rows not live get id = num_groups (scatter-drop sentinel).
+    """
+    num_groups = 1
+    gid = jnp.zeros(keys[0].data.shape, dtype=jnp.int32)
+    for k, b in zip(keys, bounds):
+        enc = jnp.where(k.validity, k.data.astype(jnp.int32) + 1, 0)
+        gid = gid * (b + 1) + enc
+        num_groups *= b + 1
+    gid = jnp.where(live, gid, num_groups)
+    return gid, num_groups
+
+
+def group_ids_sorted(
+    keys: list[Column], live: jax.Array, max_groups: int
+) -> tuple[jax.Array, jax.Array]:
+    """Generic exact group ids via lexicographic sort (no device hash table).
+
+    Returns (gid[capacity] int32 with dead rows = max_groups, n_groups
+    scalar). Group ids are assigned in sorted key order, so downstream
+    per-group outputs come out key-ordered.
+    """
+    # sort dead rows last; NULLs first within a key (stable choice)
+    sort_keys = []
+    for k in reversed(keys):
+        sort_keys.append(k.data)
+        sort_keys.append(~k.validity)
+    sort_keys.append(~live)
+    perm = jnp.lexsort(tuple(sort_keys))  # last key is primary
+    inv = jnp.argsort(perm)  # original row -> sorted pos
+
+    live_s = live[perm]
+
+    def sorted_col(k: Column):
+        return k.data[perm], k.validity[perm]
+
+    changed = jnp.zeros(live.shape, dtype=bool)
+    for k in keys:
+        d, v = sorted_col(k)
+        # normalize garbage under NULL slots so all NULLs form one group
+        d = jnp.where(v, d, jnp.zeros_like(d))
+        prev_d = jnp.roll(d, 1)
+        prev_v = jnp.roll(v, 1)
+        diff = (d != prev_d) | (v != prev_v)
+        changed = changed | diff
+    changed = changed.at[0].set(True)
+    # boundaries only count within the live prefix
+    boundary = changed & live_s
+    seg_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    n_groups = jnp.maximum(jnp.max(jnp.where(live_s, seg_sorted, -1)) + 1, 0)
+    seg_sorted = jnp.where(live_s, seg_sorted, max_groups)
+    gid = seg_sorted[inv]
+    return gid, n_groups.astype(jnp.int32)
+
+
+def scatter_first(values: jax.Array, valid_row, gid, num_groups: int):
+    """Per-group 'some' value: any valid row's value wins (scatter, drop OOB)."""
+    idx = jnp.where(valid_row, gid, num_groups)
+    out = jnp.zeros((num_groups,) + values.shape[1:], dtype=values.dtype)
+    return out.at[idx].set(values, mode="drop")
+
+
+def scatter_sum(values, valid_row, gid, num_groups: int, dtype=None):
+    dtype = dtype or values.dtype
+    idx = jnp.where(valid_row, gid, num_groups)
+    out = jnp.zeros((num_groups,), dtype=dtype)
+    return out.at[idx].add(values.astype(dtype), mode="drop")
+
+
+def scatter_min(values, valid_row, gid, num_groups: int):
+    idx = jnp.where(valid_row, gid, num_groups)
+    init = _extreme(values.dtype, maximum=True)
+    out = jnp.full((num_groups,), init, dtype=values.dtype)
+    return out.at[idx].min(values, mode="drop")
+
+
+def scatter_max(values, valid_row, gid, num_groups: int):
+    idx = jnp.where(valid_row, gid, num_groups)
+    init = _extreme(values.dtype, maximum=False)
+    out = jnp.full((num_groups,), init, dtype=values.dtype)
+    return out.at[idx].max(values, mode="drop")
+
+
+def _extreme(dtype, maximum: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if maximum else -jnp.inf
+    if dtype == jnp.bool_:
+        return True if maximum else False
+    info = jnp.iinfo(dtype)
+    return info.max if maximum else info.min
+
+
+# ---------------- sort / top-k ----------------
+
+
+def sort_perm(
+    keys: list[Column],
+    descending: list[bool],
+    live: jax.Array,
+) -> jax.Array:
+    """Stable multi-key sort permutation; dead rows sink to the end.
+
+    Descending numeric keys negate via bitwise complement on ints (exact,
+    overflow-free) and negation on floats; NULLS LAST within each key.
+    """
+    sort_keys = []
+    for k, desc in zip(reversed(keys), reversed(descending)):
+        d = k.data
+        if desc:
+            if d.dtype == jnp.bool_:
+                d = ~d
+            elif jnp.issubdtype(d.dtype, jnp.integer):
+                d = ~d  # exact order reversal, overflow-free
+            else:
+                d = -d
+        # NULLs last regardless of direction; the null flag is appended
+        # after the data key so it is more significant in the lexsort
+        sort_keys.append(d)
+        sort_keys.append(~k.validity)
+    sort_keys.append(~live)
+    return jnp.lexsort(tuple(sort_keys))
+
+
+def sort_block(
+    block: TableBlock,
+    keys: list[str],
+    descending: list[bool],
+    limit: int | None = None,
+) -> TableBlock:
+    live = block.row_mask()
+    perm = sort_perm([block.columns[k] for k in keys], descending, live)
+    cols = {
+        n: Column(c.data[perm], c.validity[perm] & live[perm])
+        for n, c in block.columns.items()
+    }
+    length = block.length
+    if limit is not None:
+        length = jnp.minimum(length, jnp.int32(limit))
+        # zero validity past the limit so padding never leaks
+        cut = jnp.arange(block.capacity, dtype=jnp.int32) < length
+        cols = {n: Column(c.data, c.validity & cut) for n, c in cols.items()}
+    return TableBlock(cols, length, block.schema)

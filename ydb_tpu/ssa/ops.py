@@ -1,0 +1,87 @@
+"""Scalar and aggregate operation vocabulary for SSA programs.
+
+The TPU analog of the reference's kernel-op enums — simple scalar ops
+(ydb/library/arrow_kernels/operations.h: casts, comparison, logic,
+arithmetic, string match, math) and aggregate functions
+(ydb/core/formats/arrow/program.h `EAggregate`). Each op lowers to a jnp
+expression over column arrays in ydb_tpu.ssa.kernels; XLA fuses chains of
+them into single HBM passes.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Op(enum.Enum):
+    # comparison (null-propagating)
+    EQ = "eq"
+    NE = "ne"
+    LT = "lt"
+    LE = "le"
+    GT = "gt"
+    GE = "ge"
+    # logic (Kleene where nullable)
+    AND = "and"
+    OR = "or"
+    NOT = "not"
+    XOR = "xor"
+    # arithmetic
+    ADD = "add"
+    SUB = "sub"
+    MUL = "mul"
+    DIV = "div"
+    MOD = "mod"
+    NEG = "neg"
+    ABS = "abs"
+    # math
+    SQRT = "sqrt"
+    EXP = "exp"
+    LN = "ln"
+    FLOOR = "floor"
+    CEIL = "ceil"
+    ROUND = "round"
+    POW = "pow"
+    # null handling
+    IS_NULL = "is_null"
+    IS_NOT_NULL = "is_not_null"
+    COALESCE = "coalesce"
+    IF = "if"
+    # casts
+    CAST_INT32 = "cast_int32"
+    CAST_INT64 = "cast_int64"
+    CAST_FLOAT = "cast_float"
+    CAST_DOUBLE = "cast_double"
+    # date parts (DATE=int32 days / TIMESTAMP=int64 us)
+    YEAR = "year"
+    MONTH = "month"
+    # string ops on dictionary ids (plan-time resolved masks)
+    DICT_GATHER = "dict_gather"   # aux table lookup by id (masks, ranks)
+    IN_SET = "in_set"
+
+
+class Agg(enum.Enum):
+    """Aggregate functions (reference: program.h EAggregate — some/count/
+    min/max/sum + numrows; avg decomposes into sum+count)."""
+
+    COUNT = "count"          # non-null count
+    COUNT_ALL = "count_all"  # row count (NumRows)
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    AVG = "avg"
+    SOME = "some"            # any value (first non-null)
+
+
+#: Merge rule applied when combining partial aggregate states between
+#: shards (reference two-phase agg: BlockCombineHashed partial states merged
+#: by BlockMergeFinalizeHashed, mkql_block_agg.cpp). SUM-like states psum
+#: over the mesh; MIN/MAX take elementwise extremes.
+PARTIAL_MERGE = {
+    Agg.COUNT: Agg.SUM,
+    Agg.COUNT_ALL: Agg.SUM,
+    Agg.SUM: Agg.SUM,
+    Agg.MIN: Agg.MIN,
+    Agg.MAX: Agg.MAX,
+    Agg.SOME: Agg.SOME,
+}
