@@ -1,0 +1,92 @@
+"""Benchmark: TPC-H Q1 scan+filter+group-by throughput on the device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config (BASELINE.md config 1/2): TPC-H Q1 at SF (default 1.0 — ~6M
+lineitem rows), executed by the block-streamed columnar engine on the
+default JAX device (the real TPU chip under the driver). The baseline is
+the single-threaded CPU reference engine (ydb_tpu.engine.oracle) on the
+identical data — the stand-in for the reference's single-node CPU KQP
+baseline, which BASELINE.md notes must be measured, not copied (the
+reference publishes no numbers and its 2M-LoC C++ server cannot be built
+in this image).
+
+Env knobs: YDB_TPU_BENCH_SF (default 1.0), YDB_TPU_BENCH_ITERS (default 5),
+YDB_TPU_BENCH_BLOCK_ROWS (default 2^21).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    sf = float(os.environ.get("YDB_TPU_BENCH_SF", "1.0"))
+    iters = int(os.environ.get("YDB_TPU_BENCH_ITERS", "5"))
+    block_rows = int(os.environ.get("YDB_TPU_BENCH_BLOCK_ROWS", str(1 << 21)))
+
+    import jax
+
+    from ydb_tpu.engine.oracle import OracleTable, run_oracle
+    from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
+    from ydb_tpu.workload import tpch
+
+    data = tpch.TpchData(sf=sf, seed=42)
+    li = data.tables["lineitem"]
+    n_rows = len(li["l_orderkey"])
+    src = ColumnSource(
+        columns=li, schema=tpch.LINEITEM_SCHEMA, dicts=data.dicts
+    )
+    prog = tpch.q1_program()
+
+    ex = ScanExecutor(prog, src, block_rows=block_rows)
+    # preload device-resident blocks (the engine's steady state: data lives
+    # in HBM portions; host->HBM transfer is the ingest path, not the scan)
+    blocks = [
+        jax.device_put(b) for b in src.blocks(block_rows, ex.read_cols)
+    ]
+    jax.block_until_ready(blocks)
+
+    def run_once():
+        partials = [ex.run_block(b) for b in blocks]
+        out = ex.finalize(partials)
+        jax.block_until_ready(out.length)
+        return out
+
+    run_once()  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_once()
+    dt = (time.perf_counter() - t0) / iters
+    device_rps = n_rows / dt
+
+    # CPU baseline (single-thread numpy reference engine, same data)
+    oracle_tbl = OracleTable(
+        {n: (v, np.ones(len(v), dtype=bool)) for n, v in li.items()},
+        tpch.LINEITEM_SCHEMA,
+    )
+    t0 = time.perf_counter()
+    ora = run_oracle(prog, oracle_tbl, data.dicts)
+    cpu_dt = time.perf_counter() - t0
+    cpu_rps = n_rows / cpu_dt
+
+    # sanity: engine result matches oracle
+    res = ex.finalize([ex.run_block(b) for b in blocks])
+    res_host = np.asarray(res.columns["count_order"].data)[: int(res.length)]
+    ora_host = ora.cols["count_order"][0]
+    assert sorted(res_host.tolist()) == sorted(ora_host.tolist()), (
+        "engine/oracle mismatch"
+    )
+
+    print(json.dumps({
+        "metric": f"tpch_q1_sf{sf:g}_scan_rows_per_sec",
+        "value": round(device_rps),
+        "unit": "rows/s",
+        "vs_baseline": round(device_rps / cpu_rps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Mesh-parallel execution tests on the virtual 8-device CPU mesh
+(SURVEY.md §4 tier-2: deterministic multi-node behavior in one process)."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu.engine.oracle import OracleTable, run_oracle
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.parallel import MeshScan, make_mesh
+from ydb_tpu.workload import tpch
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch.TpchData(sf=0.002, seed=11)
+
+
+def _source(data, table):
+    return ColumnSource(
+        columns=data.tables[table],
+        schema=data.schema(table),
+        dicts=data.dicts,
+    )
+
+
+def _oracle(data, table):
+    cols = {
+        n: (v, np.ones(len(v), dtype=bool))
+        for n, v in data.tables[table].items()
+    }
+    return OracleTable(cols, data.schema(table))
+
+
+def _match(engine: OracleTable, oracle: OracleTable):
+    assert engine.num_rows == oracle.num_rows
+    for name in oracle.cols:
+        ev, eo = engine.cols[name]
+        ov, oo = oracle.cols[name]
+        np.testing.assert_array_equal(eo, oo, err_msg=f"validity {name}")
+        if np.issubdtype(ev.dtype, np.floating):
+            np.testing.assert_allclose(ev[eo], ov[oo], rtol=1e-9,
+                                       err_msg=name)
+        else:
+            np.testing.assert_array_equal(ev[eo], ov[oo], err_msg=name)
+
+
+def test_q1_mesh_psum_path(data):
+    """Q1: dense slot states merged with psum/pmax over 8 shards."""
+    mesh = make_mesh(8)
+    prog = tpch.q1_program()
+    scan = MeshScan(prog, tpch.LINEITEM_SCHEMA, data.dicts, mesh=mesh)
+    assert scan.partial.group_layout[0] == "dense_slots"
+    res = scan.execute(_source(data, "lineitem"))
+    ora = run_oracle(prog, _oracle(data, "lineitem"), data.dicts)
+    _match(res, ora)
+
+
+def test_q6_mesh_keyless_psum(data):
+    mesh = make_mesh(8)
+    prog = tpch.q6_program()
+    scan = MeshScan(prog, tpch.LINEITEM_SCHEMA, data.dicts, mesh=mesh)
+    assert scan.partial.group_layout[0] == "keyless"
+    res = scan.execute(_source(data, "lineitem"))
+    ora = run_oracle(prog, _oracle(data, "lineitem"), data.dicts)
+    _match(res, ora)
+
+
+def test_generic_groupby_gather_path(data):
+    """High-cardinality keys: compacted partials merged via all_gather."""
+    from ydb_tpu.ssa import Agg, AggSpec, GroupByStep, Program, SortStep
+
+    mesh = make_mesh(4)
+    prog = Program((
+        GroupByStep(
+            keys=("l_orderkey",),
+            aggs=(
+                AggSpec(Agg.SUM, "l_extendedprice", "total"),
+                AggSpec(Agg.COUNT_ALL, None, "n"),
+            ),
+        ),
+        SortStep(keys=("l_orderkey",)),
+    ))
+    scan = MeshScan(prog, tpch.LINEITEM_SCHEMA, data.dicts, mesh=mesh)
+    assert scan.partial.group_layout[0] == "compact"
+    res = scan.execute(_source(data, "lineitem"))
+    ora = run_oracle(prog, _oracle(data, "lineitem"), data.dicts)
+    _match(res, ora)
+
+
+def test_no_groupby_gather_concat(data):
+    from ydb_tpu.ssa import Call, Col, FilterStep, Op, Program, ProjectStep
+    from ydb_tpu.ssa.program import decimal_lit
+
+    mesh = make_mesh(8)
+    prog = Program((
+        FilterStep(Call(Op.GT, Col("l_quantity"), decimal_lit("49", 2))),
+        ProjectStep(("l_orderkey",)),
+    ))
+    scan = MeshScan(prog, tpch.LINEITEM_SCHEMA, data.dicts, mesh=mesh)
+    res = scan.execute(_source(data, "lineitem"))
+    ora = run_oracle(prog, _oracle(data, "lineitem"), data.dicts)
+    assert res.num_rows == ora.num_rows
+    np.testing.assert_array_equal(
+        np.sort(res.cols["l_orderkey"][0]),
+        np.sort(ora.cols["l_orderkey"][0]),
+    )
+
+
+def test_uneven_shard_sizes(data):
+    """Row count not divisible by mesh size: padding must not leak."""
+    mesh = make_mesh(8)
+    prog = tpch.q6_program()
+    src = _source(data, "lineitem")
+    # trim to a prime-ish row count
+    n = src.num_rows - 13
+    src = ColumnSource(
+        {k: v[:n] for k, v in src.columns.items()}, src.schema, src.dicts
+    )
+    scan = MeshScan(prog, tpch.LINEITEM_SCHEMA, data.dicts, mesh=mesh)
+    res = scan.execute(src)
+    ora_cols = {
+        k: (v[:n], np.ones(n, dtype=bool))
+        for k, v in data.tables["lineitem"].items()
+    }
+    ora = run_oracle(prog, OracleTable(ora_cols, tpch.LINEITEM_SCHEMA),
+                     data.dicts)
+    _match(res, ora)
+
+
+def test_string_min_max_across_mesh():
+    """Dictionary insertion order != lexicographic order: the cross-device
+    MIN/MAX merge must re-pack ids by rank (review regression)."""
+    from ydb_tpu import dtypes
+    from ydb_tpu.blocks import DictionarySet
+    from ydb_tpu.ssa import Agg, AggSpec, GroupByStep, Program
+
+    dicts = DictionarySet()
+    d = dicts.for_column("s")
+    # zebra gets id 0, apple id 1: id order is the reverse of lexicographic
+    ids = d.encode([b"zebra", b"apple", b"middle", b"banana"])
+    sch = dtypes.schema(("s", dtypes.STRING), ("g", dtypes.INT64))
+    cols = {"s": ids, "g": np.zeros(4, dtype=np.int64)}
+    src = ColumnSource(cols, sch, dicts)
+    prog = Program((
+        GroupByStep(keys=("g",), aggs=(
+            AggSpec(Agg.MIN, "s", "lo"),
+            AggSpec(Agg.MAX, "s", "hi"),
+        )),
+    ))
+    mesh = make_mesh(4)  # one row per device: every device has a different local min
+    scan = MeshScan(prog, sch, dicts, key_spaces={"g": 1}, mesh=mesh)
+    assert scan.partial.group_layout[0] == "dense_slots"
+    res = scan.execute(src)
+    assert d.values[int(res.cols["lo"][0][0])] == b"apple"
+    assert d.values[int(res.cols["hi"][0][0])] == b"zebra"

@@ -128,3 +128,24 @@ def test_keyless_aggregate_on_empty_selection():
     assert res["n"][0] == 0 and valid["n"][0]
     assert res["c"][0] == 0 and valid["c"][0]
     assert not valid["s"][0]  # SUM over empty => NULL
+
+
+def test_integer_div_mod_truncate_toward_zero():
+    blk = _block(
+        a=([-7, 7, -7, 7], dtypes.INT64),
+        b=([2, -2, -2, 2], dtypes.INT64),
+    )
+    prog = Program((
+        AssignStep("q", Call(Op.DIV, Col("a"), Col("b"))),
+        AssignStep("r", Call(Op.MOD, Col("a"), Col("b"))),
+    ))
+    out = compile_program(prog, blk.schema)(blk)
+    res = out.to_numpy()
+    np.testing.assert_array_equal(res["q"], [-3, -3, 3, 3])
+    np.testing.assert_array_equal(res["r"], [-1, 1, -1, 1])
+
+    from ydb_tpu.engine.oracle import OracleTable, run_oracle
+
+    ora = run_oracle(prog, OracleTable.from_block(blk))
+    np.testing.assert_array_equal(ora.cols["q"][0], [-3, -3, 3, 3])
+    np.testing.assert_array_equal(ora.cols["r"][0], [-1, 1, -1, 1])

@@ -1,0 +1,100 @@
+"""End-to-end TPC-H Q1/Q6 through the block-streamed scan executor,
+cross-checked against the independent numpy oracle engine (the
+default-CPU-engine-as-correctness-oracle pattern, SURVEY.md §7.1.4)."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu.engine.oracle import OracleTable, run_oracle
+from ydb_tpu.engine.scan import ColumnSource, execute_scan, required_columns
+from ydb_tpu.workload import tpch
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch.TpchData(sf=0.002, seed=7)
+
+
+def _source(data, table):
+    return ColumnSource(
+        columns=data.tables[table],
+        schema=data.schema(table),
+        dicts=data.dicts,
+    )
+
+
+def _oracle(data, table):
+    cols = {
+        n: (v, np.ones(len(v), dtype=bool))
+        for n, v in data.tables[table].items()
+    }
+    return OracleTable(cols, data.schema(table))
+
+
+def assert_tables_match(engine: OracleTable, oracle: OracleTable, sort_by=None):
+    assert set(engine.cols) == set(oracle.cols)
+    assert engine.num_rows == oracle.num_rows
+    for name in engine.cols:
+        ev, eo = engine.cols[name]
+        ov, oo = oracle.cols[name]
+        np.testing.assert_array_equal(eo, oo, err_msg=f"validity {name}")
+        if np.issubdtype(ev.dtype, np.floating):
+            np.testing.assert_allclose(
+                ev[eo], ov[oo], rtol=1e-9, err_msg=name
+            )
+        else:
+            np.testing.assert_array_equal(ev[eo], ov[oo], err_msg=name)
+
+
+def test_q1_engine_matches_oracle(data):
+    prog = tpch.q1_program()
+    res = execute_scan(prog, _source(data, "lineitem"), block_rows=4096)
+    ora = run_oracle(prog, _oracle(data, "lineitem"), data.dicts)
+    assert res.num_rows == 4  # R/A/N x O/F with date cutoff -> 4 combos
+    assert_tables_match(res, ora)
+
+
+def test_q1_block_size_invariance(data):
+    prog = tpch.q1_program()
+    r1 = execute_scan(prog, _source(data, "lineitem"), block_rows=1024)
+    r2 = execute_scan(prog, _source(data, "lineitem"), block_rows=1 << 16)
+    for name in r1.cols:
+        np.testing.assert_allclose(
+            r1.cols[name][0], r2.cols[name][0], rtol=1e-12, err_msg=name
+        )
+
+
+def test_q6_engine_matches_oracle(data):
+    prog = tpch.q6_program()
+    res = execute_scan(prog, _source(data, "lineitem"), block_rows=4096)
+    ora = run_oracle(prog, _oracle(data, "lineitem"), data.dicts)
+    assert res.num_rows == 1
+    assert_tables_match(res, ora)
+    # and the revenue is a plausible positive decimal(4)
+    assert res.schema.field("revenue").type.scale == 4
+    assert res.cols["revenue"][0][0] > 0
+
+
+def test_projection_pushdown(data):
+    prog = tpch.q6_program()
+    cols = required_columns(prog, tpch.LINEITEM_SCHEMA)
+    assert set(cols) == {
+        "l_shipdate", "l_discount", "l_quantity", "l_extendedprice"
+    }
+
+
+def test_filter_only_program_concatenates(data):
+    from ydb_tpu import dtypes
+    from ydb_tpu.ssa import Call, Col, FilterStep, Op, Program, ProjectStep
+    from ydb_tpu.ssa.program import decimal_lit
+
+    prog = Program((
+        FilterStep(Call(Op.GT, Col("l_quantity"), decimal_lit("49", 2))),
+        ProjectStep(("l_orderkey", "l_quantity")),
+    ))
+    res = execute_scan(prog, _source(data, "lineitem"), block_rows=2048)
+    ora = run_oracle(prog, _oracle(data, "lineitem"), data.dicts)
+    assert res.num_rows == ora.num_rows > 0
+    np.testing.assert_array_equal(
+        np.sort(res.cols["l_orderkey"][0]), np.sort(ora.cols["l_orderkey"][0])
+    )

@@ -1,0 +1,390 @@
+"""CPU reference engine (the correctness oracle and default engine).
+
+The reference keeps a CPU MiniKQL engine as the default with the
+accelerator runner plugged in behind a factory seam (SURVEY.md §2.9,
+TComputationNodeFactory mkql_factory.cpp:360). This module is that default
+engine for SSA programs: a straightforward numpy evaluator with identical
+semantics to the JAX lowering (nulls, Kleene logic, decimal scaling,
+group-by, sort). Deliberately implemented independently of
+ydb_tpu.ssa.kernels so tests can cross-check the two.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.ssa.ops import Agg, Op
+from ydb_tpu.ssa.program import (
+    AssignStep,
+    Call,
+    Col,
+    Const,
+    DictPredicate,
+    FilterStep,
+    GroupByStep,
+    ProjectStep,
+    Program,
+    SortStep,
+    agg_result_type,
+    infer_type,
+)
+
+Array = np.ndarray
+ColT = tuple[Array, Array]  # (values, validity)
+
+
+class OracleTable:
+    """Host columnar table: name -> (values, validity)."""
+
+    def __init__(self, cols: dict[str, ColT], schema: dtypes.Schema):
+        self.cols = cols
+        self.schema = schema
+
+    @property
+    def num_rows(self) -> int:
+        if not self.cols:
+            return 0
+        return len(next(iter(self.cols.values()))[0])
+
+    @staticmethod
+    def from_block(block) -> "OracleTable":
+        data = block.to_numpy()
+        valid = block.validity_numpy()
+        return OracleTable(
+            {n: (data[n], valid[n]) for n in data}, block.schema
+        )
+
+
+def run_oracle(
+    program: Program,
+    table: OracleTable,
+    dicts: DictionarySet | None = None,
+) -> OracleTable:
+    cols = dict(table.cols)
+    types = {f.name: f.type for f in table.schema.fields}
+    n = table.num_rows
+    mask = np.ones(n, dtype=bool)
+    names = list(cols.keys())
+
+    for step in program.steps:
+        if isinstance(step, AssignStep):
+            cols[step.name] = _eval(step.expr, cols, types, dicts, n)
+            types[step.name] = infer_type(step.expr, table.schema, types)
+            if step.name not in names:
+                names.append(step.name)
+        elif isinstance(step, FilterStep):
+            v, ok = _eval(step.expr, cols, types, dicts, n)
+            mask = mask & (v.astype(bool) & ok)
+        elif isinstance(step, ProjectStep):
+            names = list(step.names)
+        elif isinstance(step, GroupByStep):
+            cols, types, names = _group_by(step, cols, types, mask, dicts,
+                                           table.schema)
+            n = len(next(iter(cols.values()))[0]) if cols else 0
+            mask = np.ones(n, dtype=bool)
+        elif isinstance(step, SortStep):
+            cols = {nm: (c[0][mask], c[1][mask]) for nm, c in cols.items()}
+            n = int(mask.sum())
+            mask = np.ones(n, dtype=bool)
+            order = _sort_order(step, cols, types, dicts)
+            cols = {nm: (c[0][order], c[1][order]) for nm, c in cols.items()}
+            if step.limit is not None:
+                cols = {nm: (c[0][:step.limit], c[1][:step.limit])
+                        for nm, c in cols.items()}
+                n = min(n, step.limit)
+                mask = np.ones(n, dtype=bool)
+        else:
+            raise NotImplementedError(step)
+
+    out_cols = {nm: (cols[nm][0][mask], cols[nm][1][mask]) for nm in names}
+    out_schema = dtypes.Schema(
+        tuple(dtypes.Field(nm, types[nm]) for nm in names)
+    )
+    return OracleTable(out_cols, out_schema)
+
+
+def _const_array(c: Const, n: int) -> ColT:
+    return (
+        np.full(n, c.value, dtype=c.type.physical),
+        np.ones(n, dtype=bool),
+    )
+
+
+def _eval(expr, cols, types, dicts, n) -> ColT:
+    if isinstance(expr, Col):
+        return cols[expr.name]
+    if isinstance(expr, Const):
+        return _const_array(expr, n)
+    if isinstance(expr, DictPredicate):
+        d = dicts[expr.column]
+        ids, ok = cols[expr.column]
+        if expr.kind in ("eq", "ne"):
+            table = np.zeros(max(len(d), 1), dtype=bool)
+            i = d.eq_id(expr.pattern)
+            if i >= 0:
+                table[i] = True
+            if expr.kind == "ne":
+                table = ~table
+        elif expr.kind == "like":
+            table = d.like_mask(expr.pattern)
+        elif expr.kind == "prefix":
+            table = d.prefix_mask(expr.pattern)
+        elif expr.kind in ("in_set", "not_in_set"):
+            table = np.zeros(max(len(d), 1), dtype=bool)
+            for v in expr.pattern:
+                i = d.eq_id(v)
+                if i >= 0:
+                    table[i] = True
+            if expr.kind == "not_in_set":
+                table = ~table
+        else:
+            raise NotImplementedError(expr.kind)
+        if len(table) == 0:
+            table = np.zeros(1, dtype=bool)
+        return table[np.clip(ids, 0, len(table) - 1)], ok.copy()
+    assert isinstance(expr, Call)
+    op = expr.op
+    args = [_eval(a, cols, types, dicts, n) for a in expr.args]
+    ts = [infer_type(a, None, types) if not isinstance(a, Const) else a.type
+          for a in expr.args]
+    return _apply_op(op, expr, args, ts, cols, types, dicts, n)
+
+
+def _align_dec(op, args, ts):
+    if len(ts) != 2 or not (ts[0].is_decimal or ts[1].is_decimal):
+        return args
+    sa = ts[0].scale if ts[0].is_decimal else 0
+    sb = ts[1].scale if ts[1].is_decimal else 0
+    if sa == sb:
+        return args
+    t = max(sa, sb)
+    out = list(args)
+    for i, s in enumerate((sa, sb)):
+        if s < t:
+            v, ok = out[i]
+            if np.issubdtype(v.dtype, np.floating):
+                out[i] = (np.round(v * 10 ** (t - s)).astype(np.int64), ok)
+            else:
+                out[i] = (v.astype(np.int64) * 10 ** (t - s), ok)
+    return out
+
+
+def _apply_op(op, expr, args, ts, cols, types, dicts, n) -> ColT:
+    # decimal MUL multiplies unscaled values (scales add); only additive and
+    # comparison ops align operand scales
+    if op in (Op.ADD, Op.SUB, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT,
+              Op.GE, Op.MOD):
+        args = _align_dec(op, args, ts)
+    simple = {
+        Op.EQ: np.equal, Op.NE: np.not_equal, Op.LT: np.less,
+        Op.LE: np.less_equal, Op.GT: np.greater, Op.GE: np.greater_equal,
+        Op.ADD: np.add, Op.SUB: np.subtract, Op.MUL: np.multiply,
+        Op.XOR: np.bitwise_xor,
+    }
+    if op in simple:
+        (a, va), (b, vb) = args
+        return simple[op](a, b), va & vb
+    if op is Op.AND:
+        (a, va), (b, vb) = args
+        return a & b, ((~a & va) | (~b & vb) | (va & vb))
+    if op is Op.OR:
+        (a, va), (b, vb) = args
+        return a | b, ((a & va) | (b & vb) | (va & vb))
+    if op is Op.NOT:
+        a, va = args[0]
+        return ~a, va
+    if op in (Op.NEG,):
+        a, va = args[0]
+        return -a, va
+    if op is Op.ABS:
+        a, va = args[0]
+        return np.abs(a), va
+    if op is Op.DIV:
+        (a, va), (b, vb) = args
+        ta, tb = ts
+        zero = b == 0
+        denom = np.where(zero, 1, b)
+        if ta.is_floating or tb.is_floating or ta.is_decimal or tb.is_decimal:
+            fa = a.astype(np.float64) / (10.0 ** ta.scale if ta.is_decimal else 1)
+            fb = denom.astype(np.float64) / (10.0 ** tb.scale if tb.is_decimal else 1)
+            fb = np.where(fb == 0, 1.0, fb)
+            return fa / fb, va & vb & ~zero
+        # SQL integer division truncates toward zero
+        q = np.floor_divide(a, denom)
+        q = np.where((a - q * denom != 0) & ((a < 0) ^ (denom < 0)), q + 1, q)
+        return q, va & vb & ~zero
+    if op is Op.MOD:
+        (a, va), (b, vb) = args
+        zero = b == 0
+        denom = np.where(zero, 1, b)
+        q = np.floor_divide(a, denom)
+        q = np.where((a - q * denom != 0) & ((a < 0) ^ (denom < 0)), q + 1, q)
+        return a - denom * q, va & vb & ~zero
+    if op is Op.IS_NULL:
+        a, va = args[0]
+        return ~va, np.ones(len(va), dtype=bool)
+    if op is Op.IS_NOT_NULL:
+        a, va = args[0]
+        return va.copy(), np.ones(len(va), dtype=bool)
+    if op is Op.COALESCE:
+        data, valid = args[-1]
+        data, valid = data.copy(), valid.copy()
+        for a, va in reversed(args[:-1]):
+            data = np.where(va, a, data)
+            valid = va | valid
+        return data, valid
+    if op is Op.IF:
+        (c, vc), (a, va), (b, vb) = args
+        take = c.astype(bool) & vc
+        return np.where(take, a, b), vc & np.where(take, va, vb)
+    if op in (Op.CAST_INT32, Op.CAST_INT64, Op.CAST_FLOAT, Op.CAST_DOUBLE):
+        a, va = args[0]
+        ta = ts[0]
+        target = {
+            Op.CAST_INT32: np.int32, Op.CAST_INT64: np.int64,
+            Op.CAST_FLOAT: np.float32, Op.CAST_DOUBLE: np.float64,
+        }[op]
+        if ta.is_decimal:
+            if np.issubdtype(target, np.floating):
+                return (a.astype(np.float64) / 10 ** ta.scale).astype(target), va
+            return (a // 10 ** ta.scale).astype(target), va
+        return a.astype(target), va
+    if op in (Op.YEAR, Op.MONTH):
+        a, va = args[0]
+        ta = ts[0]
+        days = a // 86_400_000_000 if ta.kind == dtypes.Kind.TIMESTAMP else a
+        dt = days.astype("datetime64[D]")
+        if op is Op.YEAR:
+            return dt.astype("datetime64[Y]").astype(int) + 1970, va
+        m = (dt.astype("datetime64[M]").astype(int) % 12) + 1
+        return m.astype(np.int32), va
+    if op in (Op.SQRT, Op.EXP, Op.LN, Op.FLOOR, Op.CEIL, Op.ROUND):
+        f = {Op.SQRT: np.sqrt, Op.EXP: np.exp, Op.LN: np.log,
+             Op.FLOOR: np.floor, Op.CEIL: np.ceil, Op.ROUND: np.round}[op]
+        a, va = args[0]
+        return f(a), va
+    if op is Op.POW:
+        (a, va), (b, vb) = args
+        return np.power(a.astype(np.float64), b.astype(np.float64)), va & vb
+    if op is Op.IN_SET:
+        a, va = args[0]
+        hit = np.zeros(len(a), dtype=bool)
+        for cst in expr.args[1:]:
+            hit |= a == cst.value
+        return hit, va
+    raise NotImplementedError(op)
+
+
+def _group_by(step: GroupByStep, cols, types, mask, dicts, schema):
+    import numpy as np
+
+    key_vals = []
+    for k in step.keys:
+        v, ok = cols[k]
+        key_vals.append(np.where(ok, v, 0))
+        key_vals.append(ok)
+    nrows = len(mask)
+    if step.keys:
+        stacked = np.rec.fromarrays(key_vals)
+        live_keys = stacked[mask]
+        uniq, inv = np.unique(live_keys, return_inverse=True)
+        ngroups = len(uniq)
+    else:
+        ngroups = 1
+        inv = np.zeros(int(mask.sum()), dtype=np.int64)
+
+    out_cols: dict[str, ColT] = {}
+    out_types: dict[str, dtypes.LogicalType] = {}
+    for i, k in enumerate(step.keys):
+        v, ok = cols[k]
+        lv, lok = v[mask], ok[mask]
+        kd = np.zeros(ngroups, dtype=v.dtype)
+        kv = np.zeros(ngroups, dtype=bool)
+        kd[inv] = lv
+        kv[inv] = lok
+        out_cols[k] = (kd, kv)
+        out_types[k] = types[k]
+
+    for spec in step.aggs:
+        t = agg_result_type(spec, schema, types)
+        out_types[spec.out_name] = t
+        if spec.func is Agg.COUNT_ALL:
+            data = np.bincount(inv, minlength=ngroups).astype(np.int64)
+            valid = (
+                np.ones(ngroups, dtype=bool)
+                if not step.keys
+                else data >= 0
+            )
+            out_cols[spec.out_name] = (data, valid)
+            continue
+        v, ok = cols[spec.column]
+        lv, lok = v[mask], ok[mask]
+        nn = np.bincount(inv[lok], minlength=ngroups).astype(np.int64)
+        if spec.func is Agg.COUNT:
+            out_cols[spec.out_name] = (
+                nn,
+                np.ones(ngroups, dtype=bool) if not step.keys else nn >= 0,
+            )
+            continue
+        if spec.func is Agg.SUM:
+            acc = np.zeros(ngroups, dtype=t.physical)
+            np.add.at(acc, inv[lok], lv[lok].astype(t.physical))
+            out_cols[spec.out_name] = (acc, nn > 0)
+        elif spec.func is Agg.AVG:
+            src_t = types[spec.column]
+            acc = np.zeros(ngroups, dtype=np.float64)
+            np.add.at(acc, inv[lok], lv[lok].astype(np.float64))
+            if src_t.is_decimal:
+                acc /= 10.0 ** src_t.scale
+            out_cols[spec.out_name] = (
+                acc / np.maximum(nn, 1), nn > 0
+            )
+        elif spec.func in (Agg.MIN, Agg.MAX):
+            src_t = types[spec.column]
+            vals = lv
+            if src_t.is_string:
+                rank = dicts[spec.column].sort_rank()
+                vals = rank[lv].astype(np.int64) << 32 | lv.astype(np.int64)
+            red = np.minimum if spec.func is Agg.MIN else np.maximum
+            if np.issubdtype(vals.dtype, np.floating):
+                init = np.inf if spec.func is Agg.MIN else -np.inf
+            else:
+                ii = np.iinfo(vals.dtype)
+                init = ii.max if spec.func is Agg.MIN else ii.min
+            acc = np.full(ngroups, init, dtype=vals.dtype)
+            red.at(acc, inv[lok], vals[lok])
+            if src_t.is_string:
+                acc = (acc & 0xFFFFFFFF).astype(np.int32)
+            out_cols[spec.out_name] = (acc, nn > 0)
+        elif spec.func is Agg.SOME:
+            acc = np.zeros(ngroups, dtype=lv.dtype)
+            acc[inv[lok][::-1]] = lv[lok][::-1]
+            out_cols[spec.out_name] = (acc, nn > 0)
+        else:
+            raise NotImplementedError(spec.func)
+
+    names = list(step.keys) + [s.out_name for s in step.aggs]
+    return out_cols, out_types, names
+
+
+def _sort_order(step: SortStep, cols, types, dicts):
+    desc = step.descending or (False,) * len(step.keys)
+    sort_keys = []
+    for k, dsc in zip(reversed(step.keys), reversed(desc)):
+        v, ok = cols[k]
+        t = types[k]
+        if t.is_string and dicts is not None and k in dicts:
+            v = dicts[k].sort_rank()[v]
+        d = v
+        if dsc:
+            if d.dtype == np.bool_:
+                d = ~d
+            elif np.issubdtype(d.dtype, np.integer):
+                d = ~d
+            else:
+                d = -d
+        sort_keys.append(d)
+        sort_keys.append(~ok)
+    return np.lexsort(tuple(sort_keys))

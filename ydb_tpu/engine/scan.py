@@ -1,0 +1,181 @@
+"""Single-shard scan execution: stream column blocks through a compiled
+SSA program with partial/final aggregation.
+
+This is the minimum end-to-end slice of the reference's ColumnShard scan
+(SURVEY.md §3.3): portions → assemble → program steps → merged result.
+Here: a host column source is tiled into fixed-capacity device blocks; the
+*partial* program (filters + assigns + partial group-by) runs jitted per
+block (one XLA compile for all blocks — identical shapes); the small
+partial results are merged by the *final* program. Programs without a
+GROUP BY concatenate block outputs directly.
+
+The per-block loop is the host-side analog of the scan iterator
+(engines/reader/plain_reader/iterator/iterator.h:53) — flow control,
+prefetch and credit windows attach here (ydb_tpu.dq channels reuse it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.block import TableBlock, concat_blocks
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.ssa import twophase
+from ydb_tpu.ssa.compiler import compile_program
+from ydb_tpu.ssa.program import Program
+
+DEFAULT_BLOCK_ROWS = 1 << 20
+
+
+@dataclasses.dataclass
+class ColumnSource:
+    """A host-resident columnar table (one shard's worth of data)."""
+
+    columns: dict[str, np.ndarray]
+    schema: dtypes.Schema
+    dicts: DictionarySet | None = None
+    validity: dict[str, np.ndarray] | None = None
+
+    @property
+    def num_rows(self) -> int:
+        return len(next(iter(self.columns.values()))) if self.columns else 0
+
+    def blocks(
+        self, block_rows: int = DEFAULT_BLOCK_ROWS,
+        columns: tuple[str, ...] | None = None,
+    ) -> Iterator[TableBlock]:
+        """Tile into equal-capacity blocks (last one padded)."""
+        names = columns if columns is not None else self.schema.names
+        sch = self.schema.select(names)
+        n = self.num_rows
+        cap = min(block_rows, max(n, 1))
+        for off in range(0, max(n, 1), cap):
+            hi = min(off + cap, n)
+            arrays = {m: self.columns[m][off:hi] for m in names}
+            validity = None
+            if self.validity:
+                validity = {
+                    m: self.validity[m][off:hi]
+                    for m in names if m in self.validity
+                }
+            yield TableBlock.from_numpy(arrays, sch, validity, capacity=cap)
+
+
+def required_columns(program: Program, schema: dtypes.Schema) -> tuple[str, ...]:
+    """Input columns the program actually reads (scan projection pushdown)."""
+    from ydb_tpu.ssa.program import (
+        AssignStep, Call, Col, DictPredicate, FilterStep, GroupByStep,
+        ProjectStep, SortStep,
+    )
+
+    used: set[str] = set()
+    assigned: set[str] = set()
+
+    def walk(e):
+        if isinstance(e, Col):
+            if e.name not in assigned:
+                used.add(e.name)
+        elif isinstance(e, Call):
+            for a in e.args:
+                walk(a)
+        elif isinstance(e, DictPredicate):
+            if e.column not in assigned:
+                used.add(e.column)
+
+    for s in program.steps:
+        if isinstance(s, AssignStep):
+            walk(s.expr)
+            assigned.add(s.name)
+        elif isinstance(s, FilterStep):
+            walk(s.expr)
+        elif isinstance(s, GroupByStep):
+            for k in s.keys:
+                if k not in assigned:
+                    used.add(k)
+            for a in s.aggs:
+                if a.column is not None and a.column not in assigned:
+                    used.add(a.column)
+        elif isinstance(s, SortStep):
+            for k in s.keys:
+                if k not in assigned:
+                    used.add(k)
+        elif isinstance(s, ProjectStep):
+            for nm in s.names:
+                if nm not in assigned:
+                    used.add(nm)
+    return tuple(n for n in schema.names if n in used)
+
+
+class ScanExecutor:
+    """Compiles a program against a source and executes block-streamed."""
+
+    def __init__(
+        self,
+        program: Program,
+        source: ColumnSource,
+        block_rows: int = DEFAULT_BLOCK_ROWS,
+        key_spaces: dict[str, int] | None = None,
+    ):
+        self.source = source
+        self.block_rows = block_rows
+        self.read_cols = required_columns(program, source.schema)
+        in_schema = source.schema.select(self.read_cols)
+        self.partial_prog, self.final_prog = twophase.split(program)
+        self.partial = compile_program(
+            self.partial_prog, in_schema, source.dicts, key_spaces
+        )
+        self._partial_jit = jax.jit(self.partial.run)
+        self._partial_aux = {
+            k: jnp.asarray(v) for k, v in self.partial.aux.items()
+        }
+        if self.final_prog is not None:
+            self.final = compile_program(
+                self.final_prog, self.partial.out_schema, source.dicts,
+                key_spaces,
+                dict_aliases=twophase.dict_aliases(self.partial_prog),
+            )
+            self._final_jit = jax.jit(self.final.run)
+            self._final_aux = {
+                k: jnp.asarray(v) for k, v in self.final.aux.items()
+            }
+            self.out_schema = self.final.out_schema
+        else:
+            self.final = None
+            self.out_schema = self.partial.out_schema
+
+    def run_block(self, block: TableBlock) -> TableBlock:
+        return self._partial_jit(block, self._partial_aux)
+
+    def finalize(self, partials: list[TableBlock]) -> TableBlock:
+        merged = (
+            partials[0] if len(partials) == 1 else concat_blocks(partials)
+        )
+        if self.final is None:
+            return merged
+        return self._final_jit(merged, self._final_aux)
+
+    def execute(self) -> OracleTable:
+        partials = [
+            self.run_block(b)
+            for b in self.source.blocks(self.block_rows, self.read_cols)
+        ]
+        if self.final is None:
+            # pure filter/project program: block outputs concatenate
+            return OracleTable.from_block(concat_blocks(partials))
+        return OracleTable.from_block(self.finalize(partials))
+
+
+def execute_scan(
+    program: Program,
+    source: ColumnSource,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    key_spaces: dict[str, int] | None = None,
+) -> OracleTable:
+    return ScanExecutor(program, source, block_rows, key_spaces).execute()

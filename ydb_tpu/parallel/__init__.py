@@ -1,0 +1,2 @@
+from ydb_tpu.parallel.mesh import make_mesh, shard_axis  # noqa: F401
+from ydb_tpu.parallel.dist import MeshScan  # noqa: F401

@@ -1,0 +1,258 @@
+"""Mesh-parallel scan execution: SPMD partial aggregation + ICI merge.
+
+The TPU-native equivalent of the reference's distributed aggregate pipeline
+(SURVEY.md §2.11): per-tablet partial states + inter-node shuffle/merge over
+DQ channels become ONE SPMD program under shard_map:
+
+  device-local partial SSA program (filters/assigns/group-by states)
+    → state merge over the ``shard`` mesh axis:
+        dense/keyless group layouts: elementwise psum / pmin / pmax of
+          slot-aligned states (the gradient-psum-shaped path — BASELINE
+          north star)
+        generic layouts: all_gather of compacted partial rows + local
+          re-aggregation (the DQ UnionAll-then-final-agg shape)
+    → final SSA program (AVG fixups, HAVING, ORDER BY) replicated.
+
+Everything here is jit-compiled once per (program, block shape, mesh) — the
+whole distributed query step is a single XLA executable with fused
+collectives, not a message exchange.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.block import Column, TableBlock
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.engine.scan import ColumnSource, required_columns
+from ydb_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from ydb_tpu.ssa import twophase
+from ydb_tpu.ssa.compiler import compile_program
+from ydb_tpu.ssa.ops import Agg
+from ydb_tpu.ssa.program import Program
+
+
+def stack_blocks(blocks: list[TableBlock]) -> TableBlock:
+    """Stack per-shard blocks along a leading device axis."""
+    sch = blocks[0].schema
+    cols = {}
+    for n in sch.names:
+        cols[n] = Column(
+            jnp.stack([b.columns[n].data for b in blocks]),
+            jnp.stack([b.columns[n].validity for b in blocks]),
+        )
+    length = jnp.stack([b.length for b in blocks])
+    return TableBlock(cols, length, sch)
+
+
+def _local(stacked: TableBlock) -> TableBlock:
+    """Inside shard_map: strip the (size-1) leading device axis."""
+    cols = {
+        n: Column(c.data[0], c.validity[0])
+        for n, c in stacked.columns.items()
+    }
+    return TableBlock(cols, stacked.length[0], stacked.schema)
+
+
+def _relocal(block: TableBlock) -> TableBlock:
+    """Inside shard_map: re-add the singleton device axis so per-shard
+    outputs concatenate under out_specs=P(shard)."""
+    cols = {
+        n: Column(c.data[None], c.validity[None])
+        for n, c in block.columns.items()
+    }
+    return TableBlock(cols, block.length[None], block.schema)
+
+
+def _merge_slots(
+    block: TableBlock,
+    merge_kinds: dict[str, Agg | str],
+    rank_tables: dict[str, jax.Array],
+):
+    """Elementwise merge of slot-aligned partial states across the mesh.
+
+    String MIN/MAX states hold dictionary ids; ids do not order like the
+    strings, so those columns re-pack as (lexicographic rank << 32 | id)
+    before pmin/pmax and unpack after (``rank_tables`` ships the plan-time
+    rank arrays)."""
+    cols = {}
+    for name, col in block.columns.items():
+        kind = merge_kinds[name]
+        d, v = col.data, col.validity
+        packed = kind in (Agg.MIN, Agg.MAX) and name in rank_tables
+        if packed:
+            rank = rank_tables[name][jnp.clip(d, 0, rank_tables[name].shape[0] - 1)]
+            d = (rank.astype(jnp.int64) << 32) | d.astype(jnp.int64)
+        if kind in ("key", Agg.SOME, Agg.MAX):
+            lo = _neutral(d.dtype, maximum=False)
+            d = jax.lax.pmax(jnp.where(v, d, lo), SHARD_AXIS)
+            v = jax.lax.pmax(v, SHARD_AXIS)
+        elif kind is Agg.MIN:
+            hi = _neutral(d.dtype, maximum=True)
+            d = jax.lax.pmin(jnp.where(v, d, hi), SHARD_AXIS)
+            v = jax.lax.pmax(v, SHARD_AXIS)
+        else:  # SUM / COUNT / COUNT_ALL states
+            d = jax.lax.psum(jnp.where(v, d, jnp.zeros_like(d)), SHARD_AXIS)
+            v = jax.lax.pmax(v, SHARD_AXIS)
+        if packed:
+            d = (d & 0xFFFFFFFF).astype(jnp.int32)
+        cols[name] = Column(d, v)
+    return TableBlock(cols, block.length, block.schema)
+
+
+def _neutral(dtype, maximum: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if maximum else -jnp.inf, dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(maximum, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if maximum else info.min, dtype)
+
+
+def _gather_rows(block: TableBlock) -> TableBlock:
+    """all_gather compacted partial rows from every shard into one block."""
+    cap = block.capacity
+    cols = {}
+    for n, c in block.columns.items():
+        d = jax.lax.all_gather(c.data, SHARD_AXIS)      # (ndev, cap)
+        v = jax.lax.all_gather(c.validity, SHARD_AXIS)
+        cols[n] = Column(d.reshape(-1), v.reshape(-1))
+    lens = jax.lax.all_gather(block.length, SHARD_AXIS)  # (ndev,)
+    ndev = lens.shape[0]
+    row = jnp.arange(cap, dtype=jnp.int32)
+    mask = (row[None, :] < lens[:, None]).reshape(-1)
+    big = TableBlock(cols, jnp.int32(ndev * cap), block.schema)
+    from ydb_tpu.ssa import kernels
+
+    return kernels.compact(big, mask)
+
+
+class MeshScan:
+    """A distributed scan+aggregate program over a device mesh."""
+
+    def __init__(
+        self,
+        program: Program,
+        schema: dtypes.Schema,
+        dicts: DictionarySet | None = None,
+        key_spaces: dict[str, int] | None = None,
+        mesh=None,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.read_cols = required_columns(program, schema)
+        in_schema = schema.select(self.read_cols)
+        partial_prog, final_prog = twophase.split(
+            program, with_row_counts=True
+        )
+        self.partial = compile_program(
+            partial_prog, in_schema, dicts, key_spaces, partial_slots=True
+        )
+        self.final = (
+            compile_program(final_prog, self.partial.out_schema, dicts,
+                            key_spaces,
+                            dict_aliases=twophase.dict_aliases(partial_prog))
+            if final_prog is not None
+            else None
+        )
+        self.out_schema = (
+            self.final.out_schema if self.final else self.partial.out_schema
+        )
+        layout = self.partial.group_layout[0]
+        self._use_slots = layout in ("dense_slots", "keyless")
+
+        merge_kinds: dict[str, Agg | str] = {}
+        rank_tables: dict[str, jax.Array] = {}
+        gb = partial_prog.group_by
+        if gb is not None:
+            for k in gb.keys:
+                merge_kinds[k] = "key"
+            for spec in gb.aggs:
+                merge_kinds[spec.out_name] = spec.func
+                if (
+                    spec.func in (Agg.MIN, Agg.MAX)
+                    and spec.column is not None
+                    and self.partial.out_schema.field(
+                        spec.out_name
+                    ).type.is_string
+                ):
+                    rank_tables[spec.out_name] = jnp.asarray(
+                        dicts[spec.column].sort_rank()
+                    )
+        self._merge_kinds = merge_kinds
+        self._rank_tables = rank_tables
+
+        paux = {k: jnp.asarray(v) for k, v in self.partial.aux.items()}
+        faux = (
+            {k: jnp.asarray(v) for k, v in self.final.aux.items()}
+            if self.final
+            else {}
+        )
+
+        def step(stacked: TableBlock) -> TableBlock:
+            block = _local(stacked)
+            part = self.partial.run(block, paux)
+            if self.final is None:
+                return _gather_rows(part)
+            if self._use_slots:
+                merged = _merge_slots(
+                    part, self._merge_kinds, self._rank_tables
+                )
+                # drop dead group slots (keyless keeps its single row:
+                # COUNT()=0 over empty input is still one output row)
+                if (
+                    self.partial.group_layout[0] == "dense_slots"
+                    and "__rows" in merged.columns
+                ):
+                    from ydb_tpu.ssa import kernels
+
+                    live = merged.columns["__rows"].data > 0
+                    merged = kernels.compact(merged, live & merged.row_mask())
+            else:
+                merged = _gather_rows(part)
+            return self.final.run(merged, faux)
+
+        self._step = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=P(SHARD_AXIS),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+
+    # ---- host-side drivers ----
+
+    def run_stacked(self, stacked: TableBlock) -> TableBlock:
+        """stacked: leading device axis == mesh shard count."""
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        stacked = jax.device_put(stacked, sharding)
+        return self._step(stacked)
+
+    def execute(self, source: ColumnSource) -> OracleTable:
+        """Partition a host table across the mesh and run one SPMD step."""
+        n_shards = self.mesh.shape[SHARD_AXIS]
+        n = source.num_rows
+        per = -(-n // n_shards)
+        blocks = []
+        sch = source.schema.select(self.read_cols)
+        for s in range(n_shards):
+            lo, hi = min(s * per, n), min((s + 1) * per, n)
+            arrays = {m: source.columns[m][lo:hi] for m in self.read_cols}
+            validity = None
+            if source.validity:
+                validity = {
+                    m: source.validity[m][lo:hi]
+                    for m in self.read_cols
+                    if m in source.validity
+                }
+            blocks.append(
+                TableBlock.from_numpy(arrays, sch, validity, capacity=per)
+            )
+        out = self.run_stacked(stack_blocks(blocks))
+        return OracleTable.from_block(out)

@@ -1,0 +1,111 @@
+"""Hash-partition shuffle over the mesh: the DQ repartitioning channel.
+
+Reference: rows route to output partitions by key hash between stages
+(TDqOutputHashPartitionConsumer, dq_output_consumer.cpp:105; vectorized
+block path :338). TPU-native: each device buckets its rows by destination
+shard and the buckets exchange via ``jax.lax.all_to_all`` over ICI — the
+same collective shape as MoE expert dispatch (SURVEY.md §2.11).
+
+XLA needs static shapes, so each device sends a fixed-capacity bucket to
+every peer (default: the full local capacity, which is always enough —
+worst case all local rows hash to one shard). Memory cost is
+ndev × bucket_rows per column; keep scan blocks modest and let the engine
+stream. After the exchange each device owns exactly the rows whose key
+hash maps to it — the precondition for partitioned (grace-style) joins and
+re-keyed aggregation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ydb_tpu.blocks.block import Column, TableBlock
+from ydb_tpu.parallel.mesh import SHARD_AXIS
+
+# splitmix64-style avalanche constants
+_C1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_C2 = jnp.uint64(0x94D049BB133111EB)
+
+
+def hash_rows(cols: list[Column]) -> jax.Array:
+    """Vectorized 64-bit row hash over key columns (uint64)."""
+    h = jnp.full(cols[0].data.shape, jnp.uint64(0x9E3779B97F4A7C15))
+    for c in cols:
+        k = c.data.astype(jnp.int64).astype(jnp.uint64)
+        # null keys hash as a distinct class via the validity bit
+        k = k ^ (c.validity.astype(jnp.uint64) << 63)
+        x = h ^ k
+        x = (x ^ (x >> 30)) * _C1
+        x = (x ^ (x >> 27)) * _C2
+        h = x ^ (x >> 31)
+    return h
+
+
+def repartition(
+    block: TableBlock,
+    key_names: list[str],
+    n_shards: int,
+    bucket_rows: int | None = None,
+) -> TableBlock:
+    """Exchange rows so each shard owns hash(keys) % n_shards == its index.
+
+    Must run inside shard_map over the ``shard`` axis. Returns a local
+    block of capacity n_shards * bucket_rows.
+    """
+    cap = block.capacity
+    B = bucket_rows if bucket_rows is not None else cap
+    live = block.row_mask()
+    h = hash_rows([block.columns[k] for k in key_names])
+    dest = (h % jnp.uint64(n_shards)).astype(jnp.int32)
+    dest = jnp.where(live, dest, n_shards)  # dead rows -> drop bucket
+
+    # stable-sort rows by destination => contiguous buckets
+    order = jnp.argsort(dest, stable=True)
+    dest_s = dest[order]
+    # position of each row within its bucket
+    ones = jnp.ones_like(dest_s, dtype=jnp.int32)
+    counts = jnp.zeros(n_shards + 1, dtype=jnp.int32).at[dest_s].add(
+        ones, mode="drop"
+    )
+    starts = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]]
+    )
+    pos_in_bucket = (
+        jnp.arange(cap, dtype=jnp.int32) - starts[jnp.clip(dest_s, 0, n_shards)]
+    )
+    # scatter into (n_shards, B) send buffers; overflow/dead rows drop
+    slot = jnp.where(
+        (dest_s < n_shards) & (pos_in_bucket < B),
+        dest_s * B + pos_in_bucket,
+        n_shards * B,
+    )
+
+    sent_counts = jnp.minimum(counts[:n_shards], B)  # per-destination rows
+
+    new_cols = {}
+    for n, c in block.columns.items():
+        d = c.data[order]
+        v = c.validity[order]
+        buf = jnp.zeros((n_shards * B,), dtype=d.dtype).at[slot].set(
+            d, mode="drop"
+        ).reshape(n_shards, B)
+        vbuf = jnp.zeros((n_shards * B,), dtype=v.dtype).at[slot].set(
+            v, mode="drop"
+        ).reshape(n_shards, B)
+        rd = jax.lax.all_to_all(buf, SHARD_AXIS, 0, 0, tiled=False)
+        rv = jax.lax.all_to_all(vbuf, SHARD_AXIS, 0, 0, tiled=False)
+        new_cols[n] = Column(rd.reshape(-1), rv.reshape(-1))
+
+    recv_counts = jax.lax.all_to_all(
+        sent_counts.reshape(n_shards, 1), SHARD_AXIS, 0, 0
+    ).reshape(-1)  # rows received from each peer
+    row = jnp.arange(B, dtype=jnp.int32)
+    mask = (row[None, :] < recv_counts[:, None]).reshape(-1)
+
+    big = TableBlock(
+        new_cols, jnp.int32(n_shards * B), block.schema
+    )
+    from ydb_tpu.ssa import kernels
+
+    return kernels.compact(big, mask)

@@ -46,12 +46,21 @@ from ydb_tpu.ssa.program import (
 
 @dataclasses.dataclass
 class CompiledProgram:
-    """A lowered program plus its plan-time inputs."""
+    """A lowered program plus its plan-time inputs.
+
+    ``group_layout`` describes the group-by output layout for distributed
+    merging (ydb_tpu.parallel):
+      ("dense_slots", n)  — uncompacted fixed slots, psum-mergeable
+      ("keyless", 1)      — single-row global aggregate, psum-mergeable
+      ("compact", None)   — compacted rows; merge via all_gather + re-agg
+      (None, None)        — no group-by in the program
+    """
 
     run: Callable  # (TableBlock, dict[str, jax.Array]) -> TableBlock
     aux: dict[str, np.ndarray]  # plan-time tables (dict masks etc.)
     out_schema: dtypes.Schema
     in_schema: dtypes.Schema
+    group_layout: tuple = (None, None)
 
     def __call__(self, block: TableBlock) -> TableBlock:
         aux = {k: jnp.asarray(v) for k, v in self.aux.items()}
@@ -62,10 +71,20 @@ class _Lowering:
     """Single-pass lowering context (types + aux tables + trace builder)."""
 
     def __init__(self, schema: dtypes.Schema, dicts: DictionarySet | None,
-                 key_spaces: dict[str, int] | None):
+                 key_spaces: dict[str, int] | None,
+                 partial_slots: bool = False,
+                 dict_aliases: dict[str, str] | None = None):
         self.schema = schema
         self.dicts = dicts
         self.key_spaces = dict(key_spaces or {})
+        # column -> source column whose dictionary it carries (aggregate
+        # outputs like MIN(s) AS lo keep s's dictionary)
+        self.dict_aliases = dict(dict_aliases or {})
+        # partial_slots: keep dense group-by states in their slots
+        # (uncompacted) so per-device states align elementwise for
+        # psum/pmin/pmax merging over the mesh
+        self.partial_slots = partial_slots
+        self.group_layout: tuple = (None, None)
         self.types: dict[str, dtypes.LogicalType] = {
             f.name: f.type for f in schema.fields
         }
@@ -78,14 +97,23 @@ class _Lowering:
         self.aux[key] = table
         return key
 
+    def dictionary(self, name: str):
+        """Dictionary for a (possibly renamed) string column, or None."""
+        if self.dicts is None:
+            return None
+        src = self.dict_aliases.get(name, name)
+        return self.dicts[src] if src in self.dicts else None
+
     def key_bound(self, name: str, t: dtypes.LogicalType) -> int | None:
         """Static cardinality bound for a group-by key column, if known.
 
         ``t`` is the column's *current* type (assigned columns included)."""
         if t.kind == dtypes.Kind.BOOL:
             return 2
-        if t.is_string and self.dicts is not None and name in self.dicts:
-            return len(self.dicts[name])
+        if t.is_string:
+            d = self.dictionary(name)
+            if d is not None:
+                return len(d)
         return self.key_spaces.get(name)
 
 
@@ -94,8 +122,10 @@ def compile_program(
     schema: dtypes.Schema,
     dicts: DictionarySet | None = None,
     key_spaces: dict[str, int] | None = None,
+    partial_slots: bool = False,
+    dict_aliases: dict[str, str] | None = None,
 ) -> CompiledProgram:
-    ctx = _Lowering(schema, dicts, key_spaces)
+    ctx = _Lowering(schema, dicts, key_spaces, partial_slots, dict_aliases)
 
     # ---- static pass: resolve plan, types, aux tables, output schema ----
     plan: list = []  # (kind, payload) closures prepared statically
@@ -154,12 +184,13 @@ def compile_program(
             ranks = []
             for k in step.keys:
                 t = cur_types[k]
-                if t.is_string and dicts is not None and k in dicts:
-                    ranks.append(ctx.add_aux(
-                        f"rank.{k}", dicts[k].sort_rank()))
-                elif t.is_string:
-                    raise ValueError(
-                        f"ORDER BY on string column {k} needs its dictionary")
+                if t.is_string:
+                    d = ctx.dictionary(k)
+                    if d is None:
+                        raise ValueError(
+                            f"ORDER BY on string column {k} needs its"
+                            " dictionary")
+                    ranks.append(ctx.add_aux(f"rank.{k}", d.sort_rank()))
                 else:
                     ranks.append(None)
             plan.append(
@@ -220,10 +251,11 @@ def compile_program(
                         dtypes.Field(n, cur_types.get(n, dtypes.INT64))
                         for n in tmp_names)),
                 )
-                blk = kernels.compact(blk, mask)
+                # single lexsort pass: the filter mask rides in as `live`
+                # (non-selected rows sink past the length cut)
                 blk = kernels.sort_block(
                     blk, [f"__sort{i}" for i in range(len(keys))],
-                    list(desc), limit)
+                    list(desc), limit, live=mask)
                 env = {n: blk.columns[n] for n in names}
                 length = blk.length
                 mask = blk.row_mask()
@@ -232,7 +264,7 @@ def compile_program(
         return kernels.compact(blk, mask)
 
     return CompiledProgram(run=run, aux=ctx.aux, out_schema=out_schema,
-                           in_schema=schema)
+                           in_schema=schema, group_layout=ctx.group_layout)
 
 
 # ---------------- expression lowering helpers ----------------
@@ -242,9 +274,9 @@ def _resolve_dict_predicate(ctx: _Lowering, p: DictPredicate, cur_types):
     t = cur_types[p.column]
     if not t.is_string:
         raise TypeError(f"dict predicate on non-string column {p.column}")
-    if ctx.dicts is None or p.column not in ctx.dicts:
+    d = ctx.dictionary(p.column)
+    if d is None:
         raise ValueError(f"no dictionary for column {p.column}")
-    d = ctx.dicts[p.column]
     if p.kind in ("eq", "ne"):
         want = d.eq_id(p.pattern)
         table = np.zeros(max(len(d), 1), dtype=np.bool_)
@@ -369,7 +401,10 @@ def _resolve_call(ctx: _Lowering, call: Call, cur_types, resolve_expr):
             a, b = _fa(env, aux), _fb(env, aux)
             zero = b.data == 0
             denom = jnp.where(zero, jnp.ones_like(b.data), b.data)
-            return Column(a.data % denom, a.validity & b.validity & ~zero)
+            return Column(
+                kernels.trunc_mod(a.data, denom),
+                a.validity & b.validity & ~zero,
+            )
 
         return lower, out_t
     if op is Op.POW:
@@ -556,14 +591,15 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
             spec.func in (Agg.MIN, Agg.MAX)
             and cur_types[spec.column].is_string
         ):
-            if ctx.dicts is None or spec.column not in ctx.dicts:
+            d = ctx.dictionary(spec.column)
+            if d is None:
                 raise ValueError(
                     f"MIN/MAX over string column {spec.column} needs its"
                     " dictionary"
                 )
             if spec.column not in str_rank_aux:
                 str_rank_aux[spec.column] = ctx.add_aux(
-                    f"rank.{spec.column}", ctx.dicts[spec.column].sort_rank()
+                    f"rank.{spec.column}", d.sort_rank()
                 )
     out_names = tuple(keys) + tuple(s.out_name for s, _ in specs)
 
@@ -571,6 +607,13 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
     use_dense = dense
     b_tuple = tuple(bounds) if dense else ()
     explicit_cap = step.max_groups
+    keep_slots = ctx.partial_slots and (dense or not keys)
+    if not keys:
+        ctx.group_layout = ("keyless", 1)
+    elif keep_slots:
+        ctx.group_layout = ("dense_slots", num_groups)
+    else:
+        ctx.group_layout = ("compact", None)
 
     def lower(env, aux, live):
         kcols = [env[k] for k in key_names]
@@ -663,7 +706,11 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
                     raise NotImplementedError(spec.func)
             new_env[spec.out_name] = Column(data, valid)
 
-        if key_names and not use_dense:
+        if key_names and keep_slots:
+            # mesh-mergeable layout: every slot stays in place; dead slots
+            # carry invalid values and zero counts
+            length = jnp.int32(ng)
+        elif key_names and not use_dense:
             # sorted path: groups already dense [0, n); length = ng_scalar
             length = ng_scalar
         elif not key_names:
@@ -671,17 +718,15 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
             # SELECT COUNT(*) ... WHERE false => one row with 0)
             length = jnp.int32(1)
         else:
-            length = jnp.sum(group_live).astype(jnp.int32)
-            if key_names:
-                # dense path: compact scattered group slots to the front
-                blk = TableBlock(
-                    new_env, jnp.int32(ng),
-                    dtypes.Schema(tuple(
-                        dtypes.Field(n, out_types[n]) for n in out_names)),
-                )
-                blk = kernels.compact(blk, group_live)
-                new_env = dict(blk.columns)
-                length = blk.length
+            # dense path: compact scattered group slots to the front
+            blk = TableBlock(
+                new_env, jnp.int32(ng),
+                dtypes.Schema(tuple(
+                    dtypes.Field(n, out_types[n]) for n in out_names)),
+            )
+            blk = kernels.compact(blk, group_live)
+            new_env = dict(blk.columns)
+            length = blk.length
         return new_env, length
 
     return _GroupByLowered(lower=lower, out_names=out_names,

@@ -57,8 +57,22 @@ def safe_div(a: Column, b: Column, float_result: bool) -> Column:
     if float_result:
         data = a.data / denom
     else:
-        data = a.data // denom
+        data = _trunc_div(a.data, denom)
     return Column(data, a.validity & b.validity & ~zero)
+
+
+def _trunc_div(a, b):
+    """SQL integer division truncates toward zero (-7/2 = -3), unlike
+    Python/jnp floor division (-7//2 = -4)."""
+    q = a // b
+    exact = a - q * b == 0
+    neg = (a < 0) ^ (b < 0)
+    return jnp.where(~exact & neg, q + 1, q)
+
+
+def trunc_mod(a, b):
+    """SQL remainder takes the dividend's sign: -7 % 2 = -1."""
+    return a - b * _trunc_div(a, b)
 
 
 def pred_mask(col: Column) -> jax.Array:
@@ -157,7 +171,10 @@ def group_ids_sorted(
         sort_keys.append(~k.validity)
     sort_keys.append(~live)
     perm = jnp.lexsort(tuple(sort_keys))  # last key is primary
-    inv = jnp.argsort(perm)  # original row -> sorted pos
+    # invert the permutation with one linear scatter (not a second sort)
+    inv = jnp.zeros_like(perm).at[perm].set(
+        jnp.arange(perm.shape[0], dtype=perm.dtype)
+    )
 
     live_s = live[perm]
 
@@ -256,17 +273,23 @@ def sort_block(
     keys: list[str],
     descending: list[bool],
     limit: int | None = None,
+    live: jax.Array | None = None,
 ) -> TableBlock:
-    live = block.row_mask()
+    """Sort live (optionally pre-masked) rows; one lexsort pass does both
+    the selection compaction (non-live rows sink) and the ordering."""
+    if live is None:
+        live = block.row_mask()
+    else:
+        live = live & block.row_mask()
     perm = sort_perm([block.columns[k] for k in keys], descending, live)
     cols = {
         n: Column(c.data[perm], c.validity[perm] & live[perm])
         for n, c in block.columns.items()
     }
-    length = block.length
+    length = jnp.sum(live).astype(jnp.int32)
     if limit is not None:
         length = jnp.minimum(length, jnp.int32(limit))
-        # zero validity past the limit so padding never leaks
-        cut = jnp.arange(block.capacity, dtype=jnp.int32) < length
-        cols = {n: Column(c.data, c.validity & cut) for n, c in cols.items()}
+    # zero validity past the length so padding never leaks
+    cut = jnp.arange(block.capacity, dtype=jnp.int32) < length
+    cols = {n: Column(c.data, c.validity & cut) for n, c in cols.items()}
     return TableBlock(cols, length, block.schema)

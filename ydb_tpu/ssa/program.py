@@ -109,11 +109,12 @@ class AggSpec:
 class GroupByStep:
     keys: tuple[str, ...]
     aggs: tuple[AggSpec, ...]
-    # Optional static cap on distinct groups per block. Dense-keyed
-    # group-bys size their tables exactly from dictionary/key-space
-    # cardinalities; the generic sort-based path defaults to the block
-    # capacity (a block of N rows has at most N groups — nothing is ever
-    # silently dropped), so the cap is purely a memory knob.
+    # Optional static cap on distinct groups per block. Default None: the
+    # sort-based path sizes its output to the block capacity (a block of N
+    # rows has at most N groups), so nothing is dropped. Setting an
+    # explicit cap trades that guarantee for memory: groups beyond the cap
+    # (in key sort order) ARE truncated — callers own the sizing, e.g.
+    # when a downstream LIMIT bounds the useful group count.
     max_groups: int | None = None
 
 

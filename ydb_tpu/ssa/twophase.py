@@ -1,0 +1,120 @@
+"""Two-phase aggregation: split a Program at its GROUP BY.
+
+The reference computes grouped aggregates in two phases — per-input partial
+states (BlockCombineHashed, mkql_block_agg.cpp:1637) merged after a shuffle
+(BlockMergeFinalizeHashed, :1655). The TPU build uses the same split for
+three purposes:
+
+  * multi-block scans: each block produces a small partial block; partials
+    concat + finalize (ydb_tpu.engine.scan)
+  * mesh parallelism: per-device partials merge via psum/all_gather over
+    ICI (ydb_tpu.parallel)
+  * DQ-style stage graphs: partial on scan tasks, final after HashPartition
+
+``split(program)`` returns (partial, final):
+  partial = steps before GROUP BY + a rewritten GROUP BY emitting mergeable
+            states (AVG -> SUM+COUNT; COUNT -> COUNT; others unchanged)
+  final   = GROUP BY over the partial columns with merge functions
+            (SUM of SUMs/COUNTs, MIN of MINs, ...) + assigns restoring AVG
+            + the original post-GROUP-BY steps + projection to the original
+            output.
+Programs without GROUP BY return (program, None): block results concat
+directly (pure filter/project programs need no merge).
+"""
+
+from __future__ import annotations
+
+from ydb_tpu.ssa.ops import Agg, Op
+from ydb_tpu.ssa.program import (
+    AggSpec,
+    AssignStep,
+    Call,
+    Col,
+    GroupByStep,
+    Program,
+    ProjectStep,
+)
+
+
+def dict_aliases(partial: Program) -> dict[str, str]:
+    """column -> source-column dictionary aliases for the FINAL program:
+    string-valued aggregate outputs (MIN(s) AS lo) carry the source
+    column's dictionary."""
+    gb = partial.group_by
+    if gb is None:
+        return {}
+    return {
+        s.out_name: s.column
+        for s in gb.aggs
+        if s.column is not None and s.out_name != s.column
+    }
+
+
+def split(
+    program: Program, with_row_counts: bool = False
+) -> tuple[Program, Program | None]:
+    """``with_row_counts`` adds an implicit ``__rows`` COUNT_ALL state to
+    the partial program — mesh merging needs per-slot liveness to drop dead
+    group slots before finalization (ydb_tpu.parallel.dist)."""
+    gb_idx = None
+    for i, s in enumerate(program.steps):
+        if isinstance(s, GroupByStep):
+            gb_idx = i
+            break
+    if gb_idx is None:
+        return program, None
+    gb: GroupByStep = program.steps[gb_idx]
+
+    partial_aggs: list[AggSpec] = []
+    final_aggs: list[AggSpec] = []
+    avg_fixups: list[AssignStep] = []
+    for spec in gb.aggs:
+        if spec.func is Agg.AVG:
+            s_name = f"__avg_sum_{spec.out_name}"
+            c_name = f"__avg_cnt_{spec.out_name}"
+            partial_aggs.append(AggSpec(Agg.SUM, spec.column, s_name))
+            partial_aggs.append(AggSpec(Agg.COUNT, spec.column, c_name))
+            final_aggs.append(AggSpec(Agg.SUM, s_name, s_name))
+            final_aggs.append(AggSpec(Agg.SUM, c_name, c_name))
+            avg_fixups.append(
+                AssignStep(
+                    spec.out_name,
+                    Call(
+                        Op.DIV,
+                        Call(Op.CAST_DOUBLE, Col(s_name)),
+                        Col(c_name),
+                    ),
+                )
+            )
+        elif spec.func in (Agg.COUNT, Agg.COUNT_ALL):
+            partial_aggs.append(spec)
+            final_aggs.append(AggSpec(Agg.SUM, spec.out_name, spec.out_name))
+        elif spec.func is Agg.SUM:
+            partial_aggs.append(spec)
+            final_aggs.append(AggSpec(Agg.SUM, spec.out_name, spec.out_name))
+        elif spec.func is Agg.MIN:
+            partial_aggs.append(spec)
+            final_aggs.append(AggSpec(Agg.MIN, spec.out_name, spec.out_name))
+        elif spec.func is Agg.MAX:
+            partial_aggs.append(spec)
+            final_aggs.append(AggSpec(Agg.MAX, spec.out_name, spec.out_name))
+        elif spec.func is Agg.SOME:
+            partial_aggs.append(spec)
+            final_aggs.append(AggSpec(Agg.SOME, spec.out_name, spec.out_name))
+        else:
+            raise NotImplementedError(f"two-phase split of {spec.func}")
+
+    if with_row_counts:
+        partial_aggs.append(AggSpec(Agg.COUNT_ALL, None, "__rows"))
+    partial = Program(
+        program.steps[:gb_idx]
+        + (GroupByStep(gb.keys, tuple(partial_aggs), gb.max_groups),)
+    )
+    out_names = tuple(gb.keys) + tuple(s.out_name for s in gb.aggs)
+    final_steps: list = [
+        GroupByStep(gb.keys, tuple(final_aggs), gb.max_groups)
+    ]
+    final_steps.extend(avg_fixups)
+    final_steps.append(ProjectStep(out_names))
+    final_steps.extend(program.steps[gb_idx + 1:])
+    return partial, Program(tuple(final_steps))

@@ -1,0 +1,327 @@
+"""TPC-H workload: deterministic data generator + queries as SSA programs.
+
+The reference ships dbgen-compatible generators and query runners
+(ydb/library/workload/tpch/, ydb/library/benchmarks/queries/tpch/,
+CLI `ydb workload tpch` — ydb_cli/commands/ydb_benchmark.cpp). This module
+is the TPU build's equivalent harness: a fast numpy generator with dbgen's
+column domains and distributions (uniform approximations; deterministic per
+seed — benchmark comparisons are engine-vs-engine on identical data, which
+is what BASELINE.md requires) and the benchmark queries expressed directly
+against the engine API.
+
+Dates are int32 days since epoch; money columns are decimal(2) scaled
+int64, matching dbgen's cent-exact semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.ssa.ops import Agg, Op
+from ydb_tpu.ssa.program import (
+    AggSpec,
+    AssignStep,
+    Call,
+    Col,
+    Const,
+    FilterStep,
+    GroupByStep,
+    Program,
+    SortStep,
+    decimal_lit,
+)
+
+DEC2 = dtypes.decimal(2)
+
+
+def _days(s: str) -> int:
+    return np.datetime64(s, "D").astype(np.int32).item()
+
+
+LINEITEM_SCHEMA = dtypes.schema(
+    ("l_orderkey", dtypes.INT64, False),
+    ("l_partkey", dtypes.INT64, False),
+    ("l_suppkey", dtypes.INT64, False),
+    ("l_linenumber", dtypes.INT32, False),
+    ("l_quantity", DEC2, False),
+    ("l_extendedprice", DEC2, False),
+    ("l_discount", DEC2, False),
+    ("l_tax", DEC2, False),
+    ("l_returnflag", dtypes.STRING, False),
+    ("l_linestatus", dtypes.STRING, False),
+    ("l_shipdate", dtypes.DATE, False),
+    ("l_commitdate", dtypes.DATE, False),
+    ("l_receiptdate", dtypes.DATE, False),
+    ("l_shipinstruct", dtypes.STRING, False),
+    ("l_shipmode", dtypes.STRING, False),
+)
+
+ORDERS_SCHEMA = dtypes.schema(
+    ("o_orderkey", dtypes.INT64, False),
+    ("o_custkey", dtypes.INT64, False),
+    ("o_orderstatus", dtypes.STRING, False),
+    ("o_totalprice", DEC2, False),
+    ("o_orderdate", dtypes.DATE, False),
+    ("o_orderpriority", dtypes.STRING, False),
+    ("o_shippriority", dtypes.INT32, False),
+)
+
+CUSTOMER_SCHEMA = dtypes.schema(
+    ("c_custkey", dtypes.INT64, False),
+    ("c_nationkey", dtypes.INT32, False),
+    ("c_mktsegment", dtypes.STRING, False),
+    ("c_acctbal", DEC2, False),
+)
+
+SUPPLIER_SCHEMA = dtypes.schema(
+    ("s_suppkey", dtypes.INT64, False),
+    ("s_nationkey", dtypes.INT32, False),
+    ("s_acctbal", DEC2, False),
+)
+
+NATION_SCHEMA = dtypes.schema(
+    ("n_nationkey", dtypes.INT32, False),
+    ("n_regionkey", dtypes.INT32, False),
+    ("n_name", dtypes.STRING, False),
+)
+
+REGION_SCHEMA = dtypes.schema(
+    ("r_regionkey", dtypes.INT32, False),
+    ("r_name", dtypes.STRING, False),
+)
+
+NATIONS = [
+    b"ALGERIA", b"ARGENTINA", b"BRAZIL", b"CANADA", b"EGYPT", b"ETHIOPIA",
+    b"FRANCE", b"GERMANY", b"INDIA", b"INDONESIA", b"IRAN", b"IRAQ",
+    b"JAPAN", b"JORDAN", b"KENYA", b"MOROCCO", b"MOZAMBIQUE", b"PERU",
+    b"CHINA", b"ROMANIA", b"SAUDI ARABIA", b"VIETNAM", b"RUSSIA",
+    b"UNITED KINGDOM", b"UNITED STATES",
+]
+NATION_REGION = [0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0, 0, 1, 2,
+                 3, 4, 2, 3, 3, 1]
+REGIONS = [b"AFRICA", b"AMERICA", b"ASIA", b"EUROPE", b"MIDDLE EAST"]
+SEGMENTS = [b"AUTOMOBILE", b"BUILDING", b"FURNITURE", b"MACHINERY",
+            b"HOUSEHOLD"]
+SHIPMODES = [b"REG AIR", b"AIR", b"RAIL", b"SHIP", b"TRUCK", b"MAIL", b"FOB"]
+INSTRUCTS = [b"DELIVER IN PERSON", b"COLLECT COD", b"NONE",
+             b"TAKE BACK RETURN"]
+PRIORITIES = [b"1-URGENT", b"2-HIGH", b"3-MEDIUM", b"4-NOT SPECIFIED",
+              b"5-LOW"]
+
+
+def _register(dicts: DictionarySet, col: str, values) -> np.ndarray:
+    d = dicts.for_column(col)
+    return np.fromiter((d.add(v) for v in values), dtype=np.int32,
+                       count=len(values))
+
+
+class TpchData:
+    """Generated tables as host numpy column dicts + shared dictionaries."""
+
+    def __init__(self, sf: float, seed: int = 42):
+        self.sf = sf
+        self.dicts = DictionarySet()
+        rng = np.random.default_rng(seed)
+        self.tables: dict[str, dict[str, np.ndarray]] = {}
+        self._gen_orders_lineitem(rng)
+        self._gen_customer(rng)
+        self._gen_supplier(rng)
+        self._gen_nation_region()
+
+    # dbgen cardinalities: orders = 1.5M * SF; lineitem ~ 4 lines/order
+    def _gen_orders_lineitem(self, rng):
+        n_orders = int(1_500_000 * self.sf)
+        n_cust = max(int(150_000 * self.sf), 1)
+        start = _days("1992-01-01")
+        end = _days("1998-08-02")
+        o_orderkey = np.arange(1, n_orders + 1, dtype=np.int64)
+        o_orderdate = rng.integers(start, end + 1, n_orders, dtype=np.int32)
+        o_custkey = rng.integers(1, n_cust + 1, n_orders, dtype=np.int64)
+        lines_per_order = rng.integers(1, 8, n_orders, dtype=np.int32)
+        n_li = int(lines_per_order.sum())
+
+        li_order_idx = np.repeat(np.arange(n_orders), lines_per_order)
+        l_orderkey = o_orderkey[li_order_idx]
+        l_linenumber = (
+            np.arange(n_li, dtype=np.int64)
+            - np.repeat(
+                np.cumsum(lines_per_order) - lines_per_order, lines_per_order
+            )
+            + 1
+        ).astype(np.int32)
+        n_part = max(int(200_000 * self.sf), 1)
+        n_supp = max(int(10_000 * self.sf), 1)
+        l_partkey = rng.integers(1, n_part + 1, n_li, dtype=np.int64)
+        l_suppkey = rng.integers(1, n_supp + 1, n_li, dtype=np.int64)
+        l_quantity = rng.integers(1, 51, n_li, dtype=np.int64) * 100
+        # dbgen: extendedprice = qty * part retail price (~90k-110k cents)
+        part_price = rng.integers(90_000, 110_001, n_li, dtype=np.int64)
+        l_extendedprice = (l_quantity // 100) * part_price // 100 * 100
+        l_discount = rng.integers(0, 11, n_li, dtype=np.int64)  # 0.00-0.10
+        l_tax = rng.integers(0, 9, n_li, dtype=np.int64)        # 0.00-0.08
+        ship_delay = rng.integers(1, 122, n_li, dtype=np.int32)
+        l_shipdate = o_orderdate[li_order_idx] + ship_delay
+        l_commitdate = o_orderdate[li_order_idx] + rng.integers(
+            30, 91, n_li, dtype=np.int32)
+        l_receiptdate = l_shipdate + rng.integers(1, 31, n_li, dtype=np.int32)
+
+        today = _days("1995-06-17")
+        shipped = l_shipdate <= today
+        # returnflag: R or A for shipped-long-ago (50/50), N otherwise
+        ret = np.where(
+            l_receiptdate > today,
+            2,  # N
+            rng.integers(0, 2, n_li),  # 0=R 1=A
+        )
+        rf_dict = self.dicts.for_column("l_returnflag")
+        ids = np.array([rf_dict.add(b"R"), rf_dict.add(b"A"),
+                        rf_dict.add(b"N")], dtype=np.int32)
+        l_returnflag = ids[ret]
+        ls_dict = self.dicts.for_column("l_linestatus")
+        ls_ids = np.array([ls_dict.add(b"O"), ls_dict.add(b"F")],
+                          dtype=np.int32)
+        l_linestatus = ls_ids[shipped.astype(np.int32)]
+        sm = rng.integers(0, len(SHIPMODES), n_li)
+        si = rng.integers(0, len(INSTRUCTS), n_li)
+        smd = self.dicts.for_column("l_shipmode")
+        sm_ids = np.array([smd.add(v) for v in SHIPMODES], dtype=np.int32)
+        sid = self.dicts.for_column("l_shipinstruct")
+        si_ids = np.array([sid.add(v) for v in INSTRUCTS], dtype=np.int32)
+
+        self.tables["lineitem"] = {
+            "l_orderkey": l_orderkey,
+            "l_partkey": l_partkey,
+            "l_suppkey": l_suppkey,
+            "l_linenumber": l_linenumber,
+            "l_quantity": l_quantity,
+            "l_extendedprice": l_extendedprice,
+            "l_discount": l_discount,
+            "l_tax": l_tax,
+            "l_returnflag": l_returnflag,
+            "l_linestatus": l_linestatus,
+            "l_shipdate": l_shipdate.astype(np.int32),
+            "l_commitdate": l_commitdate.astype(np.int32),
+            "l_receiptdate": l_receiptdate.astype(np.int32),
+            "l_shipinstruct": si_ids[si],
+            "l_shipmode": sm_ids[sm],
+        }
+        pr = rng.integers(0, len(PRIORITIES), n_orders)
+        prd = self.dicts.for_column("o_orderpriority")
+        pr_ids = np.array([prd.add(v) for v in PRIORITIES], dtype=np.int32)
+        osd = self.dicts.for_column("o_orderstatus")
+        os_ids = np.array([osd.add(b"O"), osd.add(b"F"), osd.add(b"P")],
+                          dtype=np.int32)
+        status = rng.integers(0, 3, n_orders)
+        self.tables["orders"] = {
+            "o_orderkey": o_orderkey,
+            "o_custkey": o_custkey,
+            "o_orderstatus": os_ids[status],
+            "o_totalprice": rng.integers(
+                100_00, 500_000_00, n_orders, dtype=np.int64),
+            "o_orderdate": o_orderdate,
+            "o_orderpriority": pr_ids[pr],
+            "o_shippriority": np.zeros(n_orders, dtype=np.int32),
+        }
+
+    def _gen_customer(self, rng):
+        n = max(int(150_000 * self.sf), 1)
+        seg = rng.integers(0, len(SEGMENTS), n)
+        sd = self.dicts.for_column("c_mktsegment")
+        seg_ids = np.array([sd.add(v) for v in SEGMENTS], dtype=np.int32)
+        self.tables["customer"] = {
+            "c_custkey": np.arange(1, n + 1, dtype=np.int64),
+            "c_nationkey": rng.integers(0, 25, n, dtype=np.int32),
+            "c_mktsegment": seg_ids[seg],
+            "c_acctbal": rng.integers(-999_99, 9999_99, n, dtype=np.int64),
+        }
+
+    def _gen_supplier(self, rng):
+        n = max(int(10_000 * self.sf), 1)
+        self.tables["supplier"] = {
+            "s_suppkey": np.arange(1, n + 1, dtype=np.int64),
+            "s_nationkey": rng.integers(0, 25, n, dtype=np.int32),
+            "s_acctbal": rng.integers(-999_99, 9999_99, n, dtype=np.int64),
+        }
+
+    def _gen_nation_region(self):
+        self.tables["nation"] = {
+            "n_nationkey": np.arange(25, dtype=np.int32),
+            "n_regionkey": np.array(NATION_REGION, dtype=np.int32),
+            "n_name": _register(self.dicts, "n_name", NATIONS),
+        }
+        self.tables["region"] = {
+            "r_regionkey": np.arange(5, dtype=np.int32),
+            "r_name": _register(self.dicts, "r_name", REGIONS),
+        }
+
+    def schema(self, table: str) -> dtypes.Schema:
+        return {
+            "lineitem": LINEITEM_SCHEMA,
+            "orders": ORDERS_SCHEMA,
+            "customer": CUSTOMER_SCHEMA,
+            "supplier": SUPPLIER_SCHEMA,
+            "nation": NATION_SCHEMA,
+            "region": REGION_SCHEMA,
+        }[table]
+
+
+# ---------------- queries as SSA programs ----------------
+
+
+def q1_program() -> Program:
+    """TPC-H Q1: pricing summary report (the BASELINE north-star scan).
+
+    select l_returnflag, l_linestatus, sum(qty), sum(price),
+           sum(price*(1-disc)), sum(price*(1-disc)*(1+tax)),
+           avg(qty), avg(price), avg(disc), count(*)
+    from lineitem where l_shipdate <= '1998-12-01' - 90 days
+    group by l_returnflag, l_linestatus order by same
+    """
+    cutoff = _days("1998-12-01") - 90
+    one = decimal_lit("1", 2)
+    disc_price = Call(Op.MUL, Col("l_extendedprice"),
+                      Call(Op.SUB, one, Col("l_discount")))
+    # charge: scale-6 decimal; int64 sums hold through ~SF-10 (SF-100 needs
+    # the planned two-word accumulator)
+    charge = Call(Op.MUL, Col("disc_price"),
+                  Call(Op.ADD, one, Col("l_tax")))
+    return Program((
+        FilterStep(Call(Op.LE, Col("l_shipdate"),
+                        Const(cutoff, dtypes.DATE))),
+        AssignStep("disc_price", disc_price),
+        AssignStep("charge", charge),
+        GroupByStep(
+            keys=("l_returnflag", "l_linestatus"),
+            aggs=(
+                AggSpec(Agg.SUM, "l_quantity", "sum_qty"),
+                AggSpec(Agg.SUM, "l_extendedprice", "sum_base_price"),
+                AggSpec(Agg.SUM, "disc_price", "sum_disc_price"),
+                AggSpec(Agg.SUM, "charge", "sum_charge"),
+                AggSpec(Agg.AVG, "l_quantity", "avg_qty"),
+                AggSpec(Agg.AVG, "l_extendedprice", "avg_price"),
+                AggSpec(Agg.AVG, "l_discount", "avg_disc"),
+                AggSpec(Agg.COUNT_ALL, None, "count_order"),
+            ),
+        ),
+        SortStep(keys=("l_returnflag", "l_linestatus")),
+    ))
+
+
+def q6_program() -> Program:
+    """TPC-H Q6: forecasting revenue change (pure filter + global agg)."""
+    d0 = _days("1994-01-01")
+    d1 = _days("1995-01-01")
+    return Program((
+        FilterStep(Call(Op.GE, Col("l_shipdate"), Const(d0, dtypes.DATE))),
+        FilterStep(Call(Op.LT, Col("l_shipdate"), Const(d1, dtypes.DATE))),
+        FilterStep(Call(Op.GE, Col("l_discount"), decimal_lit("0.05", 2))),
+        FilterStep(Call(Op.LE, Col("l_discount"), decimal_lit("0.07", 2))),
+        FilterStep(Call(Op.LT, Col("l_quantity"), decimal_lit("24", 2))),
+        AssignStep("revenue_item",
+                   Call(Op.MUL, Col("l_extendedprice"), Col("l_discount"))),
+        GroupByStep(keys=(), aggs=(
+            AggSpec(Agg.SUM, "revenue_item", "revenue"),
+        )),
+    ))
