@@ -1,0 +1,223 @@
+"""Join kernels + multi-table plan execution (Q3/Q5), cross-checked
+against independent python-dict reference joins."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks import TableBlock
+from ydb_tpu.plan import Database, execute_plan, to_host
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.ssa import join as jk
+from ydb_tpu.ssa import kernels
+from ydb_tpu.workload import tpch
+
+
+def _block(**cols):
+    sch = []
+    arrays = {}
+    validity = {}
+    for name, spec in cols.items():
+        arr, t = spec[0], spec[1]
+        sch.append((name, t))
+        arrays[name] = np.asarray(arr)
+        if len(spec) > 2:
+            validity[name] = np.asarray(spec[2])
+    return TableBlock.from_numpy(arrays, dtypes.schema(*sch), validity or None)
+
+
+def test_lookup_join_inner_left_semi_anti():
+    probe = _block(
+        k=([1, 2, 3, 2, 9], dtypes.INT64),
+        pv=([10, 20, 30, 21, 90], dtypes.INT64),
+    )
+    build = _block(
+        bk=([2, 3, 4], dtypes.INT64),
+        bv=([200, 300, 400], dtypes.INT64),
+    )
+    joined, found = jk.lookup_join(probe, build, ["k"], ["bk"], ["bv"])
+    inner = kernels.compact(joined, found)
+    res = TableBlock.to_numpy(inner)
+    np.testing.assert_array_equal(res["k"], [2, 3, 2])
+    np.testing.assert_array_equal(res["bv"], [200, 300, 200])
+
+    # left: unmatched rows keep NULL payload
+    lres = joined.validity_numpy()
+    assert lres["bv"].tolist() == [False, True, True, True, False]
+
+    semi = kernels.compact(probe, found)
+    assert TableBlock.to_numpy(semi)["k"].tolist() == [2, 3, 2]
+    anti = kernels.compact(probe, ~found & probe.row_mask())
+    assert TableBlock.to_numpy(anti)["k"].tolist() == [1, 9]
+
+
+def test_lookup_join_null_keys_never_match():
+    probe = _block(k=([1, 1], dtypes.INT64, [True, False]))
+    build = _block(bk=([1], dtypes.INT64), bv=([5], dtypes.INT64))
+    _, found = jk.lookup_join(probe, build, ["k"], ["bk"], ["bv"])
+    assert np.asarray(found)[:2].tolist() == [True, False]
+
+
+def test_two_column_key_packing():
+    probe = _block(
+        a=([1, 1, 2], dtypes.INT64),
+        b=([7, 8, 7], dtypes.INT64),
+    )
+    build = _block(
+        x=([1, 2], dtypes.INT64),
+        y=([7, 7], dtypes.INT64),
+        v=([100, 200], dtypes.INT64),
+    )
+    _, found = jk.lookup_join(probe, build, ["a", "b"], ["x", "y"], ["v"])
+    assert np.asarray(found)[:3].tolist() == [True, False, True]
+
+
+def test_expand_join_n_to_m():
+    probe = _block(k=([1, 2, 3], dtypes.INT64), p=([10, 20, 30], dtypes.INT64))
+    build = _block(k2=([2, 2, 1, 5], dtypes.INT64),
+                   q=([201, 202, 101, 501], dtypes.INT64))
+    out, total = jk.expand_join(
+        probe, build, ["k"], ["k2"], ["k", "p"], ["q"], out_capacity=16
+    )
+    assert int(total) == 3
+    res = TableBlock.to_numpy(out)
+    got = sorted(zip(res["k"].tolist(), res["q"].tolist()))
+    assert got == [(1, 101), (2, 201), (2, 202)]
+
+
+def test_expand_join_overflow_reports_total():
+    probe = _block(k=([7] * 4, dtypes.INT64))
+    build = _block(k2=([7] * 4, dtypes.INT64), q=(list(range(4)), dtypes.INT64))
+    out, total = jk.expand_join(
+        probe, build, ["k"], ["k2"], ["k"], ["q"], out_capacity=8
+    )
+    assert int(total) == 16  # 4x4 cross on same key; caller must retry
+    assert int(out.length) == 8
+
+
+# ---------------- reference joins for Q3/Q5 ----------------
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch.TpchData(sf=0.01, seed=23)
+
+
+@pytest.fixture(scope="module")
+def db(data):
+    return Database(
+        sources={
+            t: ColumnSource(cols, data.schema(t), data.dicts)
+            for t, cols in data.tables.items()
+        },
+        dicts=data.dicts,
+    )
+
+
+def _ref_q3(data):
+    t = data.tables
+    d = tpch._days("1995-03-15")
+    seg = data.dicts["c_mktsegment"].eq_id(b"BUILDING")
+    cust = set(t["customer"]["c_custkey"][
+        t["customer"]["c_mktsegment"] == seg].tolist())
+    omask = (t["orders"]["o_orderdate"] < d) & np.isin(
+        t["orders"]["o_custkey"], list(cust))
+    orders = {
+        k: (dt, sp)
+        for k, dt, sp in zip(
+            t["orders"]["o_orderkey"][omask],
+            t["orders"]["o_orderdate"][omask],
+            t["orders"]["o_shippriority"][omask],
+        )
+    }
+    li = t["lineitem"]
+    lmask = li["l_shipdate"] > d
+    agg = {}
+    for ok, price, disc in zip(
+        li["l_orderkey"][lmask], li["l_extendedprice"][lmask],
+        li["l_discount"][lmask],
+    ):
+        if int(ok) in orders:
+            dt, sp = orders[int(ok)]
+            key = (int(ok), int(dt), int(sp))
+            agg[key] = agg.get(key, 0) + int(price) * (100 - int(disc))
+    rows = sorted(agg.items(), key=lambda kv: (-kv[1], kv[0][1], kv[0][0]))[:10]
+    return rows
+
+
+def test_q3_matches_reference(db, data):
+    out = to_host(execute_plan(tpch.q3_plan(), db))
+    ref = _ref_q3(data)
+    assert out.num_rows == len(ref)
+    for i, ((ok, dt, sp), rev) in enumerate(ref):
+        assert int(out.cols["l_orderkey"][0][i]) == ok
+        assert int(out.cols["o_orderdate"][0][i]) == dt
+        assert int(out.cols["revenue"][0][i]) == rev
+
+
+def _ref_q5(data):
+    t = data.tables
+    d0, d1 = tpch._days("1994-01-01"), tpch._days("1995-01-01")
+    asia = data.dicts["r_name"].eq_id(b"ASIA")
+    rk = set(t["region"]["r_regionkey"][
+        t["region"]["r_name"] == asia].tolist())
+    nations = {
+        int(nk): int(nm)
+        for nk, nrk, nm in zip(
+            t["nation"]["n_nationkey"], t["nation"]["n_regionkey"],
+            t["nation"]["n_name"])
+        if int(nrk) in rk
+    }
+    omask = (t["orders"]["o_orderdate"] >= d0) & (
+        t["orders"]["o_orderdate"] < d1)
+    orders = dict(zip(
+        t["orders"]["o_orderkey"][omask].tolist(),
+        t["orders"]["o_custkey"][omask].tolist(),
+    ))
+    supp = dict(zip(t["supplier"]["s_suppkey"].tolist(),
+                    t["supplier"]["s_nationkey"].tolist()))
+    cust = dict(zip(t["customer"]["c_custkey"].tolist(),
+                    t["customer"]["c_nationkey"].tolist()))
+    li = t["lineitem"]
+    agg = {}
+    for ok, sk, price, disc in zip(
+        li["l_orderkey"].tolist(), li["l_suppkey"].tolist(),
+        li["l_extendedprice"].tolist(), li["l_discount"].tolist(),
+    ):
+        ck = orders.get(ok)
+        if ck is None:
+            continue
+        sn = supp[sk]
+        if sn not in nations or cust[ck] != sn:
+            continue
+        agg[sn] = agg.get(sn, 0) + price * (100 - disc)
+    return sorted(
+        ((nations[sn], rev) for sn, rev in agg.items()),
+        key=lambda kv: -kv[1],
+    )
+
+
+def test_q5_matches_reference(db, data):
+    out = to_host(execute_plan(tpch.q5_plan(), db))
+    ref = _ref_q5(data)
+    assert out.num_rows == len(ref)
+    np.testing.assert_array_equal(
+        out.cols["revenue"][0], [rev for _, rev in ref]
+    )
+    np.testing.assert_array_equal(
+        out.cols["n_name"][0], [nm for nm, _ in ref]
+    )
+
+
+def test_lookup_join_int64_max_key_matches():
+    """No value sentinel: INT64_MAX is a legitimate joinable key."""
+    big = np.iinfo(np.int64).max
+    probe = _block(k=([big, 5], dtypes.INT64))
+    build = _block(bk=([big], dtypes.INT64), bv=([1], dtypes.INT64))
+    _, found = jk.lookup_join(probe, build, ["k"], ["bk"], ["bv"])
+    assert np.asarray(found)[:2].tolist() == [True, False]
+    out, total = jk.expand_join(
+        probe, build, ["k"], ["bk"], ["k"], ["bv"], out_capacity=8
+    )
+    assert int(total) == 1
+    assert TableBlock.to_numpy(out)["k"].tolist() == [big]

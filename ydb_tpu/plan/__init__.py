@@ -1,0 +1,8 @@
+from ydb_tpu.plan.nodes import (  # noqa: F401
+    ExpandJoin,
+    LookupJoin,
+    PlanNode,
+    TableScan,
+    Transform,
+)
+from ydb_tpu.plan.executor import Database, execute_plan, to_host  # noqa: F401

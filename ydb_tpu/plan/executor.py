@@ -1,0 +1,124 @@
+"""Single-chip plan executor: walks the logical plan bottom-up.
+
+The host-side analog of the KQP executer driving stage tasks
+(kqp_executer_impl.h:120) collapsed to one device: scans stream blocks
+through compiled SSA (ydb_tpu.engine.scan), joins run the device kernels
+(ydb_tpu.ssa.join), transforms compile against the inferred intermediate
+schema. Intermediate results materialize as single blocks — streaming
+stage pipelining arrives with the DQ layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.block import TableBlock, concat_blocks
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
+from ydb_tpu.ssa import join as join_kernels
+from ydb_tpu.ssa import kernels
+from ydb_tpu.ssa.compiler import compile_program
+from ydb_tpu.plan.nodes import (
+    ExpandJoin,
+    LookupJoin,
+    PlanNode,
+    TableScan,
+    Transform,
+)
+
+
+@dataclasses.dataclass
+class Database:
+    """Named host tables + shared dictionaries (one 'shard' worth).
+
+    ``_compile_cache`` memoizes compiled Transform programs per
+    (program, schema) — the XLA-era computation-pattern cache
+    (mkql_computation_pattern_cache.h). Ingest that extends dictionaries
+    must call ``invalidate_compile_cache()`` (plan-time dictionary tables
+    bake into the cached aux)."""
+
+    sources: dict[str, ColumnSource]
+    dicts: DictionarySet | None = None
+    key_spaces: dict[str, int] | None = None
+    _compile_cache: dict = dataclasses.field(default_factory=dict)
+
+    def invalidate_compile_cache(self):
+        self._compile_cache.clear()
+
+
+def _materialize(source: ColumnSource, columns) -> TableBlock:
+    names = columns if columns is not None else source.schema.names
+    blocks = list(source.blocks(block_rows=1 << 40, columns=names))
+    return blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
+
+
+def execute_plan(plan: PlanNode, db: Database) -> TableBlock:
+    if isinstance(plan, TableScan):
+        src = db.sources[plan.table]
+        if plan.program is None:
+            return _materialize(src, plan.columns)
+        ex = ScanExecutor(
+            plan.program, src, block_rows=1 << 22,
+            key_spaces=db.key_spaces,
+        )
+        partials = [
+            ex.run_block(b)
+            for b in src.blocks(1 << 22, ex.read_cols)
+        ]
+        out = ex.finalize(partials) if ex.final is not None else (
+            partials[0] if len(partials) == 1 else concat_blocks(partials)
+        )
+        return out
+    if isinstance(plan, LookupJoin):
+        probe = execute_plan(plan.probe, db)
+        build = execute_plan(plan.build, db)
+        joined, found = join_kernels.lookup_join(
+            probe, build, list(plan.probe_keys), list(plan.build_keys),
+            list(plan.payload), plan.suffix,
+        )
+        if plan.kind == "inner":
+            return kernels.compact(joined, found)
+        if plan.kind == "left":
+            return joined
+        if plan.kind == "semi":
+            return kernels.compact(probe, found)
+        if plan.kind == "anti":
+            return kernels.compact(probe, ~found & probe.row_mask())
+        raise ValueError(plan.kind)
+    if isinstance(plan, ExpandJoin):
+        probe = execute_plan(plan.probe, db)
+        build = execute_plan(plan.build, db)
+        cap = max(int(probe.capacity * plan.fanout_hint), 1024)
+        while True:
+            out, total = join_kernels.expand_join(
+                probe, build, list(plan.probe_keys), list(plan.build_keys),
+                list(plan.probe_payload), list(plan.build_payload),
+                out_capacity=cap, build_suffix=plan.build_suffix,
+            )
+            if int(total) <= cap:
+                return out
+            cap = int(int(total) + 1023) // 1024 * 1024  # exact retry
+    if isinstance(plan, Transform):
+        block = execute_plan(plan.input, db)
+        key = (plan.program, block.schema)
+        hit = db._compile_cache.get(key)
+        if hit is None:
+            cp = compile_program(
+                plan.program, block.schema, db.dicts, db.key_spaces
+            )
+            hit = (jax.jit(cp.run),
+                   {k: jnp.asarray(v) for k, v in cp.aux.items()})
+            db._compile_cache[key] = hit
+        run, aux = hit
+        return run(block, aux)
+    raise NotImplementedError(plan)
+
+
+def to_host(block: TableBlock) -> OracleTable:
+    return OracleTable.from_block(block)

@@ -1,0 +1,61 @@
+"""Logical query plan: the multi-table dataflow above SSA programs.
+
+The reference splits a query into stages connected by channels
+(dq_tasks.proto:190); each stage hosts a MiniKQL program, and joins are
+stage operators (GraceJoin/MapJoin). Here the plan is a small node tree:
+table scans carry pushed-down SSA programs (the kqp_olap pushdown shape,
+kqp_opt_phy_olap_filter.cpp), joins pick the N:1 lookup or N:M expand
+kernel, and Transform nodes run post-join SSA (aggregation/sort/having).
+The executor (plan/executor.py) walks it bottom-up; the distributed
+executor maps the same tree onto the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Union
+
+from ydb_tpu.ssa.program import Program
+
+
+@dataclasses.dataclass(frozen=True)
+class TableScan:
+    table: str
+    program: Program | None = None  # pushed-down filter/project/partial-agg
+    columns: tuple[str, ...] | None = None  # projection when no program
+
+
+@dataclasses.dataclass(frozen=True)
+class LookupJoin:
+    """N:1 equi-join (build keys unique): every TPC-H FK->PK join."""
+
+    probe: "PlanNode"
+    build: "PlanNode"
+    probe_keys: tuple[str, ...]
+    build_keys: tuple[str, ...]
+    payload: tuple[str, ...] = ()  # build columns carried to output
+    kind: str = "inner"  # inner | left | semi | anti
+    suffix: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpandJoin:
+    """N:M inner equi-join via static-capacity expansion."""
+
+    probe: "PlanNode"
+    build: "PlanNode"
+    probe_keys: tuple[str, ...]
+    build_keys: tuple[str, ...]
+    probe_payload: tuple[str, ...]
+    build_payload: tuple[str, ...]
+    fanout_hint: float = 4.0
+    build_suffix: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Transform:
+    input: "PlanNode"
+    program: Program
+
+
+PlanNode = Union[TableScan, LookupJoin, ExpandJoin, Transform]

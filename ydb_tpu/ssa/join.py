@@ -1,0 +1,179 @@
+"""Device equi-join kernels.
+
+The reference joins rows with hash tables (GraceJoin partitioned hash join
+mkql_grace_join.cpp:558, MapJoin broadcast mkql_map_join.cpp). Dynamic hash
+tables don't exist on TPU; the TPU-native designs here are sort-based with
+static shapes:
+
+  * ``lookup_join`` — N:1 join (probe side may repeat keys; build keys
+    unique, e.g. any FK -> PK join): sort build by key once, then
+    ``searchsorted`` + gather per probe row. Output shape == probe shape;
+    a found-mask drives inner/left/semi/anti variants. This covers every
+    TPC-H dimension join.
+  * ``expand_join`` — N:M join via prefix-sum expansion into a static
+    output capacity: per-probe match counts -> cumulative offsets ->
+    each output slot maps back to (probe row, k-th match) with two
+    searchsorted passes. Exact while total matches <= out capacity; the
+    returned total lets callers detect overflow and re-run with a larger
+    capacity (grace-style bucketing keeps capacities bounded after a
+    hash repartition).
+
+Multi-key joins pack keys into one int64 via the shuffle hash (exact for
+<=64-bit concatenations; otherwise hash with verify-on-gather).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ydb_tpu.blocks.block import Column, TableBlock
+
+
+def _key_i64(cols: list[Column]) -> jax.Array:
+    """Combine key columns into one int64 per row, exactly.
+
+    One column passes through; two int columns pack as (a << 32) | b —
+    exact while both values fit in 32 bits (all TPC-H/ClickBench composite
+    keys do, e.g. partsupp's (partkey, suppkey)). Wider composites need a
+    pre-assigned join-key column (planner's job), not a lossy hash: a hash
+    here would silently drop/duplicate matches on collision. Liveness /
+    NULL handling lives entirely in _join_keys_live.
+    """
+    if len(cols) == 1:
+        return cols[0].data.astype(jnp.int64)
+    if len(cols) == 2:
+        a = cols[0].data.astype(jnp.int64)
+        b = cols[1].data.astype(jnp.int64)
+        return (a << 32) | (b & jnp.int64(0xFFFFFFFF))
+    raise NotImplementedError(
+        ">2 join key columns: pre-pack a composite key column"
+    )
+
+
+def _sorted_build(bk: jax.Array, blive: jax.Array):
+    """Sort build keys with dead rows last, WITHOUT a value sentinel
+    (sentinels collide with legitimate INT64_MAX keys).
+
+    Returns (order, bk_sorted, n_live): live keys sorted in the prefix
+    [0, n_live); suffix positions are overwritten with the prefix's last
+    value so the whole array stays sorted for searchsorted. Matches are
+    validated against idx < n_live, so suffix duplicates never count.
+    """
+    perm_keys = (bk, ~blive)  # primary: liveness (live first), then key
+    order = jnp.lexsort(perm_keys)
+    bk_sorted = bk[order]
+    n_live = jnp.sum(blive).astype(jnp.int32)
+    cap = bk.shape[0]
+    last_live = bk_sorted[jnp.maximum(n_live - 1, 0)]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    bk_sorted = jnp.where(pos < n_live, bk_sorted, last_live)
+    return order, bk_sorted, n_live
+
+
+def _join_keys_live(block: TableBlock, keys: list[str]) -> tuple:
+    cols = [block.columns[k] for k in keys]
+    live = block.row_mask()
+    for c in cols:
+        live = live & c.validity  # NULL keys drop out of equi-joins
+    return _key_i64(cols), live
+
+
+def lookup_join(
+    probe: TableBlock,
+    build: TableBlock,
+    probe_keys: list[str],
+    build_keys: list[str],
+    payload: list[str],
+    suffix: str = "",
+) -> tuple[TableBlock, jax.Array]:
+    """N:1 equi-join: gather ``payload`` columns from build into probe.
+
+    Returns (probe + payload columns, found_mask). Build keys must be
+    unique among live rows (duplicate keys: one match wins). Inner join =
+    compact by found; left join = keep all, payload validity = found.
+    """
+    pk, plive = _join_keys_live(probe, probe_keys)
+    bk, blive = _join_keys_live(build, build_keys)
+
+    order, bk_sorted, n_live = _sorted_build(bk, blive)
+    idx = jnp.searchsorted(bk_sorted, pk)
+    idx = jnp.clip(idx, 0, bk_sorted.shape[0] - 1)
+    found = (idx < n_live) & (bk_sorted[idx] == pk) & plive
+    src = order[idx]
+
+    out_cols = dict(probe.columns)
+    sch = probe.schema
+    for name in payload:
+        c = build.columns[name]
+        out_name = name + suffix
+        out_cols[out_name] = Column(
+            c.data[src], c.validity[src] & found
+        )
+        f = build.schema.field(name)
+        if out_name not in sch:
+            from ydb_tpu import dtypes
+
+            sch = sch.with_field(dtypes.Field(out_name, f.type))
+    return TableBlock(out_cols, probe.length, sch), found
+
+
+def expand_join(
+    probe: TableBlock,
+    build: TableBlock,
+    probe_keys: list[str],
+    build_keys: list[str],
+    probe_payload: list[str],
+    build_payload: list[str],
+    out_capacity: int,
+    build_suffix: str = "",
+) -> tuple[TableBlock, jax.Array]:
+    """N:M inner equi-join with static output capacity.
+
+    Returns (joined block, total_matches). Rows beyond ``out_capacity``
+    are truncated — callers check ``total_matches <= out_capacity`` (host
+    side) and retry bigger or pre-partition (grace) if exceeded.
+    """
+    pk, plive = _join_keys_live(probe, probe_keys)
+    bk, blive = _join_keys_live(build, build_keys)
+
+    order, bk_sorted, n_live = _sorted_build(bk, blive)
+    lo = jnp.searchsorted(bk_sorted, pk, side="left")
+    hi = jnp.searchsorted(bk_sorted, pk, side="right")
+    # the suffix repeats the last live key: clamp ranges to the live prefix
+    lo = jnp.minimum(lo, n_live)
+    hi = jnp.minimum(hi, n_live)
+    # int64 accounting: skewed keys can exceed 2^31 matches, and a wrapped
+    # total would defeat the overflow-retry protocol
+    counts = jnp.where(plive, (hi - lo).astype(jnp.int64), jnp.int64(0))
+    offsets = jnp.cumsum(counts)  # inclusive
+    total = offsets[-1] if counts.shape[0] else jnp.int64(0)
+    starts = offsets - counts
+
+    # map each output slot j to (probe row i, k-th match)
+    j = jnp.arange(out_capacity, dtype=offsets.dtype)
+    i = jnp.searchsorted(offsets, j, side="right")
+    i = jnp.clip(i, 0, probe.capacity - 1)
+    valid_out = j < jnp.minimum(total, out_capacity)
+    k = j - starts[i]
+    b_src = order[jnp.clip(lo[i] + k, 0, build.capacity - 1)]
+
+    from ydb_tpu import dtypes
+
+    cols: dict[str, Column] = {}
+    fields = []
+    for name in probe_payload:
+        c = probe.columns[name]
+        cols[name] = Column(c.data[i], c.validity[i] & valid_out)
+        fields.append(probe.schema.field(name))
+    for name in build_payload:
+        c = build.columns[name]
+        out_name = name + build_suffix
+        cols[out_name] = Column(c.data[b_src], c.validity[b_src] & valid_out)
+        f = build.schema.field(name)
+        fields.append(dtypes.Field(out_name, f.type))
+    length = jnp.minimum(total, out_capacity).astype(jnp.int32)
+    return (
+        TableBlock(cols, length, dtypes.Schema(tuple(fields))),
+        total,
+    )

@@ -29,6 +29,7 @@ from ydb_tpu.ssa.program import (
     FilterStep,
     GroupByStep,
     Program,
+    ProjectStep,
     SortStep,
     decimal_lit,
 )
@@ -325,3 +326,109 @@ def q6_program() -> Program:
             AggSpec(Agg.SUM, "revenue_item", "revenue"),
         )),
     ))
+
+
+# ---------------- join queries as logical plans ----------------
+
+
+def q3_plan():
+    """TPC-H Q3: shipping priority (BASELINE config 4 join shape).
+
+    customer(BUILDING) semi-> orders(< date) -> lineitem(> date) joins,
+    then group by (l_orderkey, o_orderdate, o_shippriority), top-10 by
+    revenue.
+    """
+    from ydb_tpu.plan import LookupJoin, TableScan, Transform
+    from ydb_tpu.ssa.program import DictPredicate
+
+    date = _days("1995-03-15")
+    customers = TableScan("customer", Program((
+        FilterStep(DictPredicate("c_mktsegment", "eq", b"BUILDING")),
+        ProjectStep(("c_custkey",)),
+    )))
+    orders = TableScan("orders", Program((
+        FilterStep(Call(Op.LT, Col("o_orderdate"), Const(date, dtypes.DATE))),
+        ProjectStep(("o_orderkey", "o_custkey", "o_orderdate",
+                     "o_shippriority")),
+    )))
+    orders_building = LookupJoin(
+        probe=orders, build=customers,
+        probe_keys=("o_custkey",), build_keys=("c_custkey",), kind="semi",
+    )
+    lineitem = TableScan("lineitem", Program((
+        FilterStep(Call(Op.GT, Col("l_shipdate"), Const(date, dtypes.DATE))),
+        ProjectStep(("l_orderkey", "l_extendedprice", "l_discount")),
+    )))
+    joined = LookupJoin(
+        probe=lineitem, build=orders_building,
+        probe_keys=("l_orderkey",), build_keys=("o_orderkey",),
+        payload=("o_orderdate", "o_shippriority"), kind="inner",
+    )
+    return Transform(joined, Program((
+        AssignStep("rev_item", Call(Op.MUL, Col("l_extendedprice"),
+                   Call(Op.SUB, decimal_lit("1", 2), Col("l_discount")))),
+        GroupByStep(
+            keys=("l_orderkey", "o_orderdate", "o_shippriority"),
+            aggs=(AggSpec(Agg.SUM, "rev_item", "revenue"),),
+        ),
+        # l_orderkey tie-break pins the order beyond the spec's
+        # (revenue desc, date) for deterministic comparisons
+        SortStep(keys=("revenue", "o_orderdate", "l_orderkey"),
+                 descending=(True, False, False), limit=10),
+    )))
+
+
+def q5_plan():
+    """TPC-H Q5: local supplier volume (6-table join chain)."""
+    from ydb_tpu.plan import LookupJoin, TableScan, Transform
+    from ydb_tpu.ssa.program import DictPredicate
+
+    d0, d1 = _days("1994-01-01"), _days("1995-01-01")
+    region = TableScan("region", Program((
+        FilterStep(DictPredicate("r_name", "eq", b"ASIA")),
+        ProjectStep(("r_regionkey",)),
+    )))
+    nation = LookupJoin(
+        probe=TableScan("nation"), build=region,
+        probe_keys=("n_regionkey",), build_keys=("r_regionkey",),
+        kind="semi",
+    )
+    orders = TableScan("orders", Program((
+        FilterStep(Call(Op.GE, Col("o_orderdate"), Const(d0, dtypes.DATE))),
+        FilterStep(Call(Op.LT, Col("o_orderdate"), Const(d1, dtypes.DATE))),
+        ProjectStep(("o_orderkey", "o_custkey")),
+    )))
+    li = TableScan("lineitem", Program((
+        ProjectStep(("l_orderkey", "l_suppkey", "l_extendedprice",
+                     "l_discount")),
+    )))
+    li_orders = LookupJoin(
+        probe=li, build=orders,
+        probe_keys=("l_orderkey",), build_keys=("o_orderkey",),
+        payload=("o_custkey",), kind="inner",
+    )
+    li_supp = LookupJoin(
+        probe=li_orders, build=TableScan("supplier"),
+        probe_keys=("l_suppkey",), build_keys=("s_suppkey",),
+        payload=("s_nationkey",), kind="inner",
+    )
+    li_cust = LookupJoin(
+        probe=li_supp, build=TableScan("customer"),
+        probe_keys=("o_custkey",), build_keys=("c_custkey",),
+        payload=("c_nationkey",), kind="inner",
+    )
+    li_nation = LookupJoin(
+        probe=li_cust, build=nation,
+        probe_keys=("s_nationkey",), build_keys=("n_nationkey",),
+        payload=("n_name",), kind="inner",
+    )
+    return Transform(li_nation, Program((
+        # customer and supplier must share the nation
+        FilterStep(Call(Op.EQ, Call(Op.CAST_INT64, Col("c_nationkey")),
+                        Call(Op.CAST_INT64, Col("s_nationkey")))),
+        AssignStep("rev_item", Call(Op.MUL, Col("l_extendedprice"),
+                   Call(Op.SUB, decimal_lit("1", 2), Col("l_discount")))),
+        GroupByStep(keys=("n_name",),
+                    aggs=(AggSpec(Agg.SUM, "rev_item", "revenue"),)),
+        SortStep(keys=("revenue",), descending=(True,)),
+    )))
