@@ -1,0 +1,167 @@
+"""ColumnShard state-plane tests: MVCC, compaction, TTL, WAL recovery.
+
+Coverage mirrors the reference's columnshard ut_rw / engine change tests
+(tx/columnshard/ut_rw, engines/changes/*) at the capability level."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.blobs import DirBlobStore, MemBlobStore
+from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+from ydb_tpu.ssa import Agg, AggSpec, Call, Col, FilterStep, GroupByStep, Op
+from ydb_tpu.ssa.program import Program, lit
+
+SCHEMA = dtypes.schema(
+    ("id", dtypes.INT64, False),
+    ("ts", dtypes.DATE, False),
+    ("tag", dtypes.STRING),
+    ("val", dtypes.INT64),
+)
+
+
+def _shard(store=None, **cfg):
+    return ColumnShard(
+        "shard1", SCHEMA, store or MemBlobStore(),
+        pk_column="id", ttl_column="ts",
+        config=ShardConfig(**cfg) if cfg else None,
+    )
+
+
+def _write(shard, ids, ts=None, tags=None, vals=None):
+    n = len(ids)
+    cols = shard.encode_strings({
+        "id": np.asarray(ids, dtype=np.int64),
+        "ts": np.asarray(ts if ts is not None else [100] * n, dtype=np.int32),
+        "tag": tags if tags is not None else [b"x"] * n,
+        "val": np.asarray(vals if vals is not None else ids, dtype=np.int64),
+    })
+    return shard.write(cols)
+
+
+def _count(shard, snap=None):
+    prog = Program((
+        GroupByStep(keys=(), aggs=(AggSpec(Agg.COUNT_ALL, None, "n"),)),
+    ))
+    return int(shard.scan(prog, snap).cols["n"][0][0])
+
+
+def test_write_commit_scan_mvcc():
+    shard = _shard()
+    w1 = _write(shard, [1, 2, 3])
+    assert _count(shard) == 0  # uncommitted writes invisible
+    s1 = shard.commit([w1])
+    assert _count(shard) == 3
+    w2 = _write(shard, [4, 5])
+    s2 = shard.commit([w2])
+    assert _count(shard) == 5
+    # reads at the older snapshot still see the old state
+    assert _count(shard, s1) == 3
+    assert _count(shard, s2) == 5
+    assert _count(shard, 0) == 0
+
+
+def test_scan_with_program_and_strings():
+    shard = _shard()
+    shard.commit([_write(shard, [1, 2, 3, 4],
+                         tags=[b"a", b"b", b"a", b"c"],
+                         vals=[10, 20, 30, 40])])
+    from ydb_tpu.ssa.program import DictPredicate
+
+    prog = Program((
+        FilterStep(DictPredicate("tag", "eq", b"a")),
+        GroupByStep(keys=(), aggs=(AggSpec(Agg.SUM, "val", "s"),)),
+    ))
+    assert int(shard.scan(prog).cols["s"][0][0]) == 40
+
+
+def test_compaction_preserves_snapshots_and_sorts_pk():
+    shard = _shard()
+    s_old = None
+    for batch in ([5, 3], [9, 1], [7, 2]):
+        s_old = shard.commit([_write(shard, batch)])
+    assert len(shard.visible_portions()) == 3
+    shard.compact()
+    vis = shard.visible_portions()
+    assert len(vis) == 1
+    # merged portion is PK-sorted with correct stats
+    assert (vis[0].pk_min, vis[0].pk_max) == (1, 9)
+    assert _count(shard) == 6
+    # reader at the pre-compaction snapshot sees the old portions
+    assert _count(shard, s_old) == 6
+    metas_old = shard.visible_portions(s_old)
+    assert len(metas_old) == 3
+
+
+def test_pk_range_pruning():
+    shard = _shard()
+    shard.commit([_write(shard, [1, 2, 3])])
+    shard.commit([_write(shard, [100, 200])])
+    pruned = shard.visible_portions(pk_range=(150, None))
+    assert len(pruned) == 1
+    assert pruned[0].pk_min == 100
+
+
+def test_ttl_eviction():
+    shard = _shard()
+    shard.commit([_write(shard, [1, 2, 3], ts=[10, 20, 30])])
+    shard.commit([_write(shard, [4], ts=[50])])
+    evicted = shard.evict_ttl(cutoff=25)
+    assert evicted == 2
+    assert _count(shard) == 2
+    prog = Program((FilterStep(Call(Op.GE, Col("id"), lit(0))),))
+    res = shard.scan(prog)
+    assert sorted(res.cols["id"][0].tolist()) == [3, 4]
+
+
+def test_gc_blobs():
+    shard = _shard()
+    shard.commit([_write(shard, [1])])
+    shard.commit([_write(shard, [2])])
+    shard.compact()
+    n_before = len(shard.store.list("shard1/portion/"))
+    assert shard.gc_blobs(keep_snap=shard.snap) == 2
+    assert len(shard.store.list("shard1/portion/")) == n_before - 2
+    assert _count(shard) == 2  # live data untouched
+
+
+def test_boot_replays_wal(tmp_path):
+    store = DirBlobStore(str(tmp_path))
+    shard = ColumnShard("s", SCHEMA, store, pk_column="id",
+                        ttl_column="ts")
+    shard.commit([_write(shard, [1, 2], tags=[b"x", b"y"])])
+    shard.commit([_write(shard, [3], tags=[b"z"])])
+    snap = shard.snap
+
+    # new process: recover purely from storage
+    shard2 = ColumnShard.boot("s", SCHEMA, store, pk_column="id",
+                              ttl_column="ts")
+    assert shard2.snap == snap
+    assert _count(shard2) == 3
+    # dictionaries recovered (ids in portions must decode)
+    assert shard2.dicts["tag"].values == [b"x", b"y", b"z"]
+    # and the recovered shard continues writing correctly
+    shard2.commit([_write(shard2, [4], tags=[b"w"])])
+    assert _count(shard2) == 4
+
+
+def test_boot_from_checkpoint_plus_tail(tmp_path):
+    store = DirBlobStore(str(tmp_path))
+    cfg = ShardConfig(checkpoint_interval=2)
+    shard = ColumnShard("s", SCHEMA, store, pk_column="id", config=cfg)
+    for i in range(5):
+        shard.commit([_write(shard, [i * 10 + 1, i * 10 + 2])])
+    shard2 = ColumnShard.boot("s", SCHEMA, store, pk_column="id", config=cfg)
+    assert _count(shard2) == 10
+    assert shard2.snap == shard.snap
+    assert shard2.next_portion_id == shard.next_portion_id
+
+
+def test_auto_compaction_trigger():
+    shard = _shard(compact_portion_threshold=3)
+    shard.commit([_write(shard, [1])])
+    shard.commit([_write(shard, [2])])
+    assert not shard.maybe_compact()
+    shard.commit([_write(shard, [3])])
+    assert shard.maybe_compact()
+    assert len(shard.visible_portions()) == 1

@@ -1,0 +1,109 @@
+"""Blob storage behind a narrow Put/Get/Delete interface.
+
+The reference's BlobStorage is a distributed erasure-coded store reached
+through per-group DSProxy actors (TEvPut/TEvGet, dsproxy_put.cpp:29;
+SURVEY.md §2.3). The TPU-era equivalent (§2.3 header) is a persistent
+object store behind the same narrow surface: tablets never see disks,
+only blob ids. Backends:
+
+  * ``MemBlobStore``  — in-process fake for deterministic tests (the
+    pattern of the reference's fake storages, e.g. wrappers/fake_storage.h)
+  * ``DirBlobStore``  — local filesystem directory (one file per blob),
+    crash-safe via write-to-temp + atomic rename
+
+A real deployment points this at an object store (GCS/S3); the interface
+is deliberately async-free here — the host runtime wraps calls in worker
+threads (conveyor analog) when overlap matters.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+class BlobStore:
+    def put(self, blob_id: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, blob_id: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, blob_id: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, blob_id: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> list[str]:
+        raise NotImplementedError
+
+
+class MemBlobStore(BlobStore):
+    def __init__(self):
+        self._data: dict[str, bytes] = {}
+
+    def put(self, blob_id, data):
+        self._data[blob_id] = bytes(data)
+
+    def get(self, blob_id):
+        return self._data[blob_id]
+
+    def delete(self, blob_id):
+        self._data.pop(blob_id, None)
+
+    def exists(self, blob_id):
+        return blob_id in self._data
+
+    def list(self, prefix=""):
+        return sorted(k for k in self._data if k.startswith(prefix))
+
+
+class DirBlobStore(BlobStore):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, blob_id: str) -> str:
+        from urllib.parse import quote
+
+        return os.path.join(self.root, quote(blob_id, safe=""))
+
+    def put(self, blob_id, data):
+        # temp + rename: a crash mid-write never leaves a torn blob
+        fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".tmp.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(blob_id))
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def get(self, blob_id):
+        with open(self._path(blob_id), "rb") as f:
+            return f.read()
+
+    def delete(self, blob_id):
+        try:
+            os.unlink(self._path(blob_id))
+        except FileNotFoundError:
+            pass
+
+    def exists(self, blob_id):
+        return os.path.exists(self._path(blob_id))
+
+    def list(self, prefix=""):
+        from urllib.parse import quote, unquote
+
+        enc_prefix = quote(prefix, safe="")
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith(".tmp."):
+                continue
+            if name.startswith(enc_prefix):
+                out.append(unquote(name))
+        return sorted(out)

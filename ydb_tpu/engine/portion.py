@@ -1,0 +1,86 @@
+"""Portions: immutable columnar data units with PK stats.
+
+Reference: a ColumnShard's data is a set of *portions* — per-column blobs
+plus metadata (row count, PK min/max, snapshot) grouped into granules
+(TPortionInfo, engines/portion_info.h; SURVEY.md §2.7). Scans plan by
+intersecting portion PK ranges with the query range at a snapshot.
+
+Here a portion serializes all columns into one npz blob (validity masks
+included for nullable columns); metadata lives in the shard's WAL/snapshot
+(not in the blob), so planning never touches blob storage. Column data is
+the *physical* encoding (dict ids, scaled decimals) — dictionaries are
+table-level state owned by the shard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.blobs import BlobStore
+
+
+@dataclasses.dataclass
+class PortionMeta:
+    portion_id: int
+    blob_id: str
+    num_rows: int
+    # MVCC window: visible when commit_snap <= snap < removed_snap
+    commit_snap: int
+    removed_snap: int | None = None
+    # PK range stats for scan pruning (min/max of the first PK column)
+    pk_min: int | None = None
+    pk_max: int | None = None
+    # min/max of the TTL column, for eviction planning
+    ttl_min: int | None = None
+    ttl_max: int | None = None
+
+    def visible_at(self, snap: int) -> bool:
+        if self.commit_snap > snap:
+            return False
+        return self.removed_snap is None or snap < self.removed_snap
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "PortionMeta":
+        return PortionMeta(**d)
+
+
+def write_portion_blob(
+    store: BlobStore,
+    blob_id: str,
+    columns: dict[str, np.ndarray],
+    validity: dict[str, np.ndarray] | None = None,
+) -> None:
+    buf = io.BytesIO()
+    payload = dict(columns)
+    if validity:
+        for name, v in validity.items():
+            payload[f"__valid__{name}"] = v
+    np.savez(buf, **payload)
+    store.put(blob_id, buf.getvalue())
+
+
+def read_portion_blob(
+    store: BlobStore, blob_id: str
+) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray]]:
+    with np.load(io.BytesIO(store.get(blob_id))) as z:
+        cols = {}
+        valid = {}
+        for name in z.files:
+            if name.startswith("__valid__"):
+                valid[name[len("__valid__"):]] = z[name]
+            else:
+                cols[name] = z[name]
+    return cols, valid
+
+
+def column_stats(arr: np.ndarray) -> tuple[int | None, int | None]:
+    if arr.size == 0 or not np.issubdtype(arr.dtype, np.integer):
+        return None, None
+    return int(arr.min()), int(arr.max())

@@ -110,6 +110,16 @@ def required_columns(program: Program, schema: dtypes.Schema) -> tuple[str, ...]
             for nm in s.names:
                 if nm not in assigned:
                     used.add(nm)
+    if not used:
+        # pure COUNT(*)-style programs still need one column for the row
+        # count; read the narrowest physical column (the reference reads a
+        # system column)
+        if not schema.fields:
+            return ()
+        cheapest = min(
+            schema.fields, key=lambda f: f.type.physical.itemsize
+        )
+        return (cheapest.name,)
     return tuple(n for n in schema.names if n in used)
 
 

@@ -1,0 +1,470 @@
+"""ColumnShard: the OLAP partition tablet (host state plane).
+
+Mirror of the reference's ColumnShard + column engine
+(tx/columnshard/columnshard_impl.h:134; TColumnEngineForLogs
+engines/column_engine_logs.h:40; SURVEY.md §2.7), redesigned for the TPU
+split: ALL durable state is host-side (TPUs never own durability —
+SURVEY.md §7.0 plane 3); scans hand device-ready blocks to the kernel
+plane.
+
+State machine:
+  * ``write(batch)``       — buffered rows under a write id (insert table,
+                             columnshard__write.cpp shape)
+  * ``commit(write_ids)``  — assigns the next snapshot, flushes buffered
+                             rows into an immutable *portion* (blob +
+                             meta) and logs the change
+  * ``scan(program, snap)``— plans visible portions at the snapshot (MVCC
+                             window + PK-range pruning), streams blocks
+                             through the compiled program
+                             (ydb_tpu.engine.scan)
+  * ``compact()``          — merges small portions into one, sorted by PK
+                             (general_compaction.cpp analog); old portions
+                             get removed_snap, readers at older snapshots
+                             still see them
+  * ``evict_ttl(cutoff)``  — drops rows older than the TTL cutoff by
+                             rewriting affected portions (ttl.cpp analog)
+  * durability             — every mutation appends a WAL record; periodic
+                             ``checkpoint()`` writes a full-state snapshot;
+                             ``ColumnShard.boot`` = snapshot + WAL replay
+                             (tablet_flat boot logic, flat_boot_*.h analog)
+
+Local write ids stand in for the reference's long-tx writes; the
+distributed coordinator (ydb_tpu.tx) supplies cross-shard snapshots.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.blobs import BlobStore
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.engine.portion import (
+    PortionMeta,
+    column_stats,
+    read_portion_blob,
+    write_portion_blob,
+)
+from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
+from ydb_tpu.ssa.program import Program
+
+
+@dataclasses.dataclass
+class ShardConfig:
+    # compaction triggers when this many live portions exist
+    compact_portion_threshold: int = 8
+    # checkpoint every N WAL records
+    checkpoint_interval: int = 64
+    scan_block_rows: int = 1 << 20
+
+
+class ColumnShard:
+    def __init__(
+        self,
+        shard_id: str,
+        schema: dtypes.Schema,
+        store: BlobStore,
+        pk_column: str | None = None,
+        ttl_column: str | None = None,
+        config: ShardConfig | None = None,
+        dicts: DictionarySet | None = None,
+    ):
+        self.shard_id = shard_id
+        self.schema = schema
+        self.store = store
+        self.pk_column = pk_column
+        self.ttl_column = ttl_column
+        self.config = config or ShardConfig()
+        # dicts may be shared table-wide across shards (ids must agree for
+        # cross-shard merges); sharing implies single-process ingest
+        self.dicts = dicts if dicts is not None else DictionarySet()
+        # when part of a coordinated shard group, background operations
+        # take their snapshots from the global plan-step clock so local
+        # bumps never collide with coordinator-assigned steps
+        self.snap_source = None  # Optional[Callable[[], int]]
+
+        self.snap: int = 0           # last committed snapshot
+        self.next_portion_id = 1
+        self.portions: dict[int, PortionMeta] = {}
+        self._insert_buffer: dict[int, dict] = {}  # write_id -> batch
+        self._next_write_id = 1
+        self._wal_seq = 0
+        self._records_since_checkpoint = 0
+        # per-column dictionary size already made durable; portions carry
+        # dict ids, so dictionary growth must be WAL-logged with the
+        # portion that introduced it
+        self._dict_durable_sizes: dict[str, int] = {}
+
+    # ---------------- write path ----------------
+
+    def write(
+        self,
+        columns: dict[str, np.ndarray],
+        validity: dict[str, np.ndarray] | None = None,
+    ) -> int:
+        """Buffer a batch; returns the write id (uncommitted, invisible)."""
+        for f in self.schema.fields:
+            if f.name not in columns:
+                raise KeyError(f"missing column {f.name}")
+        n = len(next(iter(columns.values())))
+        for name, arr in columns.items():
+            if len(arr) != n:
+                raise ValueError("ragged batch")
+        wid = self._next_write_id
+        self._next_write_id += 1
+        self._insert_buffer[wid] = {
+            "columns": {
+                k: np.asarray(v, dtype=self.schema.field(k).type.physical)
+                for k, v in columns.items()
+            },
+            "validity": {k: np.asarray(v) for k, v in (validity or {}).items()},
+        }
+        return wid
+
+    def encode_strings(
+        self, columns: dict[str, np.ndarray | list]
+    ) -> dict[str, np.ndarray]:
+        """Dictionary-encode raw bytes/str values for string columns."""
+        out = {}
+        for name, vals in columns.items():
+            f = self.schema.field(name)
+            if f.type.is_string and not (
+                isinstance(vals, np.ndarray) and vals.dtype.kind == "i"
+            ):
+                out[name] = self.dicts.for_column(name).encode(list(vals))
+            else:
+                out[name] = np.asarray(vals)
+        return out
+
+    # -- distributed-commit participant protocol (ydb_tpu.tx.Coordinator) --
+
+    def prepare(self, write_ids: list[int]) -> list[int]:
+        """Validate and lock write ids for a coordinated commit."""
+        missing = [w for w in write_ids if w not in self._insert_buffer]
+        if missing:
+            raise KeyError(f"unknown write ids {missing}")
+        return list(write_ids)
+
+    def commit_at(self, write_ids: list[int], step: int) -> int:
+        """Commit prepared writes at a coordinator-assigned plan step."""
+        if step <= self.snap:
+            raise ValueError(
+                f"plan step {step} not ahead of shard snapshot {self.snap}"
+            )
+        return self._commit(write_ids, step)
+
+    def abort(self, write_ids: list[int]) -> None:
+        for w in write_ids:
+            self._insert_buffer.pop(w, None)
+
+    def commit(self, write_ids: list[int]) -> int:
+        """Single-shard commit at the next local snapshot. Do not mix with
+        coordinated commit_at on the same shard group — the coordinator
+        owns global time there."""
+        return self._commit(write_ids, self.snap + 1)
+
+    def _commit(self, write_ids: list[int], snap: int) -> int:
+        batches = [self._insert_buffer.pop(w) for w in write_ids]
+        self.snap = snap
+        if not batches:
+            self._log({"op": "noop", "snap": snap})
+            return snap
+        cols = {
+            f.name: np.concatenate([b["columns"][f.name] for b in batches])
+            for f in self.schema.fields
+        }
+        validity = {}
+        for f in self.schema.fields:
+            parts = []
+            any_mask = False
+            for b in batches:
+                n = len(next(iter(b["columns"].values())))
+                v = b["validity"].get(f.name)
+                if v is None:
+                    v = np.ones(n, dtype=bool)
+                else:
+                    any_mask = True
+                parts.append(v)
+            if any_mask:
+                validity[f.name] = np.concatenate(parts)
+        self._add_portion(cols, validity, snap)
+        return snap
+
+    def _add_portion(self, cols, validity, snap, removed=None) -> PortionMeta:
+        pid = self.next_portion_id
+        self.next_portion_id += 1
+        blob_id = f"{self.shard_id}/portion/{pid}"
+        write_portion_blob(self.store, blob_id, cols, validity)
+        meta = PortionMeta(
+            portion_id=pid,
+            blob_id=blob_id,
+            num_rows=len(next(iter(cols.values()))) if cols else 0,
+            commit_snap=snap,
+        )
+        if self.pk_column and self.pk_column in cols:
+            meta.pk_min, meta.pk_max = column_stats(cols[self.pk_column])
+        if self.ttl_column and self.ttl_column in cols:
+            meta.ttl_min, meta.ttl_max = column_stats(cols[self.ttl_column])
+        self.portions[pid] = meta
+        self._log({"op": "add_portion", "meta": meta.to_json(),
+                   "snap": snap, "removed": removed or [],
+                   "dict_delta": self._dict_delta()})
+        return meta
+
+    def _dict_delta(self) -> dict:
+        """New dictionary entries since last durable point (WAL payload)."""
+        delta = {}
+        for col in self.dicts.columns():
+            d = self.dicts[col]
+            done = self._dict_durable_sizes.get(col, 0)
+            if len(d) > done:
+                delta[col] = [
+                    v.decode("latin1") for v in d.values[done:]
+                ]
+                self._dict_durable_sizes[col] = len(d)
+        return delta
+
+    # ---------------- scan path ----------------
+
+    def visible_portions(
+        self, snap: int | None = None,
+        pk_range: tuple[int | None, int | None] | None = None,
+    ) -> list[PortionMeta]:
+        snap = self.snap if snap is None else snap
+        out = []
+        for meta in self.portions.values():
+            if not meta.visible_at(snap):
+                continue
+            if pk_range and meta.pk_min is not None:
+                lo, hi = pk_range
+                if lo is not None and meta.pk_max is not None and meta.pk_max < lo:
+                    continue
+                if hi is not None and meta.pk_min is not None and meta.pk_min > hi:
+                    continue
+            out.append(meta)
+        return sorted(out, key=lambda m: m.portion_id)
+
+    def _materialize(
+        self, metas: list[PortionMeta], columns: tuple[str, ...] | None = None
+    ) -> tuple[dict, dict]:
+        names = columns if columns is not None else self.schema.names
+        cols = {n: [] for n in names}
+        valid = {n: [] for n in names}
+        for meta in metas:
+            c, v = read_portion_blob(self.store, meta.blob_id)
+            for n in names:
+                cols[n].append(c[n])
+                valid[n].append(
+                    v.get(n, np.ones(len(c[n]), dtype=bool))
+                )
+        out_c = {n: np.concatenate(cols[n]) if cols[n] else
+                 np.empty(0, dtype=self.schema.field(n).type.physical)
+                 for n in names}
+        out_v = {n: np.concatenate(valid[n]) if valid[n] else
+                 np.empty(0, dtype=bool) for n in names}
+        return out_c, out_v
+
+    def source_at(
+        self, snap: int | None = None,
+        columns: tuple[str, ...] | None = None,
+        pk_range=None,
+    ) -> ColumnSource:
+        metas = self.visible_portions(snap, pk_range)
+        cols, valid = self._materialize(metas, columns)
+        sch = self.schema if columns is None else self.schema.select(columns)
+        return ColumnSource(cols, sch, self.dicts, valid)
+
+    def scan(
+        self, program: Program, snap: int | None = None,
+        key_spaces: dict[str, int] | None = None,
+    ) -> OracleTable:
+        from ydb_tpu.engine.scan import execute_scan, required_columns
+
+        cols = required_columns(program, self.schema)
+        src = self.source_at(snap, cols)
+        return execute_scan(
+            program, src, self.config.scan_block_rows, key_spaces
+        )
+
+    # ---------------- background: compaction / TTL ----------------
+
+    def maybe_compact(self) -> bool:
+        if len(self.visible_portions()) >= self.config.compact_portion_threshold:
+            self.compact()
+            return True
+        return False
+
+    def _advance_snap(self) -> int:
+        if self.snap_source is not None:
+            s = self.snap_source()
+            if s <= self.snap:
+                raise ValueError(
+                    f"snapshot source went backwards: {s} <= {self.snap}"
+                )
+        else:
+            s = self.snap + 1
+        self.snap = s
+        return s
+
+    def compact(self) -> None:
+        """Merge all visible portions into one, PK-sorted."""
+        metas = self.visible_portions()
+        if len(metas) <= 1:
+            return
+        cols, valid = self._materialize(metas)
+        if self.pk_column:
+            order = np.argsort(cols[self.pk_column], kind="stable")
+            cols = {n: a[order] for n, a in cols.items()}
+            valid = {n: a[order] for n, a in valid.items()}
+        snap = self._advance_snap()
+        removed = []
+        for m in metas:
+            m.removed_snap = snap
+            removed.append(m.portion_id)
+        self._add_portion(cols, valid, snap, removed=removed)
+
+    def evict_ttl(self, cutoff: int) -> int:
+        """Drop rows whose TTL column < cutoff. Returns rows evicted."""
+        if not self.ttl_column:
+            return 0
+        evicted = 0
+        metas = [
+            m for m in self.visible_portions()
+            if m.ttl_min is not None and m.ttl_min < cutoff
+        ]
+        if not metas:
+            return 0
+        snap = self._advance_snap()
+        for meta in metas:
+            cols, valid = self._materialize([meta])
+            keep = cols[self.ttl_column] >= cutoff
+            evicted += int((~keep).sum())
+            meta.removed_snap = snap
+            if keep.any():
+                kept_c = {n: a[keep] for n, a in cols.items()}
+                kept_v = {n: a[keep] for n, a in valid.items()}
+                self._add_portion(kept_c, kept_v, snap,
+                                  removed=[meta.portion_id])
+            else:
+                self._log({"op": "remove_portion", "snap": snap,
+                           "portion_id": meta.portion_id})
+        return evicted
+
+    def gc_blobs(self, keep_snap: int) -> int:
+        """Delete blobs of portions invisible at and after keep_snap
+        (BlobStorage collect-garbage analog). Returns blobs deleted."""
+        dead = [
+            pid for pid, m in self.portions.items()
+            if m.removed_snap is not None and m.removed_snap <= keep_snap
+        ]
+        for pid in dead:
+            self.store.delete(self.portions[pid].blob_id)
+            del self.portions[pid]
+        if dead:
+            self._log({"op": "gc", "portions": dead, "snap": self.snap})
+        return len(dead)
+
+    # ---------------- durability: WAL + checkpoint + boot ----------------
+
+    def _log(self, record: dict) -> None:
+        self._wal_seq += 1
+        record["seq"] = self._wal_seq
+        self.store.put(
+            f"{self.shard_id}/wal/{self._wal_seq:012d}",
+            json.dumps(record).encode(),
+        )
+        self._records_since_checkpoint += 1
+        if self._records_since_checkpoint >= self.config.checkpoint_interval:
+            self.checkpoint()
+
+    def checkpoint(self) -> None:
+        state = {
+            "snap": self.snap,
+            "next_portion_id": self.next_portion_id,
+            "wal_seq": self._wal_seq,
+            "portions": [m.to_json() for m in self.portions.values()],
+            "dicts": {
+                col: [v.decode("latin1") for v in
+                      self.dicts[col].values]
+                for col in self.dicts.columns()
+            },
+        }
+        self.store.put(
+            f"{self.shard_id}/checkpoint",
+            json.dumps(state).encode(),
+        )
+        # WAL records up to wal_seq are now redundant
+        for bid in self.store.list(f"{self.shard_id}/wal/"):
+            self.store.delete(bid)
+        self._records_since_checkpoint = 0
+        for col in self.dicts.columns():
+            self._dict_durable_sizes[col] = len(self.dicts[col])
+
+    @staticmethod
+    def boot(
+        shard_id: str,
+        schema: dtypes.Schema,
+        store: BlobStore,
+        pk_column: str | None = None,
+        ttl_column: str | None = None,
+        config: ShardConfig | None = None,
+    ) -> "ColumnShard":
+        """Recover shard state: checkpoint + WAL replay (flat_boot analog)."""
+        shard = ColumnShard(shard_id, schema, store, pk_column, ttl_column,
+                            config)
+        ckpt_id = f"{shard_id}/checkpoint"
+        base_seq = 0
+        if store.exists(ckpt_id):
+            state = json.loads(store.get(ckpt_id).decode())
+            shard.snap = state["snap"]
+            shard.next_portion_id = state["next_portion_id"]
+            shard._wal_seq = state["wal_seq"]
+            base_seq = state["wal_seq"]
+            for mj in state["portions"]:
+                m = PortionMeta.from_json(mj)
+                shard.portions[m.portion_id] = m
+            for col, values in state.get("dicts", {}).items():
+                d = shard.dicts.for_column(col)
+                for v in values:
+                    d.add(v.encode("latin1"))
+        # replay WAL after the checkpoint
+        for bid in store.list(f"{shard_id}/wal/"):
+            rec = json.loads(store.get(bid).decode())
+            if rec["seq"] <= base_seq:
+                continue
+            shard._replay(rec)
+        for col in shard.dicts.columns():
+            shard._dict_durable_sizes[col] = len(shard.dicts[col])
+        return shard
+
+    def _replay(self, rec: dict) -> None:
+        op = rec["op"]
+        self._wal_seq = max(self._wal_seq, rec["seq"])
+        self.snap = max(self.snap, rec.get("snap", 0))
+        if op == "add_portion":
+            meta = PortionMeta.from_json(rec["meta"])
+            self.portions[meta.portion_id] = meta
+            self.next_portion_id = max(self.next_portion_id,
+                                       meta.portion_id + 1)
+            for pid in rec.get("removed", []):
+                if pid in self.portions:
+                    self.portions[pid].removed_snap = rec["snap"]
+            for col, values in rec.get("dict_delta", {}).items():
+                d = self.dicts.for_column(col)
+                for v in values:
+                    d.add(v.encode("latin1"))
+        elif op == "remove_portion":
+            pid = rec["portion_id"]
+            if pid in self.portions:
+                self.portions[pid].removed_snap = rec["snap"]
+        elif op == "gc":
+            for pid in rec["portions"]:
+                self.portions.pop(pid, None)
+        elif op == "noop":
+            pass
+        else:
+            raise ValueError(f"unknown WAL op {op}")

@@ -63,10 +63,14 @@ def execute_plan(plan: PlanNode, db: Database) -> TableBlock:
         src = db.sources[plan.table]
         if plan.program is None:
             return _materialize(src, plan.columns)
-        ex = ScanExecutor(
-            plan.program, src, block_rows=1 << 22,
-            key_spaces=db.key_spaces,
-        )
+        key = (plan.table, plan.program)
+        ex = db._compile_cache.get(key)
+        if ex is None:
+            ex = ScanExecutor(
+                plan.program, src, block_rows=1 << 22,
+                key_spaces=db.key_spaces,
+            )
+            db._compile_cache[key] = ex
         partials = [
             ex.run_block(b)
             for b in src.blocks(1 << 22, ex.read_cols)

@@ -107,14 +107,20 @@ def lookup_join(
     for name in payload:
         c = build.columns[name]
         out_name = name + suffix
+        if out_name in probe.columns:
+            # a silent overwrite would leave the schema typed as the probe
+            # column while the data came from the build side
+            raise ValueError(
+                f"payload column {out_name!r} collides with a probe column;"
+                " pass a suffix"
+            )
         out_cols[out_name] = Column(
             c.data[src], c.validity[src] & found
         )
         f = build.schema.field(name)
-        if out_name not in sch:
-            from ydb_tpu import dtypes
+        from ydb_tpu import dtypes
 
-            sch = sch.with_field(dtypes.Field(out_name, f.type))
+        sch = sch.with_field(dtypes.Field(out_name, f.type))
     return TableBlock(out_cols, probe.length, sch), found
 
 

@@ -1,0 +1,2 @@
+from ydb_tpu.tx.coordinator import Coordinator, TxResult  # noqa: F401
+from ydb_tpu.tx.sharded import ShardedTable  # noqa: F401

@@ -1,0 +1,81 @@
+"""Deterministic distributed commit: the coordinator/mediator plane.
+
+Reference (SURVEY.md §2.5, §3.2-commit): a Coordinator tablet assigns
+monotonically increasing *plan steps* to proposed transactions, batches
+them, and Mediators fan the planned tx ids to participant tablets, which
+execute planned txs in step order; MVCC snapshots read at (step, tx) time.
+Volatile txs skip the coordinator round for single-step commits.
+
+TPU build: transactions are host-side metadata operations (the device
+never participates in commit). This module keeps the same contract in one
+process — the coordinator is the single source of global time:
+
+  * ``propose(participants)`` assigns the next plan step
+  * every participant shard commits *at that step* (ColumnShard.commit
+    with an explicit snapshot), all-or-nothing per the prepare checks
+  * a read snapshot is just a plan step: readers at step S see exactly
+    the transactions planned <= S on every shard — the same guarantee
+    the reference's mediator time barrier provides
+
+The multi-node version replaces direct calls with the runtime actor shim
+(ydb_tpu.runtime) carrying the same messages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+
+@dataclasses.dataclass
+class TxResult:
+    txid: int
+    step: int
+    committed: bool
+    error: str | None = None
+
+
+class Coordinator:
+    """Global plan-step clock + two-phase commit driver."""
+
+    def __init__(self, start_step: int = 0):
+        self._lock = threading.Lock()
+        self._step = start_step
+        self._next_txid = 1
+
+    @property
+    def last_step(self) -> int:
+        return self._step
+
+    def read_snapshot(self) -> int:
+        """Current consistent read point (mediator-time analog)."""
+        with self._lock:
+            return self._step
+
+    def plan(self) -> tuple[int, int]:
+        """Assign (txid, step) for a new transaction."""
+        with self._lock:
+            self._step += 1
+            txid = self._next_txid
+            self._next_txid += 1
+            return txid, self._step
+
+    def commit(self, participants: list, prepare_args: list) -> TxResult:
+        """Two-phase commit: prepare on every participant, then commit all
+        at one plan step; abort (release) everywhere on any failure.
+
+        ``participants`` expose prepare(args) -> token, commit_at(token,
+        step), abort(token).
+        """
+        txid, step = self.plan()
+        tokens = []
+        try:
+            for p, args in zip(participants, prepare_args):
+                tokens.append(p.prepare(args))
+        except Exception as e:  # prepare failed somewhere: abort prepared
+            for p, t in zip(participants, tokens):
+                p.abort(t)
+            return TxResult(txid, step, False, f"prepare: {e}")
+        for p, t in zip(participants, tokens):
+            p.commit_at(t, step)
+        return TxResult(txid, step, True)

@@ -1,0 +1,141 @@
+"""Sharded tables: partition parallelism over ColumnShards with
+coordinated commits and consistent cross-shard snapshots.
+
+Reference shape (SURVEY.md §2.11 row 1): a table splits into tablets by PK
+range (row) or hash sharding function (OLAP, tx/sharding/); writes route
+by the sharding function, distributed commits ride coordinator plan steps,
+and scans fan out per shard and merge. Here:
+
+  * ``insert`` routes rows by hash(pk) % n_shards, writes each shard's
+    slice, and commits everything at ONE coordinator plan step — readers
+    at any step see all-or-nothing across shards
+  * ``scan`` runs the partial program per shard (one compiled executable
+    shared across shards — same schema, same block capacity) and merges
+    partials with the final program, exactly the MeshScan dataflow with
+    host-side shards standing in for mesh devices
+  * dictionaries are table-level, shared by all shards, so ids agree in
+    cross-shard merges
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.block import concat_blocks
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.blobs import BlobStore
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
+from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+from ydb_tpu.ssa.program import Program
+from ydb_tpu.tx.coordinator import Coordinator, TxResult
+
+
+def _fnv_route(keys: np.ndarray, n_shards: int) -> np.ndarray:
+    """Deterministic row -> shard routing (tx/sharding hash analog)."""
+    h = keys.astype(np.uint64)
+    h ^= h >> 33
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> 33
+    return (h % np.uint64(n_shards)).astype(np.int64)
+
+
+class ShardedTable:
+    def __init__(
+        self,
+        name: str,
+        schema: dtypes.Schema,
+        store: BlobStore,
+        coordinator: Coordinator,
+        n_shards: int = 4,
+        pk_column: str | None = None,
+        ttl_column: str | None = None,
+        config: ShardConfig | None = None,
+    ):
+        self.name = name
+        self.schema = schema
+        self.coordinator = coordinator
+        self.pk_column = pk_column or schema.names[0]
+        self.dicts = DictionarySet()
+        self.shards = [
+            ColumnShard(
+                f"{name}/{i}", schema, store,
+                pk_column=self.pk_column, ttl_column=ttl_column,
+                config=config, dicts=self.dicts,
+            )
+            for i in range(n_shards)
+        ]
+        for s in self.shards:
+            s.snap_source = lambda: coordinator.plan()[1]
+
+    # ---------------- writes ----------------
+
+    def insert(
+        self,
+        columns: dict[str, np.ndarray | list],
+        validity: dict[str, np.ndarray] | None = None,
+    ) -> TxResult:
+        """Route rows by PK hash, write every shard, commit at one step."""
+        enc = self.shards[0].encode_strings(columns)
+        n = len(next(iter(enc.values())))
+        route = _fnv_route(
+            np.asarray(enc[self.pk_column], dtype=np.int64),
+            len(self.shards),
+        )
+        participants, prepare_args = [], []
+        for i, shard in enumerate(self.shards):
+            mask = route == i
+            if not mask.any():
+                continue
+            cols_i = {k: np.asarray(v)[mask] for k, v in enc.items()}
+            val_i = (
+                {k: np.asarray(v)[mask] for k, v in validity.items()}
+                if validity else None
+            )
+            wid = shard.write(cols_i, val_i)
+            participants.append(shard)
+            prepare_args.append([wid])
+        return self.coordinator.commit(participants, prepare_args)
+
+    # ---------------- reads ----------------
+
+    def scan(
+        self,
+        program: Program,
+        snap: int | None = None,
+        key_spaces: dict[str, int] | None = None,
+        block_rows: int = 1 << 20,
+    ) -> OracleTable:
+        """Fan out per shard, merge partials (the DQ scan fan-out shape)."""
+        snap = self.coordinator.read_snapshot() if snap is None else snap
+        from ydb_tpu.engine.scan import required_columns
+
+        cols = required_columns(program, self.schema)
+        sources = [s.source_at(snap, cols) for s in self.shards]
+        ex = ScanExecutor(program, sources[0], block_rows, key_spaces)
+        partials = []
+        for src in sources:
+            if src.num_rows == 0:
+                continue
+            for b in src.blocks(block_rows, ex.read_cols):
+                partials.append(ex.run_block(b))
+        if not partials:
+            empty = sources[0]
+            return ScanExecutor(program, empty, block_rows,
+                                key_spaces).execute()
+        if ex.final is None:
+            return OracleTable.from_block(concat_blocks(partials))
+        return OracleTable.from_block(ex.finalize(partials))
+
+    # ---------------- background ----------------
+
+    def run_background(self, ttl_cutoff: int | None = None) -> dict:
+        """One background maintenance pass over all shards."""
+        stats = {"compacted": 0, "evicted": 0}
+        for s in self.shards:
+            if s.maybe_compact():
+                stats["compacted"] += 1
+            if ttl_cutoff is not None:
+                stats["evicted"] += s.evict_ttl(ttl_cutoff)
+        return stats
