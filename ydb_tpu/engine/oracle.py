@@ -139,6 +139,10 @@ def _eval(expr, cols, types, dicts, n) -> ColT:
                     table[i] = True
             if expr.kind == "not_in_set":
                 table = ~table
+        elif expr.kind == "custom":
+            from ydb_tpu.ssa.compiler import _custom_dict_mask
+
+            table = _custom_dict_mask(d, expr.pattern)
         else:
             raise NotImplementedError(expr.kind)
         if len(table) == 0:

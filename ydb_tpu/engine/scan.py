@@ -160,6 +160,13 @@ class ScanExecutor:
             self.final = None
             self.out_schema = self.partial.out_schema
 
+    def detach(self) -> "ScanExecutor":
+        """Drop the source reference: compiled state only. Callers that
+        cache executors across source replacements (plan executor) must
+        not pin the original table's arrays."""
+        self.source = None
+        return self
+
     def run_block(self, block: TableBlock) -> TableBlock:
         return self._partial_jit(block, self._partial_aux)
 

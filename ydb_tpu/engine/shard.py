@@ -361,11 +361,14 @@ class ColumnShard:
             pid for pid, m in self.portions.items()
             if m.removed_snap is not None and m.removed_snap <= keep_snap
         ]
+        if not dead:
+            return 0
+        # log BEFORE deleting: a crash in between leaks blobs (re-collected
+        # later) instead of leaving metadata pointing at deleted blobs
+        self._log({"op": "gc", "portions": dead, "snap": self.snap})
         for pid in dead:
             self.store.delete(self.portions[pid].blob_id)
             del self.portions[pid]
-        if dead:
-            self._log({"op": "gc", "portions": dead, "snap": self.snap})
         return len(dead)
 
     # ---------------- durability: WAL + checkpoint + boot ----------------

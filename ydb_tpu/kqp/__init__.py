@@ -1,0 +1,1 @@
+from ydb_tpu.kqp.session import Cluster, Session  # noqa: F401

@@ -1,0 +1,223 @@
+"""Query-processor sessions: SQL in, results out.
+
+The compact analog of the reference's KQP session path (SURVEY.md §3.2):
+gRPC request → session actor → compile (cached) → execute. Here:
+
+  * ``Cluster`` owns storage (blob store + coordinator + sharded tables)
+    and the schema catalog — the in-process stand-in for a node's service
+    set (driver_lib/run analog); the API layer (ydb_tpu.api) serves it
+    over the wire
+  * ``Session.execute(sql)`` parses, consults the per-cluster plan cache
+    (keyed on SQL text — the compile-service LRU shape,
+    kqp_compile_service.cpp), plans against the catalog, and runs the
+    plan executor at a consistent read snapshot
+
+DDL (CREATE TABLE) and DML (INSERT) execute directly against the state
+plane with coordinated commits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.blobs import BlobStore, MemBlobStore
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.plan import Database, execute_plan, to_host
+from ydb_tpu.sql import ast
+from ydb_tpu.sql.parser import parse
+from ydb_tpu.sql.planner import Catalog, PlanError, plan_select
+from ydb_tpu.tx import Coordinator, ShardedTable
+from ydb_tpu.tx.coordinator import TxResult
+
+_TYPE_MAP = {
+    "int8": dtypes.INT8, "int16": dtypes.INT16, "int32": dtypes.INT32,
+    "int": dtypes.INT32, "int64": dtypes.INT64, "bigint": dtypes.INT64,
+    "uint64": dtypes.UINT64, "float": dtypes.FLOAT, "double": dtypes.DOUBLE,
+    "bool": dtypes.BOOL, "date": dtypes.DATE, "timestamp": dtypes.TIMESTAMP,
+    "string": dtypes.STRING, "utf8": dtypes.STRING, "text": dtypes.STRING,
+}
+
+
+def _parse_type(t: str) -> dtypes.LogicalType:
+    t = t.lower()
+    if t.startswith("decimal"):
+        if "(" in t:
+            s = int(t.split(",")[1].rstrip(")"))
+        else:
+            s = 0
+        return dtypes.decimal(s)
+    if t in _TYPE_MAP:
+        return _TYPE_MAP[t]
+    raise PlanError(f"unknown type {t}")
+
+
+class Cluster:
+    """Storage + catalog + plan cache: one in-process database."""
+
+    def __init__(
+        self,
+        store: BlobStore | None = None,
+        n_shards: int = 4,
+        plan_cache_size: int = 128,
+    ):
+        self.store = store if store is not None else MemBlobStore()
+        self.coordinator = Coordinator()
+        self.n_shards = n_shards
+        self.tables: dict[str, ShardedTable] = {}
+        self.dicts = DictionarySet()  # cluster-wide, shared by all tables
+        self._plan_cache: OrderedDict = OrderedDict()
+        self._plan_cache_size = plan_cache_size
+
+    # ---- DDL / DML ----
+
+    def create_table(self, stmt: ast.CreateTable) -> None:
+        if stmt.table in self.tables:
+            raise PlanError(f"table {stmt.table} already exists")
+        fields = []
+        for name, typ, not_null in stmt.columns:
+            fields.append(dtypes.Field(name, _parse_type(typ), not not_null))
+        schema = dtypes.Schema(tuple(fields))
+        pk = stmt.primary_key[0] if stmt.primary_key else fields[0].name
+        t = ShardedTable(
+            stmt.table, schema, self.store, self.coordinator,
+            n_shards=self.n_shards, pk_column=pk,
+        )
+        t.dicts = self.dicts
+        for s in t.shards:
+            s.dicts = self.dicts
+        self.tables[stmt.table] = t
+        self._plan_cache.clear()
+
+    def insert(self, stmt: ast.Insert) -> TxResult:
+        t = self.tables.get(stmt.table)
+        if t is None:
+            raise PlanError(f"unknown table {stmt.table}")
+        names = stmt.columns or t.schema.names
+        cols: dict[str, list] = {n: [] for n in names}
+        validity: dict[str, list] = {n: [] for n in names}
+        for row in stmt.rows:
+            if len(row) != len(names):
+                raise PlanError("row arity mismatch")
+            for n, e in zip(names, row):
+                v, ok = _literal_value(e, t.schema.field(n).type)
+                cols[n].append(v)
+                validity[n].append(ok)
+        missing = [n for n in t.schema.names if n not in cols]
+        if missing:
+            raise PlanError(f"INSERT must set all columns; missing {missing}")
+        arrays = {}
+        for n in names:
+            f = t.schema.field(n)
+            if f.type.is_string:
+                arrays[n] = cols[n]
+            else:
+                arrays[n] = np.asarray(cols[n], dtype=f.type.physical)
+        val = {n: np.asarray(v, dtype=bool) for n, v in validity.items()}
+        res = t.insert(arrays, val)
+        # new dictionary entries may invalidate cached plan aux tables
+        self._plan_cache.clear()
+        return res
+
+    # ---- query path ----
+
+    def catalog(self) -> Catalog:
+        return Catalog(
+            schemas={n: t.schema for n, t in self.tables.items()},
+            primary_keys={
+                n: (t.pk_column,) for n, t in self.tables.items()
+            },
+            dicts=self.dicts,
+        )
+
+    def snapshot_db(self, snap: int | None = None) -> Database:
+        snap = self.coordinator.read_snapshot() if snap is None else snap
+        sources = {}
+        for name, t in self.tables.items():
+            merged = _merge_shard_sources(t, snap)
+            sources[name] = merged
+        return Database(sources=sources, dicts=self.dicts)
+
+    def plan(self, sql: str):
+        hit = self._plan_cache.get(sql)
+        if hit is not None:
+            self._plan_cache.move_to_end(sql)
+            return hit
+        stmt = parse(sql)
+        if not isinstance(stmt, ast.Select):
+            return stmt
+        p = plan_select(stmt, self.catalog())
+        self._plan_cache[sql] = p
+        while len(self._plan_cache) > self._plan_cache_size:
+            self._plan_cache.popitem(last=False)
+        return p
+
+    def session(self) -> "Session":
+        return Session(self)
+
+
+def _merge_shard_sources(t: ShardedTable, snap: int) -> ColumnSource:
+    parts = [s.source_at(snap) for s in t.shards]
+    cols = {
+        n: np.concatenate([p.columns[n] for p in parts])
+        for n in t.schema.names
+    }
+    validity = {}
+    for n in t.schema.names:
+        vs = [
+            p.validity[n] if p.validity and n in p.validity
+            else np.ones(len(p.columns[n]), dtype=bool)
+            for p in parts
+        ]
+        validity[n] = np.concatenate(vs)
+    return ColumnSource(cols, t.schema, t.dicts, validity)
+
+
+def _literal_value(e: ast.Expr, t: dtypes.LogicalType):
+    """Evaluate an INSERT literal to (physical value, validity)."""
+    if isinstance(e, ast.Literal):
+        if e.kind == "null":
+            return (b"" if t.is_string else 0), False
+        if e.kind == "string":
+            if t.is_string:
+                return e.value.encode(), True
+            raise PlanError(f"string literal for {t}")
+        if e.kind == "decimal":
+            import decimal as pydec
+
+            return int(
+                pydec.Decimal(e.value).scaleb(t.scale).to_integral_value()
+            ), True
+        if e.kind in ("int", "bool"):
+            if t.is_decimal:
+                return int(e.value) * 10 ** t.scale, True
+            return e.value, True
+    if isinstance(e, ast.UnOp) and e.op == "neg":
+        v, ok = _literal_value(e.operand, t)
+        return -v, ok
+    if isinstance(e, ast.FuncCall) and e.name == "date":
+        return int(np.datetime64(e.args[0].value, "D").astype(np.int32)), True
+    raise PlanError(f"unsupported INSERT value {e}")
+
+
+@dataclasses.dataclass
+class Session:
+    """One client session (kqp_session_actor analog)."""
+
+    cluster: Cluster
+
+    def execute(self, sql: str):
+        """Returns OracleTable for SELECT, TxResult for INSERT, None DDL."""
+        planned = self.cluster.plan(sql)
+        if isinstance(planned, ast.CreateTable):
+            self.cluster.create_table(planned)
+            return None
+        if isinstance(planned, ast.Insert):
+            return self.cluster.insert(planned)
+        db = self.cluster.snapshot_db()
+        return to_host(execute_plan(planned, db))

@@ -69,7 +69,7 @@ def execute_plan(plan: PlanNode, db: Database) -> TableBlock:
             ex = ScanExecutor(
                 plan.program, src, block_rows=1 << 22,
                 key_spaces=db.key_spaces,
-            )
+            ).detach()  # cache compiled state, not the source arrays
             db._compile_cache[key] = ex
         partials = [
             ex.run_block(b)

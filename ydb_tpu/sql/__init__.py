@@ -1,0 +1,2 @@
+from ydb_tpu.sql.parser import parse  # noqa: F401
+from ydb_tpu.sql.planner import plan_select  # noqa: F401

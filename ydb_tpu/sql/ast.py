@@ -1,0 +1,144 @@
+"""SQL AST nodes (unresolved names; the planner binds them).
+
+The reference parses SQL into an expression graph via NSQLTranslation →
+TExprNode (SURVEY.md §2 layer 7a). This is the TPU build's lean analog: a
+typed AST for the supported dialect subset, produced by
+ydb_tpu.sql.parser and consumed by ydb_tpu.sql.planner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Union
+
+
+@dataclasses.dataclass(frozen=True)
+class Name:
+    """Possibly qualified column reference (t.col or col)."""
+
+    parts: tuple[str, ...]
+
+    @property
+    def column(self) -> str:
+        return self.parts[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal:
+    value: Any
+    kind: str  # int | float | string | null | bool | decimal
+
+
+@dataclasses.dataclass(frozen=True)
+class BinOp:
+    op: str
+    left: "Expr"
+    right: "Expr"
+
+
+@dataclasses.dataclass(frozen=True)
+class UnOp:
+    op: str
+    operand: "Expr"
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncCall:
+    name: str
+    args: tuple["Expr", ...]
+    star: bool = False  # count(*)
+
+
+@dataclasses.dataclass(frozen=True)
+class Between:
+    expr: "Expr"
+    low: "Expr"
+    high: "Expr"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList:
+    expr: "Expr"
+    items: tuple["Expr", ...]
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Like:
+    expr: "Expr"
+    pattern: str
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull:
+    expr: "Expr"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Case:
+    whens: tuple[tuple["Expr", "Expr"], ...]
+    else_: "Expr | None"
+
+
+Expr = Union[Name, Literal, BinOp, UnOp, FuncCall, Between, InList, Like,
+             IsNull, Case]
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: str | None
+
+
+@dataclasses.dataclass(frozen=True)
+class TableRef:
+    name: str
+    alias: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Join:
+    left: "FromItem"
+    right: TableRef
+    on: Expr | None
+    kind: str = "inner"  # inner | left
+
+
+FromItem = Union[TableRef, Join]
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderItem:
+    expr: Expr
+    descending: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Select:
+    items: tuple[SelectItem, ...]
+    from_: FromItem | None
+    where: Expr | None
+    group_by: tuple[Expr, ...]
+    having: Expr | None
+    order_by: tuple[OrderItem, ...]
+    limit: int | None
+
+
+@dataclasses.dataclass(frozen=True)
+class Insert:
+    table: str
+    columns: tuple[str, ...]
+    rows: tuple[tuple[Expr, ...], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable:
+    table: str
+    columns: tuple[tuple[str, str, bool], ...]  # (name, type, not_null)
+    primary_key: tuple[str, ...]
+
+
+Statement = Union[Select, Insert, CreateTable]

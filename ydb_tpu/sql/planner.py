@@ -1,0 +1,682 @@
+"""SQL AST → logical plan (binding, pushdown, join + aggregate planning).
+
+The compact analog of the reference's KQP compile pipeline (SURVEY.md
+§3.2): name binding and type derivation (kqp_type_ann), predicate
+pushdown into table scans (the OLAP pushdown shape,
+opt/physical/kqp_opt_phy_olap_filter.cpp), join planning over FK->PK
+lookup joins vs N:M expansion (CBO-lite: keyed on catalog primary keys),
+aggregate/HAVING/ORDER BY lowering into SSA programs, projection naming.
+
+Output is a ydb_tpu.plan tree; the same tree drives the single-chip and
+mesh executors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, Transform
+from ydb_tpu.sql import ast
+from ydb_tpu.ssa.ops import Agg, Op
+from ydb_tpu.ssa.program import (
+    AggSpec,
+    AssignStep,
+    Call,
+    Col,
+    Const,
+    DictPredicate,
+    FilterStep,
+    GroupByStep,
+    Program,
+    ProjectStep,
+    SortStep,
+    infer_type,
+)
+
+_AGG_FUNCS = {
+    "sum": Agg.SUM, "avg": Agg.AVG, "min": Agg.MIN, "max": Agg.MAX,
+    "count": Agg.COUNT, "some": Agg.SOME,
+}
+
+_CMP = {"eq": Op.EQ, "ne": Op.NE, "lt": Op.LT, "le": Op.LE, "gt": Op.GT,
+        "ge": Op.GE}
+_ARITH = {"add": Op.ADD, "sub": Op.SUB, "mul": Op.MUL, "div": Op.DIV,
+          "mod": Op.MOD}
+
+
+@dataclasses.dataclass
+class Catalog:
+    schemas: dict[str, dtypes.Schema]
+    primary_keys: dict[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=dict)
+    dicts: DictionarySet | None = None
+
+
+class PlanError(Exception):
+    pass
+
+
+# ---------------- binding ----------------
+
+
+@dataclasses.dataclass
+class _Binding:
+    """alias -> table; column -> owning alias (unique or qualified)."""
+
+    tables: list[tuple[str, str]]  # (alias, table) in FROM order
+    col_owner: dict[str, str]      # unqualified column -> alias
+    ambiguous: set[str]
+    catalog: Catalog
+
+    def resolve(self, name: ast.Name) -> tuple[str, str]:
+        """-> (alias, column)"""
+        if len(name.parts) == 2:
+            alias, col = name.parts
+            for a, t in self.tables:
+                if a == alias:
+                    if col not in self.catalog.schemas[t]:
+                        raise PlanError(f"no column {col} in {t}")
+                    return a, col
+            raise PlanError(f"unknown table alias {alias}")
+        col = name.parts[0]
+        if col in self.ambiguous:
+            raise PlanError(f"ambiguous column {col}")
+        if col not in self.col_owner:
+            raise PlanError(f"unknown column {col}")
+        return self.col_owner[col], col
+
+    def column_type(self, col: str) -> dtypes.LogicalType:
+        alias = self.col_owner[col]
+        table = dict(self.tables)[alias]
+        return self.catalog.schemas[table].field(col).type
+
+
+def _flatten_from(f: ast.FromItem) -> tuple[list[ast.TableRef], list]:
+    """-> ([tables in order], [(right_index, on_expr, kind)])"""
+    if isinstance(f, ast.TableRef):
+        return [f], []
+    tables, joins = _flatten_from(f.left)
+    tables.append(f.right)
+    joins.append((len(tables) - 1, f.on, f.kind))
+    return tables, joins
+
+
+def _bind(sel: ast.Select, catalog: Catalog) -> tuple[_Binding, list, list]:
+    if sel.from_ is None:
+        raise PlanError("SELECT without FROM is not supported")
+    refs, join_specs = _flatten_from(sel.from_)
+    tables = []
+    for r in refs:
+        if r.name not in catalog.schemas:
+            raise PlanError(f"unknown table {r.name}")
+        tables.append((r.alias or r.name, r.name))
+    seen: dict[str, str] = {}
+    ambiguous: set[str] = set()
+    for alias, t in tables:
+        for f in catalog.schemas[t].fields:
+            if f.name in seen and seen[f.name] != alias:
+                ambiguous.add(f.name)
+            else:
+                seen[f.name] = alias
+    return _Binding(tables, seen, ambiguous, catalog), refs, join_specs
+
+
+# ---------------- expression lowering ----------------
+
+
+def _conjuncts(e: ast.Expr | None) -> list[ast.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, ast.BinOp) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _expr_columns(e: ast.Expr, binding: _Binding) -> set[str]:
+    """Aliases of tables referenced by an expression."""
+    out: set[str] = set()
+
+    def walk(x):
+        if isinstance(x, ast.Name):
+            out.add(binding.resolve(x)[0])
+        elif isinstance(x, ast.BinOp):
+            walk(x.left); walk(x.right)
+        elif isinstance(x, ast.UnOp):
+            walk(x.operand)
+        elif isinstance(x, ast.FuncCall):
+            for a in x.args:
+                walk(a)
+        elif isinstance(x, ast.Between):
+            walk(x.expr); walk(x.low); walk(x.high)
+        elif isinstance(x, ast.InList):
+            walk(x.expr)
+            for a in x.items:
+                walk(a)
+        elif isinstance(x, (ast.Like, ast.IsNull)):
+            walk(x.expr)
+        elif isinstance(x, ast.Case):
+            for c, v in x.whens:
+                walk(c); walk(v)
+            if x.else_ is not None:
+                walk(x.else_)
+
+    walk(e)
+    return out
+
+
+def _days(s: str) -> int:
+    return int(np.datetime64(s, "D").astype(np.int32))
+
+
+class _Lower:
+    """AST expr -> SSA expr against a column-type environment."""
+
+    def __init__(self, types: dict[str, dtypes.LogicalType],
+                 dicts: DictionarySet | None):
+        self.types = types
+        self.dicts = dicts
+
+    def type_of(self, e) -> dtypes.LogicalType | None:
+        try:
+            return infer_type(e, None, self.types)
+        except Exception:
+            return None
+
+    def lower(self, e: ast.Expr):
+        if isinstance(e, ast.Name):
+            col = e.column
+            if col not in self.types:
+                raise PlanError(f"column {col} not in scope")
+            return Col(col)
+        if isinstance(e, ast.Literal):
+            return self._literal(e)
+        if isinstance(e, ast.UnOp):
+            if e.op == "not":
+                return Call(Op.NOT, self.lower(e.operand))
+            if e.op == "neg":
+                return Call(Op.NEG, self.lower(e.operand))
+            raise PlanError(f"unary {e.op}")
+        if isinstance(e, ast.BinOp):
+            return self._binop(e)
+        if isinstance(e, ast.Between):
+            lo = ast.BinOp("ge", e.expr, e.low)
+            hi = ast.BinOp("le", e.expr, e.high)
+            both = Call(Op.AND, self._binop(lo), self._binop(hi))
+            return Call(Op.NOT, both) if e.negated else both
+        if isinstance(e, ast.InList):
+            return self._in_list(e)
+        if isinstance(e, ast.Like):
+            col = self._string_col(e.expr, "LIKE")
+            p = DictPredicate(col, "like", e.pattern)
+            return Call(Op.NOT, p) if e.negated else p
+        if isinstance(e, ast.IsNull):
+            inner = self.lower(e.expr)
+            return Call(Op.IS_NOT_NULL if e.negated else Op.IS_NULL, inner)
+        if isinstance(e, ast.Case):
+            if e.else_ is None:
+                raise PlanError("CASE without ELSE is not supported yet")
+            out = self.lower(e.else_)
+            for cond, val in reversed(e.whens):
+                out = Call(Op.IF, self.lower(cond), self.lower(val), out)
+            return out
+        if isinstance(e, ast.FuncCall):
+            return self._func(e)
+        raise PlanError(f"cannot lower {e}")
+
+    def _literal(self, e: ast.Literal):
+        if e.kind == "int":
+            return Const(e.value, dtypes.INT64)
+        if e.kind == "decimal":
+            from ydb_tpu.ssa.program import decimal_lit
+
+            scale = len(e.value.split(".")[1]) if "." in e.value else 0
+            return decimal_lit(e.value, scale)
+        if e.kind == "bool":
+            return Const(e.value, dtypes.BOOL)
+        if e.kind == "string":
+            raise PlanError(
+                f"string literal {e.value!r} outside a string comparison"
+            )
+        raise PlanError(f"literal {e.kind}")
+
+    def _string_col(self, e: ast.Expr, what: str) -> str:
+        if isinstance(e, ast.Name) and self.types.get(
+                e.column, dtypes.INT64).is_string:
+            return e.column
+        raise PlanError(f"{what} needs a string column operand")
+
+    def _binop(self, e: ast.BinOp):
+        if e.op in ("and", "or"):
+            return Call(Op.AND if e.op == "and" else Op.OR,
+                        self.lower(e.left), self.lower(e.right))
+        if e.op in _CMP:
+            # string column vs string literal -> dictionary predicate
+            lit_side = col_side = None
+            if isinstance(e.right, ast.Literal) and e.right.kind == "string":
+                col_side, lit_side, op = e.left, e.right, e.op
+            elif isinstance(e.left, ast.Literal) and e.left.kind == "string":
+                col_side, lit_side = e.right, e.left
+                op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(
+                    e.op, e.op)
+            if lit_side is not None:
+                col = self._string_col(col_side, "string comparison")
+                if op == "eq":
+                    return DictPredicate(col, "eq", lit_side.value)
+                if op == "ne":
+                    return DictPredicate(col, "ne", lit_side.value)
+                # ordered string compare via dictionary prefix masks
+                d = self.dicts[col] if (
+                    self.dicts and col in self.dicts) else None
+                if d is None:
+                    raise PlanError(
+                        f"ordered string compare on {col} needs dictionary")
+                kind = {"lt": "lt", "le": "le", "gt": "gt", "ge": "ge"}[op]
+                val = lit_side.value.encode() if isinstance(
+                    lit_side.value, str) else lit_side.value
+                mask_kind = {"lt": lambda v: v < val,
+                             "le": lambda v: v <= val,
+                             "gt": lambda v: v > val,
+                             "ge": lambda v: v >= val}[kind]
+                # custom predicate via match_mask at plan time
+                return DictPredicate(col, "custom", ("ord", op, val))
+            return Call(_CMP[e.op], self.lower(e.left), self.lower(e.right))
+        if e.op in _ARITH:
+            return Call(_ARITH[e.op], self.lower(e.left),
+                        self.lower(e.right))
+        raise PlanError(f"binop {e.op}")
+
+    def _in_list(self, e: ast.InList):
+        if all(isinstance(i, ast.Literal) and i.kind == "string"
+               for i in e.items):
+            col = self._string_col(e.expr, "IN")
+            kind = "not_in_set" if e.negated else "in_set"
+            return DictPredicate(col, kind,
+                                 tuple(i.value for i in e.items))
+        inner = self.lower(e.expr)
+        consts = []
+        for i in e.items:
+            c = self.lower(i)
+            if not isinstance(c, Const):
+                raise PlanError("IN items must be literals")
+            consts.append(c)
+        call = Call(Op.IN_SET, inner, *consts)
+        return Call(Op.NOT, call) if e.negated else call
+
+    def _func(self, e: ast.FuncCall):
+        if e.name in _AGG_FUNCS or (e.name == "count" and e.star):
+            raise PlanError(f"aggregate {e.name} in scalar context")
+        if e.name == "date":
+            return Const(_days(e.args[0].value), dtypes.DATE)
+        if e.name == "interval":
+            n = int(e.args[0].value)
+            unit = e.args[1].value
+            days = {"day": 1, "week": 7}.get(unit)
+            if days is None:
+                raise PlanError(f"interval unit {unit}")
+            return Const(n * days, dtypes.INT32)
+        if e.name in ("year", "month"):
+            op = Op.YEAR if e.name == "year" else Op.MONTH
+            return Call(op, self.lower(e.args[0]))
+        if e.name.startswith("cast_"):
+            target = e.name[5:]
+            op = {"int32": Op.CAST_INT32, "int64": Op.CAST_INT64,
+                  "bigint": Op.CAST_INT64, "float": Op.CAST_FLOAT,
+                  "double": Op.CAST_DOUBLE}.get(target)
+            if op is None:
+                raise PlanError(f"cast to {target}")
+            return Call(op, self.lower(e.args[0]))
+        simple = {"abs": Op.ABS, "sqrt": Op.SQRT, "exp": Op.EXP,
+                  "ln": Op.LN, "floor": Op.FLOOR, "ceil": Op.CEIL,
+                  "round": Op.ROUND, "coalesce": Op.COALESCE}
+        if e.name in simple:
+            return Call(simple[e.name], *[self.lower(a) for a in e.args])
+        raise PlanError(f"unknown function {e.name}")
+
+
+def _contains_agg(e: ast.Expr) -> bool:
+    if isinstance(e, ast.FuncCall):
+        if e.name in _AGG_FUNCS or (e.name == "count" and e.star):
+            return True
+        return any(_contains_agg(a) for a in e.args)
+    if isinstance(e, ast.BinOp):
+        return _contains_agg(e.left) or _contains_agg(e.right)
+    if isinstance(e, ast.UnOp):
+        return _contains_agg(e.operand)
+    if isinstance(e, ast.Between):
+        return any(_contains_agg(x) for x in (e.expr, e.low, e.high))
+    if isinstance(e, (ast.Like, ast.IsNull)):
+        return _contains_agg(e.expr)
+    if isinstance(e, ast.InList):
+        return _contains_agg(e.expr)
+    if isinstance(e, ast.Case):
+        return any(
+            _contains_agg(c) or _contains_agg(v) for c, v in e.whens
+        ) or (e.else_ is not None and _contains_agg(e.else_))
+    return False
+
+
+# ---------------- the planner ----------------
+
+
+def plan_select(sel: ast.Select, catalog: Catalog):
+    binding, refs, join_specs = _bind(sel, catalog)
+    alias_to_table = dict(binding.tables)
+
+    # classify WHERE conjuncts
+    pushdown: dict[str, list[ast.Expr]] = {a: [] for a, _ in binding.tables}
+    join_conds: list[tuple[str, str, str, str]] = []  # (la, lc, ra, rc)
+    residual: list[ast.Expr] = []
+    for c in _conjuncts(sel.where):
+        aliases = _expr_columns(c, binding)
+        if len(aliases) <= 1:
+            target = next(iter(aliases)) if aliases else binding.tables[0][0]
+            pushdown[target].append(c)
+        elif (
+            len(aliases) == 2
+            and isinstance(c, ast.BinOp) and c.op == "eq"
+            and isinstance(c.left, ast.Name)
+            and isinstance(c.right, ast.Name)
+        ):
+            la, lc = binding.resolve(c.left)
+            ra, rc = binding.resolve(c.right)
+            join_conds.append((la, lc, ra, rc))
+        else:
+            residual.append(c)
+
+    # explicit ON conditions
+    on_conds: dict[int, list[tuple[str, str, str, str]]] = {}
+    for idx, on, kind in join_specs:
+        conds = []
+        for c in _conjuncts(on):
+            if not (isinstance(c, ast.BinOp) and c.op == "eq"
+                    and isinstance(c.left, ast.Name)
+                    and isinstance(c.right, ast.Name)):
+                raise PlanError("JOIN ON supports equi-conditions only")
+            la, lc = binding.resolve(c.left)
+            ra, rc = binding.resolve(c.right)
+            conds.append((la, lc, ra, rc))
+        on_conds[idx] = conds
+
+    # column demand per table: everything referenced anywhere
+    demand: dict[str, set[str]] = {a: set() for a, _ in binding.tables}
+
+    def demand_expr(e):
+        for x in _walk_names(e):
+            a, c = binding.resolve(x)
+            demand[a].add(c)
+
+    out_aliases = {
+        _item_name(item, i) for i, item in enumerate(sel.items)
+    }
+    for item in sel.items:
+        demand_expr(item.expr)
+    for e in sel.group_by:
+        demand_expr(e)
+    for o in sel.order_by:
+        # ORDER BY may reference select aliases, which are not table columns
+        if isinstance(o.expr, ast.Name) and o.expr.parts[-1] in out_aliases:
+            continue
+        demand_expr(o.expr)
+    if sel.having is not None:
+        demand_expr(sel.having)
+    for e in residual:
+        demand_expr(e)
+    for la, lc, ra, rc in join_conds:
+        demand[la].add(lc)
+        demand[ra].add(rc)
+    for conds in on_conds.values():
+        for la, lc, ra, rc in conds:
+            demand[la].add(lc)
+            demand[ra].add(rc)
+
+    # per-table scan with pushdown
+    def scan_for(alias: str) -> TableScan:
+        table = alias_to_table[alias]
+        sch = catalog.schemas[table]
+        types = {f.name: f.type for f in sch.fields}
+        low = _Lower(types, catalog.dicts)
+        steps = []
+        for c in pushdown[alias]:
+            steps.append(FilterStep(low.lower(c)))
+        cols = tuple(
+            n for n in sch.names
+            if n in demand[alias]
+        ) or sch.names[:1]
+        steps.append(ProjectStep(cols))
+        return TableScan(table, Program(tuple(steps)))
+
+    # left-deep join tree in FROM order
+    joined_aliases = [binding.tables[0][0]]
+    plan = scan_for(joined_aliases[0])
+    types: dict[str, dtypes.LogicalType] = {}
+    a0, t0 = binding.tables[0]
+    for n in demand[a0] or set(catalog.schemas[t0].names[:1]):
+        types[n] = catalog.schemas[t0].field(n).type
+
+    pending = join_conds[:]
+    for i in range(1, len(binding.tables)):
+        alias, table = binding.tables[i]
+        conds = list(on_conds.get(i, []))
+        # WHERE-derived equi conds connecting this table to joined ones
+        still = []
+        for la, lc, ra, rc in pending:
+            if ra == alias and la in joined_aliases:
+                conds.append((la, lc, ra, rc))
+            elif la == alias and ra in joined_aliases:
+                conds.append((ra, rc, la, lc))
+            else:
+                still.append((la, lc, ra, rc))
+        pending = still
+        if not conds:
+            raise PlanError(
+                f"no equi-join condition connects {alias}; cross joins are"
+                " not supported"
+            )
+        probe_keys = tuple(lc for la, lc, ra, rc in conds)
+        build_keys = tuple(rc for la, lc, ra, rc in conds)
+        kind = dict((j[0], j[2]) for j in join_specs).get(i, "inner")
+        payload = tuple(
+            n for n in catalog.schemas[table].names
+            if n in demand[alias] and n not in build_keys
+        )
+        # keep join keys when referenced downstream
+        payload += tuple(
+            n for n in build_keys
+            if n in demand[alias] and n not in payload
+            and n not in types  # probe side may already carry same name
+        )
+        pk = catalog.primary_keys.get(table)
+        unique_build = pk is not None and set(pk) <= set(build_keys)
+        if not payload and kind == "inner":
+            plan = LookupJoin(plan, scan_for(alias), probe_keys, build_keys,
+                              (), "semi")
+        elif unique_build or kind == "left":
+            plan = LookupJoin(plan, scan_for(alias), probe_keys, build_keys,
+                              payload, kind)
+        else:
+            probe_payload = tuple(types.keys())
+            plan = ExpandJoin(plan, scan_for(alias), probe_keys, build_keys,
+                              probe_payload, payload)
+        for n in payload:
+            types[n] = catalog.schemas[table].field(n).type
+        joined_aliases.append(alias)
+    if pending:
+        raise PlanError(f"unplaced join conditions {pending}")
+
+    # final transform: residual filters, aggregation, having, order, project
+    low = _Lower(types, catalog.dicts)
+    steps: list = []
+    for c in residual:
+        steps.append(FilterStep(low.lower(c)))
+
+    has_agg = any(_contains_agg(i.expr) for i in sel.items) or (
+        sel.having is not None and _contains_agg(sel.having)
+    ) or bool(sel.group_by)
+
+    out_names: list[str] = []
+    if has_agg:
+        steps, out_names = _plan_aggregate(sel, low, steps, binding)
+    else:
+        for idx, item in enumerate(sel.items):
+            name = _item_name(item, idx)
+            if isinstance(item.expr, ast.Name) and (
+                    item.alias is None
+                    or item.alias == item.expr.column):
+                out_names.append(item.expr.column)
+            else:
+                steps.append(AssignStep(name, low.lower(item.expr)))
+                out_names.append(name)
+        steps.append(ProjectStep(tuple(out_names)))
+
+    if sel.order_by:
+        keys = []
+        desc = []
+        for o in sel.order_by:
+            if isinstance(o.expr, ast.Name) and o.expr.parts[-1] in out_names:
+                keys.append(o.expr.parts[-1])
+            else:
+                raise PlanError(
+                    "ORDER BY must reference output columns/aliases")
+            desc.append(o.descending)
+        steps.append(SortStep(tuple(keys), tuple(desc), sel.limit))
+    elif sel.limit is not None:
+        steps.append(SortStep((), (), sel.limit))
+
+    return Transform(plan, Program(tuple(steps)))
+
+
+def _walk_names(e):
+    if isinstance(e, ast.Name):
+        yield e
+    elif isinstance(e, ast.BinOp):
+        yield from _walk_names(e.left)
+        yield from _walk_names(e.right)
+    elif isinstance(e, ast.UnOp):
+        yield from _walk_names(e.operand)
+    elif isinstance(e, ast.FuncCall):
+        for a in e.args:
+            yield from _walk_names(a)
+    elif isinstance(e, ast.Between):
+        yield from _walk_names(e.expr)
+        yield from _walk_names(e.low)
+        yield from _walk_names(e.high)
+    elif isinstance(e, (ast.Like, ast.IsNull)):
+        yield from _walk_names(e.expr)
+    elif isinstance(e, ast.InList):
+        yield from _walk_names(e.expr)
+        for i in e.items:
+            yield from _walk_names(i)
+    elif isinstance(e, ast.Case):
+        for c, v in e.whens:
+            yield from _walk_names(c)
+            yield from _walk_names(v)
+        if e.else_ is not None:
+            yield from _walk_names(e.else_)
+
+
+def _item_name(item: ast.SelectItem, idx: int) -> str:
+    if item.alias:
+        return item.alias
+    if isinstance(item.expr, ast.Name):
+        return item.expr.column
+    return f"column{idx}"
+
+
+def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, binding):
+    """Lower GROUP BY + aggregates + HAVING into SSA steps."""
+    # group keys: plain columns stay; computed keys get pre-assigns
+    key_names: list[str] = []
+    key_exprs: dict = {}  # ast expr -> key column name
+    for i, g in enumerate(sel.group_by):
+        if isinstance(g, ast.Name):
+            key_names.append(g.column)
+            key_exprs[g] = g.column
+        else:
+            name = f"__key{i}"
+            steps.append(AssignStep(name, low.lower(g)))
+            low.types[name] = infer_type(
+                steps[-1].expr, None, low.types)
+            key_names.append(name)
+            key_exprs[g] = name
+
+    agg_specs: list[AggSpec] = []
+    agg_map: dict = {}  # ast.FuncCall (by repr) -> out name
+
+    def register_agg(fc: ast.FuncCall) -> str:
+        key = repr(fc)
+        if key in agg_map:
+            return agg_map[key]
+        name = f"__agg{len(agg_specs)}"
+        if fc.name == "count" and fc.star:
+            agg_specs.append(AggSpec(Agg.COUNT_ALL, None, name))
+        else:
+            func = _AGG_FUNCS[fc.name]
+            arg = fc.args[0]
+            if isinstance(arg, ast.Name):
+                col = arg.column
+            else:
+                col = f"__arg{len(agg_specs)}"
+                assign = AssignStep(col, low.lower(arg))
+                steps.append(assign)
+                low.types[col] = infer_type(assign.expr, None, low.types)
+            agg_specs.append(AggSpec(func, col, name))
+        agg_map[key] = name
+        return name
+
+    def rewrite(e: ast.Expr) -> ast.Expr:
+        """Replace group-key expressions and aggregate calls with
+        references to their group-by outputs (SQL: every select expr is a
+        function of group keys and aggregates)."""
+        if e in key_exprs:
+            return ast.Name((key_exprs[e],))
+        if isinstance(e, ast.FuncCall) and (
+                e.name in _AGG_FUNCS or (e.name == "count" and e.star)):
+            return ast.Name((register_agg(e),))
+        if isinstance(e, ast.BinOp):
+            return ast.BinOp(e.op, rewrite(e.left), rewrite(e.right))
+        if isinstance(e, ast.UnOp):
+            return ast.UnOp(e.op, rewrite(e.operand))
+        if isinstance(e, ast.FuncCall):
+            return ast.FuncCall(e.name, tuple(rewrite(a) for a in e.args),
+                                e.star)
+        return e
+
+    post_items: list[tuple[str, ast.Expr]] = []
+    out_names: list[str] = []
+    for idx, item in enumerate(sel.items):
+        name = _item_name(item, idx)
+        if isinstance(item.expr, ast.Name):
+            col = item.expr.column
+            if col not in key_names:
+                raise PlanError(
+                    f"column {col} is neither aggregated nor a group key")
+            out_names.append(col if item.alias in (None, col) else name)
+            post_items.append((out_names[-1], item.expr))
+            continue
+        out_names.append(name)
+        post_items.append((name, rewrite(item.expr)))
+    having_rw = rewrite(sel.having) if sel.having is not None else None
+
+    steps.append(GroupByStep(tuple(key_names), tuple(agg_specs)))
+    # post-aggregation scope: keys + agg outputs
+    from ydb_tpu.ssa.program import agg_result_type
+
+    post_types = {k: low.types[k] for k in key_names}
+    for spec in agg_specs:
+        post_types[spec.out_name] = agg_result_type(spec, None, low.types)
+    post_low = _Lower(post_types, low.dicts)
+
+    if having_rw is not None:
+        steps.append(FilterStep(post_low.lower(having_rw)))
+    for name, e in post_items:
+        if isinstance(e, ast.Name) and e.parts[-1] == name:
+            continue
+        steps.append(AssignStep(name, post_low.lower(e)))
+        post_low.types[name] = infer_type(steps[-1].expr, None,
+                                          post_low.types)
+    steps.append(ProjectStep(tuple(out_names)))
+    return steps, out_names

@@ -296,6 +296,8 @@ def _resolve_dict_predicate(ctx: _Lowering, p: DictPredicate, cur_types):
                 table[i] = True
         if p.kind == "not_in_set":
             table = ~table
+    elif p.kind == "custom":
+        table = _custom_dict_mask(d, p.pattern)
     else:
         raise NotImplementedError(f"dict predicate kind {p.kind}")
     if table.size == 0:
@@ -307,6 +309,23 @@ def _resolve_dict_predicate(ctx: _Lowering, p: DictPredicate, cur_types):
         return kernels.dict_gather(aux[_key], env[_col])
 
     return lower, dtypes.BOOL
+
+
+def _custom_dict_mask(d, pattern) -> np.ndarray:
+    """Plan-time masks beyond the fixed kinds. ("ord", op, val) = ordered
+    byte-string comparison evaluated over the dictionary values."""
+    tag = pattern[0]
+    if tag == "ord":
+        _, op, val = pattern
+        val = val if isinstance(val, bytes) else str(val).encode()
+        cmp = {
+            "lt": lambda v: v < val,
+            "le": lambda v: v <= val,
+            "gt": lambda v: v > val,
+            "ge": lambda v: v >= val,
+        }[op]
+        return d.match_mask(cmp)
+    raise NotImplementedError(f"custom dict predicate {tag}")
 
 
 _SIMPLE_BINOPS = {

@@ -102,6 +102,8 @@ def lookup_join(
     found = (idx < n_live) & (bk_sorted[idx] == pk) & plive
     src = order[idx]
 
+    if len(set(payload)) != len(payload):
+        raise ValueError(f"duplicate payload columns {payload}")
     out_cols = dict(probe.columns)
     sch = probe.schema
     for name in payload:

@@ -36,11 +36,21 @@ class TxResult:
 
 
 class Coordinator:
-    """Global plan-step clock + two-phase commit driver."""
+    """Global plan-step clock + two-phase commit driver.
+
+    Commits serialize on a commit lock (the reference coordinator also
+    plans steps through one tablet), which keeps per-shard steps monotonic
+    under concurrency. ``read_snapshot`` returns the last *fully
+    committed* step — the mediator-time barrier: a step becomes readable
+    only after every participant of every tx planned at or before it has
+    committed, so readers never see a torn cross-shard transaction.
+    """
 
     def __init__(self, start_step: int = 0):
         self._lock = threading.Lock()
+        self._commit_lock = threading.Lock()
         self._step = start_step
+        self._completed = start_step
         self._next_txid = 1
 
     @property
@@ -48,9 +58,9 @@ class Coordinator:
         return self._step
 
     def read_snapshot(self) -> int:
-        """Current consistent read point (mediator-time analog)."""
+        """Last fully-committed plan step (mediator time barrier)."""
         with self._lock:
-            return self._step
+            return self._completed
 
     def plan(self) -> tuple[int, int]:
         """Assign (txid, step) for a new transaction."""
@@ -60,22 +70,59 @@ class Coordinator:
             self._next_txid += 1
             return txid, self._step
 
+    def _mark_completed(self, step: int) -> None:
+        with self._lock:
+            self._completed = max(self._completed, step)
+
+    def background_plan(self) -> int:
+        """Plan step for a single-shard background op (compaction/TTL).
+
+        Marked completed immediately: shard-local metadata swaps cannot
+        tear a cross-shard read, and background results should become
+        visible without waiting for the next distributed commit."""
+        _, step = self.plan()
+        self._mark_completed(step)
+        return step
+
     def commit(self, participants: list, prepare_args: list) -> TxResult:
         """Two-phase commit: prepare on every participant, then commit all
-        at one plan step; abort (release) everywhere on any failure.
+        at one plan step.
 
-        ``participants`` expose prepare(args) -> token, commit_at(token,
-        step), abort(token).
+        Prepare failure aborts EVERY participant (prepared or not) and
+        returns committed=False. Once all prepares succeed the decision is
+        commit: commit_at is applied to every participant even if one
+        errors (textbook 2PC — post-decision failures need repair/retry,
+        not rollback), and any such error surfaces as RuntimeError after
+        all attempts.
         """
-        txid, step = self.plan()
-        tokens = []
-        try:
+        with self._commit_lock:
+            txid, step = self.plan()
+            tokens = []
+            failed = None
             for p, args in zip(participants, prepare_args):
-                tokens.append(p.prepare(args))
-        except Exception as e:  # prepare failed somewhere: abort prepared
+                try:
+                    tokens.append(p.prepare(args))
+                except Exception as e:
+                    failed = e
+                    break
+            if failed is not None:
+                for p, args, i in zip(participants, prepare_args,
+                                      range(len(participants))):
+                    try:
+                        p.abort(tokens[i] if i < len(tokens) else args)
+                    except Exception:
+                        pass
+                return TxResult(txid, step, False, f"prepare: {failed}")
+            errors = []
             for p, t in zip(participants, tokens):
-                p.abort(t)
-            return TxResult(txid, step, False, f"prepare: {e}")
-        for p, t in zip(participants, tokens):
-            p.commit_at(t, step)
-        return TxResult(txid, step, True)
+                try:
+                    p.commit_at(t, step)
+                except Exception as e:  # post-decision failure: keep going
+                    errors.append((p, e))
+            self._mark_completed(step)
+            if errors:
+                raise RuntimeError(
+                    f"commit decided at step {step} but participants "
+                    f"failed to apply: {errors}; shard repair required"
+                )
+            return TxResult(txid, step, True)

@@ -67,7 +67,7 @@ class ShardedTable:
             for i in range(n_shards)
         ]
         for s in self.shards:
-            s.snap_source = lambda: coordinator.plan()[1]
+            s.snap_source = coordinator.background_plan
 
     # ---------------- writes ----------------
 
@@ -121,9 +121,9 @@ class ShardedTable:
             for b in src.blocks(block_rows, ex.read_cols):
                 partials.append(ex.run_block(b))
         if not partials:
-            empty = sources[0]
-            return ScanExecutor(program, empty, block_rows,
-                                key_spaces).execute()
+            # all shards empty at this snapshot: one empty padded block
+            # through the already-compiled executor
+            return ex.execute()
         if ex.final is None:
             return OracleTable.from_block(concat_blocks(partials))
         return OracleTable.from_block(ex.finalize(partials))
