@@ -257,3 +257,34 @@ def test_cluster_end_to_end_sql():
         from events group by kind order by kind
     """)
     np.testing.assert_array_equal(res2.cols["n"][0], [3, 2])
+
+
+def test_select_distinct(data, db, catalog):
+    res = _sql("select distinct l_shipmode from lineitem order by l_shipmode",
+               catalog, db)
+    assert res.num_rows == 7  # all ship modes, deduplicated
+    d = data.dicts["l_shipmode"]
+    names = [d.values[int(i)] for i in res.cols["l_shipmode"][0]]
+    assert names == sorted(names)
+
+
+def test_on_condition_orientation(data, db, catalog):
+    # reversed operand order in ON must plan identically
+    a = _sql("""select count(*) n from lineitem l
+                join orders o on o_orderkey = l_orderkey
+                where o_orderdate < date '1995-01-01'""", catalog, db)
+    b = _sql("""select count(*) n from lineitem l
+                join orders o on l_orderkey = o_orderkey
+                where o_orderdate < date '1995-01-01'""", catalog, db)
+    assert int(a.cols["n"][0][0]) == int(b.cols["n"][0][0]) > 0
+
+
+def test_no_payload_join_preserves_multiplicity(data, db, catalog):
+    # lineitem joined to itself-shaped non-unique side must not collapse
+    # multiplicity: count(*) over orders x lineitem on orderkey equals
+    # lineitem rows with matching order (orders unique -> semi fine),
+    # but joining the non-unique direction must expand
+    res = _sql("""select count(*) n from orders, lineitem
+                  where o_orderkey = l_orderkey""", catalog, db)
+    n_li = len(data.tables["lineitem"]["l_orderkey"])
+    assert int(res.cols["n"][0][0]) == n_li  # every lineitem has its order

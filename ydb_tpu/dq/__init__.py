@@ -1,0 +1,9 @@
+from ydb_tpu.dq.graph import (  # noqa: F401
+    HashPartition,
+    ResultOutput,
+    SourceInput,
+    StageSpec,
+    UnionAllInput,
+    build_tasks,
+)
+from ydb_tpu.dq.compute import run_stage_graph  # noqa: F401

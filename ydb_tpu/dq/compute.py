@@ -1,0 +1,406 @@
+"""DQ compute actors: task execution with credit-based channel flow.
+
+Mirror of the reference's compute-actor framework (SURVEY.md §2.10):
+a generic actor hosts one task's program, drives its input/output
+channels with a credit protocol (TEvChannelData / TEvChannelDataAck,
+dq_compute_actor_channels.h:15), spills backlog beyond the memory quota
+(spilling service), and streams the result channel to the executer.
+
+Device work happens inside the task: each arriving block lifts to a
+TableBlock, runs the stage's compiled SSA program on the accelerator, and
+the (much smaller) result travels the channels host-side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.block import TableBlock, concat_blocks
+from ydb_tpu.dq.graph import (
+    Broadcast,
+    ChannelSpec,
+    HashPartition,
+    ResultOutput,
+    SourceInput,
+    StageSpec,
+    TaskSpec,
+    UnionAll,
+    build_tasks,
+)
+from ydb_tpu.dq.spilling import Spiller
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.runtime.actors import Actor, ActorId
+from ydb_tpu.ssa.compiler import compile_program
+
+DEFAULT_WINDOW = 4  # unacked blocks per channel before spilling
+
+
+# ---- channel protocol messages ----
+
+
+@dataclasses.dataclass
+class ChannelData:
+    channel_id: int
+    seq: int
+    payload: dict | None
+    finished: bool
+
+
+@dataclasses.dataclass
+class ChannelAck:
+    channel_id: int
+    seq: int
+
+
+@dataclasses.dataclass
+class StartTask:
+    pass
+
+
+@dataclasses.dataclass
+class ResultData:
+    payload: dict | None
+    finished: bool
+
+
+# ---- payload <-> block ----
+
+
+def block_to_payload(block: TableBlock) -> dict:
+    data = block.to_numpy()
+    valid = block.validity_numpy()
+    out = {}
+    for k, v in data.items():
+        out[k] = v
+        out[f"__v_{k}"] = valid[k]
+    return out
+
+
+def payload_to_block(payload: dict, schema: dtypes.Schema) -> TableBlock:
+    cols = {f.name: payload[f.name] for f in schema.fields}
+    validity = {f.name: payload[f"__v_{f.name}"] for f in schema.fields}
+    return TableBlock.from_numpy(cols, schema, validity)
+
+
+def _partition_payload(payload: dict, schema, keys, n: int) -> list[dict]:
+    """Host-side hash split (the vectorized block hash partitioner,
+    dq_output_consumer.cpp:338)."""
+    if n == 1:
+        return [payload]
+    first = payload[schema.names[0]]
+    h = np.zeros(len(first), dtype=np.uint64)
+    h[:] = 0x9E3779B97F4A7C15
+    for k in keys:
+        kv = payload[k].astype(np.int64).view(np.uint64)
+        ok = payload[f"__v_{k}"].astype(np.uint64) << np.uint64(63)
+        x = h ^ (kv ^ ok)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        h = x ^ (x >> np.uint64(31))
+    dest = (h % np.uint64(n)).astype(np.int64)
+    out = []
+    for d in range(n):
+        m = dest == d
+        out.append({k: v[m] for k, v in payload.items()})
+    return out
+
+
+class _CompiledStage:
+    """Per-stage compiled programs + schemas (shared by its tasks)."""
+
+    def __init__(self, spec: StageSpec, in_schema, dicts, key_spaces):
+        self.in_schema = in_schema
+        if spec.program is not None:
+            self.per_block = compile_program(
+                spec.program, in_schema, dicts, key_spaces
+            )
+            mid = self.per_block.out_schema
+            self._pb_aux = {
+                k: jnp.asarray(v) for k, v in self.per_block.aux.items()
+            }
+        else:
+            self.per_block = None
+            mid = in_schema
+        self.mid_schema = mid
+        if spec.final_program is not None:
+            from ydb_tpu.ssa import twophase
+
+            aliases = (
+                twophase.dict_aliases(spec.program)
+                if spec.program is not None else None
+            )
+            self.final = compile_program(
+                spec.final_program, mid, dicts, key_spaces,
+                dict_aliases=aliases,
+            )
+            self._f_aux = {
+                k: jnp.asarray(v) for k, v in self.final.aux.items()
+            }
+            self.out_schema = self.final.out_schema
+        else:
+            self.final = None
+            self.out_schema = mid
+
+    def run_block(self, block: TableBlock) -> TableBlock:
+        if self.per_block is None:
+            return block
+        return self.per_block.run(block, self._pb_aux)
+
+    def run_final(self, blocks: list[TableBlock]) -> TableBlock:
+        merged = blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
+        if self.final is None:
+            return merged
+        return self.final.run(merged, self._f_aux)
+
+
+class ComputeActor(Actor):
+    """Hosts one task (sync compute actor variant,
+    dq_compute_actor_impl.h:95)."""
+
+    def __init__(
+        self,
+        task: TaskSpec,
+        compiled: _CompiledStage,
+        channel_targets: dict[int, ActorId],  # my out channel -> consumer
+        channel_specs: dict[int, ChannelSpec],
+        source: ColumnSource | None,
+        result_target: ActorId | None,
+        spiller: Spiller | None = None,
+        window: int = DEFAULT_WINDOW,
+        block_rows: int = 1 << 16,
+    ):
+        super().__init__()
+        self.task = task
+        self.compiled = compiled
+        self.channel_targets = channel_targets
+        self.channel_specs = channel_specs
+        self.source = source
+        self.result_target = result_target
+        self.window = window
+        self.block_rows = block_rows
+        self.spiller = spiller or Spiller()
+
+        self._in_finished: set[int] = set()
+        self._acc: list[TableBlock] = []  # agg stages accumulate
+        self._unacked: dict[int, int] = {c: 0 for c in task.output_channels}
+        self._parked: dict[int, list] = {c: [] for c in task.output_channels}
+        self._next_seq: dict[int, int] = {c: 0 for c in task.output_channels}
+        self._fin_pending: set[int] = set()
+        self._done = False
+
+    # ---- input side ----
+
+    def receive(self, message, sender):
+        if isinstance(message, StartTask):
+            self._consume_source()
+        elif isinstance(message, ChannelData):
+            self.send(sender, ChannelAck(message.channel_id, message.seq))
+            if message.payload is not None:
+                blk = payload_to_block(message.payload,
+                                       self.compiled.in_schema)
+                self._ingest(blk)
+            if message.finished:
+                self._in_finished.add(message.channel_id)
+                if self._in_finished >= set(self.task.input_channels):
+                    self._finish_input()
+        elif isinstance(message, ChannelAck):
+            self._on_ack(message)
+        else:
+            raise TypeError(message)
+
+    def _consume_source(self):
+        if self.source is not None:
+            for blk in self.source.blocks(self.block_rows):
+                self._ingest(blk)
+        if not self.task.input_channels:
+            self._finish_input()
+
+    def _ingest(self, block: TableBlock):
+        spec = self.task.stage_spec
+        if spec.final_program is not None:
+            # aggregate stage: per-block partial, accumulate for the merge
+            self._acc.append(self.compiled.run_block(block))
+        else:
+            out = self.compiled.run_block(block)
+            self._emit(out)
+
+    def _finish_input(self):
+        spec = self.task.stage_spec
+        if spec.final_program is not None:
+            if self._acc:
+                self._emit(self.compiled.run_final(self._acc))
+            else:
+                # empty input still finalizes (COUNT over nothing etc.)
+                empty = _empty_block(self.compiled.mid_schema)
+                self._emit(self.compiled.run_final([empty]))
+            self._acc = []
+        self._finish_output()
+
+    # ---- output side ----
+
+    def _emit(self, block: TableBlock):
+        if int(block.capacity) == 0:
+            return
+        payload = block_to_payload(block)
+        out = self.task.stage_spec.output
+        if isinstance(out, ResultOutput):
+            self.send(self.result_target, ResultData(payload, False))
+            return
+        chans = self.task.output_channels
+        if isinstance(out, HashPartition):
+            parts = _partition_payload(
+                payload, self.compiled.out_schema, out.keys, len(chans)
+            )
+            for ch, part in zip(chans, parts):
+                if len(next(iter(part.values()))) == 0:
+                    continue
+                self._send_channel(ch, part)
+        elif isinstance(out, Broadcast):
+            for ch in chans:
+                self._send_channel(ch, payload)
+        else:  # UnionAll: single consumer
+            for ch in chans:
+                self._send_channel(ch, payload)
+
+    def _send_channel(self, ch: int, payload: dict):
+        if self._unacked[ch] >= self.window:
+            self._parked[ch].append(self.spiller.put(payload))
+            return
+        self._dispatch(ch, payload, finished=False)
+
+    def _dispatch(self, ch: int, payload: dict | None, finished: bool):
+        seq = self._next_seq[ch]
+        self._next_seq[ch] += 1
+        if payload is not None:
+            self._unacked[ch] += 1
+        self.send(self.channel_targets[ch],
+                  ChannelData(ch, seq, payload, finished))
+
+    def _finish_output(self):
+        self._done = True
+        if isinstance(self.task.stage_spec.output, ResultOutput):
+            self.send(self.result_target, ResultData(None, True))
+            return
+        for ch in self.task.output_channels:
+            if self._parked[ch] or self._unacked[ch] > 0:
+                self._fin_pending.add(ch)
+            else:
+                self._dispatch(ch, None, finished=True)
+
+    def _on_ack(self, ack: ChannelAck):
+        ch = ack.channel_id
+        self._unacked[ch] -= 1
+        while self._parked[ch] and self._unacked[ch] < self.window:
+            sid = self._parked[ch].pop(0)
+            self._dispatch(ch, self.spiller.get(sid), finished=False)
+        if (
+            ch in self._fin_pending
+            and not self._parked[ch]
+            and self._unacked[ch] == 0
+        ):
+            self._fin_pending.discard(ch)
+            self._dispatch(ch, None, finished=True)
+
+
+def _empty_block(schema: dtypes.Schema) -> TableBlock:
+    cols = {
+        f.name: np.empty(0, dtype=f.type.physical) for f in schema.fields
+    }
+    return TableBlock.from_numpy(cols, schema, capacity=1)
+
+
+class ResultCollector(Actor):
+    def __init__(self, schema: dtypes.Schema):
+        super().__init__()
+        self.schema = schema
+        self.payloads: list[dict] = []
+        self.done = False
+
+    def receive(self, message, sender):
+        assert isinstance(message, ResultData)
+        if message.payload is not None:
+            self.payloads.append(message.payload)
+        if message.finished:
+            self.done = True
+
+    def table(self) -> OracleTable:
+        if not self.payloads:
+            blk = _empty_block(self.schema)
+            return OracleTable.from_block(blk)
+        blocks = [payload_to_block(p, self.schema) for p in self.payloads]
+        return OracleTable.from_block(
+            blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
+        )
+
+
+def run_stage_graph(
+    stages: list[StageSpec],
+    sources: dict[str, list[ColumnSource]],
+    runtime,
+    dicts=None,
+    key_spaces=None,
+    spill_quota_bytes: int = 64 << 20,
+    window: int = DEFAULT_WINDOW,
+) -> OracleTable:
+    """Compile stages, place tasks round-robin over the runtime's nodes,
+    run to completion, return the result (the executer-actor shape,
+    kqp_executer_impl.h:120 + planner kqp_planner.cpp:116)."""
+    # schemas flow source -> downstream
+    compiled: list[_CompiledStage] = []
+    for spec in stages:
+        in_schema = None
+        for inp in spec.inputs:
+            if isinstance(inp, SourceInput):
+                in_schema = sources[inp.source_id][0].schema
+            else:
+                in_schema = compiled[inp.from_stage].out_schema
+        if in_schema is None:
+            raise ValueError("stage with no inputs")
+        compiled.append(_CompiledStage(spec, in_schema, dicts, key_spaces))
+
+    tasks, channels, result_stage = build_tasks(stages)
+    systems = list(runtime.nodes.values()) if hasattr(runtime, "nodes") \
+        else [runtime]
+    collector = ResultCollector(compiled[result_stage].out_schema)
+    collector_id = systems[0].register(collector)
+
+    # place tasks, then wire channel targets
+    actor_of_task: dict[int, ActorId] = {}
+    actors: list[ComputeActor] = []
+    chan_by_id = {c.channel_id: c for c in channels}
+    for i, t in enumerate(tasks):
+        src = None
+        for inp in t.stage_spec.inputs:
+            if isinstance(inp, SourceInput):
+                parts = sources[inp.source_id]
+                src = parts[t.partition % len(parts)]
+        a = ComputeActor(
+            t, compiled[t.stage], {}, chan_by_id, src,
+            collector_id,
+            spiller=Spiller(mem_quota_bytes=spill_quota_bytes,
+                            prefix=f"spill/task{t.task_id}"),
+            window=window,
+        )
+        sys_i = systems[i % len(systems)]
+        actor_of_task[t.task_id] = sys_i.register(a)
+        actors.append(a)
+    for a in actors:
+        for ch in a.task.output_channels:
+            a.channel_targets[ch] = actor_of_task[chan_by_id[ch].dst_task]
+    sys_by_node = {s.node: s for s in systems}
+    for t in tasks:
+        aid = actor_of_task[t.task_id]
+        sys_by_node[aid.node].send(aid, StartTask())
+
+    if hasattr(runtime, "dispatch"):
+        runtime.dispatch()
+    else:
+        runtime.run()
+    if not collector.done:
+        raise RuntimeError("stage graph did not complete")
+    return collector.table()

@@ -1,0 +1,137 @@
+"""DQ stage/task/channel graph model.
+
+Mirror of the reference's distributed-query task model (dq_tasks.proto:71-
+207; SURVEY.md §2.10): a query phase is a DAG of *stages*; each stage runs
+N parallel *tasks* hosting a program; tasks connect through *channels*
+with partitioned (HashPartition), broadcast, or merge-less (UnionAll)
+routing, with credit-based flow control between compute actors.
+
+TPU-era position: when all stages fit one SPMD program the mesh executor
+(ydb_tpu.parallel.MeshScan) fuses them — channels become collectives.
+This layer is the general form: host-mediated streaming between compiled
+device programs, for plans that don't fuse (multi-phase queries, sources
+of different shapes, cross-pod DCN hops).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ydb_tpu.ssa.program import Program
+
+
+@dataclasses.dataclass(frozen=True)
+class SourceInput:
+    """Stage reads partitioned table data: task i gets partition i."""
+
+    source_id: str
+
+
+@dataclasses.dataclass(frozen=True)
+class UnionAllInput:
+    """Stage consumes every output channel of an upstream stage."""
+
+    from_stage: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HashPartition:
+    keys: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Broadcast:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class UnionAll:
+    """Route every block to the consumer task (consumer stage has 1 task
+    or doesn't care which task receives)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ResultOutput:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class StageSpec:
+    """One stage: per-block ``program`` (map/partial phase), optional
+    ``final_program`` applied to the accumulated inputs (aggregate merge),
+    input wiring, output routing and task parallelism."""
+
+    program: Program | None
+    inputs: tuple
+    output: object
+    tasks: int = 1
+    final_program: Program | None = None
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: int
+    stage: int
+    stage_spec: StageSpec
+    partition: int
+    # channel wiring filled by build_tasks
+    input_channels: list[int] = dataclasses.field(default_factory=list)
+    output_channels: list[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ChannelSpec:
+    channel_id: int
+    src_task: int
+    dst_task: int
+    # routing metadata: dst index within the producer's consumer set
+    dst_index: int
+
+
+def build_tasks(
+    stages: list[StageSpec],
+) -> tuple[list[TaskSpec], list[ChannelSpec], int]:
+    """Expand stages into tasks + channels.
+
+    Returns (tasks, channels, result_stage). The result stage must have
+    exactly one task with ResultOutput.
+    (reference: task graph construction kqp_tasks_graph.cpp:448,778)
+    """
+    tasks: list[TaskSpec] = []
+    channels: list[ChannelSpec] = []
+    stage_tasks: list[list[int]] = []
+    next_channel = 0
+    result_stage = -1
+    for si, spec in enumerate(stages):
+        ids = []
+        for p in range(spec.tasks):
+            t = TaskSpec(len(tasks), si, spec, p)
+            ids.append(t.task_id)
+            tasks.append(t)
+        stage_tasks.append(ids)
+        if isinstance(spec.output, ResultOutput):
+            if result_stage >= 0 or spec.tasks != 1:
+                raise ValueError("exactly one single-task result stage")
+            result_stage = si
+    if result_stage < 0:
+        raise ValueError("no result stage")
+
+    for si, spec in enumerate(stages):
+        for inp in spec.inputs:
+            if isinstance(inp, SourceInput):
+                continue
+            if not isinstance(inp, UnionAllInput):
+                raise ValueError(inp)
+            up = inp.from_stage
+            up_spec = stages[up]
+            consumers = stage_tasks[si]
+            for src in stage_tasks[up]:
+                for di, dst in enumerate(consumers):
+                    ch = ChannelSpec(next_channel, src, dst, di)
+                    next_channel += 1
+                    channels.append(ch)
+                    tasks[src].output_channels.append(ch.channel_id)
+                    tasks[dst].input_channels.append(ch.channel_id)
+            if isinstance(up_spec.output, UnionAll) and len(consumers) != 1:
+                raise ValueError("UnionAll output needs 1 consumer task")
+    return tasks, channels, result_stage

@@ -1,0 +1,1 @@
+from ydb_tpu.runtime.actors import Actor, ActorSystem, ActorId  # noqa: F401

@@ -125,6 +125,7 @@ class Select:
     having: Expr | None
     order_by: tuple[OrderItem, ...]
     limit: int | None
+    distinct: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -125,7 +125,7 @@ class Parser:
 
     def parse_select(self) -> ast.Select:
         self.expect("kw", "select")
-        self.kw("distinct")  # DISTINCT == GROUP BY all items; planner checks
+        distinct = self.kw("distinct")
         items = [self.parse_select_item()]
         while self.accept("op", ","):
             items.append(self.parse_select_item())
@@ -152,7 +152,7 @@ class Parser:
         if self.kw("limit"):
             limit = int(self.expect("number").value)
         return ast.Select(tuple(items), from_, where, group_by, having,
-                          order_by, limit)
+                          order_by, limit, distinct)
 
     def parse_select_item(self) -> ast.SelectItem:
         expr = self.parse_expr()

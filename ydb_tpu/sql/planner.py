@@ -268,20 +268,13 @@ class _Lower:
                     return DictPredicate(col, "eq", lit_side.value)
                 if op == "ne":
                     return DictPredicate(col, "ne", lit_side.value)
-                # ordered string compare via dictionary prefix masks
-                d = self.dicts[col] if (
-                    self.dicts and col in self.dicts) else None
-                if d is None:
+                # ordered string compare: lowered by the compiler via a
+                # plan-time dictionary mask (_custom_dict_mask)
+                if not (self.dicts and col in self.dicts):
                     raise PlanError(
                         f"ordered string compare on {col} needs dictionary")
-                kind = {"lt": "lt", "le": "le", "gt": "gt", "ge": "ge"}[op]
                 val = lit_side.value.encode() if isinstance(
                     lit_side.value, str) else lit_side.value
-                mask_kind = {"lt": lambda v: v < val,
-                             "le": lambda v: v <= val,
-                             "gt": lambda v: v > val,
-                             "ge": lambda v: v >= val}[kind]
-                # custom predicate via match_mask at plan time
                 return DictPredicate(col, "custom", ("ord", op, val))
             return Call(_CMP[e.op], self.lower(e.left), self.lower(e.right))
         if e.op in _ARITH:
@@ -366,6 +359,14 @@ def plan_select(sel: ast.Select, catalog: Catalog):
     binding, refs, join_specs = _bind(sel, catalog)
     alias_to_table = dict(binding.tables)
 
+    # right sides of LEFT JOINs: WHERE on them filters AFTER the join
+    # (pushing into the scan would keep NULL-extended rows WHERE should
+    # drop), so their single-table conjuncts stay residual
+    left_right_aliases = {
+        binding.tables[idx][0]
+        for idx, _, kind in join_specs if kind == "left"
+    }
+
     # classify WHERE conjuncts
     pushdown: dict[str, list[ast.Expr]] = {a: [] for a, _ in binding.tables}
     join_conds: list[tuple[str, str, str, str]] = []  # (la, lc, ra, rc)
@@ -374,6 +375,9 @@ def plan_select(sel: ast.Select, catalog: Catalog):
         aliases = _expr_columns(c, binding)
         if len(aliases) <= 1:
             target = next(iter(aliases)) if aliases else binding.tables[0][0]
+            if target in left_right_aliases:
+                residual.append(c)
+                continue
             pushdown[target].append(c)
         elif (
             len(aliases) == 2
@@ -460,8 +464,19 @@ def plan_select(sel: ast.Select, catalog: Catalog):
     pending = join_conds[:]
     for i in range(1, len(binding.tables)):
         alias, table = binding.tables[i]
-        conds = list(on_conds.get(i, []))
-        # WHERE-derived equi conds connecting this table to joined ones
+        # orient every condition (ON or WHERE-derived) as
+        # (joined-side alias/col, new-table alias/col)
+        conds = []
+        for la, lc, ra, rc in on_conds.get(i, []):
+            if ra == alias and la in joined_aliases:
+                conds.append((la, lc, ra, rc))
+            elif la == alias and ra in joined_aliases:
+                conds.append((ra, rc, la, lc))
+            else:
+                raise PlanError(
+                    f"ON condition does not connect {alias} to the joined"
+                    f" tables: {la}.{lc} = {ra}.{rc}"
+                )
         still = []
         for la, lc, ra, rc in pending:
             if ra == alias and la in joined_aliases:
@@ -491,13 +506,20 @@ def plan_select(sel: ast.Select, catalog: Catalog):
         )
         pk = catalog.primary_keys.get(table)
         unique_build = pk is not None and set(pk) <= set(build_keys)
-        if not payload and kind == "inner":
+        if kind == "left" and not unique_build:
+            raise PlanError(
+                f"LEFT JOIN with non-unique build side {table} is not"
+                " supported yet (N:M left expansion)"
+            )
+        if not payload and kind == "inner" and unique_build:
+            # pure filtering join: multiplicity can't change (<=1 match)
             plan = LookupJoin(plan, scan_for(alias), probe_keys, build_keys,
                               (), "semi")
         elif unique_build or kind == "left":
             plan = LookupJoin(plan, scan_for(alias), probe_keys, build_keys,
                               payload, kind)
         else:
+            # non-unique build changes row multiplicity: expand exactly
             probe_payload = tuple(types.keys())
             plan = ExpandJoin(plan, scan_for(alias), probe_keys, build_keys,
                               probe_payload, payload)
@@ -519,6 +541,9 @@ def plan_select(sel: ast.Select, catalog: Catalog):
 
     out_names: list[str] = []
     if has_agg:
+        if sel.distinct:
+            raise PlanError("SELECT DISTINCT with aggregates is redundant"
+                            " or unsupported; drop DISTINCT")
         steps, out_names = _plan_aggregate(sel, low, steps, binding)
     else:
         for idx, item in enumerate(sel.items):
@@ -531,6 +556,9 @@ def plan_select(sel: ast.Select, catalog: Catalog):
                 steps.append(AssignStep(name, low.lower(item.expr)))
                 out_names.append(name)
         steps.append(ProjectStep(tuple(out_names)))
+        if sel.distinct:
+            # DISTINCT == group by every output column, no aggregates
+            steps.append(GroupByStep(tuple(out_names), ()))
 
     if sel.order_by:
         keys = []

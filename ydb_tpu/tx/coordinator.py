@@ -79,10 +79,13 @@ class Coordinator:
 
         Marked completed immediately: shard-local metadata swaps cannot
         tear a cross-shard read, and background results should become
-        visible without waiting for the next distributed commit."""
-        _, step = self.plan()
-        self._mark_completed(step)
-        return step
+        visible without waiting for the next distributed commit. Takes the
+        commit lock so it cannot interleave with an in-flight distributed
+        commit and advance the barrier past its not-yet-applied step."""
+        with self._commit_lock:
+            _, step = self.plan()
+            self._mark_completed(step)
+            return step
 
     def commit(self, participants: list, prepare_args: list) -> TxResult:
         """Two-phase commit: prepare on every participant, then commit all
