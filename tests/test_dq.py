@@ -182,3 +182,64 @@ def test_filter_map_stage_without_agg():
         {k: (v[: 200], np.ones(200, dtype=bool))
          for k, v in merged.items()}, sch))
     assert res.num_rows == ora.num_rows
+
+
+def test_source_partitions_differ_from_task_count():
+    """Strided partition assignment: every partition is read exactly once
+    whether tasks < partitions or tasks > partitions."""
+    sch, parts, merged = _make_sources(n_parts=4, rows=2000)
+    total = int(merged["v"].sum())
+    prog = Program((GroupByStep(keys=(), aggs=(
+        AggSpec(Agg.SUM, "v", "total"),)),))
+    partial, final = twophase.split(prog)
+    for tasks in (2, 3, 4, 6):
+        rt = SimRuntime(n_nodes=2)
+        s0 = StageSpec(program=partial, inputs=(SourceInput("t"),),
+                       output=HashPartition(()), tasks=tasks)
+        s1 = StageSpec(program=None, inputs=(UnionAllInput(0),),
+                       output=ResultOutput(), tasks=1,
+                       final_program=final)
+        res = run_stage_graph([s0, s1], {"t": parts}, rt)
+        assert int(res.cols["total"][0][0]) == total, tasks
+
+
+def test_multi_consumer_stage_gets_full_stream():
+    """A producer feeding two consumer stages must route the FULL stream
+    to each (per-consumer channel groups), not split it across them."""
+    sch, parts, merged = _make_sources(n_parts=2, rows=1000)
+    total = int(merged["v"].sum())
+    keyless = Program((GroupByStep(keys=(), aggs=(
+        AggSpec(Agg.SUM, "v", "total"),)),))
+    _, final = twophase.split(keyless)
+    rt = SimRuntime(n_nodes=2)
+    s0 = StageSpec(program=None, inputs=(SourceInput("t"),),
+                   output=HashPartition(("k",)), tasks=2)
+    # two independent consumers of stage 0, same output schema
+    s1 = StageSpec(program=None, inputs=(UnionAllInput(0),),
+                   output=HashPartition(()), tasks=2,
+                   final_program=keyless)
+    s2 = StageSpec(program=None, inputs=(UnionAllInput(0),),
+                   output=HashPartition(()), tasks=1,
+                   final_program=keyless)
+    # result merges both totals: 2x the table sum iff each consumer saw
+    # every row
+    s3 = StageSpec(program=None, inputs=(UnionAllInput(1), UnionAllInput(2)),
+                   output=ResultOutput(), tasks=1,
+                   final_program=final)
+    res = run_stage_graph([s0, s1, s2, s3], {"t": parts}, rt)
+    assert int(res.cols["total"][0][0]) == 2 * total
+
+
+def test_multi_input_schema_mismatch_raises():
+    sch, parts, merged = _make_sources(n_parts=2, rows=200)
+    rt = SimRuntime(n_nodes=1)
+    s0 = StageSpec(program=Program((ProjectStep(("k",)),)),
+                   inputs=(SourceInput("t"),),
+                   output=HashPartition(("k",)), tasks=1)
+    s1 = StageSpec(program=Program((ProjectStep(("v",)),)),
+                   inputs=(SourceInput("t"),),
+                   output=HashPartition(("v",)), tasks=1)
+    s2 = StageSpec(program=None, inputs=(UnionAllInput(0), UnionAllInput(1)),
+                   output=ResultOutput(), tasks=1)
+    with pytest.raises(ValueError, match="share one schema"):
+        run_stage_graph([s0, s1, s2], {"t": parts}, rt)

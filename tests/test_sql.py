@@ -288,3 +288,43 @@ def test_no_payload_join_preserves_multiplicity(data, db, catalog):
                   where o_orderkey = l_orderkey""", catalog, db)
     n_li = len(data.tables["lineitem"]["l_orderkey"])
     assert int(res.cols["n"][0][0]) == n_li  # every lineitem has its order
+
+
+def test_left_join_where_equi_cond_stays_post_join():
+    """WHERE a.ya = b.yb on a LEFT JOIN must filter AFTER the join (drop
+    NULL-extended rows), not fold into the ON condition."""
+    c = Cluster(n_shards=1)
+    s = c.session()
+    s.execute("""create table a (k bigint not null, ya bigint,
+                 primary key (k))""")
+    s.execute("""create table b (k bigint not null, yb bigint,
+                 primary key (k))""")
+    s.execute("insert into a values (1, 10), (2, 20), (3, 30)")
+    s.execute("insert into b values (1, 10), (2, 99)")
+    # matches: k=1 (ya=yb=10 kept), k=2 (20!=99 dropped),
+    # k=3 (no match -> NULL yb -> dropped by WHERE)
+    res = s.execute("""select a.k as k, yb from a
+                       left join b on a.k = b.k
+                       where ya = yb order by k""")
+    assert res.num_rows == 1
+    assert int(res.cols["k"][0][0]) == 1
+    assert int(res.cols["yb"][0][0]) == 10
+    # sanity: without the WHERE all three left rows survive
+    res2 = s.execute("""select a.k as k from a
+                        left join b on a.k = b.k order by k""")
+    assert res2.num_rows == 3
+
+
+def test_left_join_residual_on_colliding_name_raises():
+    """A residual predicate referencing a build-side column shadowed by a
+    probe-side column of the same name must raise, not silently resolve
+    to the probe side."""
+    c = Cluster(n_shards=1)
+    s = c.session()
+    s.execute("create table a (k bigint not null, ya bigint, primary key (k))")
+    s.execute("create table b (k bigint not null, yb bigint, primary key (k))")
+    s.execute("insert into a values (1, 1), (2, 20)")
+    s.execute("insert into b values (2, 99)")
+    with pytest.raises(PlanError, match="not carried through the join"):
+        s.execute("""select a.k from a left join b on a.k = b.k
+                     where a.ya = b.k""")

@@ -13,6 +13,7 @@ the (much smaller) result travels the channels host-side.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 
 import jax.numpy as jnp
@@ -87,11 +88,10 @@ def payload_to_block(payload: dict, schema: dtypes.Schema) -> TableBlock:
     return TableBlock.from_numpy(cols, schema, validity)
 
 
-def _partition_payload(payload: dict, schema, keys, n: int) -> list[dict]:
-    """Host-side hash split (the vectorized block hash partitioner,
-    dq_output_consumer.cpp:338)."""
-    if n == 1:
-        return [payload]
+def _hash_rows(payload: dict, schema, keys) -> np.ndarray:
+    """Row hash for partition routing (the vectorized block hash
+    partitioner, dq_output_consumer.cpp:338); computed once per block and
+    reduced mod the channel count per consumer group."""
     first = payload[schema.names[0]]
     h = np.zeros(len(first), dtype=np.uint64)
     h[:] = 0x9E3779B97F4A7C15
@@ -102,6 +102,12 @@ def _partition_payload(payload: dict, schema, keys, n: int) -> list[dict]:
         x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
         x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
         h = x ^ (x >> np.uint64(31))
+    return h
+
+
+def _split_by_hash(payload: dict, h: np.ndarray, n: int) -> list[dict]:
+    if n == 1:
+        return [payload]
     dest = (h % np.uint64(n)).astype(np.int64)
     out = []
     for d in range(n):
@@ -168,7 +174,7 @@ class ComputeActor(Actor):
         compiled: _CompiledStage,
         channel_targets: dict[int, ActorId],  # my out channel -> consumer
         channel_specs: dict[int, ChannelSpec],
-        source: ColumnSource | None,
+        sources: list[ColumnSource],
         result_target: ActorId | None,
         spiller: Spiller | None = None,
         window: int = DEFAULT_WINDOW,
@@ -179,7 +185,7 @@ class ComputeActor(Actor):
         self.compiled = compiled
         self.channel_targets = channel_targets
         self.channel_specs = channel_specs
-        self.source = source
+        self.sources = sources
         self.result_target = result_target
         self.window = window
         self.block_rows = block_rows
@@ -188,10 +194,16 @@ class ComputeActor(Actor):
         self._in_finished: set[int] = set()
         self._acc: list[TableBlock] = []  # agg stages accumulate
         self._unacked: dict[int, int] = {c: 0 for c in task.output_channels}
-        self._parked: dict[int, list] = {c: [] for c in task.output_channels}
+        self._parked: dict[int, collections.deque] = {
+            c: collections.deque() for c in task.output_channels
+        }
         self._next_seq: dict[int, int] = {c: 0 for c in task.output_channels}
         self._fin_pending: set[int] = set()
         self._done = False
+        groups: dict[int, list[int]] = {}
+        for c in task.output_channels:
+            groups.setdefault(channel_specs[c].dst_stage, []).append(c)
+        self._consumer_groups: list[list[int]] = list(groups.values())
 
     # ---- input side ----
 
@@ -214,8 +226,8 @@ class ComputeActor(Actor):
             raise TypeError(message)
 
     def _consume_source(self):
-        if self.source is not None:
-            for blk in self.source.blocks(self.block_rows):
+        for source in self.sources:
+            for blk in source.blocks(self.block_rows):
                 self._ingest(blk)
         if not self.task.input_channels:
             self._finish_input()
@@ -251,21 +263,20 @@ class ComputeActor(Actor):
         if isinstance(out, ResultOutput):
             self.send(self.result_target, ResultData(payload, False))
             return
-        chans = self.task.output_channels
+        # each consumer stage gets the full routed stream independently
+        h = None
         if isinstance(out, HashPartition):
-            parts = _partition_payload(
-                payload, self.compiled.out_schema, out.keys, len(chans)
-            )
-            for ch, part in zip(chans, parts):
-                if len(next(iter(part.values()))) == 0:
-                    continue
-                self._send_channel(ch, part)
-        elif isinstance(out, Broadcast):
-            for ch in chans:
-                self._send_channel(ch, payload)
-        else:  # UnionAll: single consumer
-            for ch in chans:
-                self._send_channel(ch, payload)
+            h = _hash_rows(payload, self.compiled.out_schema, out.keys)
+        for chans in self._consumer_groups:
+            if isinstance(out, HashPartition):
+                for ch, part in zip(chans,
+                                    _split_by_hash(payload, h, len(chans))):
+                    if len(next(iter(part.values()))) == 0:
+                        continue
+                    self._send_channel(ch, part)
+            else:  # Broadcast, or UnionAll (single consumer task per stage)
+                for ch in chans:
+                    self._send_channel(ch, payload)
 
     def _send_channel(self, ch: int, payload: dict):
         if self._unacked[ch] >= self.window:
@@ -296,7 +307,7 @@ class ComputeActor(Actor):
         ch = ack.channel_id
         self._unacked[ch] -= 1
         while self._parked[ch] and self._unacked[ch] < self.window:
-            sid = self._parked[ch].pop(0)
+            sid = self._parked[ch].popleft()
             self._dispatch(ch, self.spiller.get(sid), finished=False)
         if (
             ch in self._fin_pending
@@ -352,16 +363,25 @@ def run_stage_graph(
     kqp_executer_impl.h:120 + planner kqp_planner.cpp:116)."""
     # schemas flow source -> downstream
     compiled: list[_CompiledStage] = []
-    for spec in stages:
-        in_schema = None
+    for si, spec in enumerate(stages):
+        in_schemas = []
         for inp in spec.inputs:
             if isinstance(inp, SourceInput):
-                in_schema = sources[inp.source_id][0].schema
+                in_schemas.append(sources[inp.source_id][0].schema)
             else:
-                in_schema = compiled[inp.from_stage].out_schema
-        if in_schema is None:
+                in_schemas.append(compiled[inp.from_stage].out_schema)
+        if not in_schemas:
             raise ValueError("stage with no inputs")
-        compiled.append(_CompiledStage(spec, in_schema, dicts, key_spaces))
+        if any(s != in_schemas[0] for s in in_schemas[1:]):
+            # every channel payload decodes with one schema; unequal
+            # upstream schemas would silently mislabel columns
+            raise ValueError(
+                f"stage {si}: all inputs must share one schema, got "
+                f"{[s.names for s in in_schemas]}"
+            )
+        compiled.append(
+            _CompiledStage(spec, in_schemas[0], dicts, key_spaces)
+        )
 
     tasks, channels, result_stage = build_tasks(stages)
     systems = list(runtime.nodes.values()) if hasattr(runtime, "nodes") \
@@ -374,13 +394,16 @@ def run_stage_graph(
     actors: list[ComputeActor] = []
     chan_by_id = {c.channel_id: c for c in channels}
     for i, t in enumerate(tasks):
-        src = None
+        srcs: list[ColumnSource] = []
         for inp in t.stage_spec.inputs:
             if isinstance(inp, SourceInput):
+                # strided assignment: task p reads partitions p, p+N, …
+                # so every partition is read exactly once regardless of
+                # the task-count / partition-count ratio
                 parts = sources[inp.source_id]
-                src = parts[t.partition % len(parts)]
+                srcs.extend(parts[t.partition::t.stage_spec.tasks])
         a = ComputeActor(
-            t, compiled[t.stage], {}, chan_by_id, src,
+            t, compiled[t.stage], {}, chan_by_id, srcs,
             collector_id,
             spiller=Spiller(mem_quota_bytes=spill_quota_bytes,
                             prefix=f"spill/task{t.task_id}"),

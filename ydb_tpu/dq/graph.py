@@ -22,7 +22,9 @@ from ydb_tpu.ssa.program import Program
 
 @dataclasses.dataclass(frozen=True)
 class SourceInput:
-    """Stage reads partitioned table data: task i gets partition i."""
+    """Stage reads partitioned table data; task p of an N-task stage reads
+    partitions p, p+N, p+2N, … so every partition is read exactly once for
+    any task-count / partition-count ratio."""
 
     source_id: str
 
@@ -84,8 +86,11 @@ class ChannelSpec:
     channel_id: int
     src_task: int
     dst_task: int
-    # routing metadata: dst index within the producer's consumer set
+    # routing metadata: dst index within the consumer stage's task set
     dst_index: int
+    # consumer stage: a producer feeding several stages routes each
+    # consumer's channel group independently (full stream to each)
+    dst_stage: int
 
 
 def build_tasks(
@@ -127,7 +132,7 @@ def build_tasks(
             consumers = stage_tasks[si]
             for src in stage_tasks[up]:
                 for di, dst in enumerate(consumers):
-                    ch = ChannelSpec(next_channel, src, dst, di)
+                    ch = ChannelSpec(next_channel, src, dst, di, si)
                     next_channel += 1
                     channels.append(ch)
                     tasks[src].output_channels.append(ch.channel_id)

@@ -387,7 +387,12 @@ def plan_select(sel: ast.Select, catalog: Catalog):
         ):
             la, lc = binding.resolve(c.left)
             ra, rc = binding.resolve(c.right)
-            join_conds.append((la, lc, ra, rc))
+            if la in left_right_aliases or ra in left_right_aliases:
+                # folding a WHERE equi-cond into a LEFT JOIN's ON would
+                # keep NULL-extended rows that WHERE must drop
+                residual.append(c)
+            else:
+                join_conds.append((la, lc, ra, rc))
         else:
             residual.append(c)
 
@@ -457,9 +462,14 @@ def plan_select(sel: ast.Select, catalog: Catalog):
     joined_aliases = [binding.tables[0][0]]
     plan = scan_for(joined_aliases[0])
     types: dict[str, dtypes.LogicalType] = {}
+    # joined output columns are keyed by bare name; owner tracks which
+    # alias a carried name actually came from so residual predicates can
+    # reject silent cross-alias mis-resolution on name collisions
+    owner: dict[str, str] = {}
     a0, t0 = binding.tables[0]
     for n in demand[a0] or set(catalog.schemas[t0].names[:1]):
         types[n] = catalog.schemas[t0].field(n).type
+        owner[n] = a0
 
     pending = join_conds[:]
     for i in range(1, len(binding.tables)):
@@ -525,11 +535,22 @@ def plan_select(sel: ast.Select, catalog: Catalog):
                               probe_payload, payload)
         for n in payload:
             types[n] = catalog.schemas[table].field(n).type
+            owner[n] = alias
         joined_aliases.append(alias)
     if pending:
         raise PlanError(f"unplaced join conditions {pending}")
 
     # final transform: residual filters, aggregation, having, order, project
+    if len(binding.tables) > 1:
+        for c in residual:
+            for x in _walk_names(c):
+                a, col = binding.resolve(x)
+                if col not in types or owner.get(col, a) != a:
+                    raise PlanError(
+                        f"predicate references {a}.{col}, which is not"
+                        " carried through the join under that name (name"
+                        " collision with another table); rename the column"
+                    )
     low = _Lower(types, catalog.dicts)
     steps: list = []
     for c in residual:
