@@ -200,10 +200,16 @@ class ComputeActor(Actor):
         self._next_seq: dict[int, int] = {c: 0 for c in task.output_channels}
         self._fin_pending: set[int] = set()
         self._done = False
-        groups: dict[int, list[int]] = {}
+        groups: dict[tuple[int, int], list[int]] = {}
         for c in task.output_channels:
-            groups.setdefault(channel_specs[c].dst_stage, []).append(c)
-        self._consumer_groups: list[list[int]] = list(groups.values())
+            spec = channel_specs[c]
+            groups.setdefault((spec.dst_stage, spec.input_index),
+                              []).append(c)
+        # hash slot p must land on the consumer task with dst_index p
+        self._consumer_groups: list[list[int]] = [
+            sorted(chs, key=lambda c: channel_specs[c].dst_index)
+            for chs in groups.values()
+        ]
 
     # ---- input side ----
 
@@ -263,18 +269,20 @@ class ComputeActor(Actor):
         if isinstance(out, ResultOutput):
             self.send(self.result_target, ResultData(payload, False))
             return
-        # each consumer stage gets the full routed stream independently
+        # each consumer edge gets the full routed stream independently;
+        # the row hash is only needed when some edge actually fans out
         h = None
-        if isinstance(out, HashPartition):
+        if isinstance(out, HashPartition) and any(
+                len(chans) > 1 for chans in self._consumer_groups):
             h = _hash_rows(payload, self.compiled.out_schema, out.keys)
         for chans in self._consumer_groups:
-            if isinstance(out, HashPartition):
+            if isinstance(out, HashPartition) and len(chans) > 1:
                 for ch, part in zip(chans,
                                     _split_by_hash(payload, h, len(chans))):
                     if len(next(iter(part.values()))) == 0:
                         continue
                     self._send_channel(ch, part)
-            else:  # Broadcast, or UnionAll (single consumer task per stage)
+            else:  # Broadcast/UnionAll, or a single-task hash consumer
                 for ch in chans:
                     self._send_channel(ch, payload)
 

@@ -86,11 +86,16 @@ class ChannelSpec:
     channel_id: int
     src_task: int
     dst_task: int
-    # routing metadata: dst index within the consumer stage's task set
+    # routing metadata: dst index within the consumer stage's task set —
+    # hash slot p of a HashPartition output goes to the dst with
+    # dst_index == p (consumer groups sort by this)
     dst_index: int
-    # consumer stage: a producer feeding several stages routes each
-    # consumer's channel group independently (full stream to each)
+    # consumer edge: a producer feeding several consumer edges routes
+    # each edge's channel group independently (full stream to each);
+    # two edges from the same pair of stages stay distinct via
+    # input_index (the edge's position in the consumer's inputs)
     dst_stage: int
+    input_index: int = 0
 
 
 def build_tasks(
@@ -122,7 +127,7 @@ def build_tasks(
         raise ValueError("no result stage")
 
     for si, spec in enumerate(stages):
-        for inp in spec.inputs:
+        for ei, inp in enumerate(spec.inputs):
             if isinstance(inp, SourceInput):
                 continue
             if not isinstance(inp, UnionAllInput):
@@ -132,7 +137,7 @@ def build_tasks(
             consumers = stage_tasks[si]
             for src in stage_tasks[up]:
                 for di, dst in enumerate(consumers):
-                    ch = ChannelSpec(next_channel, src, dst, di, si)
+                    ch = ChannelSpec(next_channel, src, dst, di, si, ei)
                     next_channel += 1
                     channels.append(ch)
                     tasks[src].output_channels.append(ch.channel_id)

@@ -1,0 +1,4 @@
+from ydb_tpu.tablet.executor import TabletExecutor, Transaction
+from ydb_tpu.tablet.localdb import LocalDb
+
+__all__ = ["TabletExecutor", "Transaction", "LocalDb"]

@@ -1,0 +1,164 @@
+"""Tablet executor: the universal persistence primitive.
+
+Mirror of the reference's tablet_flat executor (ITransaction
+Execute/Complete tablet_flat_executor.h:281,297; TExecutor
+flat_executor.h:320; boot stages flat_boot_*.h; SURVEY.md §3.5): a
+per-tablet single-writer transaction machine whose only durable state is
+a snapshot plus a redo log in the blob store. ``execute`` runs the
+transaction against the local DB, persists the change set as a redo
+record, applies it, then runs ``complete`` for side effects. ``boot``
+replays snapshot + redo — any node can resurrect the tablet from the
+store alone, which is what lets Hive restart dead tablets elsewhere
+(mind/hive; SURVEY.md §5.3).
+
+Generations fence zombie writers: each boot bumps the generation, and
+log records carry it. Replay follows the highest-generation chain, so a
+stale leader's appends after a takeover are ignored by the next boot
+(the blob-store analog of BlobStorage's barrier/block mechanism).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ydb_tpu.engine.blobs import BlobStore
+from ydb_tpu.tablet.localdb import LocalDb
+
+
+class TxContext:
+    """Change-staging handle passed to Transaction.execute."""
+
+    def __init__(self, db: LocalDb, version: int):
+        self.db = db
+        self.version = version  # version this commit will get
+        self.changes: list[tuple] = []
+
+    # reads see committed state only (single-writer: no dirty reads needed)
+    def get(self, table: str, key: tuple):
+        return self.db.table(table).get(tuple(key))
+
+    def range(self, table: str, lo=None, hi=None):
+        return self.db.table(table).range(lo, hi)
+
+    def put(self, table: str, key: tuple, row: dict) -> None:
+        self.changes.append((table, tuple(key), dict(row)))
+
+    def erase(self, table: str, key: tuple) -> None:
+        self.changes.append((table, tuple(key), None))
+
+
+class Transaction:
+    def execute(self, txc: TxContext, tablet) -> None:
+        raise NotImplementedError
+
+    def complete(self, tablet) -> None:
+        pass
+
+
+class TabletExecutor:
+    SNAP_EVERY = 64  # commits between automatic checkpoints
+
+    def __init__(self, tablet_id: str, store: BlobStore, *,
+                 generation: int = 1, db: LocalDb | None = None,
+                 version: int = 0, log_index: int = 0):
+        self.tablet_id = tablet_id
+        self.store = store
+        self.generation = generation
+        self.db = db or LocalDb()
+        self.version = version  # last committed version
+        self.log_index = log_index  # next redo record index
+        self._since_snap = 0
+
+    # ---- commit path ----
+
+    def _prefix(self) -> str:
+        return f"tablet/{self.tablet_id}/"
+
+    def execute(self, tx: Transaction):
+        txc = TxContext(self.db, self.version + 1)
+        tx.execute(txc, self)
+        if txc.changes:
+            record = {
+                "gen": self.generation,
+                "version": txc.version,
+                "changes": [
+                    [t, list(k), r] for t, k, r in txc.changes
+                ],
+            }
+            blob_id = (f"{self._prefix()}log/"
+                       f"{self.generation:08d}.{self.log_index:010d}")
+            self.store.put(blob_id, json.dumps(record).encode())
+            self.log_index += 1
+            self.db.apply(txc.changes, txc.version)
+            self.version = txc.version
+            self._since_snap += 1
+            if self._since_snap >= self.SNAP_EVERY:
+                self.checkpoint()
+        tx.complete(self)
+        return tx
+
+    def checkpoint(self) -> None:
+        snap = {
+            "gen": self.generation,
+            "version": self.version,
+            "log_index": self.log_index,
+            "db": self.db.dump(),
+        }
+        self.store.put(f"{self._prefix()}snap/{self.version:012d}",
+                       json.dumps(snap).encode())
+        # truncate redo records covered by the snapshot
+        for blob_id in self.store.list(f"{self._prefix()}log/"):
+            gen, idx = blob_id.rsplit("/", 1)[1].split(".")
+            if (int(gen), int(idx)) < (self.generation, self.log_index):
+                self.store.delete(blob_id)
+        self._since_snap = 0
+
+    # ---- boot path ----
+
+    @classmethod
+    def boot(cls, tablet_id: str, store: BlobStore) -> "TabletExecutor":
+        prefix = f"tablet/{tablet_id}/"
+        db, version, log_index, gen = LocalDb(), 0, 0, 0
+        best_snap, best_key = None, (-1, -1)
+        for blob_id in store.list(f"{prefix}snap/"):
+            snap = json.loads(store.get(blob_id).decode())
+            key = (snap["gen"], snap["version"])
+            if key > best_key:
+                best_snap, best_key = snap, key
+        if best_snap is not None:
+            db = LocalDb.load(best_snap["db"])
+            version = best_snap["version"]
+            log_index = best_snap["log_index"]
+            gen = best_snap["gen"]
+        # Replay redo records after the snapshot with zombie fencing: a
+        # generation g record is only valid below the first version any
+        # higher generation wrote — the successor booted without seeing
+        # anything past that point, so later g-writes are a fenced-out
+        # leader's and must be discarded (the blob-barrier analog).
+        by_gen: dict[int, list] = {}
+        for blob_id in store.list(f"{prefix}log/"):
+            rec = json.loads(store.get(blob_id).decode())
+            g, idx = blob_id.rsplit("/", 1)[1].split(".")
+            by_gen.setdefault(int(g), []).append((int(idx), rec))
+        first_version = {
+            g: min(rec["version"] for _, rec in recs)
+            for g, recs in by_gen.items()
+        }
+        for g in sorted(by_gen):
+            if g < gen:
+                continue  # pre-snapshot stale generation
+            limit = min((first_version[h] for h in by_gen if h > g),
+                        default=None)
+            for idx, rec in sorted(by_gen[g]):
+                if rec["version"] <= version:
+                    continue
+                if limit is not None and rec["version"] >= limit:
+                    continue  # fenced zombie write
+                changes = [(t, tuple(k), r) for t, k, r in rec["changes"]]
+                db.apply(changes, rec["version"])
+                version = rec["version"]
+                gen = max(gen, g)
+                log_index = max(log_index, idx + 1)
+        gen = max(gen, max(by_gen, default=0))
+        return cls(tablet_id, store, generation=gen + 1, db=db,
+                   version=version, log_index=log_index)

@@ -1,0 +1,140 @@
+"""In-tablet sorted KV store with MVCC row versions.
+
+Mirror of the reference's NTable local database (flat_database.h:41;
+SURVEY.md §2.4): every tablet persists its state through one of these —
+named tables of sorted rows, where each key holds a list of versioned
+values so reads at an older snapshot still see the old row. The reference
+keeps a memtable plus immutable B-tree parts; at the scale of host
+control-plane state (schemas, tx queues, offsets — not user data) a
+single sorted dict per table with explicit version chains carries the
+same semantics, and ``freeze_part``/``compact`` keep the memtable/part
+shape for the OLTP datashard built on top.
+
+Rows are dict[str, value]; keys are tuples (the primary key columns).
+Versions are monotonically increasing integers supplied by the executor
+(the tablet's commit counter — the analog of the redo-log step).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Iterator
+
+
+TOMBSTONE = object()
+
+
+class TableStore:
+    """One table: sorted keys, each with a version chain (newest first)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._keys: list[tuple] = []  # sorted
+        self._chains: dict[tuple, list[tuple[int, Any]]] = {}
+
+    def put(self, key: tuple, row: dict | None, version: int) -> None:
+        """row=None erases (writes a tombstone version)."""
+        chain = self._chains.get(key)
+        if chain is None:
+            idx = bisect.bisect_left(self._keys, key)
+            self._keys.insert(idx, key)
+            chain = []
+            self._chains[key] = chain
+        value = TOMBSTONE if row is None else dict(row)
+        chain.insert(0, (version, value))
+
+    def get(self, key: tuple, version: int | None = None) -> dict | None:
+        chain = self._chains.get(key)
+        if not chain:
+            return None
+        for ver, value in chain:
+            if version is None or ver <= version:
+                return None if value is TOMBSTONE else value
+        return None
+
+    def range(self, lo: tuple | None = None, hi: tuple | None = None,
+              version: int | None = None,
+              ) -> Iterator[tuple[tuple, dict]]:
+        """Yield (key, row) in key order for lo <= key < hi at version."""
+        start = 0 if lo is None else bisect.bisect_left(self._keys, lo)
+        for i in range(start, len(self._keys)):
+            key = self._keys[i]
+            if hi is not None and key >= hi:
+                break
+            row = self.get(key, version)
+            if row is not None:
+                yield key, row
+
+    def compact(self, keep_after: int) -> None:
+        """Drop versions shadowed by a newer one at or below keep_after
+        (no snapshot older than keep_after can still read them)."""
+        dead_keys = []
+        for key, chain in self._chains.items():
+            kept = []
+            shadowed = False
+            for ver, value in chain:
+                if shadowed:
+                    break
+                kept.append((ver, value))
+                if ver <= keep_after:
+                    shadowed = True  # everything older is invisible
+            # a sole tombstone older than the horizon is gone entirely
+            if len(kept) == 1 and kept[0][1] is TOMBSTONE and \
+                    kept[0][0] <= keep_after:
+                dead_keys.append(key)
+            else:
+                self._chains[key] = kept
+        for key in dead_keys:
+            del self._chains[key]
+            idx = bisect.bisect_left(self._keys, key)
+            if idx < len(self._keys) and self._keys[idx] == key:
+                self._keys.pop(idx)
+
+    # ---- snapshot (de)serialization ----
+
+    def dump(self) -> list:
+        out = []
+        for key in self._keys:
+            chain = [
+                [ver, None if v is TOMBSTONE else v]
+                for ver, v in self._chains[key]
+            ]
+            out.append([list(key), chain])
+        return out
+
+    @classmethod
+    def load(cls, name: str, data: list) -> "TableStore":
+        t = cls(name)
+        for key_list, chain in data:
+            key = tuple(key_list)
+            t._keys.append(key)
+            t._chains[key] = [
+                (ver, TOMBSTONE if v is None else v) for ver, v in chain
+            ]
+        return t
+
+
+class LocalDb:
+    def __init__(self):
+        self.tables: dict[str, TableStore] = {}
+
+    def table(self, name: str) -> TableStore:
+        t = self.tables.get(name)
+        if t is None:
+            t = self.tables[name] = TableStore(name)
+        return t
+
+    def apply(self, changes: list[tuple], version: int) -> None:
+        """changes: [(table, key_tuple, row_or_None), ...]"""
+        for table, key, row in changes:
+            self.table(table).put(tuple(key), row, version)
+
+    def dump(self) -> dict:
+        return {name: t.dump() for name, t in self.tables.items()}
+
+    @classmethod
+    def load(cls, data: dict) -> "LocalDb":
+        db = cls()
+        for name, tdata in data.items():
+            db.tables[name] = TableStore.load(name, tdata)
+        return db
