@@ -1,0 +1,262 @@
+"""SchemeShard + scheme board + DDL tests (SURVEY.md §2.5).
+
+Covers: path-tree DDL operations persisted through the tablet executor
+(reboot-safe), scheme board pub/sub propagation to per-node caches, and
+the SQL DDL surface (CREATE/ALTER/DROP TABLE) end to end including full
+cluster reboot from the blob store."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.kqp.session import Cluster
+from ydb_tpu.runtime.test_runtime import SimRuntime
+from ydb_tpu.scheme.board import SchemeBoardReplica, SchemeCache
+from ydb_tpu.scheme.model import TableDescription
+from ydb_tpu.scheme.shard import SchemeError, SchemeShardCore
+from ydb_tpu.sql.planner import PlanError
+from ydb_tpu.tablet.executor import TabletExecutor
+
+
+def _core(store=None):
+    store = store or MemBlobStore()
+    return SchemeShardCore(TabletExecutor.boot("schemeshard", store)), store
+
+
+def _desc(path, n_shards=2):
+    return TableDescription(
+        path=path,
+        schema=dtypes.schema(("id", dtypes.INT64), ("v", dtypes.STRING)),
+        primary_key=("id",),
+        n_shards=n_shards,
+    )
+
+
+def test_scheme_path_tree_and_table_lifecycle():
+    core, store = _core()
+    core.mkdir("/app")
+    core.create_table(_desc("/app/events"))
+    assert core.kind("/app") == "dir"
+    assert core.kind("/app/events") == "table"
+    assert core.children("/") == ["/app"]
+    assert core.children("/app") == ["/app/events"]
+    d = core.describe("/app/events")
+    assert d.primary_key == ("id",) and d.schema_version == 1
+
+    with pytest.raises(SchemeError):
+        core.create_table(_desc("/app/events"))      # exists
+    with pytest.raises(SchemeError):
+        core.create_table(_desc("/nodir/t"))          # no parent
+    with pytest.raises(SchemeError):
+        core.mkdir("/app/events/sub")                 # parent not a dir
+
+    core.drop_table("/app/events")
+    assert core.describe("/app/events") is None
+    assert core.children("/app") == []
+    ops = [o["kind"] for o in core.operations_log()]
+    assert ops == ["mkdir", "create_table", "drop_table"]
+
+
+def test_scheme_alter_versioning_and_rules():
+    core, _ = _core()
+    core.create_table(_desc("/t"))
+    d = core.alter_table(
+        "/t", add_columns=[dtypes.Field("extra", dtypes.DOUBLE, True)])
+    assert d.schema_version == 2 and "extra" in d.schema
+    with pytest.raises(SchemeError):
+        core.alter_table(
+            "/t", add_columns=[dtypes.Field("x", dtypes.INT32, False)])
+    with pytest.raises(SchemeError):
+        core.alter_table("/t", drop_columns=["id"])   # key column
+    d = core.alter_table("/t", drop_columns=["extra"])
+    assert d.schema_version == 3 and "extra" not in d.schema
+
+
+def test_scheme_survives_tablet_reboot():
+    core, store = _core()
+    core.mkdir("/a")
+    core.create_table(_desc("/a/t1"))
+    core.alter_table(
+        "/a/t1", add_columns=[dtypes.Field("z", dtypes.INT32, True)])
+    # tablet dies; new executor boots from the same store
+    core2 = SchemeShardCore(TabletExecutor.boot("schemeshard", store))
+    d = core2.describe("/a/t1")
+    assert d is not None and "z" in d.schema and d.schema_version == 2
+    assert core2.children("/") == ["/a"]
+
+
+def test_scheme_board_propagation():
+    rt = SimRuntime(n_nodes=3)
+    replica = rt.system(1).register(SchemeBoardReplica())
+    cache2 = SchemeCache(replica)
+    cache3 = SchemeCache(replica)
+    rt.system(2).register(cache2)
+    rt.system(3).register(cache3)
+    rt.dispatch()
+
+    core, _ = _core()
+    # populator edge: schemeshard listeners push into the board
+    from ydb_tpu.scheme.board import BoardPublish
+
+    core.listeners.append(
+        lambda p, d, v: rt.system(1).send(replica, BoardPublish(p, d, v)))
+    core.create_table(_desc("/t"))
+    rt.dispatch()
+    assert cache2.resolve("/t")["primary_key"] == ["id"]
+    assert cache3.resolve("/t")["primary_key"] == ["id"]
+
+    core.alter_table(
+        "/t", add_columns=[dtypes.Field("w", dtypes.INT32, True)])
+    rt.dispatch()
+    assert any(c[0] == "w" for c in cache2.resolve("/t")["schema"])
+
+    # late subscriber gets a snapshot
+    cache_late = SchemeCache(replica)
+    rt.system(2).register(cache_late)
+    rt.dispatch()
+    assert cache_late.resolve("/t") is not None
+
+    core.drop_table("/t")
+    rt.dispatch()
+    assert cache2.resolve("/t") is None
+    assert cache_late.resolve("/t") is None
+
+
+def test_sql_ddl_end_to_end():
+    store = MemBlobStore()
+    c = Cluster(store=store)
+    s = c.session()
+    s.execute("CREATE TABLE t (id int64, name string, v double, "
+              "PRIMARY KEY (id)) WITH (shards = 2)")
+    assert c.tables["t"].schema.names == ("id", "name", "v")
+    assert len(c.tables["t"].shards) == 2
+    s.execute("INSERT INTO t VALUES (1, 'a', 1.5), (2, 'b', 2.5)")
+
+    s.execute("ALTER TABLE t ADD COLUMN w int32")
+    out = s.execute("SELECT id, w FROM t ORDER BY id")
+    assert list(out.column("id")) == [1, 2]
+    assert not out.validity("w").any()     # pre-ALTER rows read as NULL
+
+    s.execute("INSERT INTO t VALUES (3, 'c', 3.5, 30)")
+    out = s.execute("SELECT id, w FROM t WHERE w IS NOT NULL")
+    assert list(out.column("id")) == [3]
+    assert list(out.column("w")) == [30]
+
+    s.execute("ALTER TABLE t DROP COLUMN v")
+    with pytest.raises(PlanError):
+        s.execute("SELECT v FROM t")
+
+    s.execute("DROP TABLE t")
+    with pytest.raises(PlanError):
+        s.execute("SELECT id FROM t")
+    with pytest.raises(PlanError):
+        s.execute("DROP TABLE t")
+
+
+def test_cluster_reboots_from_store():
+    store = MemBlobStore()
+    c = Cluster(store=store)
+    s = c.session()
+    s.execute("CREATE TABLE users (id int64, city string, "
+              "PRIMARY KEY (id)) WITH (shards = 3)")
+    s.execute("INSERT INTO users VALUES (1, 'berlin'), (2, 'tokyo'), "
+              "(3, 'berlin'), (4, 'lima')")
+
+    # process dies; a new cluster boots from the same blob store
+    c2 = Cluster(store=store)
+    s2 = c2.session()
+    out = s2.execute("SELECT city, count(*) AS n FROM users "
+                     "GROUP BY city ORDER BY city")
+    assert [v.decode() for v in out.strings("city")] == \
+        ["berlin", "lima", "tokyo"]
+    assert list(out.column("n")) == [2, 1, 1]
+
+    # writes keep working after reboot (coordinator clock resumed)
+    s2.execute("INSERT INTO users VALUES (5, 'tokyo')")
+    out = s2.execute("SELECT count(*) AS n FROM users")
+    assert list(out.column("n")) == [5]
+
+
+# ---------- review regressions ----------
+
+def test_drop_then_recreate_does_not_resurrect_rows():
+    store = MemBlobStore()
+    c = Cluster(store=store)
+    s = c.session()
+    s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id)) "
+              "WITH (shards = 1)")
+    s.execute("INSERT INTO t VALUES (1), (2), (3)")
+    s.execute("DROP TABLE t")
+    s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id)) "
+              "WITH (shards = 1)")
+    s.execute("INSERT INTO t VALUES (100)")
+    c2 = Cluster(store=store)
+    out = c2.session().execute("SELECT id FROM t ORDER BY id")
+    assert list(out.column("id")) == [100]
+
+
+def test_drop_add_same_column_reads_null():
+    store = MemBlobStore()
+    c = Cluster(store=store)
+    s = c.session()
+    s.execute("CREATE TABLE t (id int64, v double, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 42.0)")
+    s.execute("ALTER TABLE t DROP COLUMN v")
+    s.execute("ALTER TABLE t ADD COLUMN v double")
+    out = s.execute("SELECT id, v FROM t")
+    assert not out.validity("v").any()
+    # and survives a reboot (column_added restored from scheme)
+    c2 = Cluster(store=store)
+    out = c2.session().execute("SELECT id, v FROM t")
+    assert not out.validity("v").any()
+
+
+def test_dict_journal_is_durable_before_shard_wal():
+    store = MemBlobStore()
+    c = Cluster(store=store)
+    s = c.session()
+    s.execute("CREATE TABLE t (id int64, v string, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 'hello')")
+    # the dict blob must exist the moment any shard WAL references ids
+    assert store.list("cluster/dicts/")
+    c2 = Cluster(store=store)
+    out = c2.session().execute("SELECT v FROM t")
+    assert out.strings("v") == [b"hello"]
+
+
+def test_with_option_validation():
+    c = Cluster()
+    s = c.session()
+    with pytest.raises(PlanError):
+        s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id)) "
+                  "WITH (shards = x)")
+    with pytest.raises(PlanError):
+        s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id)) "
+                  "WITH (sharsd = 2)")
+    with pytest.raises(PlanError):
+        s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id)) "
+                  "WITH (store = rows)")
+
+
+def test_board_stale_update_cannot_resurrect_drop():
+    from ydb_tpu.scheme.board import BoardPublish, SchemeBoardReplica
+
+    rep = SchemeBoardReplica()
+    rep.system = None
+    sent = []
+    rep.send = lambda t, m: sent.append(m)
+    core, _ = _core()
+    versions = []
+    core.listeners.append(lambda p, d, v: versions.append((p, d, v)))
+    core.create_table(_desc("/t"))
+    core.alter_table(
+        "/t", add_columns=[dtypes.Field("w", dtypes.INT32, True)])
+    core.drop_table("/t")
+    (p1, d1, v1), (p2, d2, v2), (p3, d3, v3) = versions
+    assert v1 < v2 < v3 and d3 is None
+    # deliver out of order: create, drop, then the STALE alter replay
+    rep._apply(BoardPublish(p1, d1, v1))
+    rep._apply(BoardPublish(p3, d3, v3))
+    assert rep._apply(BoardPublish(p2, d2, v2)) is False
+    assert rep.entries["/t"][0] is None   # still deleted

@@ -41,12 +41,26 @@ class OracleTable:
     def __init__(self, cols: dict[str, ColT], schema: dtypes.Schema):
         self.cols = cols
         self.schema = schema
+        self.dicts = None  # attached by the session for string decode
 
     @property
     def num_rows(self) -> int:
         if not self.cols:
             return 0
         return len(next(iter(self.cols.values()))[0])
+
+    def column(self, name: str):
+        return self.cols[name][0]
+
+    def validity(self, name: str):
+        return self.cols[name][1]
+
+    def strings(self, name: str, dicts=None) -> list[bytes]:
+        """Decode a dictionary-encoded string column to bytes values."""
+        dicts = dicts if dicts is not None else self.dicts
+        if dicts is None:
+            raise ValueError("no DictionarySet attached for decode")
+        return dicts[name].decode(np.asarray(self.cols[name][0]))
 
     @staticmethod
     def from_block(block) -> "OracleTable":

@@ -37,6 +37,10 @@ class PortionMeta:
     # min/max of the TTL column, for eviction planning
     ttl_min: int | None = None
     ttl_max: int | None = None
+    # table schema version this portion was written under: a column only
+    # reads from portions at least as new as the version that (re)added
+    # it — DROP then ADD of the same name must not resurrect old bytes
+    schema_version: int = 1
 
     def visible_at(self, snap: int) -> bool:
         if self.commit_snap > snap:

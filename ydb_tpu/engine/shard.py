@@ -87,6 +87,12 @@ class ColumnShard:
         # bumps never collide with coordinator-assigned steps
         self.snap_source = None  # Optional[Callable[[], int]]
 
+        # schema evolution state (set by the owning table on ALTER):
+        # current version + the version at which each column was added
+        # (absent = original column, version 1)
+        self.schema_version: int = 1
+        self.column_added: dict[str, int] = {}
+
         self.snap: int = 0           # last committed snapshot
         self.next_portion_id = 1
         self.portions: dict[int, PortionMeta] = {}
@@ -204,6 +210,7 @@ class ColumnShard:
             blob_id=blob_id,
             num_rows=len(next(iter(cols.values()))) if cols else 0,
             commit_snap=snap,
+            schema_version=self.schema_version,
         )
         if self.pk_column and self.pk_column in cols:
             meta.pk_min, meta.pk_max = column_stats(cols[self.pk_column])
@@ -256,11 +263,20 @@ class ColumnShard:
         valid = {n: [] for n in names}
         for meta in metas:
             c, v = read_portion_blob(self.store, meta.blob_id)
+            n_rows = len(next(iter(c.values()))) if c else 0
             for n in names:
-                cols[n].append(c[n])
-                valid[n].append(
-                    v.get(n, np.ones(len(c[n]), dtype=bool))
-                )
+                if n in c and meta.schema_version >= \
+                        self.column_added.get(n, 1):
+                    cols[n].append(c[n])
+                    valid[n].append(
+                        v.get(n, np.ones(len(c[n]), dtype=bool))
+                    )
+                else:
+                    # column added by ALTER after this portion was
+                    # written: old rows read as NULL
+                    cols[n].append(np.zeros(
+                        n_rows, dtype=self.schema.field(n).type.physical))
+                    valid[n].append(np.zeros(n_rows, dtype=bool))
         out_c = {n: np.concatenate(cols[n]) if cols[n] else
                  np.empty(0, dtype=self.schema.field(n).type.physical)
                  for n in names}
@@ -415,10 +431,20 @@ class ColumnShard:
         pk_column: str | None = None,
         ttl_column: str | None = None,
         config: ShardConfig | None = None,
+        dicts: DictionarySet | None = None,
     ) -> "ColumnShard":
-        """Recover shard state: checkpoint + WAL replay (flat_boot analog)."""
+        """Recover shard state: checkpoint + WAL replay (flat_boot analog).
+
+        With ``dicts`` supplied (a table/cluster-shared DictionarySet the
+        caller recovered from its own journal — Cluster's dict log), the
+        shard trusts it and skips replaying its private dict state: ids
+        must come from the shared global assignment order, not this
+        shard's local view of it.
+        """
         shard = ColumnShard(shard_id, schema, store, pk_column, ttl_column,
-                            config)
+                            config, dicts=dicts)
+        external_dicts = dicts is not None
+        shard._external_dicts = external_dicts
         ckpt_id = f"{shard_id}/checkpoint"
         base_seq = 0
         if store.exists(ckpt_id):
@@ -430,10 +456,11 @@ class ColumnShard:
             for mj in state["portions"]:
                 m = PortionMeta.from_json(mj)
                 shard.portions[m.portion_id] = m
-            for col, values in state.get("dicts", {}).items():
-                d = shard.dicts.for_column(col)
-                for v in values:
-                    d.add(v.encode("latin1"))
+            if not external_dicts:
+                for col, values in state.get("dicts", {}).items():
+                    d = shard.dicts.for_column(col)
+                    for v in values:
+                        d.add(v.encode("latin1"))
         # replay WAL after the checkpoint
         for bid in store.list(f"{shard_id}/wal/"):
             rec = json.loads(store.get(bid).decode())
@@ -456,10 +483,11 @@ class ColumnShard:
             for pid in rec.get("removed", []):
                 if pid in self.portions:
                     self.portions[pid].removed_snap = rec["snap"]
-            for col, values in rec.get("dict_delta", {}).items():
-                d = self.dicts.for_column(col)
-                for v in values:
-                    d.add(v.encode("latin1"))
+            if not getattr(self, "_external_dicts", False):
+                for col, values in rec.get("dict_delta", {}).items():
+                    d = self.dicts.for_column(col)
+                    for v in values:
+                        d.add(v.encode("latin1"))
         elif op == "remove_portion":
             pid = rec["portion_id"]
             if pid in self.portions:

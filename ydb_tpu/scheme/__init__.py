@@ -1,0 +1,9 @@
+from ydb_tpu.scheme.model import (
+    TableDescription, schema_from_json, schema_to_json,
+)
+from ydb_tpu.scheme.shard import SchemeError, SchemeShardCore
+
+__all__ = [
+    "TableDescription", "schema_from_json", "schema_to_json",
+    "SchemeError", "SchemeShardCore",
+]

@@ -1,0 +1,77 @@
+"""Schema object model + JSON serialization.
+
+The reference describes every scheme entity with protobuf path
+descriptions flowing from SchemeShard through the scheme board to
+per-node caches (TPathDescription; SURVEY.md §2.5). This is the
+equivalent wire model: table descriptions as JSON-able dicts, so they
+can live in tablet-executor state and cross the scheme board.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ydb_tpu import dtypes
+
+
+def type_to_str(t: dtypes.LogicalType) -> str:
+    if t.is_decimal:
+        return f"decimal({t.scale})"
+    return t.kind.value
+
+
+def type_from_str(s: str) -> dtypes.LogicalType:
+    if s.startswith("decimal("):
+        return dtypes.decimal(int(s[8:-1]))
+    return dtypes.LogicalType(dtypes.Kind(s))
+
+
+def schema_to_json(schema: dtypes.Schema) -> list:
+    return [[f.name, type_to_str(f.type), f.nullable]
+            for f in schema.fields]
+
+
+def schema_from_json(data: list) -> dtypes.Schema:
+    return dtypes.Schema(tuple(
+        dtypes.Field(name, type_from_str(ts), nullable)
+        for name, ts, nullable in data
+    ))
+
+
+@dataclasses.dataclass
+class TableDescription:
+    path: str
+    schema: dtypes.Schema
+    primary_key: tuple[str, ...]
+    n_shards: int = 4
+    store: str = "column"          # "column" (OLAP) | "row" (OLTP)
+    ttl_column: str | None = None
+    schema_version: int = 1
+    # column name -> schema version that (re)introduced it; absent means
+    # the column existed from version 1 (guards DROP+ADD resurrection)
+    column_added: dict = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "schema": schema_to_json(self.schema),
+            "primary_key": list(self.primary_key),
+            "n_shards": self.n_shards,
+            "store": self.store,
+            "ttl_column": self.ttl_column,
+            "schema_version": self.schema_version,
+            "column_added": dict(self.column_added),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "TableDescription":
+        return cls(
+            path=d["path"],
+            schema=schema_from_json(d["schema"]),
+            primary_key=tuple(d["primary_key"]),
+            n_shards=d["n_shards"],
+            store=d.get("store", "column"),
+            ttl_column=d.get("ttl_column"),
+            schema_version=d.get("schema_version", 1),
+            column_added=dict(d.get("column_added", {})),
+        )

@@ -140,6 +140,34 @@ class CreateTable:
     table: str
     columns: tuple[tuple[str, str, bool], ...]  # (name, type, not_null)
     primary_key: tuple[str, ...]
+    # WITH (store = column|row, shards = N, ttl_column = name)
+    options: tuple[tuple[str, str], ...] = ()
 
 
-Statement = Union[Select, Insert, CreateTable]
+@dataclasses.dataclass(frozen=True)
+class DropTable:
+    table: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Update:
+    table: str
+    sets: tuple[tuple[str, Expr], ...]
+    where: Expr | None
+
+
+@dataclasses.dataclass(frozen=True)
+class Delete:
+    table: str
+    where: Expr | None
+
+
+@dataclasses.dataclass(frozen=True)
+class AlterTable:
+    table: str
+    add_columns: tuple[tuple[str, str], ...] = ()  # (name, type)
+    drop_columns: tuple[str, ...] = ()
+
+
+Statement = Union[Select, Insert, CreateTable, DropTable, AlterTable,
+                  Update, Delete]

@@ -33,7 +33,8 @@ _KEYWORDS = {
     "asc", "desc", "join", "inner", "left", "on", "insert", "into",
     "values", "create", "table", "primary", "key", "case", "when", "then",
     "else", "end", "date", "interval", "true", "false", "distinct",
-    "outer", "exists", "cast",
+    "outer", "exists", "cast", "drop", "alter", "add", "column", "with",
+    "update", "set", "delete",
 }
 
 
@@ -118,6 +119,14 @@ class Parser:
             stmt = self.parse_insert()
         elif self.peek().value == "create":
             stmt = self.parse_create()
+        elif self.peek().value == "drop":
+            stmt = self.parse_drop()
+        elif self.peek().value == "alter":
+            stmt = self.parse_alter()
+        elif self.peek().value == "update":
+            stmt = self.parse_update()
+        elif self.peek().value == "delete":
+            stmt = self.parse_delete()
         else:
             raise SyntaxError(f"unsupported statement {self.peek().value!r}")
         self.expect("eof")
@@ -266,7 +275,79 @@ class Parser:
             if not self.accept("op", ","):
                 break
         self.expect("op", ")")
-        return ast.CreateTable(table, tuple(columns), pk)
+        options: list[tuple[str, str]] = []
+        if self.kw("with"):
+            self.expect("op", "(")
+            while True:
+                k = self.next()
+                if k.kind not in ("name", "kw"):
+                    raise SyntaxError("expected option name in WITH")
+                self.expect("op", "=")
+                v = self.next()
+                if v.kind not in ("name", "kw", "number", "string"):
+                    raise SyntaxError(f"bad option value for {k.value}")
+                options.append((k.value.lower(), str(v.value)))
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        return ast.CreateTable(table, tuple(columns), pk, tuple(options))
+
+    def parse_drop(self) -> ast.DropTable:
+        self.expect("kw", "drop")
+        self.expect("kw", "table")
+        return ast.DropTable(self.expect("name").value)
+
+    def parse_update(self) -> ast.Update:
+        self.expect("kw", "update")
+        table = self.expect("name").value
+        self.expect("kw", "set")
+        sets = []
+        while True:
+            name = self.expect("name").value
+            self.expect("op", "=")
+            sets.append((name, self.parse_expr()))
+            if not self.accept("op", ","):
+                break
+        where = self.parse_expr() if self.kw("where") else None
+        return ast.Update(table, tuple(sets), where)
+
+    def parse_delete(self) -> ast.Delete:
+        self.expect("kw", "delete")
+        self.expect("kw", "from")
+        table = self.expect("name").value
+        where = self.parse_expr() if self.kw("where") else None
+        return ast.Delete(table, where)
+
+    def parse_alter(self) -> ast.AlterTable:
+        self.expect("kw", "alter")
+        self.expect("kw", "table")
+        table = self.expect("name").value
+        add: list[tuple[str, str]] = []
+        drop: list[str] = []
+        while True:
+            if self.kw("add"):
+                self.kw("column")
+                name = self.expect("name").value
+                t = self.next()
+                if t.kind not in ("name", "kw"):
+                    raise SyntaxError(f"expected type after {name}")
+                typ = t.value
+                if self.accept("op", "("):
+                    p = self.expect("number").value
+                    s = "0"
+                    if self.accept("op", ","):
+                        s = self.expect("number").value
+                    self.expect("op", ")")
+                    typ = f"{typ}({p},{s})"
+                add.append((name, typ))
+            elif self.kw("drop"):
+                self.kw("column")
+                drop.append(self.expect("name").value)
+            else:
+                raise SyntaxError("expected ADD or DROP in ALTER TABLE")
+            if not self.accept("op", ","):
+                break
+        return ast.AlterTable(table, tuple(add), tuple(drop))
 
     # -- expressions by precedence --
 

@@ -52,22 +52,61 @@ class ShardedTable:
         pk_column: str | None = None,
         ttl_column: str | None = None,
         config: ShardConfig | None = None,
+        dicts: DictionarySet | None = None,
+        boot: bool = False,
     ):
         self.name = name
         self.schema = schema
         self.coordinator = coordinator
         self.pk_column = pk_column or schema.names[0]
-        self.dicts = DictionarySet()
-        self.shards = [
-            ColumnShard(
-                f"{name}/{i}", schema, store,
-                pk_column=self.pk_column, ttl_column=ttl_column,
-                config=config, dicts=self.dicts,
-            )
-            for i in range(n_shards)
-        ]
+        self.dicts = dicts if dicts is not None else DictionarySet()
+        if boot:
+            # reboot from the blob store (snapshot + WAL per shard); the
+            # shared dict set must already be recovered by the caller
+            self.shards = [
+                ColumnShard.boot(
+                    f"{name}/{i}", schema, store,
+                    pk_column=self.pk_column, ttl_column=ttl_column,
+                    config=config, dicts=self.dicts,
+                )
+                for i in range(n_shards)
+            ]
+        else:
+            self.shards = [
+                ColumnShard(
+                    f"{name}/{i}", schema, store,
+                    pk_column=self.pk_column, ttl_column=ttl_column,
+                    config=config, dicts=self.dicts,
+                )
+                for i in range(n_shards)
+            ]
         for s in self.shards:
             s.snap_source = coordinator.background_plan
+        # called after string encode but before any shard write: the
+        # cluster journals dictionary growth here so no durable shard
+        # state ever references a dict id that is not itself durable
+        self.pre_commit = None
+
+    def storage_prefixes(self) -> list[str]:
+        """Blob-store prefixes owning this table's durable state (DROP
+        TABLE deletes them so a same-name CREATE starts empty)."""
+        return [f"{s.shard_id}/" for s in self.shards]
+
+    def alter_schema(
+        self,
+        schema: dtypes.Schema,
+        schema_version: int = 1,
+        column_added: dict[str, int] | None = None,
+    ) -> None:
+        """Apply an ALTER'd schema. ``column_added`` maps column name ->
+        schema version that (re)introduced it; portions older than that
+        version read the column as NULL, so DROP+ADD of one name cannot
+        resurrect dropped bytes."""
+        self.schema = schema
+        for s in self.shards:
+            s.schema = schema
+            s.schema_version = schema_version
+            s.column_added = dict(column_added or {})
 
     # ---------------- writes ----------------
 
@@ -78,6 +117,8 @@ class ShardedTable:
     ) -> TxResult:
         """Route rows by PK hash, write every shard, commit at one step."""
         enc = self.shards[0].encode_strings(columns)
+        if self.pre_commit is not None:
+            self.pre_commit()
         n = len(next(iter(enc.values())))
         route = _fnv_route(
             np.asarray(enc[self.pk_column], dtype=np.int64),
