@@ -1,0 +1,218 @@
+"""DataShard (row-store OLTP) tests: MVCC reads, 2PC, locks, read
+iterator paging, SQL UPDATE/DELETE on row tables (SURVEY.md §2.6)."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.datashard.shard import DataShard, LockBroken, RowOp, TxRejected
+from ydb_tpu.datashard.table import RowTable
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.kqp.session import Cluster
+from ydb_tpu.sql.planner import PlanError
+from ydb_tpu.tx.coordinator import Coordinator
+
+
+SCHEMA = dtypes.schema(("id", dtypes.INT64), ("v", dtypes.INT64))
+
+
+def _shard(store=None):
+    return DataShard("t/0", SCHEMA, store or MemBlobStore(), ("id",))
+
+
+def test_propose_commit_read_mvcc():
+    ds = _shard()
+    w1 = ds.propose([RowOp((1,), {"id": 1, "v": 10}),
+                     RowOp((2,), {"id": 2, "v": 20})])
+    ds.prepare([w1])
+    ds.commit_at([w1], step=5)
+    w2 = ds.propose([RowOp((1,), {"id": 1, "v": 11}),
+                     RowOp((2,), None)])  # update + delete
+    ds.commit_at([w2], step=9)
+
+    def rows_at(snap):
+        return [r for page in ds.read(snap) for r in page]
+
+    assert rows_at(4) == []
+    assert rows_at(5) == [((1,), {"id": 1, "v": 10}),
+                          ((2,), {"id": 2, "v": 20})]
+    assert rows_at(9) == [((1,), {"id": 1, "v": 11})]
+    assert ds.last_step == 9
+
+
+def test_read_iterator_paging_and_range():
+    ds = _shard()
+    w = ds.propose([RowOp((i,), {"id": i, "v": i}) for i in range(50)])
+    ds.commit_at([w], step=1)
+    pages = list(ds.read(1, page_rows=16))
+    assert [len(p) for p in pages] == [16, 16, 16, 2]
+    ranged = [r for page in ds.read(1, lo=(10,), hi=(20,)) for r in page]
+    assert [k for k, _ in ranged] == [(i,) for i in range(10, 20)]
+    pts = [r for page in ds.read(1, keys=[(3,), (99,), (7,)])
+           for r in page]
+    assert [k for k, _ in pts] == [(3,), (7,)]
+
+
+def test_shard_survives_reboot():
+    store = MemBlobStore()
+    ds = _shard(store)
+    w = ds.propose([RowOp((1,), {"id": 1, "v": 10})])
+    ds.commit_at([w], step=3)
+    ds2 = DataShard("t/0", SCHEMA, store, ("id",))
+    rows = [r for page in ds2.read(3) for r in page]
+    assert rows == [((1,), {"id": 1, "v": 10})]
+    assert ds2.last_step == 3
+
+
+def test_optimistic_lock_breaks_on_conflicting_write():
+    ds = _shard()
+    w = ds.propose([RowOp((1,), {"id": 1, "v": 10})])
+    ds.commit_at([w], step=1)
+    lock = ds.acquire_lock()
+    _ = [r for page in ds.read(1, lo=(0,), hi=(100,), lock_id=lock)
+         for r in page]
+    # a conflicting write commits
+    w2 = ds.propose([RowOp((1,), {"id": 1, "v": 99})])
+    ds.commit_at([w2], step=2)
+    assert ds.lock_broken(lock)
+    # a tx that validated under the lock must now fail at prepare
+    w3 = ds.propose([RowOp((1,), {"id": 1, "v": 50})], lock_id=lock)
+    with pytest.raises(LockBroken):
+        ds.prepare([w3])
+    # non-conflicting lock stays valid
+    lock2 = ds.acquire_lock()
+    _ = [r for page in ds.read(2, keys=[(5,)], lock_id=lock2)
+         for r in page]
+    w4 = ds.propose([RowOp((7,), {"id": 7, "v": 1})])
+    ds.commit_at([w4], step=3)
+    assert not ds.lock_broken(lock2)
+
+
+def test_precondition_insert_semantics():
+    ds = _shard()
+    w = ds.propose([RowOp((1,), {"id": 1, "v": 10})],
+                   expect={(1,): None})  # INSERT: must not exist
+    ds.prepare([w])
+    ds.commit_at([w], step=1)
+    w2 = ds.propose([RowOp((1,), {"id": 1, "v": 20})],
+                    expect={(1,): None})
+    with pytest.raises(TxRejected):
+        ds.prepare([w2])
+
+
+def test_row_table_two_phase_commit_and_abort():
+    store = MemBlobStore()
+    coord = Coordinator()
+    t = RowTable("t", SCHEMA, store, coord, n_shards=3)
+    res = t.insert({"id": np.arange(10, dtype=np.int64),
+                    "v": np.arange(10, dtype=np.int64) * 10})
+    assert res.committed
+    src = t.source_at()
+    assert sorted(src.columns["id"]) == list(range(10))
+    # all-or-nothing: snapshot before commit sees nothing
+    old_snap = res.step - 1
+    assert t.source_at(old_snap).num_rows == 0
+    t.delete_keys([(0,), (5,)])
+    assert sorted(t.source_at().columns["id"]) == [1, 2, 3, 4, 6, 7, 8, 9]
+
+
+def test_sql_row_table_update_delete():
+    c = Cluster()
+    s = c.session()
+    s.execute("CREATE TABLE kv (id int64, city string, score double, "
+              "PRIMARY KEY (id)) WITH (store = row, shards = 2)")
+    s.execute("INSERT INTO kv VALUES (1, 'berlin', 1.0), "
+              "(2, 'tokyo', 2.0), (3, 'berlin', 3.0)")
+    out = s.execute("SELECT id, score FROM kv ORDER BY id")
+    assert list(out.column("id")) == [1, 2, 3]
+
+    s.execute("UPDATE kv SET score = score * 10 WHERE city = 'berlin'")
+    out = s.execute("SELECT id, score FROM kv ORDER BY id")
+    assert list(out.column("score")) == [10.0, 2.0, 30.0]
+
+    s.execute("UPDATE kv SET city = 'kyoto' WHERE id = 2")
+    out = s.execute("SELECT city FROM kv WHERE id = 2")
+    assert out.strings("city") == [b"kyoto"]
+
+    s.execute("DELETE FROM kv WHERE score >= 30")
+    out = s.execute("SELECT id FROM kv ORDER BY id")
+    assert list(out.column("id")) == [1, 2]
+
+    # UPDATE on a column-store table is rejected with guidance
+    s.execute("CREATE TABLE olap (id int64, PRIMARY KEY (id))")
+    with pytest.raises(PlanError):
+        s.execute("UPDATE olap SET id = 1")
+    with pytest.raises(PlanError):
+        s.execute("UPDATE kv SET id = 9")   # key column
+
+
+def test_sql_row_table_survives_reboot():
+    store = MemBlobStore()
+    c = Cluster(store=store)
+    s = c.session()
+    s.execute("CREATE TABLE r (id int64, name string, PRIMARY KEY (id)) "
+              "WITH (store = row)")
+    s.execute("INSERT INTO r VALUES (1, 'a'), (2, 'b')")
+    s.execute("UPDATE r SET name = 'z' WHERE id = 1")
+    c2 = Cluster(store=store)
+    out = c2.session().execute("SELECT id, name FROM r ORDER BY id")
+    assert list(out.column("id")) == [1, 2]
+    assert out.strings("name") == [b"z", b"b"]
+    # joins across row + column tables work (same ColumnSource seam)
+    s2 = c2.session()
+    s2.execute("CREATE TABLE facts (id int64, amount int64, "
+               "PRIMARY KEY (id))")
+    s2.execute("INSERT INTO facts VALUES (1, 100), (2, 200), (1, 300)")
+    out = s2.execute(
+        "SELECT r.name AS name, sum(f.amount) AS total "
+        "FROM facts f JOIN r ON f.id = r.id GROUP BY r.name "
+        "ORDER BY r.name")
+    assert out.strings("name") == [b"b", b"z"]
+    assert list(out.column("total")) == [200, 400]
+
+
+def test_row_table_alter_add_drop():
+    c = Cluster()
+    s = c.session()
+    s.execute("CREATE TABLE t (id int64, v int64, PRIMARY KEY (id)) "
+              "WITH (store = row)")
+    s.execute("INSERT INTO t VALUES (1, 10)")
+    s.execute("ALTER TABLE t ADD COLUMN w int64")
+    out = s.execute("SELECT id, w FROM t")
+    assert not out.validity("w").any()
+    s.execute("INSERT INTO t VALUES (2, 20, 200)")
+    s.execute("ALTER TABLE t DROP COLUMN v")
+    s.execute("ALTER TABLE t ADD COLUMN v int64")
+    out = s.execute("SELECT id, v FROM t ORDER BY id")
+    assert not out.validity("v").any()   # no resurrection
+
+
+def test_row_drop_then_recreate_does_not_resurrect():
+    store = MemBlobStore()
+    c = Cluster(store=store)
+    s = c.session()
+    s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id)) "
+              "WITH (store = row, shards = 1)")
+    s.execute("INSERT INTO t VALUES (1), (2), (3)")
+    s.execute("DROP TABLE t")
+    s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id)) "
+              "WITH (store = row, shards = 1)")
+    s.execute("INSERT INTO t VALUES (100)")
+    out = s.execute("SELECT id FROM t ORDER BY id")
+    assert list(out.column("id")) == [100]
+    c2 = Cluster(store=store)
+    out = c2.session().execute("SELECT id FROM t ORDER BY id")
+    assert list(out.column("id")) == [100]
+
+
+def test_update_string_column_from_other_column():
+    c = Cluster()
+    s = c.session()
+    s.execute("CREATE TABLE t (id int64, a string, b string, "
+              "PRIMARY KEY (id)) WITH (store = row)")
+    s.execute("INSERT INTO t VALUES (1, 'aaa', 'bbb'), (2, 'xxx', 'yyy')")
+    s.execute("UPDATE t SET a = b WHERE id = 2")
+    out = s.execute("SELECT id, a FROM t ORDER BY id")
+    assert out.strings("a") == [b"aaa", b"yyy"]
+    with pytest.raises(PlanError):
+        s.execute("UPDATE t SET a = id")  # unsupported string expr

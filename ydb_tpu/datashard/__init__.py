@@ -1,0 +1,4 @@
+from ydb_tpu.datashard.shard import DataShard, LockBroken, TxRejected
+from ydb_tpu.datashard.table import RowTable
+
+__all__ = ["DataShard", "RowTable", "LockBroken", "TxRejected"]

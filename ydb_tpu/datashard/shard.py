@@ -1,0 +1,263 @@
+"""DataShard: the row-store OLTP tablet.
+
+Mirror of the reference's DataShard (tx/datashard, SURVEY.md §2.6) on the
+tablet executor: rows live in the MVCC local DB versioned by *global plan
+steps* (not the tablet's own commit counter), so cross-shard reads at a
+coordinator snapshot are consistent — exactly the reference's
+planned-step execution (datashard_pipeline.h) without the 60-unit state
+machine: the executor's single-writer discipline plus the coordinator's
+step order give the same serialization.
+
+Write path (the 2PC participant contract shared with ColumnShard, so one
+Coordinator drives either):
+  * ``propose(ops)``    -> write_id: durably stage the tx's effects
+                           (upsert/erase rows) — the pipeline's
+                           check/store units
+  * ``prepare([ids])``  -> validates locks, returns the ids (2PC vote)
+  * ``commit_at(ids, step)`` applies effects at version=step
+  * ``abort(ids)``      drops staged effects
+
+Read path: ``read(...)`` — MVCC range/point reads at a snapshot step with
+paging (TEvRead / read-iterator analog, datashard__read_iterator.cpp).
+
+Optimistic locks (datashard locks analog): ``acquire_lock`` records the
+read ranges; any committed write intersecting them breaks the lock;
+``prepare`` fails for a tx that declares a broken lock, aborting the 2PC.
+Locks are in-memory only — a shard restart breaks them all, as in the
+reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Iterator
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.blobs import BlobStore
+from ydb_tpu.tablet.executor import TabletExecutor, Transaction
+
+
+class TxRejected(Exception):
+    pass
+
+
+class LockBroken(TxRejected):
+    pass
+
+
+@dataclasses.dataclass
+class RowOp:
+    """One effect: row upsert (row != None) or erase (row == None)."""
+
+    key: tuple
+    row: dict | None
+
+
+@dataclasses.dataclass
+class _Lock:
+    lock_id: int
+    ranges: list[tuple[tuple | None, tuple | None]]
+    points: set[tuple]
+    broken: bool = False
+
+    def covers(self, key: tuple) -> bool:
+        if key in self.points:
+            return True
+        for lo, hi in self.ranges:
+            if (lo is None or key >= lo) and (hi is None or key < hi):
+                return True
+        return False
+
+
+class _ProposeTx(Transaction):
+    def __init__(self, write_id: int, ops: list[RowOp], lock_id, expect):
+        self.write_id = write_id
+        self.ops = ops
+        self.lock_id = lock_id
+        self.expect = expect
+
+    def execute(self, txc, tablet):
+        txc.put("pending", (self.write_id,), {
+            "ops": [[list(o.key), o.row] for o in self.ops],
+            "lock_id": self.lock_id,
+            "expect": self.expect,
+        })
+        # same commit as the staged tx: a crash can never reuse a write
+        # id that a durable pending entry already owns
+        txc.put("meta", ("next_write",), {"v": self.write_id + 1})
+
+
+class _CommitTx(Transaction):
+    def __init__(self, shard: "DataShard", write_ids: list[int], step: int):
+        self.shard = shard
+        self.write_ids = write_ids
+        self.step = step
+
+    def execute(self, txc, tablet):
+        for wid in self.write_ids:
+            pend = txc.get("pending", (wid,))
+            if pend is None:
+                raise TxRejected(f"no staged tx {wid}")
+            for key_list, row in pend["ops"]:
+                key = tuple(key_list)
+                txc.put_at("data", key, row, self.step)
+                self.shard._break_locks(key)
+            txc.erase("pending", (wid,))
+        txc.put("meta", ("last_step",), {"v": self.step})
+
+
+class _AbortTx(Transaction):
+    def __init__(self, write_ids: list[int]):
+        self.write_ids = write_ids
+
+    def execute(self, txc, tablet):
+        for wid in self.write_ids:
+            txc.erase("pending", (wid,))
+
+
+class DataShard:
+    def __init__(self, shard_id: str, schema: dtypes.Schema,
+                 store: BlobStore, pk_columns: tuple[str, ...]):
+        self.shard_id = shard_id
+        self.schema = schema
+        self.pk_columns = tuple(pk_columns)
+        self.executor = TabletExecutor.boot(f"ds/{shard_id}", store)
+        row = self.executor.db.table("meta").get(("next_write",))
+        self._write_ids = itertools.count(row["v"] if row else 1)
+        self._locks: dict[int, _Lock] = {}
+        self._next_lock = itertools.count(1)
+
+    # ---- MVCC state ----
+
+    @property
+    def last_step(self) -> int:
+        row = self.executor.db.table("meta").get(("last_step",))
+        return row["v"] if row else 0
+
+    # interface parity with ColumnShard (cluster boot resumes the
+    # coordinator clock from max shard snapshot)
+    @property
+    def snap(self) -> int:
+        return self.last_step
+
+    # ---- write path (2PC participant) ----
+
+    def propose(self, ops: list[RowOp], lock_id: int | None = None,
+                expect: dict | None = None) -> int:
+        """Durably stage effects; returns the write id (2PC token).
+
+        ``expect``: optional per-key preconditions, {key: row_or_None}
+        checked under the executor at prepare time — the
+        read-your-locks validation for interactive INSERT (fail if
+        exists) semantics.
+        """
+        wid = next(self._write_ids)
+        exp = (
+            [[list(k), v] for k, v in expect.items()]
+            if expect is not None else None
+        )
+        self.executor.execute(_ProposeTx(wid, ops, lock_id, exp))
+        return wid
+
+    def prepare(self, write_ids: list[int]) -> list[int]:
+        for wid in write_ids:
+            pend = self.executor.db.table("pending").get((wid,))
+            if pend is None:
+                raise TxRejected(f"unknown write id {wid}")
+            lock_id = pend.get("lock_id")
+            if lock_id is not None:
+                lock = self._locks.get(lock_id)
+                if lock is None or lock.broken:
+                    raise LockBroken(f"lock {lock_id} is broken")
+            for key_list, want in pend.get("expect") or []:
+                key = tuple(key_list)
+                have = self.executor.db.table("data").get(key)
+                if (have is None) != (want is None):
+                    raise TxRejected(
+                        f"precondition failed for key {key}")
+        return list(write_ids)
+
+    def commit_at(self, write_ids: list[int], step: int) -> int:
+        self.executor.execute(_CommitTx(self, write_ids, step))
+        return step
+
+    def abort(self, write_ids: list[int]) -> None:
+        self.executor.execute(_AbortTx(write_ids))
+
+    # ---- read path (read iterator) ----
+
+    def read(
+        self,
+        snapshot: int,
+        lo: tuple | None = None,
+        hi: tuple | None = None,
+        keys: list[tuple] | None = None,
+        columns: tuple[str, ...] | None = None,
+        page_rows: int = 1024,
+        lock_id: int | None = None,
+    ) -> Iterator[list[tuple[tuple, dict]]]:
+        """Stream pages of (key, row) visible at the snapshot step.
+
+        With ``lock_id``, the scanned range/points are recorded on the
+        lock so later conflicting commits break it (optimistic tx).
+        """
+        table = self.executor.db.table("data")
+        if lock_id is not None:
+            lock = self._locks.setdefault(
+                lock_id, _Lock(lock_id, [], set()))
+            if keys is not None:
+                lock.points.update(tuple(k) for k in keys)
+            else:
+                lock.ranges.append((lo, hi))
+        page: list[tuple[tuple, dict]] = []
+        if keys is not None:
+            for key in keys:
+                row = table.get(tuple(key), version=snapshot)
+                if row is not None:
+                    page.append((tuple(key), _project(row, columns)))
+                if len(page) >= page_rows:
+                    yield page
+                    page = []
+        else:
+            for key, row in table.range(lo, hi, version=snapshot):
+                page.append((key, _project(row, columns)))
+                if len(page) >= page_rows:
+                    yield page
+                    page = []
+        if page:
+            yield page
+
+    # ---- locks ----
+
+    def acquire_lock(self) -> int:
+        lock_id = next(self._next_lock)
+        self._locks[lock_id] = _Lock(lock_id, [], set())
+        return lock_id
+
+    def lock_broken(self, lock_id: int) -> bool:
+        lock = self._locks.get(lock_id)
+        return lock is None or lock.broken
+
+    def release_lock(self, lock_id: int) -> None:
+        self._locks.pop(lock_id, None)
+
+    def _break_locks(self, key: tuple) -> None:
+        for lock in self._locks.values():
+            if not lock.broken and lock.covers(key):
+                lock.broken = True
+
+    # ---- maintenance ----
+
+    def compact(self, keep_after: int) -> None:
+        """Collapse row version chains invisible below keep_after."""
+        self.executor.db.table("data").compact(keep_after)
+
+    def checkpoint(self) -> None:
+        self.executor.checkpoint()
+
+
+def _project(row: dict, columns: tuple[str, ...] | None) -> dict:
+    if columns is None:
+        return row
+    return {c: row.get(c) for c in columns}

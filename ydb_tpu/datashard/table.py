@@ -1,0 +1,243 @@
+"""RowTable: a sharded row-store table (OLTP) behind the same surface as
+the columnar ShardedTable, so SQL and the coordinator treat both alike.
+
+Reference shape: DataShard tablets partitioned by PK with distributed
+commits through the coordinator (SURVEY.md §2.6, §3.2 COMMIT); the
+KQP-facing difference from the OLAP path is point/range row access and
+in-place UPDATE/DELETE, which columnar portions don't do.
+
+Strings are encoded through the cluster-shared DictionarySet before any
+durable write (same id-agreement rule as ShardedTable), with the same
+pre_commit journaling hook.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.blobs import BlobStore
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.datashard.shard import DataShard, RowOp
+from ydb_tpu.tx.coordinator import Coordinator, TxResult
+from ydb_tpu.tx.sharded import _fnv_route
+
+
+class RowTable:
+    store_kind = "row"
+
+    def __init__(
+        self,
+        name: str,
+        schema: dtypes.Schema,
+        store: BlobStore,
+        coordinator: Coordinator,
+        n_shards: int = 4,
+        pk_column: str | None = None,
+        pk_columns: tuple[str, ...] | None = None,
+        dicts: DictionarySet | None = None,
+        boot: bool = False,  # DataShard.boot is implicit (executor boot)
+        ttl_column: str | None = None,
+    ):
+        self.name = name
+        self.schema = schema
+        self.coordinator = coordinator
+        self.pk_columns = tuple(
+            pk_columns if pk_columns else
+            (pk_column or schema.names[0],))
+        self.pk_column = self.pk_columns[0]
+        self.ttl_column = ttl_column
+        self.dicts = dicts if dicts is not None else DictionarySet()
+        self.shards = [
+            DataShard(f"{name}/{i}", schema, store, self.pk_columns)
+            for i in range(n_shards)
+        ]
+        self.schema_version = 1
+        self.column_added: dict[str, int] = {}
+        self.pre_commit = None
+        self._needs_sweep = boot
+
+    def post_boot_sweep(self) -> None:
+        """Crash-safe DROP COLUMN: if a prior strip (alter_schema) died
+        between the scheme commit and the rewrite, stale values would
+        resurrect on a later re-ADD. Called by the cluster once the real
+        coordinator clock is installed (reads need true snapshots)."""
+        if self._needs_sweep:
+            self._needs_sweep = False
+            self._strip_columns(keep=set(self.schema.names))
+
+    def storage_prefixes(self) -> list[str]:
+        """Blob-store prefixes owning this table's durable state (DROP
+        TABLE deletes them so a same-name CREATE starts empty)."""
+        return [f"tablet/{s.executor.tablet_id}/" for s in self.shards]
+
+    # ---- encode helpers (shared dict ids, scaled decimals) ----
+
+    def _encode_columns(self, columns: dict, validity=None) -> list[dict]:
+        """Columnar input -> list of physical row dicts (None = NULL)."""
+        n = len(next(iter(columns.values())))
+        enc: dict[str, list] = {}
+        for name in columns:
+            f = self.schema.field(name)
+            vals = columns[name]
+            if f.type.is_string:
+                d = self.dicts.for_column(name)
+                enc[name] = [int(d.add(_as_bytes(v))) for v in vals]
+            else:
+                arr = np.asarray(vals)
+                enc[name] = [_py(v) for v in arr]
+        rows = []
+        for i in range(n):
+            row = {}
+            for name in enc:
+                ok = True
+                if validity is not None and name in validity:
+                    ok = bool(np.asarray(validity[name])[i])
+                row[name] = enc[name][i] if ok else None
+            rows.append(row)
+        return rows
+
+    def _key_of(self, row: dict) -> tuple:
+        return tuple(row[c] for c in self.pk_columns)
+
+    def _route(self, keys: list[tuple]) -> np.ndarray:
+        first = np.asarray([k[0] for k in keys], dtype=np.int64)
+        return _fnv_route(first, len(self.shards))
+
+    # ---- writes (2PC across shards) ----
+
+    def _commit_ops(self, per_row_ops: list[RowOp]) -> TxResult:
+        if self.pre_commit is not None:
+            self.pre_commit()
+        route = self._route([op.key for op in per_row_ops])
+        participants, prepare_args = [], []
+        for i, shard in enumerate(self.shards):
+            ops = [op for op, r in zip(per_row_ops, route) if r == i]
+            if not ops:
+                continue
+            wid = shard.propose(ops)
+            participants.append(shard)
+            prepare_args.append([wid])
+        return self.coordinator.commit(participants, prepare_args)
+
+    def insert(self, columns: dict, validity=None) -> TxResult:
+        """Upsert semantics (same surface as ShardedTable.insert)."""
+        rows = self._encode_columns(columns, validity)
+        return self._commit_ops(
+            [RowOp(self._key_of(r), r) for r in rows])
+
+    def upsert_rows(self, rows: list[dict]) -> TxResult:
+        return self._commit_ops(
+            [RowOp(self._key_of(r), r) for r in rows])
+
+    def delete_keys(self, keys: list[tuple]) -> TxResult:
+        return self._commit_ops([RowOp(tuple(k), None) for k in keys])
+
+    # ---- reads ----
+
+    def read_row(self, key: tuple, snap: int | None = None) -> dict | None:
+        snap = (self.coordinator.read_snapshot()
+                if snap is None else snap)
+        shard = self.shards[int(self._route([tuple(key)])[0])]
+        for page in shard.read(snap, keys=[tuple(key)]):
+            for _k, row in page:
+                return row
+        return None
+
+    def source_at(self, snap: int | None = None,
+                  columns: tuple[str, ...] | None = None) -> ColumnSource:
+        """Materialize visible rows as a ColumnSource: the seam that lets
+        the OLAP scan/SSA path run over a row table."""
+        snap = (self.coordinator.read_snapshot()
+                if snap is None else snap)
+        names = columns if columns is not None else self.schema.names
+        names = tuple(n for n in names if n in self.schema)
+        cols: dict[str, list] = {n: [] for n in names}
+        valid: dict[str, list] = {n: [] for n in names}
+        for shard in self.shards:
+            for page in shard.read(snap):
+                for _key, row in page:
+                    for n in names:
+                        v = row.get(n)  # absent (pre-ALTER row) = NULL
+                        cols[n].append(0 if v is None else v)
+                        valid[n].append(v is not None)
+        out_c = {}
+        out_v = {}
+        for n in names:
+            f = self.schema.field(n)
+            out_c[n] = (np.asarray(cols[n], dtype=f.type.physical)
+                        if cols[n] else
+                        np.empty(0, dtype=f.type.physical))
+            out_v[n] = (np.asarray(valid[n], dtype=bool) if valid[n]
+                        else np.empty(0, dtype=bool))
+        sch = self.schema.select(names)
+        return ColumnSource(out_c, sch, self.dicts, out_v)
+
+    # ---- schema evolution ----
+
+    def alter_schema(self, schema, schema_version=1, column_added=None):
+        had_drops = any(n not in schema for n in self.schema.names)
+        self.schema = schema
+        self.schema_version = schema_version
+        self.column_added = dict(column_added or {})
+        for s in self.shards:
+            s.schema = schema
+        # physically strip dropped columns so a later re-ADD of the name
+        # cannot resurrect old values (row dicts would otherwise keep
+        # them forever); the boot-time sweep repeats this if a crash
+        # interrupts it here
+        if had_drops:
+            self._strip_columns(keep=set(schema.names))
+
+    def _strip_columns(self, keep: set[str]) -> None:
+        snap = self.coordinator.read_snapshot()
+        for shard in self.shards:
+            ops = []
+            for page in shard.read(snap):
+                for key, row in page:
+                    if any(n not in keep for n in row):
+                        ops.append(RowOp(
+                            key,
+                            {k: v for k, v in row.items() if k in keep}))
+            if ops:
+                wid = shard.propose(ops)
+                self.coordinator.commit([shard], [[wid]])
+
+    # ---- background ----
+
+    def run_background(self, ttl_cutoff: int | None = None) -> dict:
+        evicted = 0
+        if ttl_cutoff is not None and self.ttl_column is not None:
+            snap = self.coordinator.read_snapshot()
+            for shard in self.shards:
+                doomed = []
+                for page in shard.read(snap):
+                    for key, row in page:
+                        v = row.get(self.ttl_column)
+                        if v is not None and v < ttl_cutoff:
+                            doomed.append(key)
+                if doomed:
+                    self.delete_keys(doomed)
+                    evicted += len(doomed)
+        horizon = self.coordinator.read_snapshot()
+        for shard in self.shards:
+            shard.compact(keep_after=horizon)
+        return {"compacted": len(self.shards), "evicted": evicted}
+
+
+def _as_bytes(v) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode()
+    return bytes(v)
+
+
+def _py(v):
+    """numpy scalar -> plain python (rows are JSON in the WAL)."""
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.integer, np.bool_)):
+        return int(v)
+    return v

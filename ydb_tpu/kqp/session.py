@@ -58,7 +58,16 @@ def _parse_type(t: str) -> dtypes.LogicalType:
 
 
 class Cluster:
-    """Storage + catalog + plan cache: one in-process database."""
+    """Storage + schema tablet + plan cache: one in-process database.
+
+    The schema catalog is a real SchemeShard (ydb_tpu.scheme.shard) over
+    a tablet executor on the same blob store as the data shards, so the
+    entire database — schema AND data — reboots from the store alone:
+    ``Cluster(store=same_store)`` after process death recovers every
+    table. String dictionaries are cluster-shared (ids must agree across
+    tables for joins), so their growth is journaled cluster-wide and
+    replayed before any shard boots.
+    """
 
     def __init__(
         self,
@@ -66,33 +75,284 @@ class Cluster:
         n_shards: int = 4,
         plan_cache_size: int = 128,
     ):
+        from ydb_tpu.scheme.shard import SchemeShardCore
+        from ydb_tpu.tablet.executor import TabletExecutor
+
         self.store = store if store is not None else MemBlobStore()
-        self.coordinator = Coordinator()
         self.n_shards = n_shards
         self.tables: dict[str, ShardedTable] = {}
         self.dicts = DictionarySet()  # cluster-wide, shared by all tables
         self._plan_cache: OrderedDict = OrderedDict()
         self._plan_cache_size = plan_cache_size
+        self._dict_seq = 0
+        self._dict_durable: dict[str, int] = {}
+        self._replay_dict_journal()
+        self.scheme = SchemeShardCore(
+            TabletExecutor.boot("schemeshard", self.store))
+        # data shards boot before the coordinator so its plan-step clock
+        # can resume past every snapshot the shards have seen
+        self.coordinator = Coordinator()
+        for desc in self.scheme.list_tables():
+            self._instantiate(desc, boot=True)
+        max_snap = max(
+            (s.snap for t in self.tables.values() for s in t.shards),
+            default=0,
+        )
+        self.coordinator = Coordinator(start_step=max_snap)
+        for t in self.tables.values():
+            t.coordinator = self.coordinator
+            for s in t.shards:
+                if hasattr(s, "snap_source"):
+                    s.snap_source = self.coordinator.background_plan
+            if hasattr(t, "post_boot_sweep"):
+                t.post_boot_sweep()
+
+    # ---- dict durability (cluster-wide journal) ----
+
+    def _replay_dict_journal(self) -> None:
+        for blob_id in self.store.list("cluster/dicts/"):
+            import json
+
+            delta = json.loads(self.store.get(blob_id).decode())
+            for col, values in delta.items():
+                d = self.dicts.for_column(col)
+                for v in values:
+                    d.add(v.encode("latin1"))
+            self._dict_seq += 1
+        for col in self.dicts.columns():
+            self._dict_durable[col] = len(self.dicts[col])
+
+    def _journal_dicts(self) -> None:
+        import json
+
+        delta = {}
+        for col in self.dicts.columns():
+            d = self.dicts[col]
+            n0 = self._dict_durable.get(col, 0)
+            if len(d) > n0:
+                delta[col] = [v.decode("latin1") for v in d.values[n0:]]
+                self._dict_durable[col] = len(d)
+        if delta:
+            self.store.put(f"cluster/dicts/{self._dict_seq:010d}",
+                           json.dumps(delta).encode())
+            self._dict_seq += 1
 
     # ---- DDL / DML ----
 
+    def _instantiate(self, desc, boot: bool = False):
+        from ydb_tpu.datashard.table import RowTable
+
+        name = desc.path.strip("/")
+        if desc.store == "row":
+            t = RowTable(
+                name, desc.schema, self.store, self.coordinator,
+                n_shards=desc.n_shards,
+                pk_columns=tuple(desc.primary_key),
+                ttl_column=desc.ttl_column, dicts=self.dicts, boot=boot,
+            )
+        else:
+            t = ShardedTable(
+                name, desc.schema, self.store, self.coordinator,
+                n_shards=desc.n_shards, pk_column=desc.primary_key[0],
+                ttl_column=desc.ttl_column, dicts=self.dicts, boot=boot,
+            )
+        t.alter_schema(desc.schema, desc.schema_version, desc.column_added)
+        # dict ids must be durable BEFORE any shard WAL references them:
+        # a crash between the two would otherwise leave dangling ids
+        t.pre_commit = self._journal_dicts
+        self.tables[name] = t
+        return t
+
     def create_table(self, stmt: ast.CreateTable) -> None:
+        from ydb_tpu.scheme.model import TableDescription
+        from ydb_tpu.scheme.shard import SchemeError
+
         if stmt.table in self.tables:
             raise PlanError(f"table {stmt.table} already exists")
         fields = []
         for name, typ, not_null in stmt.columns:
             fields.append(dtypes.Field(name, _parse_type(typ), not not_null))
         schema = dtypes.Schema(tuple(fields))
-        pk = stmt.primary_key[0] if stmt.primary_key else fields[0].name
-        t = ShardedTable(
-            stmt.table, schema, self.store, self.coordinator,
-            n_shards=self.n_shards, pk_column=pk,
+        pk = stmt.primary_key or (fields[0].name,)
+        opts = dict(stmt.options)
+        unknown = set(opts) - {"shards", "store", "ttl_column"}
+        if unknown:
+            raise PlanError(f"unknown WITH option(s): {sorted(unknown)}")
+        try:
+            n_shards = int(opts.get("shards", self.n_shards))
+        except ValueError:
+            raise PlanError(f"WITH shards must be an integer, got "
+                            f"{opts['shards']!r}") from None
+        if n_shards < 1:
+            raise PlanError("WITH shards must be >= 1")
+        store_kind = opts.get("store", "column")
+        if store_kind not in ("column", "row"):
+            raise PlanError(f"WITH store must be column|row, "
+                            f"got {store_kind!r}")
+        if "ttl_column" in opts and opts["ttl_column"] not in schema:
+            raise PlanError(f"ttl_column {opts['ttl_column']!r} not in "
+                            f"schema")
+        desc = TableDescription(
+            path="/" + stmt.table,
+            schema=schema,
+            primary_key=tuple(pk),
+            n_shards=n_shards,
+            store=store_kind,
+            ttl_column=opts.get("ttl_column"),
         )
-        t.dicts = self.dicts
-        for s in t.shards:
-            s.dicts = self.dicts
-        self.tables[stmt.table] = t
+        try:
+            self.scheme.create_table(desc)
+        except SchemeError as e:
+            raise PlanError(str(e)) from e
+        self._instantiate(desc)
         self._plan_cache.clear()
+
+    def drop_table(self, stmt: ast.DropTable) -> None:
+        from ydb_tpu.scheme.shard import SchemeError
+
+        try:
+            self.scheme.drop_table("/" + stmt.table)
+        except SchemeError as e:
+            raise PlanError(str(e)) from e
+        t = self.tables.pop(stmt.table, None)
+        # delete shard state (WAL/checkpoint/portions/executor logs): a
+        # later CREATE of the same name must not resurrect rows
+        if t is not None:
+            for prefix in t.storage_prefixes():
+                for blob_id in self.store.list(prefix):
+                    self.store.delete(blob_id)
+        self._plan_cache.clear()
+
+    def alter_table(self, stmt: ast.AlterTable) -> None:
+        from ydb_tpu.scheme.shard import SchemeError
+
+        t = self.tables.get(stmt.table)
+        if t is None:
+            raise PlanError(f"unknown table {stmt.table}")
+        add = [dtypes.Field(n, _parse_type(ty), True)
+               for n, ty in stmt.add_columns]
+        try:
+            desc = self.scheme.alter_table(
+                "/" + stmt.table, add_columns=add,
+                drop_columns=list(stmt.drop_columns))
+        except SchemeError as e:
+            raise PlanError(str(e)) from e
+        t.alter_schema(desc.schema, desc.schema_version, desc.column_added)
+        self._plan_cache.clear()
+
+    # ---- row-store DML (UPDATE / DELETE) ----
+
+    def _row_table(self, name: str):
+        from ydb_tpu.datashard.table import RowTable
+
+        t = self.tables.get(name)
+        if t is None:
+            raise PlanError(f"unknown table {name}")
+        if not isinstance(t, RowTable):
+            raise PlanError(
+                f"{name} is a column-store table; UPDATE/DELETE need a "
+                f"row table (CREATE TABLE ... WITH (store = row))")
+        return t
+
+    def _select_rows(self, table, extra_items, where, snap):
+        """Run SELECT pk..., extra... FROM table WHERE ... through the
+        normal plan/execute path at the given snapshot."""
+        items = [ast.SelectItem(ast.Name((c,)), f"__pk_{i}")
+                 for i, c in enumerate(table.pk_columns)]
+        items += extra_items
+        sel = ast.Select(
+            items=tuple(items),
+            from_=ast.TableRef(table.name, None),
+            where=where, group_by=(), having=None, order_by=(),
+            limit=None,
+        )
+        p = plan_select(sel, self.catalog())
+        out = to_host(execute_plan(p, self.snapshot_db(snap)))
+        n = out.num_rows
+        keys = [
+            tuple(int(out.column(f"__pk_{i}")[r])
+                  for i in range(len(table.pk_columns)))
+            for r in range(n)
+        ]
+        return out, keys
+
+    def update(self, stmt: ast.Update) -> TxResult:
+        t = self._row_table(stmt.table)
+        for name, _ in stmt.sets:
+            if name not in t.schema:
+                raise PlanError(f"no column {name}")
+            if name in t.pk_columns:
+                raise PlanError(f"cannot UPDATE key column {name}")
+        snap = self.coordinator.read_snapshot()
+        # constant SET values evaluate directly (string literals cannot
+        # ride the device plan — they'd be bare dict ids); computed
+        # expressions run through the normal SELECT path
+        const_sets: dict[str, tuple] = {}
+        copy_sets: list[tuple[str, str]] = []  # target <- source column
+        computed: list[tuple[str, ast.Expr]] = []
+        for name, e in stmt.sets:
+            lit = e
+            f = t.schema.field(name)
+            if isinstance(lit, (ast.Literal,)) or (
+                    isinstance(lit, ast.UnOp) and lit.op == "neg" and
+                    isinstance(lit.operand, ast.Literal)):
+                v, ok = _literal_value(lit, f.type)
+                if ok and f.type.is_string:
+                    v = int(self.dicts.for_column(name).add(v))
+                const_sets[name] = (v, ok)
+            elif f.type.is_string:
+                # dict ids are per-column: a cross-column copy must
+                # decode in the source dictionary and re-encode in the
+                # target's — raw id passthrough would alias wrong values
+                if isinstance(e, ast.Name) and e.column in t.schema and \
+                        t.schema.field(e.column).type.is_string:
+                    copy_sets.append((name, e.column))
+                else:
+                    raise PlanError(
+                        f"UPDATE SET {name} = <expr>: string columns "
+                        f"support literals or another string column")
+            else:
+                computed.append((name, e))
+        extra = [ast.SelectItem(e, f"__set_{i}")
+                 for i, (_n, e) in enumerate(computed)]
+        out, keys = self._select_rows(t, extra, stmt.where, snap)
+        rows = []
+        for r, key in enumerate(keys):
+            row = t.read_row(key, snap)
+            if row is None:
+                continue
+            row = dict(row)
+            for name, (v, ok) in const_sets.items():
+                row[name] = v if ok else None
+            for name, src in copy_sets:
+                sid = row.get(src)
+                if sid is None:
+                    row[name] = None
+                else:
+                    value = self.dicts[src].decode(
+                        np.asarray([sid], dtype=np.int32))[0]
+                    row[name] = int(self.dicts.for_column(name).add(value))
+            for i, (name, _e) in enumerate(computed):
+                col = out.column(f"__set_{i}")
+                ok = bool(out.validity(f"__set_{i}")[r])
+                if not ok:
+                    row[name] = None
+                else:
+                    row[name] = _coerce(
+                        col[r], out.schema.field(f"__set_{i}").type,
+                        t.schema.field(name).type)
+            rows.append(row)
+        if not rows:
+            return TxResult(0, snap, True)
+        return t.upsert_rows(rows)
+
+    def delete(self, stmt: ast.Delete) -> TxResult:
+        t = self._row_table(stmt.table)
+        snap = self.coordinator.read_snapshot()
+        _out, keys = self._select_rows(t, [], stmt.where, snap)
+        if not keys:
+            return TxResult(0, snap, True)
+        return t.delete_keys(keys)
 
     def insert(self, stmt: ast.Insert) -> TxResult:
         t = self.tables.get(stmt.table)
@@ -119,7 +379,7 @@ class Cluster:
             else:
                 arrays[n] = np.asarray(cols[n], dtype=f.type.physical)
         val = {n: np.asarray(v, dtype=bool) for n, v in validity.items()}
-        res = t.insert(arrays, val)
+        res = t.insert(arrays, val)  # journals dict growth via pre_commit
         # new dictionary entries may invalidate cached plan aux tables
         self._plan_cache.clear()
         return res
@@ -136,11 +396,15 @@ class Cluster:
         )
 
     def snapshot_db(self, snap: int | None = None) -> Database:
+        from ydb_tpu.datashard.table import RowTable
+
         snap = self.coordinator.read_snapshot() if snap is None else snap
         sources = {}
         for name, t in self.tables.items():
-            merged = _merge_shard_sources(t, snap)
-            sources[name] = merged
+            if isinstance(t, RowTable):
+                sources[name] = t.source_at(snap)
+            else:
+                sources[name] = _merge_shard_sources(t, snap)
         return Database(sources=sources, dicts=self.dicts)
 
     def plan(self, sql: str):
@@ -176,6 +440,26 @@ def _merge_shard_sources(t: ShardedTable, snap: int) -> ColumnSource:
         ]
         validity[n] = np.concatenate(vs)
     return ColumnSource(cols, t.schema, t.dicts, validity)
+
+
+def _coerce(value, from_t: dtypes.LogicalType, to_t: dtypes.LogicalType):
+    """Physical value conversion for UPDATE SET results."""
+    v = value
+    if to_t.is_decimal:
+        if from_t.is_decimal:
+            return int(v) * 10 ** (to_t.scale - from_t.scale) \
+                if to_t.scale >= from_t.scale else \
+                int(int(v) // 10 ** (from_t.scale - to_t.scale))
+        if from_t.is_floating:
+            return int(round(float(v) * 10 ** to_t.scale))
+        return int(v) * 10 ** to_t.scale
+    if to_t.is_floating:
+        if from_t.is_decimal:
+            return float(v) / 10 ** from_t.scale
+        return float(v)
+    if to_t.is_string:
+        return int(v)  # dict id flows through unchanged
+    return int(v)
 
 
 def _literal_value(e: ast.Expr, t: dtypes.LogicalType):
@@ -217,7 +501,19 @@ class Session:
         if isinstance(planned, ast.CreateTable):
             self.cluster.create_table(planned)
             return None
+        if isinstance(planned, ast.DropTable):
+            self.cluster.drop_table(planned)
+            return None
+        if isinstance(planned, ast.AlterTable):
+            self.cluster.alter_table(planned)
+            return None
         if isinstance(planned, ast.Insert):
             return self.cluster.insert(planned)
+        if isinstance(planned, ast.Update):
+            return self.cluster.update(planned)
+        if isinstance(planned, ast.Delete):
+            return self.cluster.delete(planned)
         db = self.cluster.snapshot_db()
-        return to_host(execute_plan(planned, db))
+        out = to_host(execute_plan(planned, db))
+        out.dicts = self.cluster.dicts
+        return out

@@ -43,6 +43,14 @@ class TxContext:
     def put(self, table: str, key: tuple, row: dict) -> None:
         self.changes.append((table, tuple(key), dict(row)))
 
+    def put_at(self, table: str, key: tuple, row: dict | None,
+               version: int) -> None:
+        """Write with an explicit MVCC version (global plan step) instead
+        of this commit's version — the DataShard visibility clock."""
+        self.changes.append((table, tuple(key),
+                             dict(row) if row is not None else None,
+                             version))
+
     def erase(self, table: str, key: tuple) -> None:
         self.changes.append((table, tuple(key), None))
 
@@ -82,7 +90,8 @@ class TabletExecutor:
                 "gen": self.generation,
                 "version": txc.version,
                 "changes": [
-                    [t, list(k), r] for t, k, r in txc.changes
+                    [ch[0], list(ch[1])] + list(ch[2:])
+                    for ch in txc.changes
                 ],
             }
             blob_id = (f"{self._prefix()}log/"
@@ -154,7 +163,8 @@ class TabletExecutor:
                     continue
                 if limit is not None and rec["version"] >= limit:
                     continue  # fenced zombie write
-                changes = [(t, tuple(k), r) for t, k, r in rec["changes"]]
+                changes = [(ch[0], tuple(ch[1]), *ch[2:])
+                           for ch in rec["changes"]]
                 db.apply(changes, rec["version"])
                 version = rec["version"]
                 gen = max(gen, g)

@@ -125,9 +125,16 @@ class LocalDb:
         return t
 
     def apply(self, changes: list[tuple], version: int) -> None:
-        """changes: [(table, key_tuple, row_or_None), ...]"""
-        for table, key, row in changes:
-            self.table(table).put(tuple(key), row, version)
+        """changes: [(table, key, row_or_None[, explicit_version]), ...]
+
+        The optional 4th element overrides the commit version — used by
+        tablets whose row visibility follows the global plan-step clock
+        (DataShard MVCC) rather than the tablet's own commit counter.
+        """
+        for ch in changes:
+            table, key, row = ch[0], ch[1], ch[2]
+            ver = ch[3] if len(ch) > 3 else version
+            self.table(table).put(tuple(key), row, ver)
 
     def dump(self) -> dict:
         return {name: t.dump() for name, t in self.tables.items()}
