@@ -201,8 +201,10 @@ class DataShard:
 
         With ``lock_id``, the scanned range/points are recorded on the
         lock so later conflicting commits break it (optimistic tx).
+        Registration happens HERE, eagerly — not when the returned
+        iterator is first consumed — so a conflict in the gap between
+        opening and draining the read still breaks the lock.
         """
-        table = self.executor.db.table("data")
         if lock_id is not None:
             lock = self._locks.setdefault(
                 lock_id, _Lock(lock_id, [], set()))
@@ -210,6 +212,11 @@ class DataShard:
                 lock.points.update(tuple(k) for k in keys)
             else:
                 lock.ranges.append((lo, hi))
+        return self._read_pages(snapshot, lo, hi, keys, columns,
+                                page_rows)
+
+    def _read_pages(self, snapshot, lo, hi, keys, columns, page_rows):
+        table = self.executor.db.table("data")
         page: list[tuple[tuple, dict]] = []
         if keys is not None:
             for key in keys:

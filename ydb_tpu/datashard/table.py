@@ -56,16 +56,14 @@ class RowTable:
         self.schema_version = 1
         self.column_added: dict[str, int] = {}
         self.pre_commit = None
-        self._needs_sweep = boot
 
     def post_boot_sweep(self) -> None:
         """Crash-safe DROP COLUMN: if a prior strip (alter_schema) died
         between the scheme commit and the rewrite, stale values would
-        resurrect on a later re-ADD. Called by the cluster once the real
-        coordinator clock is installed (reads need true snapshots)."""
-        if self._needs_sweep:
-            self._needs_sweep = False
-            self._strip_columns(keep=set(self.schema.names))
+        resurrect on a later re-ADD. The cluster calls this on boot only
+        when the scheme tablet holds a pending-strip marker for this
+        table (and once the real coordinator clock is installed)."""
+        self._strip_columns(keep=set(self.schema.names))
 
     def storage_prefixes(self) -> list[str]:
         """Blob-store prefixes owning this table's durable state (DROP
@@ -107,16 +105,20 @@ class RowTable:
 
     # ---- writes (2PC across shards) ----
 
-    def _commit_ops(self, per_row_ops: list[RowOp]) -> TxResult:
+    def _commit_ops(self, per_row_ops: list[RowOp],
+                    lock_ids: dict[int, int] | None = None) -> TxResult:
+        """lock_ids: shard index -> optimistic lock the tx validated
+        under; prepare fails (aborting the 2PC) if it broke."""
         if self.pre_commit is not None:
             self.pre_commit()
         route = self._route([op.key for op in per_row_ops])
         participants, prepare_args = [], []
         for i, shard in enumerate(self.shards):
             ops = [op for op, r in zip(per_row_ops, route) if r == i]
-            if not ops:
+            if not ops and not (lock_ids and i in lock_ids):
                 continue
-            wid = shard.propose(ops)
+            wid = shard.propose(
+                ops, lock_id=lock_ids.get(i) if lock_ids else None)
             participants.append(shard)
             prepare_args.append([wid])
         return self.coordinator.commit(participants, prepare_args)
@@ -137,13 +139,41 @@ class RowTable:
     # ---- reads ----
 
     def read_row(self, key: tuple, snap: int | None = None) -> dict | None:
+        rows = self.read_rows([tuple(key)], snap)
+        return rows.get(tuple(key))
+
+    def read_rows(self, keys: list[tuple],
+                  snap: int | None = None) -> dict[tuple, dict]:
+        """Batched point reads: one shard.read per shard, not per key."""
         snap = (self.coordinator.read_snapshot()
                 if snap is None else snap)
-        shard = self.shards[int(self._route([tuple(key)])[0])]
-        for page in shard.read(snap, keys=[tuple(key)]):
-            for _k, row in page:
-                return row
-        return None
+        keys = [tuple(k) for k in keys]
+        out: dict[tuple, dict] = {}
+        if not keys:
+            return out
+        route = self._route(keys)
+        for i, shard in enumerate(self.shards):
+            mine = [k for k, r in zip(keys, route) if r == i]
+            if not mine:
+                continue
+            for page in shard.read(snap, keys=mine):
+                out.update(page)
+        return out
+
+    def lock_all_shards(self) -> dict[int, int]:
+        """Full-range optimistic lock on every shard (the coarse
+        serialization UPDATE/DELETE read-modify-write uses); returns
+        shard index -> lock id."""
+        locks = {}
+        for i, shard in enumerate(self.shards):
+            lk = shard.acquire_lock()
+            shard.read(0, lock_id=lk)  # registers the (None, None) range
+            locks[i] = lk
+        return locks
+
+    def release_locks(self, locks: dict[int, int]) -> None:
+        for i, lk in locks.items():
+            self.shards[i].release_lock(lk)
 
     def source_at(self, snap: int | None = None,
                   columns: tuple[str, ...] | None = None) -> ColumnSource:

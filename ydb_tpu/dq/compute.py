@@ -91,18 +91,14 @@ def payload_to_block(payload: dict, schema: dtypes.Schema) -> TableBlock:
 def _hash_rows(payload: dict, schema, keys) -> np.ndarray:
     """Row hash for partition routing (the vectorized block hash
     partitioner, dq_output_consumer.cpp:338); computed once per block and
-    reduced mod the channel count per consumer group."""
-    first = payload[schema.names[0]]
-    h = np.zeros(len(first), dtype=np.uint64)
-    h[:] = 0x9E3779B97F4A7C15
-    for k in keys:
-        kv = payload[k].astype(np.int64).view(np.uint64)
-        ok = payload[f"__v_{k}"].astype(np.uint64) << np.uint64(63)
-        x = h ^ (kv ^ ok)
-        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-        h = x ^ (x >> np.uint64(31))
-    return h
+    reduced mod the channel count per consumer group. Runs in the native
+    host library when built (ydb_tpu.native, bit-identical fallback)."""
+    from ydb_tpu import native
+
+    return native.hash_rows(
+        [payload[k].astype(np.int64) for k in keys],
+        [payload[f"__v_{k}"] for k in keys],
+    )
 
 
 def _split_by_hash(payload: dict, h: np.ndarray, n: int) -> list[dict]:

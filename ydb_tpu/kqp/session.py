@@ -89,6 +89,8 @@ class Cluster:
         self._replay_dict_journal()
         self.scheme = SchemeShardCore(
             TabletExecutor.boot("schemeshard", self.store))
+        # finish any DROP TABLE whose blob deletion a crash interrupted
+        self._sweep_trash()
         # data shards boot before the coordinator so its plan-step clock
         # can resume past every snapshot the shards have seen
         self.coordinator = Coordinator()
@@ -104,8 +106,13 @@ class Cluster:
             for s in t.shards:
                 if hasattr(s, "snap_source"):
                     s.snap_source = self.coordinator.background_plan
-            if hasattr(t, "post_boot_sweep"):
+        # finish any DROP COLUMN strip a crash interrupted (marker set
+        # durably before the scheme alter committed)
+        for path in self.scheme.pending_strips():
+            t = self.tables.get(path.strip("/"))
+            if t is not None and hasattr(t, "post_boot_sweep"):
                 t.post_boot_sweep()
+            self.scheme.clear_strip(path)
 
     # ---- dict durability (cluster-wide journal) ----
 
@@ -210,18 +217,25 @@ class Cluster:
     def drop_table(self, stmt: ast.DropTable) -> None:
         from ydb_tpu.scheme.shard import SchemeError
 
+        t = self.tables.get(stmt.table)
+        prefixes = t.storage_prefixes() if t is not None else []
         try:
-            self.scheme.drop_table("/" + stmt.table)
+            # prefixes are recorded durably in the drop tx itself; the
+            # boot sweep finishes deletion if we crash before it
+            self.scheme.drop_table("/" + stmt.table,
+                                   trash_prefixes=prefixes)
         except SchemeError as e:
             raise PlanError(str(e)) from e
-        t = self.tables.pop(stmt.table, None)
-        # delete shard state (WAL/checkpoint/portions/executor logs): a
-        # later CREATE of the same name must not resurrect rows
-        if t is not None:
-            for prefix in t.storage_prefixes():
+        self.tables.pop(stmt.table, None)
+        self._sweep_trash()
+        self._plan_cache.clear()
+
+    def _sweep_trash(self) -> None:
+        for op_id, prefixes in self.scheme.trash():
+            for prefix in prefixes:
                 for blob_id in self.store.list(prefix):
                     self.store.delete(blob_id)
-        self._plan_cache.clear()
+            self.scheme.clear_trash(op_id)
 
     def alter_table(self, stmt: ast.AlterTable) -> None:
         from ydb_tpu.scheme.shard import SchemeError
@@ -231,13 +245,22 @@ class Cluster:
             raise PlanError(f"unknown table {stmt.table}")
         add = [dtypes.Field(n, _parse_type(ty), True)
                for n, ty in stmt.add_columns]
+        row_strip = stmt.drop_columns and hasattr(t, "post_boot_sweep")
+        if row_strip:
+            # marker precedes the schema commit: a crash anywhere before
+            # clear_strip re-runs the strip on next boot
+            self.scheme.mark_strip("/" + stmt.table)
         try:
             desc = self.scheme.alter_table(
                 "/" + stmt.table, add_columns=add,
                 drop_columns=list(stmt.drop_columns))
         except SchemeError as e:
+            if row_strip:
+                self.scheme.clear_strip("/" + stmt.table)
             raise PlanError(str(e)) from e
         t.alter_schema(desc.schema, desc.schema_version, desc.column_added)
+        if row_strip:
+            self.scheme.clear_strip("/" + stmt.table)
         self._plan_cache.clear()
 
     # ---- row-store DML (UPDATE / DELETE) ----
@@ -276,6 +299,8 @@ class Cluster:
         ]
         return out, keys
 
+    RMW_RETRIES = 5
+
     def update(self, stmt: ast.Update) -> TxResult:
         t = self._row_table(stmt.table)
         for name, _ in stmt.sets:
@@ -283,6 +308,23 @@ class Cluster:
                 raise PlanError(f"no column {name}")
             if name in t.pk_columns:
                 raise PlanError(f"cannot UPDATE key column {name}")
+        # optimistic read-modify-write: lock, read at snapshot, write
+        # under the lock; a conflicting commit in between breaks the
+        # lock, prepare aborts the 2PC, and the whole RMW retries
+        for _attempt in range(self.RMW_RETRIES):
+            locks = t.lock_all_shards()
+            try:
+                res = self._update_once(t, stmt, locks)
+            finally:
+                t.release_locks(locks)
+            if res.committed or not (res.error or "").startswith(
+                    "prepare"):
+                return res
+        raise PlanError(
+            f"UPDATE {stmt.table} kept aborting on concurrent writes")
+
+    def _update_once(self, t, stmt: ast.Update,
+                     locks: dict[int, int]) -> TxResult:
         snap = self.coordinator.read_snapshot()
         # constant SET values evaluate directly (string literals cannot
         # ride the device plan — they'd be bare dict ids); computed
@@ -316,9 +358,10 @@ class Cluster:
         extra = [ast.SelectItem(e, f"__set_{i}")
                  for i, (_n, e) in enumerate(computed)]
         out, keys = self._select_rows(t, extra, stmt.where, snap)
+        current = t.read_rows(keys, snap)  # one batched read per shard
         rows = []
         for r, key in enumerate(keys):
-            row = t.read_row(key, snap)
+            row = current.get(key)
             if row is None:
                 continue
             row = dict(row)
@@ -344,15 +387,35 @@ class Cluster:
             rows.append(row)
         if not rows:
             return TxResult(0, snap, True)
-        return t.upsert_rows(rows)
+        from ydb_tpu.datashard.shard import RowOp
+
+        return t._commit_ops(
+            [RowOp(t._key_of(r), r) for r in rows], lock_ids=locks)
 
     def delete(self, stmt: ast.Delete) -> TxResult:
         t = self._row_table(stmt.table)
+        for _attempt in range(self.RMW_RETRIES):
+            locks = t.lock_all_shards()
+            try:
+                res = self._delete_once(t, stmt, locks)
+            finally:
+                t.release_locks(locks)
+            if res.committed or not (res.error or "").startswith(
+                    "prepare"):
+                return res
+        raise PlanError(
+            f"DELETE {stmt.table} kept aborting on concurrent writes")
+
+    def _delete_once(self, t, stmt: ast.Delete,
+                     locks: dict[int, int]) -> TxResult:
+        from ydb_tpu.datashard.shard import RowOp
+
         snap = self.coordinator.read_snapshot()
         _out, keys = self._select_rows(t, [], stmt.where, snap)
         if not keys:
             return TxResult(0, snap, True)
-        return t.delete_keys(keys)
+        return t._commit_ops(
+            [RowOp(tuple(k), None) for k in keys], lock_ids=locks)
 
     def insert(self, stmt: ast.Insert) -> TxResult:
         t = self.tables.get(stmt.table)

@@ -1,0 +1,183 @@
+"""Native host kernels with exact numpy fallbacks.
+
+Hot host-side operations between device programs — shuffle row hashing,
+K-way PK merge with MVCC dedup, bloom filters, gathers — implemented in
+C++ (src/ydbtpu_native.cpp; reference analogs cited there) and loaded
+via ctypes. Every entry point has a numpy twin producing bit-identical
+results, selected automatically when the library can't build; set
+YDB_TPU_NO_NATIVE=1 to force the fallback (tests compare both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ydb_tpu.native.build import ensure_built
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib if _lib is not False else None
+    path = ensure_built()
+    if path is None:
+        _lib = False
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.ydbtpu_kway_merge.restype = ctypes.c_int64
+        _lib = lib
+    except OSError:
+        _lib = False
+        return None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _pp(arrs, ctype):
+    """list of contiguous arrays -> C array of pointers."""
+    ptrs = (ctypes.POINTER(ctype) * len(arrs))()
+    for i, a in enumerate(arrs):
+        ptrs[i] = a.ctypes.data_as(ctypes.POINTER(ctype))
+    return ptrs
+
+
+# ---- row hashing ----
+
+def hash_rows(keys: list[np.ndarray],
+              valids: list[np.ndarray]) -> np.ndarray:
+    """Shuffle-routing row hash over int64 key columns (+ validity bit).
+
+    Identical bits from the native and numpy paths — partition routing
+    must agree across processes with and without the toolchain.
+    """
+    n = len(keys[0]) if keys else 0
+    lib = _load()
+    if lib is not None and n > 0:
+        ks = [np.ascontiguousarray(k, dtype=np.int64) for k in keys]
+        vs = [np.ascontiguousarray(v, dtype=np.uint8) for v in valids]
+        out = np.empty(n, dtype=np.uint64)
+        lib.ydbtpu_hash_rows(
+            _pp(ks, ctypes.c_int64), _pp(vs, ctypes.c_uint8),
+            ctypes.c_int32(len(ks)), ctypes.c_int64(n),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        return out
+    h = np.full(n, 0x9E3779B97F4A7C15, dtype=np.uint64)
+    for kv, ok in zip(keys, valids):
+        v = kv.astype(np.int64).view(np.uint64) ^ (
+            ok.astype(np.uint64) << np.uint64(63))
+        x = h ^ v
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        h = x ^ (x >> np.uint64(31))
+    return h
+
+
+# ---- K-way merge ----
+
+def kway_merge(runs: list[np.ndarray], dedup: bool = False):
+    """Merge sorted int64 runs into global key order.
+
+    Returns (run_idx int32[n], row_idx int64[n]). Stable across runs;
+    with dedup=True equal keys collapse to the highest run index
+    (runs ordered oldest -> newest = newest-wins MVCC dedup,
+    merge.cpp/NArrow::NMerger analog).
+    """
+    total = int(sum(len(r) for r in runs))
+    lib = _load()
+    if lib is not None:
+        rs = [np.ascontiguousarray(r, dtype=np.int64) for r in runs]
+        lens = np.asarray([len(r) for r in rs], dtype=np.int64)
+        out_run = np.empty(total, dtype=np.int32)
+        out_idx = np.empty(total, dtype=np.int64)
+        n = lib.ydbtpu_kway_merge(
+            _pp(rs, ctypes.c_int64),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int32(len(rs)), ctypes.c_int32(1 if dedup else 0),
+            out_run.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return out_run[:n], out_idx[:n]
+    # numpy twin: stable sort of (key, run) then optional last-dup keep
+    keys = np.concatenate([np.asarray(r, dtype=np.int64) for r in runs]) \
+        if runs else np.empty(0, dtype=np.int64)
+    run_of = np.concatenate([
+        np.full(len(r), i, dtype=np.int32) for i, r in enumerate(runs)
+    ]) if runs else np.empty(0, dtype=np.int32)
+    idx_of = np.concatenate([
+        np.arange(len(r), dtype=np.int64) for r in runs
+    ]) if runs else np.empty(0, dtype=np.int64)
+    order = np.lexsort((run_of, keys))
+    keys, run_of, idx_of = keys[order], run_of[order], idx_of[order]
+    if dedup and len(keys):
+        # keep the LAST of each equal-key group
+        last = np.r_[keys[1:] != keys[:-1], True]
+        run_of, idx_of = run_of[last], idx_of[last]
+    return run_of, idx_of
+
+
+# ---- bloom filter ----
+
+class BloomFilter:
+    """Bloom filter over u64 hashes (part/portion pruning analog)."""
+
+    def __init__(self, nbits: int, nprobes: int = 4,
+                 bits: np.ndarray | None = None):
+        self.nbits = int(nbits)
+        self.nprobes = int(nprobes)
+        self.bits = (bits if bits is not None else
+                     np.zeros((self.nbits + 7) // 8, dtype=np.uint8))
+
+    @staticmethod
+    def for_items(n_items: int, bits_per_item: int = 10) -> "BloomFilter":
+        return BloomFilter(max(64, n_items * bits_per_item))
+
+    def add(self, hashes: np.ndarray) -> None:
+        h = np.ascontiguousarray(hashes, dtype=np.uint64)
+        lib = _load()
+        if lib is not None:
+            lib.ydbtpu_bloom_build(
+                h.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ctypes.c_int64(len(h)),
+                self.bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.c_int64(self.nbits), ctypes.c_int32(self.nprobes))
+            return
+        h2 = _mix64(h) | np.uint64(1)
+        for p in range(self.nprobes):
+            bit = (h + np.uint64(p) * h2) % np.uint64(self.nbits)
+            np.bitwise_or.at(
+                self.bits, (bit >> np.uint64(3)).astype(np.int64),
+                (np.uint8(1) << (bit & np.uint64(7)).astype(np.uint8)))
+
+    def query(self, hashes: np.ndarray) -> np.ndarray:
+        h = np.ascontiguousarray(hashes, dtype=np.uint64)
+        lib = _load()
+        if lib is not None:
+            out = np.empty(len(h), dtype=np.uint8)
+            lib.ydbtpu_bloom_query(
+                h.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ctypes.c_int64(len(h)),
+                self.bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.c_int64(self.nbits), ctypes.c_int32(self.nprobes),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            return out.astype(bool)
+        h2 = _mix64(h) | np.uint64(1)
+        hit = np.ones(len(h), dtype=bool)
+        for p in range(self.nprobes):
+            bit = (h + np.uint64(p) * h2) % np.uint64(self.nbits)
+            byte = self.bits[(bit >> np.uint64(3)).astype(np.int64)]
+            hit &= ((byte >> (bit & np.uint64(7)).astype(np.uint8))
+                    & np.uint8(1)).astype(bool)
+        return hit
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
+    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xC4CEB9FE1A85EC53)
+    return x ^ (x >> np.uint64(33))

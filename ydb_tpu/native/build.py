@@ -1,0 +1,36 @@
+"""On-demand build of the native host library.
+
+One g++ invocation, cached by source mtime; no toolchain (or a failed
+compile) degrades to the numpy twins in ydb_tpu.native — behavior
+identical, just slower (the CPU-default/plugin-engine rule the
+reference enforces at its TComputationNodeFactory seam)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "src", "ydbtpu_native.cpp")
+OUT = os.path.join(_DIR, "_build", "libydbtpu_native.so")
+
+
+def ensure_built(force: bool = False) -> str | None:
+    """Compile if stale; returns the .so path or None when unavailable."""
+    if os.environ.get("YDB_TPU_NO_NATIVE"):
+        return None
+    try:
+        if not force and os.path.exists(OUT) and \
+                os.path.getmtime(OUT) >= os.path.getmtime(SRC):
+            return OUT
+        os.makedirs(os.path.dirname(OUT), exist_ok=True)
+        tmp = OUT + ".tmp"
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+             "-o", tmp, SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, OUT)
+        return OUT
+    except Exception:
+        return None

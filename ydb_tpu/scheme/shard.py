@@ -161,7 +161,13 @@ class SchemeShardCore:
         self._run(fn)
         self._publish(path, d, pub["v"])
 
-    def drop_table(self, path: str) -> None:
+    def drop_table(self, path: str,
+                   trash_prefixes: list[str] = ()) -> None:
+        """``trash_prefixes``: blob-store prefixes of the table's shard
+        state, recorded durably IN the drop transaction; the hosting
+        layer deletes them and calls clear_trash, and sweeps leftovers
+        on boot — a crash between drop and delete can never resurrect
+        rows under a recreated name."""
         path = _norm(path)
         if self.kind(path) != "table":
             raise SchemeError(f"{path} is not a table")
@@ -171,9 +177,33 @@ class SchemeShardCore:
             txc.erase("paths", (path,))
             txc.erase("tables", (path,))
             pub["v"] = self._journal(txc, "drop_table", path)
+            if trash_prefixes:
+                txc.put("trash", (pub["v"],),
+                        {"prefixes": list(trash_prefixes)})
 
         self._run(fn)
         self._publish(path, None, pub["v"])
+
+    # ---- trash (deferred storage cleanup) ----
+
+    def trash(self) -> list[tuple[int, list[str]]]:
+        return [(k[0], row["prefixes"])
+                for k, row in self.executor.db.table("trash").range()]
+
+    def clear_trash(self, op_id: int) -> None:
+        self._run(lambda txc: txc.erase("trash", (op_id,)))
+
+    # ---- pending column strips (crash-safe row DROP COLUMN) ----
+
+    def mark_strip(self, path: str) -> None:
+        self._run(lambda txc: txc.put("strips", (_norm(path),), {}))
+
+    def clear_strip(self, path: str) -> None:
+        self._run(lambda txc: txc.erase("strips", (_norm(path),)))
+
+    def pending_strips(self) -> set[str]:
+        return {k[0] for k, _ in
+                self.executor.db.table("strips").range()}
 
     def alter_table(
         self,
