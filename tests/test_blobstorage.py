@@ -1,0 +1,156 @@
+"""BlobStorage tests: erasure codecs, quorum DSProxy, restore-on-read,
+self-heal, and a full SQL cluster on erasure-coded storage with disk
+kills (SURVEY.md §2.3)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ydb_tpu.blobstorage.erasure import ErasureCodec
+from ydb_tpu.blobstorage.group import DSProxy, GroupInfo, VDisk
+from ydb_tpu.blobstorage.proxy_store import GroupBlobStore
+from ydb_tpu.kqp.session import Cluster
+
+
+PAYLOADS = [b"", b"x", b"hello world", bytes(range(256)) * 37,
+            np.random.default_rng(5).bytes(10000)]
+
+
+@pytest.mark.parametrize("species", ["none", "mirror3", "block42"])
+def test_erasure_roundtrip(species):
+    codec = ErasureCodec(species)
+    for data in PAYLOADS:
+        parts = codec.encode(data)
+        assert len(parts) == codec.total_parts
+        full = {i: p for i, p in enumerate(parts)}
+        assert codec.decode(full, len(data)) == data
+
+
+def test_block42_recovers_any_two_lost_parts():
+    codec = ErasureCodec("block42")
+    data = np.random.default_rng(1).bytes(5000)
+    parts = codec.encode(data)
+    for lost in itertools.combinations(range(6), 2):
+        have = {i: p for i, p in enumerate(parts) if i not in lost}
+        assert codec.decode(have, len(data)) == data
+    # and any single loss
+    for lost1 in range(6):
+        have = {i: p for i, p in enumerate(parts) if i != lost1}
+        assert codec.decode(have, len(data)) == data
+    # three losses must fail
+    with pytest.raises(ValueError):
+        codec.decode({i: parts[i] for i in (0, 4, 5)}, len(data))
+
+
+def test_mirror3_recovers_two_lost():
+    codec = ErasureCodec("mirror3")
+    data = b"important"
+    parts = codec.encode(data)
+    assert codec.decode({2: parts[2]}, len(data)) == data
+
+
+def test_reconstruct_part_matches_original():
+    codec = ErasureCodec("block42")
+    data = np.random.default_rng(2).bytes(3000)
+    parts = codec.encode(data)
+    for idx in range(6):
+        have = {i: p for i, p in enumerate(parts) if i != idx}
+        assert codec.reconstruct_part(have, idx, len(data)) == parts[idx]
+
+
+def test_dsproxy_put_get_with_disks_down():
+    group = GroupInfo(1, "block42")
+    proxy = DSProxy(group)
+    blobs = {f"blob/{i}": np.random.default_rng(i).bytes(100 + i * 37)
+             for i in range(20)}
+    for bid, data in blobs.items():
+        proxy.put(bid, data)
+    # restore-on-read with any two disks down
+    group.disks[1].down = True
+    group.disks[4].down = True
+    for bid, data in blobs.items():
+        assert proxy.get(bid) == data
+    assert sorted(proxy.list("blob/")) == sorted(blobs)
+    # a third down disk: reads start failing for some blobs
+    group.disks[0].down = True
+    failures = 0
+    for bid, data in blobs.items():
+        try:
+            assert proxy.get(bid) == data
+        except (ValueError, KeyError):
+            failures += 1
+    assert failures > 0
+
+
+def test_dsproxy_write_quorum():
+    group = GroupInfo(2, "block42")
+    proxy = DSProxy(group)
+    group.disks[0].down = True
+    group.disks[1].down = True
+    proxy.put("b1", b"still ok with 4/6")     # exactly at quorum
+    assert proxy.get("b1") == b"still ok with 4/6"
+    group.disks[2].down = True
+    with pytest.raises(IOError):
+        proxy.put("b2", b"3/6 is below quorum")
+
+
+def test_self_heal_rebuilds_dead_disk():
+    group = GroupInfo(3, "block42")
+    proxy = DSProxy(group)
+    blobs = {f"x/{i}": bytes([i]) * (50 + i) for i in range(30)}
+    for bid, data in blobs.items():
+        proxy.put(bid, data)
+    group.disks[2].down = True
+    rebuilt = proxy.self_heal(2)
+    assert rebuilt > 0
+    # now a DIFFERENT pair of disks can die and everything still reads
+    group.disks[0].down = True
+    group.disks[5].down = True
+    for bid, data in blobs.items():
+        assert proxy.get(bid) == data
+
+
+def test_full_sql_cluster_on_erasure_coded_storage():
+    group = GroupInfo(7, "block42")
+    store = GroupBlobStore(DSProxy(group))
+    c = Cluster(store=store)
+    s = c.session()
+    s.execute("CREATE TABLE t (id int64, name string, PRIMARY KEY (id)) "
+              "WITH (shards = 2)")
+    s.execute("INSERT INTO t VALUES (1, 'a'), (2, 'b'), (3, 'c')")
+    # two disks die; the whole database still reads AND writes
+    group.disks[0].down = True
+    group.disks[3].down = True
+    s.execute("INSERT INTO t VALUES (4, 'd')")
+    out = s.execute("SELECT count(*) AS n FROM t")
+    assert list(out.column("n")) == [4]
+    # cluster reboot from the degraded group
+    c2 = Cluster(store=store)
+    out = c2.session().execute("SELECT id FROM t ORDER BY id")
+    assert list(out.column("id")) == [1, 2, 3, 4]
+    # heal, then a different failure pattern
+    proxy = store.proxy
+    proxy.self_heal(0)
+    proxy.self_heal(3)
+    group.disks[1].down = True
+    group.disks[4].down = True
+    out = c2.session().execute("SELECT id FROM t ORDER BY id")
+    assert list(out.column("id")) == [1, 2, 3, 4]
+
+
+def test_failed_put_rolls_back_and_self_heal_skips_garbage():
+    group = GroupInfo(9, "block42")
+    proxy = DSProxy(group)
+    proxy.put("good", b"fine")
+    group.disks[0].down = True
+    group.disks[1].down = True
+    group.disks[2].down = True
+    with pytest.raises(IOError):
+        proxy.put("partial", b"should roll back")
+    group.disks[0].down = False
+    group.disks[1].down = False
+    group.disks[2].down = False
+    assert not proxy.exists("partial")     # no poisoned remnant
+    assert proxy.self_heal(4) >= 1         # heal still works
+    assert proxy.get("good") == b"fine"

@@ -1,0 +1,6 @@
+from ydb_tpu.blobstorage.erasure import ErasureCodec
+from ydb_tpu.blobstorage.group import DSProxy, GroupInfo, VDisk
+from ydb_tpu.blobstorage.proxy_store import GroupBlobStore
+
+__all__ = ["ErasureCodec", "DSProxy", "GroupInfo", "VDisk",
+           "GroupBlobStore"]

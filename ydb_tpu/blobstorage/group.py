@@ -1,0 +1,220 @@
+"""Blob groups: VDisks, topology, and the quorum DSProxy.
+
+Mirror of the reference's group machinery (SURVEY.md §2.3): a group is a
+set of disks across fail domains (TBlobStorageGroupInfo
+groupinfo/blobstorage_groupinfo.h:65); clients talk to a per-group
+DSProxy which erasure-encodes puts across the disks
+(dsproxy_put.cpp:29), reads with reconstruction when disks are down
+(restore-on-read, dsproxy_get.cpp:34), and the controller replaces
+broken disks and rebuilds their parts (self-heal
+mind/bscontroller/self_heal.cpp + vdisk repl).
+
+VDisk here is the per-disk part store (the hull LSM collapsed to a KV
+namespace on a host BlobStore); ``down`` simulates disk death for
+tests/nemesis.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ydb_tpu.blobstorage.erasure import ErasureCodec
+from ydb_tpu.common import fnv1a_64
+from ydb_tpu.engine.blobs import BlobStore, MemBlobStore
+
+
+class DiskDown(Exception):
+    pass
+
+
+class VDisk:
+    def __init__(self, disk_id: str, backing: BlobStore | None = None):
+        self.disk_id = disk_id
+        self.backing = backing if backing is not None else MemBlobStore()
+        self.down = False
+
+    def _key(self, blob_id: str, part: int) -> str:
+        return f"vdisk/{self.disk_id}/{part}/{blob_id}"
+
+    def put_part(self, blob_id: str, part: int, data: bytes) -> None:
+        if self.down:
+            raise DiskDown(self.disk_id)
+        self.backing.put(self._key(blob_id, part), data)
+
+    def get_part(self, blob_id: str, part: int) -> bytes:
+        if self.down:
+            raise DiskDown(self.disk_id)
+        return self.backing.get(self._key(blob_id, part))
+
+    def has_part(self, blob_id: str, part: int) -> bool:
+        if self.down:
+            raise DiskDown(self.disk_id)
+        return self.backing.exists(self._key(blob_id, part))
+
+    def delete_part(self, blob_id: str, part: int) -> None:
+        if self.down:
+            raise DiskDown(self.disk_id)
+        self.backing.delete(self._key(blob_id, part))
+
+    def list_parts(self, part: int) -> list[str]:
+        if self.down:
+            raise DiskDown(self.disk_id)
+        prefix = f"vdisk/{self.disk_id}/{part}/"
+        return [k[len(prefix):] for k in self.backing.list(prefix)]
+
+
+class GroupInfo:
+    """Topology: one disk per (fail domain, part slot). Part i of a blob
+    lands on disk (i + rotation(blob)) % n — the reference's blob->disk
+    mapper keeps load even the same way (groupinfo.h:274)."""
+
+    def __init__(self, group_id: int, species: str = "block42",
+                 disks: list[VDisk] | None = None):
+        self.group_id = group_id
+        self.codec = ErasureCodec(species)
+        n = self.codec.total_parts
+        self.disks = disks if disks is not None else [
+            VDisk(f"g{group_id}-d{i}") for i in range(n)
+        ]
+        if len(self.disks) != n:
+            raise ValueError(
+                f"{species} needs exactly {n} disks per group")
+
+    def disk_for(self, blob_id: str, part: int) -> VDisk:
+        rot = hash_rotation(blob_id, len(self.disks))
+        return self.disks[(part + rot) % len(self.disks)]
+
+
+def hash_rotation(blob_id: str, n: int) -> int:
+    return fnv1a_64(blob_id) % n
+
+
+class DSProxy:
+    """Per-group client: erasure put/get with quorum + restore-on-read."""
+
+    META_PART = 255  # per-blob metadata (orig length) replicated broadly
+
+    def __init__(self, group: GroupInfo):
+        self.group = group
+        self.codec = group.codec
+
+    # ---- put: encode, place parts, demand a write quorum ----
+
+    def put(self, blob_id: str, data: bytes) -> None:
+        parts = self.codec.encode(data)
+        meta = json.dumps({"len": len(data)}).encode()
+        written = 0
+        for i, part in enumerate(parts):
+            disk = self.group.disk_for(blob_id, i)
+            try:
+                disk.put_part(blob_id, i, part)
+                disk.put_part(blob_id, self.META_PART, meta)
+                written += 1
+            except DiskDown:
+                pass
+        # quorum: enough surviving parts that max_lost MORE failures
+        # still leave the blob readable
+        need = len(parts) - self.codec.max_lost
+        if written < need:
+            # roll back the partial write: a sub-quorum blob would list
+            # as existing but be unreconstructable, poisoning self-heal
+            self.delete(blob_id)
+            raise IOError(
+                f"write quorum failed: {written}/{len(parts)} parts "
+                f"(need {need})")
+
+    # ---- get: collect parts, reconstruct when disks are down ----
+
+    def get(self, blob_id: str) -> bytes:
+        parts: dict[int, bytes] = {}
+        meta = None
+        for i in range(self.codec.total_parts):
+            disk = self.group.disk_for(blob_id, i)
+            try:
+                if meta is None and disk.has_part(blob_id,
+                                                  self.META_PART):
+                    meta = json.loads(
+                        disk.get_part(blob_id, self.META_PART).decode())
+                if disk.has_part(blob_id, i):
+                    parts[i] = disk.get_part(blob_id, i)
+            except DiskDown:
+                continue
+        if meta is None:
+            raise KeyError(blob_id)
+        if not parts:
+            raise KeyError(blob_id)
+        return self.codec.decode(parts, meta["len"])
+
+    def exists(self, blob_id: str) -> bool:
+        for i in range(self.codec.total_parts):
+            disk = self.group.disk_for(blob_id, i)
+            try:
+                if disk.has_part(blob_id, self.META_PART):
+                    return True
+            except DiskDown:
+                continue
+        return False
+
+    def delete(self, blob_id: str) -> None:
+        for i in range(self.codec.total_parts):
+            disk = self.group.disk_for(blob_id, i)
+            try:
+                disk.delete_part(blob_id, i)
+                disk.delete_part(blob_id, self.META_PART)
+            except DiskDown:
+                continue
+
+    def list(self, prefix: str = "") -> list[str]:
+        seen = set()
+        for disk in self.group.disks:
+            try:
+                for blob_id in disk.list_parts(self.META_PART):
+                    if blob_id.startswith(prefix):
+                        seen.add(blob_id)
+            except DiskDown:
+                continue
+        return sorted(seen)
+
+    # ---- self-heal: replace a dead disk, rebuild its parts ----
+
+    def self_heal(self, disk_index: int,
+                  replacement: VDisk | None = None) -> int:
+        """Swap in a fresh disk for group slot disk_index and rebuild
+        every part the old disk held (BSC self-heal + vdisk repl).
+        Returns the number of parts rebuilt."""
+        old = self.group.disks[disk_index]
+        new = replacement if replacement is not None else VDisk(
+            old.disk_id + "'")
+        self.group.disks[disk_index] = new
+        rebuilt = 0
+        # every known blob: if its part maps to this slot, reconstruct
+        for blob_id in self.list():
+            rot = hash_rotation(blob_id, len(self.group.disks))
+            part_idx = (disk_index - rot) % len(self.group.disks)
+            if part_idx >= self.codec.total_parts:
+                continue
+            parts: dict[int, bytes] = {}
+            meta = None
+            for i in range(self.codec.total_parts):
+                disk = self.group.disk_for(blob_id, i)
+                try:
+                    if meta is None and disk.has_part(blob_id,
+                                                      self.META_PART):
+                        meta = json.loads(disk.get_part(
+                            blob_id, self.META_PART).decode())
+                    if disk.has_part(blob_id, i):
+                        parts[i] = disk.get_part(blob_id, i)
+                except DiskDown:
+                    continue
+            if meta is None:
+                continue
+            try:
+                part = self.codec.reconstruct_part(parts, part_idx,
+                                                   meta["len"])
+            except ValueError:
+                continue  # unreconstructable blob: skip, keep healing
+            new.put_part(blob_id, part_idx, part)
+            new.put_part(blob_id, self.META_PART,
+                         json.dumps({"len": meta["len"]}).encode())
+            rebuilt += 1
+        return rebuilt

@@ -95,15 +95,35 @@ class _CommitTx(Transaction):
         self.step = step
 
     def execute(self, txc, tablet):
+        cdc = self.shard.cdc_enabled
+        seq_row = txc.get("meta", ("next_change",)) if cdc else None
+        change_seq = seq_row["v"] if seq_row else 0
+        staged: dict[tuple, dict | None] = {}  # writes within THIS commit
         for wid in self.write_ids:
             pend = txc.get("pending", (wid,))
             if pend is None:
                 raise TxRejected(f"no staged tx {wid}")
             for key_list, row in pend["ops"]:
                 key = tuple(key_list)
+                if cdc:
+                    # change collector (change_collector.h analog): the
+                    # record commits IN the data transaction, so the
+                    # stream never misses or invents a change; a second
+                    # write to the same key in this commit must see the
+                    # first as its old image, not the committed state
+                    old = (staged[key] if key in staged
+                           else txc.get("data", key))
+                    txc.put("changes", (change_seq,), {
+                        "key": list(key), "old": old, "new": row,
+                        "step": self.step,
+                    })
+                    change_seq += 1
+                    staged[key] = row
                 txc.put_at("data", key, row, self.step)
                 self.shard._break_locks(key)
             txc.erase("pending", (wid,))
+        if cdc:
+            txc.put("meta", ("next_change",), {"v": change_seq})
         txc.put("meta", ("last_step",), {"v": self.step})
 
 
@@ -127,6 +147,7 @@ class DataShard:
         self._write_ids = itertools.count(row["v"] if row else 1)
         self._locks: dict[int, _Lock] = {}
         self._next_lock = itertools.count(1)
+        self.cdc_enabled = False
 
     # ---- MVCC state ----
 
@@ -253,6 +274,29 @@ class DataShard:
         for lock in self._locks.values():
             if not lock.broken and lock.covers(key):
                 lock.broken = True
+
+    # ---- CDC change queue (change sender source) ----
+
+    def pending_changes(self, limit: int = 1000) -> list[dict]:
+        """Durable change records not yet shipped (seq-ordered)."""
+        out = []
+        for key, row in self.executor.db.table("changes").range():
+            out.append(dict(row, seq=key[0]))
+            if len(out) >= limit:
+                break
+        return out
+
+    def ack_changes(self, up_to_seq: int) -> None:
+        """Forget shipped change records (<= up_to_seq)."""
+        shard = self
+
+        class Tx(Transaction):
+            def execute(self, txc, tablet):
+                for key, _row in shard.executor.db.table(
+                        "changes").range(hi=(up_to_seq + 1,)):
+                    txc.erase("changes", key)
+
+        self.executor.execute(Tx())
 
     # ---- maintenance ----
 

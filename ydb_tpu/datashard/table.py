@@ -231,8 +231,50 @@ class RowTable:
                             key,
                             {k: v for k, v in row.items() if k in keep}))
             if ops:
-                wid = shard.propose(ops)
-                self.coordinator.commit([shard], [[wid]])
+                # internal rewrite: must not emit changefeed events (a
+                # consumer would see phantom updates that also leak the
+                # dropped column's values)
+                was_cdc = shard.cdc_enabled
+                shard.cdc_enabled = False
+                try:
+                    wid = shard.propose(ops)
+                    self.coordinator.commit([shard], [[wid]])
+                finally:
+                    shard.cdc_enabled = was_cdc
+
+    # ---- CDC (change exchange; SURVEY.md §2.6) ----
+
+    def enable_cdc(self) -> None:
+        for s in self.shards:
+            s.cdc_enabled = True
+
+    def drain_changes_to(self, topic) -> int:
+        """Change sender (change_sender*.cpp analog): ship each shard's
+        durable change queue to the changefeed topic, then ack. The
+        topic's producer-seqno dedup makes redelivery after a crash
+        between write and ack exactly-once."""
+        import json as _json
+
+        shipped = 0
+        for shard in self.shards:
+            changes = shard.pending_changes()
+            if not changes:
+                continue
+            for ch in changes:
+                # per-change seqno write: shard seqs are monotonic but
+                # not contiguous per partition, so no batch renumbering
+                p = topic.partition_for(_json.dumps(ch["key"]))
+                topic.partitions[p].write(
+                    [{"data": _json.dumps({
+                        "key": ch["key"], "old": ch["old"],
+                        "new": ch["new"], "step": ch["step"],
+                    })}],
+                    producer=f"cdc/{shard.shard_id}",
+                    first_seqno=ch["seq"],
+                )
+            shard.ack_changes(changes[-1]["seq"])
+            shipped += len(changes)
+        return shipped
 
     # ---- background ----
 

@@ -81,6 +81,7 @@ class Cluster:
         self.store = store if store is not None else MemBlobStore()
         self.n_shards = n_shards
         self.tables: dict[str, ShardedTable] = {}
+        self.topics: dict = {}
         self.dicts = DictionarySet()  # cluster-wide, shared by all tables
         self._plan_cache: OrderedDict = OrderedDict()
         self._plan_cache_size = plan_cache_size
@@ -168,6 +169,14 @@ class Cluster:
         # a crash between the two would otherwise leave dangling ids
         t.pre_commit = self._journal_dicts
         self.tables[name] = t
+        if desc.changefeed:
+            from ydb_tpu.topic.topic import Topic
+
+            topic = Topic(f"{name}_changefeed", self.store,
+                          n_partitions=desc.n_shards)
+            self.topics[f"{name}_changefeed"] = topic
+            t.enable_cdc()
+            t.changefeed_topic = topic
         return t
 
     def create_table(self, stmt: ast.CreateTable) -> None:
@@ -182,7 +191,8 @@ class Cluster:
         schema = dtypes.Schema(tuple(fields))
         pk = stmt.primary_key or (fields[0].name,)
         opts = dict(stmt.options)
-        unknown = set(opts) - {"shards", "store", "ttl_column"}
+        unknown = set(opts) - {"shards", "store", "ttl_column",
+                               "changefeed"}
         if unknown:
             raise PlanError(f"unknown WITH option(s): {sorted(unknown)}")
         try:
@@ -199,6 +209,9 @@ class Cluster:
         if "ttl_column" in opts and opts["ttl_column"] not in schema:
             raise PlanError(f"ttl_column {opts['ttl_column']!r} not in "
                             f"schema")
+        changefeed = opts.get("changefeed", "off") in ("on", "true", "1")
+        if changefeed and store_kind != "row":
+            raise PlanError("changefeed requires a row-store table")
         desc = TableDescription(
             path="/" + stmt.table,
             schema=schema,
@@ -206,6 +219,7 @@ class Cluster:
             n_shards=n_shards,
             store=store_kind,
             ttl_column=opts.get("ttl_column"),
+            changefeed=changefeed,
         )
         try:
             self.scheme.create_table(desc)
@@ -219,6 +233,9 @@ class Cluster:
 
         t = self.tables.get(stmt.table)
         prefixes = t.storage_prefixes() if t is not None else []
+        topic = self.topics.pop(f"{stmt.table}_changefeed", None)
+        if topic is not None:
+            prefixes += topic.storage_prefixes()
         try:
             # prefixes are recorded durably in the drop tx itself; the
             # boot sweep finishes deletion if we crash before it
@@ -262,6 +279,19 @@ class Cluster:
         if row_strip:
             self.scheme.clear_strip("/" + stmt.table)
         self._plan_cache.clear()
+
+    def run_background(self) -> dict:
+        """One maintenance pass: table compaction/TTL + CDC drains (the
+        conveyor/background-task plane, driven by the hosting layer)."""
+        stats = {"cdc_shipped": 0, "compacted": 0}
+        for name, t in self.tables.items():
+            topic = getattr(t, "changefeed_topic", None)
+            if topic is not None:
+                stats["cdc_shipped"] += t.drain_changes_to(topic)
+            if hasattr(t, "run_background"):
+                s = t.run_background()
+                stats["compacted"] += s.get("compacted", 0)
+        return stats
 
     # ---- row-store DML (UPDATE / DELETE) ----
 

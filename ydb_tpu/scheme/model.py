@@ -50,6 +50,8 @@ class TableDescription:
     # column name -> schema version that (re)introduced it; absent means
     # the column existed from version 1 (guards DROP+ADD resurrection)
     column_added: dict = dataclasses.field(default_factory=dict)
+    # row tables: emit a CDC changefeed topic "<name>_changefeed"
+    changefeed: bool = False
 
     def to_json(self) -> dict:
         return {
@@ -61,6 +63,7 @@ class TableDescription:
             "ttl_column": self.ttl_column,
             "schema_version": self.schema_version,
             "column_added": dict(self.column_added),
+            "changefeed": self.changefeed,
         }
 
     @classmethod
@@ -74,4 +77,5 @@ class TableDescription:
             ttl_column=d.get("ttl_column"),
             schema_version=d.get("schema_version", 1),
             column_added=dict(d.get("column_added", {})),
+            changefeed=d.get("changefeed", False),
         )

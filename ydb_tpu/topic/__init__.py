@@ -1,0 +1,4 @@
+from ydb_tpu.topic.pq import Partition
+from ydb_tpu.topic.topic import Topic
+
+__all__ = ["Partition", "Topic"]
