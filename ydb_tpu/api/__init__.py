@@ -1,0 +1,4 @@
+from ydb_tpu.api.client import ApiError, Driver
+from ydb_tpu.api.server import make_server
+
+__all__ = ["Driver", "ApiError", "make_server"]

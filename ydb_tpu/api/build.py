@@ -1,0 +1,31 @@
+"""protoc codegen on demand (cached by mtime), mirroring the native
+library's build-at-import pattern."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+PROTO = os.path.join(_DIR, "protos", "ydb_tpu_api.proto")
+GEN_DIR = os.path.join(_DIR, "_gen")
+GEN = os.path.join(GEN_DIR, "ydb_tpu_api_pb2.py")
+
+
+def ensure_protos():
+    if not (os.path.exists(GEN) and
+            os.path.getmtime(GEN) >= os.path.getmtime(PROTO)):
+        os.makedirs(GEN_DIR, exist_ok=True)
+        open(os.path.join(GEN_DIR, "__init__.py"), "a").close()
+        subprocess.run(
+            ["protoc", f"--python_out={GEN_DIR}",
+             f"--proto_path={os.path.dirname(PROTO)}",
+             os.path.basename(PROTO)],
+            check=True, capture_output=True, timeout=60,
+        )
+    import importlib
+    import sys
+
+    if GEN_DIR not in sys.path:
+        sys.path.insert(0, GEN_DIR)
+    return importlib.import_module("ydb_tpu_api_pb2")

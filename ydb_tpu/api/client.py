@@ -1,0 +1,141 @@
+"""Client SDK: driver + per-service clients over gRPC.
+
+Mirror of the reference's SDK shape (TDriver/TTableClient,
+public/sdk/cpp; SURVEY.md layer 9): a Driver owns the channel and auth
+metadata; service clients hang off it. Query results come back as
+pyarrow Tables.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ydb_tpu.api.arrow_io import ipc_to_table
+from ydb_tpu.api.build import ensure_protos
+
+pb = ensure_protos()
+
+
+class ApiError(Exception):
+    pass
+
+
+class Driver:
+    def __init__(self, endpoint: str, auth_token: str | None = None):
+        self.channel = grpc.insecure_channel(endpoint)
+        self.metadata = (
+            (("x-ydb-auth-ticket", auth_token),) if auth_token else ()
+        )
+
+    def close(self):
+        self.channel.close()
+
+    def _call(self, method: str, request, resp_cls):
+        rpc = self.channel.unary_unary(
+            method,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return rpc(request, metadata=self.metadata)
+
+    def query_client(self) -> "QueryClient":
+        return QueryClient(self)
+
+    def scheme_client(self) -> "SchemeClient":
+        return SchemeClient(self)
+
+    def topic_client(self) -> "TopicClient":
+        return TopicClient(self)
+
+    def discovery(self) -> list[tuple[str, int]]:
+        resp = self._call("/ydb_tpu.Discovery/ListEndpoints",
+                          pb.ListEndpointsRequest(),
+                          pb.ListEndpointsResponse)
+        return [(e.address, e.port) for e in resp.endpoints]
+
+
+class QueryClient:
+    def __init__(self, driver: Driver):
+        self.driver = driver
+        resp = driver._call("/ydb_tpu.Query/CreateSession",
+                            pb.CreateSessionRequest(),
+                            pb.CreateSessionResponse)
+        self.session_id = resp.session_id
+
+    def close(self):
+        """Release the server-side session."""
+        self.driver._call("/ydb_tpu.Query/DeleteSession",
+                          pb.DeleteSessionRequest(
+                              session_id=self.session_id),
+                          pb.DeleteSessionResponse)
+
+    def execute(self, sql: str):
+        """pyarrow.Table for SELECT; (step, committed) for DML/DDL."""
+        resp = self.driver._call(
+            "/ydb_tpu.Query/ExecuteQuery",
+            pb.ExecuteQueryRequest(session_id=self.session_id, sql=sql),
+            pb.ExecuteQueryResponse)
+        if resp.status != pb.ExecuteQueryResponse.SUCCESS:
+            raise ApiError(resp.error)
+        if resp.arrow_ipc:
+            return ipc_to_table(resp.arrow_ipc)
+        return (resp.tx_step, resp.committed)
+
+
+class SchemeClient:
+    def __init__(self, driver: Driver):
+        self.driver = driver
+
+    def list_directory(self, path: str = "/"):
+        resp = self.driver._call(
+            "/ydb_tpu.Scheme/ListDirectory",
+            pb.ListDirectoryRequest(path=path), pb.ListDirectoryResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return [(e.path, e.kind) for e in resp.children]
+
+    def describe_table(self, path: str):
+        resp = self.driver._call(
+            "/ydb_tpu.Scheme/DescribeTable",
+            pb.DescribeTableRequest(path=path), pb.DescribeTableResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp
+
+
+class TopicClient:
+    def __init__(self, driver: Driver):
+        self.driver = driver
+
+    def write(self, topic: str, data: bytes | str, key: str = "",
+              producer: str = "", seqno: int = 0):
+        if isinstance(data, str):
+            data = data.encode()
+        resp = self.driver._call(
+            "/ydb_tpu.Topic/Write",
+            pb.TopicWriteRequest(topic=topic, key=key, data=data,
+                                 producer=producer, seqno=seqno),
+            pb.TopicWriteResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.partition, resp.offset
+
+    def read(self, topic: str, consumer: str, limit: int = 100):
+        resp = self.driver._call(
+            "/ydb_tpu.Topic/Read",
+            pb.TopicReadRequest(topic=topic, consumer=consumer,
+                                limit=limit),
+            pb.TopicReadResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return [(m.partition, m.offset, m.data) for m in resp.messages]
+
+    def commit(self, topic: str, consumer: str, partition: int,
+               offset: int):
+        resp = self.driver._call(
+            "/ydb_tpu.Topic/Commit",
+            pb.TopicCommitRequest(topic=topic, consumer=consumer,
+                                  partition=partition, offset=offset),
+            pb.TopicCommitResponse)
+        if resp.error:
+            raise ApiError(resp.error)

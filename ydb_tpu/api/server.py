@@ -1,0 +1,243 @@
+"""gRPC server: the node front door.
+
+Mirror of the reference's gRPC request proxy + per-service impls
+(grpc_request_proxy.h:30, ydb/services/ydb; SURVEY.md §2.12): each RPC
+routes through one request proxy (auth hook + per-call dispatch) into
+the in-process service set (Cluster). Method handlers are registered
+generically against the protobuf messages, so no grpc_tools codegen is
+needed — protoc generates the messages, grpc carries them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import OrderedDict
+from concurrent import futures
+
+import grpc
+
+from ydb_tpu.api.build import ensure_protos
+from ydb_tpu.api.arrow_io import oracle_to_ipc
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.kqp.session import Cluster
+from ydb_tpu.tx.coordinator import TxResult
+
+pb = ensure_protos()
+
+
+class RequestProxy:
+    """Auth + dispatch front (grpc_request_proxy analog). Tokens: when
+    ``auth_tokens`` is set, every call must carry metadata
+    ('x-ydb-auth-ticket', <token>)."""
+
+    def __init__(self, cluster: Cluster,
+                 auth_tokens: set[str] | None = None):
+        self.cluster = cluster
+        self.auth_tokens = auth_tokens
+        # bounded LRU of server-side sessions: evicting the oldest
+        # caps memory against clients that never DeleteSession
+        self.sessions: "OrderedDict[str, object]" = OrderedDict()
+        self.max_sessions = 1024
+        self._next_session = itertools.count(1)
+        # Cluster/tablet state is not thread-safe: every mutating entry
+        # point (RPC handlers AND the serve loop's run_background)
+        # serializes on this lock
+        self.lock = threading.Lock()
+        self.endpoints: tuple = ()
+
+    def check_auth(self, context) -> bool:
+        if self.auth_tokens is None:
+            return True
+        md = dict(context.invocation_metadata())
+        if md.get("x-ydb-auth-ticket") in self.auth_tokens:
+            return True
+        context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad ticket")
+        return False
+
+    # ---- Query ----
+
+    def create_session(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            sid = f"session-{next(self._next_session)}"
+            self.sessions[sid] = self.cluster.session()
+            while len(self.sessions) > self.max_sessions:
+                self.sessions.popitem(last=False)
+        return pb.CreateSessionResponse(session_id=sid)
+
+    def delete_session(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            self.sessions.pop(request.session_id, None)
+        return pb.DeleteSessionResponse()
+
+    def execute_query(self, request, context):
+        self.check_auth(context)
+        session = self.sessions.get(request.session_id)
+        if session is None:
+            session = self.cluster.session()  # sessionless query
+        try:
+            with self.lock:
+                out = session.execute(request.sql)
+        except Exception as e:  # noqa: BLE001 - surface to the client
+            return pb.ExecuteQueryResponse(
+                status=pb.ExecuteQueryResponse.ERROR, error=str(e))
+        resp = pb.ExecuteQueryResponse(
+            status=pb.ExecuteQueryResponse.SUCCESS)
+        if out is None:  # DDL: no result set, no tx step
+            resp.committed = True
+        elif isinstance(out, OracleTable):
+            # out.dicts is the per-result view the session bound (alias
+            # -> source dictionary), not the raw cluster set
+            resp.arrow_ipc = oracle_to_ipc(out)
+        elif isinstance(out, TxResult):
+            resp.tx_step = out.step
+            resp.committed = out.committed
+            if not out.committed:
+                resp.status = pb.ExecuteQueryResponse.ERROR
+                resp.error = out.error or "not committed"
+        return resp
+
+    # ---- Scheme ----
+
+    def list_directory(self, request, context):
+        self.check_auth(context)
+        path = request.path or "/"
+        if not self.cluster.scheme.exists(path):
+            return pb.ListDirectoryResponse(error=f"no path {path}")
+        children = []
+        for child in self.cluster.scheme.children(path):
+            children.append(pb.SchemeEntry(
+                path=child, kind=self.cluster.scheme.kind(child)))
+        return pb.ListDirectoryResponse(children=children)
+
+    def describe_table(self, request, context):
+        self.check_auth(context)
+        desc = self.cluster.scheme.describe(request.path)
+        if desc is None:
+            return pb.DescribeTableResponse(
+                error=f"{request.path} is not a table")
+        from ydb_tpu.scheme.model import type_to_str
+
+        return pb.DescribeTableResponse(
+            path=desc.path,
+            columns=[pb.ColumnMeta(name=f.name, type=type_to_str(f.type),
+                                   nullable=f.nullable)
+                     for f in desc.schema.fields],
+            primary_key=list(desc.primary_key),
+            shards=desc.n_shards,
+            store=desc.store,
+            schema_version=desc.schema_version,
+        )
+
+    # ---- Topic ----
+
+    def _topic(self, name: str):
+        return self.cluster.topics.get(name)
+
+    def topic_write(self, request, context):
+        self.check_auth(context)
+        topic = self._topic(request.topic)
+        if topic is None:
+            return pb.TopicWriteResponse(
+                error=f"no topic {request.topic}")
+        with self.lock:
+            p, off = topic.write(
+                request.data.decode("utf-8", "surrogateescape"),
+                key=request.key or None,
+                producer=request.producer or None,
+                seqno=request.seqno if request.producer else None,
+            )
+        return pb.TopicWriteResponse(partition=p, offset=off)
+
+    def topic_read(self, request, context):
+        self.check_auth(context)
+        topic = self._topic(request.topic)
+        if topic is None:
+            return pb.TopicReadResponse(error=f"no topic {request.topic}")
+        with self.lock:
+            reader = topic.reader(request.consumer)
+            msgs = reader.read_batch(request.limit or 100)
+        return pb.TopicReadResponse(messages=[
+            pb.TopicMessage(
+                partition=m["partition"], offset=m["offset"],
+                data=m["data"].encode("utf-8", "surrogateescape"))
+            for m in msgs
+        ])
+
+    def topic_commit(self, request, context):
+        self.check_auth(context)
+        topic = self._topic(request.topic)
+        if topic is None:
+            return pb.TopicCommitResponse(
+                error=f"no topic {request.topic}")
+        if not 0 <= request.partition < len(topic.partitions):
+            return pb.TopicCommitResponse(
+                error=f"partition {request.partition} out of range")
+        with self.lock:
+            topic.partitions[request.partition].commit(
+                request.consumer, request.offset + 1)
+        return pb.TopicCommitResponse()
+
+    # ---- Discovery ----
+
+    def list_endpoints(self, request, context):
+        self.check_auth(context)
+        return pb.ListEndpointsResponse(endpoints=[
+            pb.EndpointInfo(address=a, port=p)
+            for a, p in self.endpoints
+        ])
+
+
+_SERVICES = {
+    "ydb_tpu.Query": {
+        "CreateSession": ("create_session", pb.CreateSessionRequest,
+                          pb.CreateSessionResponse),
+        "DeleteSession": ("delete_session", pb.DeleteSessionRequest,
+                          pb.DeleteSessionResponse),
+        "ExecuteQuery": ("execute_query", pb.ExecuteQueryRequest,
+                         pb.ExecuteQueryResponse),
+    },
+    "ydb_tpu.Scheme": {
+        "ListDirectory": ("list_directory", pb.ListDirectoryRequest,
+                          pb.ListDirectoryResponse),
+        "DescribeTable": ("describe_table", pb.DescribeTableRequest,
+                          pb.DescribeTableResponse),
+    },
+    "ydb_tpu.Topic": {
+        "Write": ("topic_write", pb.TopicWriteRequest,
+                  pb.TopicWriteResponse),
+        "Read": ("topic_read", pb.TopicReadRequest, pb.TopicReadResponse),
+        "Commit": ("topic_commit", pb.TopicCommitRequest,
+                   pb.TopicCommitResponse),
+    },
+    "ydb_tpu.Discovery": {
+        "ListEndpoints": ("list_endpoints", pb.ListEndpointsRequest,
+                          pb.ListEndpointsResponse),
+    },
+}
+
+
+def make_server(cluster: Cluster, port: int = 0,
+                auth_tokens: set[str] | None = None,
+                max_workers: int = 8) -> tuple[grpc.Server, int]:
+    """Returns (server, bound_port). port=0 picks a free port."""
+    proxy = RequestProxy(cluster, auth_tokens)
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    proxy.endpoints = (("127.0.0.1", bound),)
+
+    for service, methods in _SERVICES.items():
+        handlers = {}
+        for rpc_name, (attr, req_cls, resp_cls) in methods.items():
+            handlers[rpc_name] = grpc.unary_unary_rpc_method_handler(
+                getattr(proxy, attr),
+                request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString,
+            )
+        server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(service, handlers),))
+    server.request_proxy = proxy
+    return server, bound

@@ -1,0 +1,185 @@
+"""Command-line interface: `python -m ydb_tpu.cli <command>`.
+
+Mirror of the reference's `ydb` tool (apps/ydb, public/lib/ydb_cli;
+SURVEY.md layer 9): server mode, interactive SQL, scheme browsing,
+topic read/write, and workload benchmark runners.
+
+Commands:
+  serve     --data-dir D [--port P] [--auth-token T]   run a node
+  sql       -e ENDPOINT "SELECT ..."                   run a query
+  scheme ls -e ENDPOINT [PATH]                         list a directory
+  scheme describe -e ENDPOINT PATH                     table metadata
+  topic write|read -e ENDPOINT ...                     topic I/O
+  workload tpch --sf 0.01 [--queries q1,q6]            embedded bench
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _connect(args):
+    from ydb_tpu.api.client import Driver
+
+    return Driver(args.endpoint, auth_token=args.auth_token)
+
+
+def cmd_serve(args):
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+    from ydb_tpu.api.server import make_server
+    from ydb_tpu.engine.blobs import DirBlobStore, MemBlobStore
+    from ydb_tpu.kqp.session import Cluster
+
+    store = (DirBlobStore(args.data_dir) if args.data_dir
+             else MemBlobStore())
+    cluster = Cluster(store=store)
+    tokens = {args.auth_token} if args.auth_token else None
+    server, port = make_server(cluster, port=args.port,
+                               auth_tokens=tokens)
+    server.start()
+    print(f"ydb_tpu serving on 127.0.0.1:{port}", flush=True)
+    try:
+        while True:
+            time.sleep(args.background_period)
+            # cluster state is single-writer: background maintenance
+            # takes the same lock the RPC handlers serialize on
+            with server.request_proxy.lock:
+                cluster.run_background()
+    except KeyboardInterrupt:
+        server.stop(1)
+
+
+def cmd_sql(args):
+    driver = _connect(args)
+    q = driver.query_client()
+    t0 = time.monotonic()
+    out = q.execute(args.query)
+    dt = time.monotonic() - t0
+    import pyarrow as pa
+
+    if isinstance(out, pa.Table):
+        print(out.to_pandas().to_string(index=False))
+        print(f"-- {out.num_rows} rows in {dt:.3f}s", file=sys.stderr)
+    else:
+        step, committed = out
+        print(f"-- {'committed' if committed else 'FAILED'} at step "
+              f"{step} in {dt:.3f}s", file=sys.stderr)
+    driver.close()
+
+
+def cmd_scheme(args):
+    driver = _connect(args)
+    sc = driver.scheme_client()
+    if args.scheme_cmd == "ls":
+        for path, kind in sc.list_directory(args.path):
+            print(f"{kind:8} {path}")
+    else:
+        d = sc.describe_table(args.path)
+        print(f"table {d.path}  store={d.store}  shards={d.shards}  "
+              f"version={d.schema_version}")
+        for c in d.columns:
+            null = "" if c.nullable else " NOT NULL"
+            pk = " (pk)" if c.name in d.primary_key else ""
+            print(f"  {c.name:24} {c.type}{null}{pk}")
+    driver.close()
+
+
+def cmd_topic(args):
+    driver = _connect(args)
+    tc = driver.topic_client()
+    if args.topic_cmd == "write":
+        p, off = tc.write(args.topic, args.data, key=args.key or "")
+        print(f"partition {p} offset {off}")
+    else:
+        msgs = tc.read(args.topic, args.consumer, args.limit)
+        for p, off, data in msgs:
+            print(f"[{p}:{off}] {data.decode(errors='replace')}")
+        if msgs and args.commit:
+            tops = {}
+            for p, off, _ in msgs:
+                tops[p] = max(tops.get(p, -1), off)
+            for p, off in tops.items():
+                tc.commit(args.topic, args.consumer, p, off)
+    driver.close()
+
+
+def cmd_workload(args):
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+    from ydb_tpu.workload.runner import run_tpch
+
+    queries = args.queries.split(",") if args.queries else None
+    results = run_tpch(sf=args.sf, queries=queries,
+                       iterations=args.iterations)
+    for name, seconds, rows in results:
+        print(f"{name:6} {seconds * 1000:9.1f} ms   {rows} rows")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="ydb_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def add_conn(p):
+        p.add_argument("-e", "--endpoint", default="127.0.0.1:2136")
+        p.add_argument("--auth-token", default=None)
+
+    p = sub.add_parser("serve")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--port", type=int, default=2136)
+    p.add_argument("--auth-token", default=None)
+    p.add_argument("--platform", default="cpu")
+    p.add_argument("--background-period", type=float, default=5.0)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("sql")
+    add_conn(p)
+    p.add_argument("query")
+    p.set_defaults(fn=cmd_sql)
+
+    p = sub.add_parser("scheme")
+    ssub = p.add_subparsers(dest="scheme_cmd", required=True)
+    pls = ssub.add_parser("ls")
+    add_conn(pls)
+    pls.add_argument("path", nargs="?", default="/")
+    pls.set_defaults(fn=cmd_scheme)
+    pd = ssub.add_parser("describe")
+    add_conn(pd)
+    pd.add_argument("path")
+    pd.set_defaults(fn=cmd_scheme)
+
+    p = sub.add_parser("topic")
+    tsub = p.add_subparsers(dest="topic_cmd", required=True)
+    tw = tsub.add_parser("write")
+    add_conn(tw)
+    tw.add_argument("topic")
+    tw.add_argument("data")
+    tw.add_argument("--key", default=None)
+    tw.set_defaults(fn=cmd_topic)
+    tr = tsub.add_parser("read")
+    add_conn(tr)
+    tr.add_argument("topic")
+    tr.add_argument("--consumer", default="cli")
+    tr.add_argument("--limit", type=int, default=20)
+    tr.add_argument("--commit", action="store_true")
+    tr.set_defaults(fn=cmd_topic)
+
+    p = sub.add_parser("workload")
+    wsub = p.add_subparsers(dest="workload_cmd", required=True)
+    wt = wsub.add_parser("tpch")
+    wt.add_argument("--sf", type=float, default=0.01)
+    wt.add_argument("--queries", default=None)
+    wt.add_argument("--iterations", type=int, default=1)
+    wt.add_argument("--platform", default="cpu")
+    wt.set_defaults(fn=cmd_workload)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

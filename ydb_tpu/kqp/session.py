@@ -509,10 +509,30 @@ class Cluster:
         if not isinstance(stmt, ast.Select):
             return stmt
         p = plan_select(stmt, self.catalog())
-        self._plan_cache[sql] = p
+        # output alias -> source column, for per-result dictionary
+        # binding of aliased string columns (SELECT name AS n)
+        alias_map = {}
+        for item in stmt.items:
+            if isinstance(item.expr, ast.Name) and item.alias and \
+                    item.alias != item.expr.column:
+                alias_map[item.alias] = item.expr.column
+        entry = (p, alias_map)
+        self._plan_cache[sql] = entry
         while len(self._plan_cache) > self._plan_cache_size:
             self._plan_cache.popitem(last=False)
-        return p
+        return entry
+
+    def result_dicts(self, out_schema, alias_map: dict) -> DictionarySet:
+        """Per-result dictionary view: each output string column bound
+        to its SOURCE column's dictionary (aliases included), so decode
+        never guesses by output name."""
+        view = DictionarySet()
+        for f in out_schema.fields:
+            if f.type.is_string:
+                src = alias_map.get(f.name, f.name)
+                if src in self.dicts:
+                    view._dicts[f.name] = self.dicts[src]
+        return view
 
     def session(self) -> "Session":
         return Session(self)
@@ -606,7 +626,8 @@ class Session:
             return self.cluster.update(planned)
         if isinstance(planned, ast.Delete):
             return self.cluster.delete(planned)
+        p, alias_map = planned
         db = self.cluster.snapshot_db()
-        out = to_host(execute_plan(planned, db))
-        out.dicts = self.cluster.dicts
+        out = to_host(execute_plan(p, db))
+        out.dicts = self.cluster.result_dicts(out.schema, alias_map)
         return out

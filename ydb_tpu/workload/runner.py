@@ -1,0 +1,58 @@
+"""Embedded workload runner (the `ydb workload tpch` analog,
+public/lib/ydb_cli benchmark_utils.cpp; SURVEY.md layer 9)."""
+
+from __future__ import annotations
+
+import time
+
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.plan import Database, execute_plan, to_host
+from ydb_tpu.sql.parser import parse
+from ydb_tpu.sql.planner import Catalog, plan_select
+from ydb_tpu.workload import tpch
+from ydb_tpu.workload.queries import TPCH
+
+TPCH_PRIMARY_KEYS = {
+    "orders": ("o_orderkey",), "customer": ("c_custkey",),
+    "supplier": ("s_suppkey",), "nation": ("n_nationkey",),
+    "region": ("r_regionkey",),
+    "lineitem": ("l_orderkey", "l_linenumber"),
+}
+
+
+def tpch_database(data: tpch.TpchData) -> tuple[Database, Catalog]:
+    db = Database(
+        sources={
+            t: ColumnSource(cols, data.schema(t), data.dicts)
+            for t, cols in data.tables.items()
+        },
+        dicts=data.dicts,
+    )
+    catalog = Catalog(
+        schemas={t: data.schema(t) for t in data.tables},
+        primary_keys=dict(TPCH_PRIMARY_KEYS),
+        dicts=data.dicts,
+    )
+    return db, catalog
+
+
+def run_tpch(sf: float = 0.01, queries: list[str] | None = None,
+             iterations: int = 1, seed: int = 42):
+    """Returns [(name, best_seconds, result_rows)]. The first run of a
+    query includes XLA compilation; timing takes the best of
+    ``iterations`` post-warmup runs."""
+    data = tpch.TpchData(sf=sf, seed=seed)
+    db, catalog = tpch_database(data)
+    names = queries or sorted(TPCH)
+    results = []
+    for name in names:
+        sql = TPCH[name]
+        plan = plan_select(parse(sql), catalog)
+        out = to_host(execute_plan(plan, db))  # warmup/compile
+        best = float("inf")
+        for _ in range(max(1, iterations)):
+            t0 = time.monotonic()
+            out = to_host(execute_plan(plan, db))
+            best = min(best, time.monotonic() - t0)
+        results.append((name, best, out.num_rows))
+    return results
