@@ -31,20 +31,30 @@ def cmd_serve(args):
 
     jax.config.update("jax_platforms", args.platform)
     from ydb_tpu.api.server import make_server
+    from ydb_tpu.config import AppConfig
     from ydb_tpu.engine.blobs import DirBlobStore, MemBlobStore
     from ydb_tpu.kqp.session import Cluster
 
-    store = (DirBlobStore(args.data_dir) if args.data_dir
-             else MemBlobStore())
-    cluster = Cluster(store=store)
-    tokens = {args.auth_token} if args.auth_token else None
-    server, port = make_server(cluster, port=args.port,
-                               auth_tokens=tokens)
+    config = AppConfig()
+    if args.yaml_config:
+        with open(args.yaml_config) as f:
+            config = AppConfig.from_yaml(f.read())
+    data_dir = args.data_dir or config.data_dir
+    port = args.port if args.port is not None else config.grpc_port
+    store = DirBlobStore(data_dir) if data_dir else MemBlobStore()
+    cluster = Cluster(store=store, config=config)
+    tokens = set(config.auth_tokens) or None
+    if args.auth_token:
+        tokens = (tokens or set()) | {args.auth_token}
+    server, port = make_server(cluster, port=port, auth_tokens=tokens)
     server.start()
     print(f"ydb_tpu serving on 127.0.0.1:{port}", flush=True)
+    period = (args.background_period
+              if args.background_period is not None
+              else config.background_period_seconds)
     try:
         while True:
-            time.sleep(args.background_period)
+            time.sleep(period)
             # cluster state is single-writer: background maintenance
             # takes the same lock the RPC handlers serialize on
             with server.request_proxy.lock:
@@ -130,10 +140,11 @@ def main(argv=None):
 
     p = sub.add_parser("serve")
     p.add_argument("--data-dir", default=None)
-    p.add_argument("--port", type=int, default=2136)
+    p.add_argument("--port", type=int, default=None)
     p.add_argument("--auth-token", default=None)
     p.add_argument("--platform", default="cpu")
-    p.add_argument("--background-period", type=float, default=5.0)
+    p.add_argument("--background-period", type=float, default=None)
+    p.add_argument("--yaml-config", default=None)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("sql")

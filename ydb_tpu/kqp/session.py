@@ -72,19 +72,38 @@ class Cluster:
     def __init__(
         self,
         store: BlobStore | None = None,
-        n_shards: int = 4,
-        plan_cache_size: int = 128,
+        n_shards: int | None = None,
+        plan_cache_size: int | None = None,
+        config=None,
     ):
+        from collections import deque
+
+        from ydb_tpu.config import AppConfig, ControlBoard
+        from ydb_tpu.obs.counters import CounterGroup
+        from ydb_tpu.obs.tracing import Tracer
         from ydb_tpu.scheme.shard import SchemeShardCore
         from ydb_tpu.tablet.executor import TabletExecutor
 
+        self.config = config if config is not None else AppConfig()
+        self.flags = self.config.feature_flags
         self.store = store if store is not None else MemBlobStore()
-        self.n_shards = n_shards
+        self.n_shards = (n_shards if n_shards is not None
+                         else self.config.n_shards)
         self.tables: dict[str, ShardedTable] = {}
         self.topics: dict = {}
+        self.counters = CounterGroup({"component": "kqp"})
+        self.tracer = Tracer()
+        self.query_log: deque = deque(maxlen=256)
+        # live-tunable knobs (immediate control board)
+        self.icb = ControlBoard()
+        self.icb.register("rmw_retries", 5, 1, 100)
+        self.icb.register("compact_portion_threshold",
+                          self.config.compact_portion_threshold, 2, 1024)
         self.dicts = DictionarySet()  # cluster-wide, shared by all tables
         self._plan_cache: OrderedDict = OrderedDict()
-        self._plan_cache_size = plan_cache_size
+        self._plan_cache_size = (
+            plan_cache_size if plan_cache_size is not None
+            else self.config.plan_cache_size)
         self._dict_seq = 0
         self._dict_durable: dict[str, int] = {}
         self._replay_dict_journal()
@@ -150,6 +169,14 @@ class Cluster:
     def _instantiate(self, desc, boot: bool = False):
         from ydb_tpu.datashard.table import RowTable
 
+        from ydb_tpu.engine.shard import ShardConfig
+
+        shard_config = ShardConfig(
+            compact_portion_threshold=self.config
+            .compact_portion_threshold,
+            checkpoint_interval=self.config.checkpoint_interval,
+            scan_block_rows=self.config.scan_block_rows,
+        )
         name = desc.path.strip("/")
         if desc.store == "row":
             t = RowTable(
@@ -163,6 +190,7 @@ class Cluster:
                 name, desc.schema, self.store, self.coordinator,
                 n_shards=desc.n_shards, pk_column=desc.primary_key[0],
                 ttl_column=desc.ttl_column, dicts=self.dicts, boot=boot,
+                config=shard_config,
             )
         t.alter_schema(desc.schema, desc.schema_version, desc.column_added)
         # dict ids must be durable BEFORE any shard WAL references them:
@@ -206,12 +234,16 @@ class Cluster:
         if store_kind not in ("column", "row"):
             raise PlanError(f"WITH store must be column|row, "
                             f"got {store_kind!r}")
+        if store_kind == "row" and not self.flags.enable_row_tables:
+            raise PlanError("row tables are disabled by feature flag")
         if "ttl_column" in opts and opts["ttl_column"] not in schema:
             raise PlanError(f"ttl_column {opts['ttl_column']!r} not in "
                             f"schema")
         changefeed = opts.get("changefeed", "off") in ("on", "true", "1")
         if changefeed and store_kind != "row":
             raise PlanError("changefeed requires a row-store table")
+        if changefeed and not self.flags.enable_changefeeds:
+            raise PlanError("changefeeds are disabled by feature flag")
         desc = TableDescription(
             path="/" + stmt.table,
             schema=schema,
@@ -282,16 +314,27 @@ class Cluster:
 
     def run_background(self) -> dict:
         """One maintenance pass: table compaction/TTL + CDC drains (the
-        conveyor/background-task plane, driven by the hosting layer)."""
+        conveyor/background-task plane, driven by the hosting layer).
+        ICB knobs apply here, so live tuning takes effect without a
+        restart."""
+        threshold = self.icb.get("compact_portion_threshold")
         stats = {"cdc_shipped": 0, "compacted": 0}
         for name, t in self.tables.items():
             topic = getattr(t, "changefeed_topic", None)
             if topic is not None:
                 stats["cdc_shipped"] += t.drain_changes_to(topic)
+            for s in t.shards:
+                if hasattr(s, "config"):
+                    s.config.compact_portion_threshold = threshold
             if hasattr(t, "run_background"):
                 s = t.run_background()
                 stats["compacted"] += s.get("compacted", 0)
         return stats
+
+    def health(self) -> dict:
+        from ydb_tpu.obs.sysview import health_check
+
+        return health_check(self)
 
     # ---- row-store DML (UPDATE / DELETE) ----
 
@@ -329,8 +372,6 @@ class Cluster:
         ]
         return out, keys
 
-    RMW_RETRIES = 5
-
     def update(self, stmt: ast.Update) -> TxResult:
         t = self._row_table(stmt.table)
         for name, _ in stmt.sets:
@@ -341,7 +382,7 @@ class Cluster:
         # optimistic read-modify-write: lock, read at snapshot, write
         # under the lock; a conflicting commit in between breaks the
         # lock, prepare aborts the 2PC, and the whole RMW retries
-        for _attempt in range(self.RMW_RETRIES):
+        for _attempt in range(self.icb.get("rmw_retries")):
             locks = t.lock_all_shards()
             try:
                 res = self._update_once(t, stmt, locks)
@@ -424,7 +465,7 @@ class Cluster:
 
     def delete(self, stmt: ast.Delete) -> TxResult:
         t = self._row_table(stmt.table)
-        for _attempt in range(self.RMW_RETRIES):
+        for _attempt in range(self.icb.get("rmw_retries")):
             locks = t.lock_all_shards()
             try:
                 res = self._delete_once(t, stmt, locks)
@@ -480,15 +521,19 @@ class Cluster:
     # ---- query path ----
 
     def catalog(self) -> Catalog:
-        return Catalog(
-            schemas={n: t.schema for n, t in self.tables.items()},
-            primary_keys={
-                n: (t.pk_column,) for n, t in self.tables.items()
-            },
-            dicts=self.dicts,
-        )
+        from ydb_tpu.obs.sysview import SYS_SCHEMAS
 
-    def snapshot_db(self, snap: int | None = None) -> Database:
+        schemas = {n: t.schema for n, t in self.tables.items()}
+        pks = {n: (t.pk_column,) for n, t in self.tables.items()}
+        if self.flags.enable_sys_views:
+            for name, schema in SYS_SCHEMAS.items():
+                schemas.setdefault(name, schema)
+                pks.setdefault(name, (schema.names[0],))
+        return Catalog(schemas=schemas, primary_keys=pks,
+                       dicts=self.dicts)
+
+    def snapshot_db(self, snap: int | None = None,
+                    include_sys: bool = False) -> Database:
         from ydb_tpu.datashard.table import RowTable
 
         snap = self.coordinator.read_snapshot() if snap is None else snap
@@ -498,6 +543,8 @@ class Cluster:
                 sources[name] = t.source_at(snap)
             else:
                 sources[name] = _merge_shard_sources(t, snap)
+        if include_sys:
+            sources = _SysLazySources(self, sources)
         return Database(sources=sources, dicts=self.dicts)
 
     def plan(self, sql: str):
@@ -536,6 +583,25 @@ class Cluster:
 
     def session(self) -> "Session":
         return Session(self)
+
+
+class _SysLazySources(dict):
+    """Sys views materialize only when a query actually reads them —
+    sys_partition_stats walks every shard, far too hot for the default
+    SELECT path."""
+
+    def __init__(self, cluster, base: dict):
+        super().__init__(base)
+        self._cluster = cluster
+
+    def __missing__(self, key):
+        from ydb_tpu.obs.sysview import SYS_SCHEMAS, sys_source
+
+        if key not in SYS_SCHEMAS:
+            raise KeyError(key)
+        src = sys_source(self._cluster, key)
+        self[key] = src
+        return src
 
 
 def _merge_shard_sources(t: ShardedTable, snap: int) -> ColumnSource:
@@ -608,9 +674,31 @@ class Session:
 
     cluster: Cluster
 
-    def execute(self, sql: str):
+    def execute(self, sql: str, trace_id: int | None = None):
         """Returns OracleTable for SELECT, TxResult for INSERT, None DDL."""
-        planned = self.cluster.plan(sql)
+        import time as _time
+
+        c = self.cluster
+        t0 = _time.monotonic()
+        with c.tracer.trace("query", trace_id) as span:
+            with span.child("plan") as plan_span:
+                planned = c.plan(sql)
+                kind = (type(planned).__name__.lower()
+                        if not isinstance(planned, tuple) else "select")
+                plan_span.set(kind=kind)
+            span.set(kind=kind)
+            with span.child("execute"):
+                out = self._dispatch(planned)
+        seconds = _time.monotonic() - t0
+        rows = out.num_rows if isinstance(out, OracleTable) else 0
+        c.query_log.append({"sql": sql, "kind": kind,
+                            "seconds": seconds, "rows": rows})
+        g = c.counters.group(kind=kind)
+        g.counter("queries").inc()
+        g.histogram("latency_seconds").observe(seconds)
+        return out
+
+    def _dispatch(self, planned):
         if isinstance(planned, ast.CreateTable):
             self.cluster.create_table(planned)
             return None
@@ -627,7 +715,8 @@ class Session:
         if isinstance(planned, ast.Delete):
             return self.cluster.delete(planned)
         p, alias_map = planned
-        db = self.cluster.snapshot_db()
+        db = self.cluster.snapshot_db(
+            include_sys=self.cluster.flags.enable_sys_views)
         out = to_host(execute_plan(p, db))
         out.dicts = self.cluster.result_dicts(out.schema, alias_map)
         return out

@@ -1,0 +1,4 @@
+from ydb_tpu.obs.counters import CounterGroup, root_counters
+from ydb_tpu.obs.tracing import Span, Tracer
+
+__all__ = ["CounterGroup", "root_counters", "Span", "Tracer"]

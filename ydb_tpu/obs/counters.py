@@ -1,0 +1,148 @@
+"""Dynamic counters: hierarchical metric trees + Prometheus text export.
+
+Mirror of the reference's monlib dynamic counters (TDynamicCounters
+library/cpp/monlib/dynamic_counters/counters.h; SURVEY.md §2.1, §5.5):
+services create named subgroups, counters/gauges/histograms register by
+name, and encoders walk the tree. One process-global root; tests make
+private roots.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, by: int = 1):
+        with self._lock:
+            self.value += by
+
+    def set(self, value):
+        with self._lock:
+            self.value = value
+
+
+class Histogram:
+    """Fixed-bucket histogram (exponential bounds by default)."""
+
+    def __init__(self, bounds: tuple = ()):
+        self.bounds = tuple(bounds) or tuple(
+            0.001 * (4 ** i) for i in range(12))  # 1ms .. ~4200s
+        self.buckets = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        with self._lock:
+            idx = bisect.bisect_left(self.bounds, value)
+            self.buckets[idx] += 1
+            self.count += 1
+            self.total += value
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = q * self.count
+            acc = 0
+            for i, n in enumerate(self.buckets):
+                acc += n
+                if acc >= target:
+                    return (self.bounds[i] if i < len(self.bounds)
+                            else float("inf"))
+            return float("inf")
+
+
+class CounterGroup:
+    def __init__(self, labels: dict | None = None):
+        self.labels = dict(labels or {})
+        self._children: dict[tuple, CounterGroup] = {}
+        self._counters: dict[str, Counter] = {}
+        self._histograms: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def group(self, **labels) -> "CounterGroup":
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                merged = dict(self.labels, **labels)
+                child = self._children[key] = CounterGroup(merged)
+            return child
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter()
+            return c
+
+    def histogram(self, name: str, bounds: tuple = ()) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(bounds)
+            return h
+
+    # ---- encoding ----
+
+    def _label_str(self) -> str:
+        if not self.labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"'
+                         for k, v in sorted(self.labels.items()))
+        return "{" + inner + "}"
+
+    def encode_prometheus(self) -> str:
+        lines = []
+        self._encode(lines)
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def _encode(self, lines: list):
+        ls = self._label_str()
+        for name, c in sorted(self._counters.items()):
+            lines.append(f"{name}{ls} {c.value}")
+        for name, h in sorted(self._histograms.items()):
+            lines.append(f"{name}_count{ls} {h.count}")
+            lines.append(f"{name}_sum{ls} {h.total}")
+            acc = 0
+            bounds = [str(b) for b in h.bounds] + ["+Inf"]
+            for bound, n in zip(bounds, h.buckets):
+                acc += n
+                le = dict(self.labels, le=bound)
+                inner = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(le.items()))
+                lines.append(f"{name}_bucket{{{inner}}} {acc}")
+        for child in self._children.values():
+            child._encode(lines)
+
+    def snapshot(self) -> dict:
+        """Flat dict for sys views / tests."""
+        out = {}
+        self._snap(out)
+        return out
+
+    def _snap(self, out: dict):
+        prefix = ",".join(f"{k}={v}"
+                          for k, v in sorted(self.labels.items()))
+        for name, c in self._counters.items():
+            out[f"{name}|{prefix}"] = c.value
+        for name, h in self._histograms.items():
+            out[f"{name}_count|{prefix}"] = h.count
+        for child in self._children.values():
+            child._snap(out)
+
+
+_root = CounterGroup()
+
+
+def root_counters() -> CounterGroup:
+    return _root

@@ -1,0 +1,138 @@
+"""System views + health check.
+
+Mirror of the reference's sys_view providers (`SELECT ... FROM .sys
+tables`: partition_stats, query_stats, nodes — core/sys_view;
+SURVEY.md §2.14) and the health-check service
+(core/health_check/health_check.cpp): live cluster state exposed
+through the NORMAL query path — sys tables materialize as ColumnSources
+injected into the snapshot database, so the planner/executor treat
+them like any table (dots become underscores: sys_partition_stats).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.scan import ColumnSource
+
+
+SYS_SCHEMAS = {
+    "sys_partition_stats": dtypes.schema(
+        ("table_name", dtypes.STRING), ("shard", dtypes.INT32),
+        ("store", dtypes.STRING), ("rows", dtypes.INT64),
+        ("portions", dtypes.INT32)),
+    "sys_query_stats": dtypes.schema(
+        ("query_text", dtypes.STRING), ("kind", dtypes.STRING),
+        ("duration_us", dtypes.INT64), ("result_rows", dtypes.INT64)),
+    "sys_scheme_paths": dtypes.schema(
+        ("path", dtypes.STRING), ("kind", dtypes.STRING)),
+}
+
+
+def _source(name: str, rows, dicts) -> ColumnSource:
+    """rows: per-column python lists, ordered per SYS_SCHEMAS[name]."""
+    schema = SYS_SCHEMAS[name]
+    arrays = {}
+    for f, values in zip(schema.fields, rows):
+        if f.type.is_string:
+            d = dicts.for_column(f.name)
+            arrays[f.name] = np.asarray(
+                [d.add(v.encode() if isinstance(v, str) else v)
+                 for v in values], dtype=np.int32)
+        else:
+            arrays[f.name] = np.asarray(values, dtype=f.type.physical)
+    return ColumnSource(arrays, schema, dicts)
+
+
+def _partition_stats_rows(cluster):
+    names, shards, kinds, rows_c, extra = [], [], [], [], []
+    for tname, t in cluster.tables.items():
+        for i, s in enumerate(t.shards):
+            names.append(tname)
+            shards.append(i)
+            if hasattr(s, "portions"):  # ColumnShard
+                kinds.append("column")
+                vis = s.visible_portions()
+                rows_c.append(int(sum(p.num_rows for p in vis)))
+                extra.append(len(vis))
+            else:                        # DataShard
+                kinds.append("row")
+                n = sum(len(page) for page in s.read(s.last_step))
+                rows_c.append(n)
+                extra.append(0)
+    return [names, shards, kinds, rows_c, extra]
+
+
+def _query_stats_rows(cluster):
+    log = list(cluster.query_log)
+    return [[q["sql"][:256] for q in log], [q["kind"] for q in log],
+            [int(q["seconds"] * 1e6) for q in log],
+            [q["rows"] for q in log]]
+
+
+def _scheme_paths_rows(cluster):
+    paths, kinds = [], []
+    for (p,), row in cluster.scheme.executor.db.table("paths").range():
+        paths.append(p)
+        kinds.append(row["type"])
+    return [paths, kinds]
+
+
+_BUILDERS = {
+    "sys_partition_stats": _partition_stats_rows,
+    "sys_query_stats": _query_stats_rows,
+    "sys_scheme_paths": _scheme_paths_rows,
+}
+
+
+def sys_source(cluster, name: str) -> ColumnSource:
+    """Materialize ONE sys view (each has its own cost; the lazy source
+    map builds only what a query touches)."""
+    return _source(name, _BUILDERS[name](cluster), cluster.dicts)
+
+
+def sys_sources(cluster) -> dict[str, ColumnSource]:
+    return {name: sys_source(cluster, name) for name in SYS_SCHEMAS}
+
+
+def health_check(cluster) -> dict:
+    """Aggregated health (health_check.cpp analog): GOOD | DEGRADED |
+    EMERGENCY plus per-issue detail."""
+    issues = []
+    # storage probe: write/read/delete a canary blob
+    try:
+        cluster.store.put("health/canary", b"ok")
+        if cluster.store.get("health/canary") != b"ok":
+            issues.append({"severity": "red",
+                           "message": "storage canary mismatch"})
+        cluster.store.delete("health/canary")
+    except Exception as e:  # noqa: BLE001
+        issues.append({"severity": "red",
+                       "message": f"storage unavailable: {e}"})
+    # degraded erasure groups (when running on a GroupBlobStore)
+    proxy = getattr(cluster.store, "proxy", None)
+    if proxy is not None:
+        down = sum(1 for d in proxy.group.disks if d.down)
+        if down:
+            sev = ("red" if down > proxy.codec.max_lost else "yellow")
+            issues.append({
+                "severity": sev,
+                "message": f"group {proxy.group.group_id}: {down} "
+                           f"disk(s) down",
+            })
+    # scheme/table agreement
+    for desc in cluster.scheme.list_tables():
+        if desc.path.strip("/") not in cluster.tables:
+            issues.append({
+                "severity": "yellow",
+                "message": f"table {desc.path} in scheme but not "
+                           f"instantiated",
+            })
+    if any(i["severity"] == "red" for i in issues):
+        status = "EMERGENCY"
+    elif issues:
+        status = "DEGRADED"
+    else:
+        status = "GOOD"
+    return {"status": status, "issues": issues}

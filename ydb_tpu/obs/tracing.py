@@ -1,0 +1,104 @@
+"""Distributed tracing: spans with trace-id propagation.
+
+Mirror of the reference's Wilson tracing (NWilson::TSpan
+wilson/wilson_span.h:50, TTraceId wilson/wilson_trace.h, uploader ->
+OTLP wilson/wilson_uploader.cpp; SURVEY.md §5.1): spans open under a
+trace id, nest by parent span id, and finished spans collect in a
+Tracer which exports OTLP-shaped JSON. The session opens a root span
+per query; inner phases (compile/plan/execute) nest under it; actor
+envelopes can carry the id across nodes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import time
+
+
+_ids = itertools.count(1)
+
+
+class Span:
+    def __init__(self, tracer: "Tracer", name: str, trace_id: int,
+                 parent_id: int | None = None, clock=time.monotonic):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = next(_ids)
+        self.parent_id = parent_id
+        self.attrs: dict = {}
+        self._clock = clock
+        self.start = clock()
+        self.end: float | None = None
+
+    def child(self, name: str) -> "Span":
+        return Span(self.tracer, name, self.trace_id, self.span_id,
+                    self._clock)
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def finish(self) -> None:
+        if self.end is None:
+            self.end = self._clock()
+            self.tracer._record(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None:
+            self.attrs["error"] = repr(exc)
+        self.finish()
+
+
+class Tracer:
+    def __init__(self, max_spans: int = 10000, clock=time.monotonic):
+        self.max_spans = max_spans
+        self.finished: list[Span] = []
+        self._clock = clock
+        self._next_tid = 1
+
+    def trace(self, name: str, trace_id: int | None = None) -> Span:
+        """Open a root span (new trace id unless one is propagated).
+        The local allocator always skips past propagated ids so two
+        unrelated traces never share an id."""
+        if trace_id is not None:
+            tid = trace_id
+            self._next_tid = max(self._next_tid, trace_id + 1)
+        else:
+            tid = self._next_tid
+            self._next_tid += 1
+        return Span(self, name, tid, None, self._clock)
+
+    def _record(self, span: Span) -> None:
+        self.finished.append(span)
+        if len(self.finished) > self.max_spans:
+            del self.finished[: len(self.finished) - self.max_spans]
+
+    def spans_for(self, trace_id: int) -> list[Span]:
+        return [s for s in self.finished if s.trace_id == trace_id]
+
+    def export_otlp_json(self) -> str:
+        """OTLP/JSON-shaped export (the uploader's wire format)."""
+        return json.dumps({
+            "resourceSpans": [{
+                "scopeSpans": [{
+                    "spans": [{
+                        "traceId": f"{s.trace_id:032x}",
+                        "spanId": f"{s.span_id:016x}",
+                        "parentSpanId": (f"{s.parent_id:016x}"
+                                         if s.parent_id else ""),
+                        "name": s.name,
+                        "startTimeUnixNano": int(s.start * 1e9),
+                        "endTimeUnixNano": int((s.end or s.start) * 1e9),
+                        "attributes": [
+                            {"key": k, "value": {"stringValue": str(v)}}
+                            for k, v in s.attrs.items()
+                        ],
+                    } for s in self.finished],
+                }],
+            }],
+        })
