@@ -64,6 +64,13 @@ class StartTask:
 
 
 @dataclasses.dataclass
+class _PumpSource:
+    """Self-message: consume ONE source block, then re-arm. Keeps the
+    mailbox responsive between blocks so checkpoint barriers (and any
+    control traffic) interleave with streaming reads."""
+
+
+@dataclasses.dataclass
 class ResultData:
     payload: dict | None
     finished: bool
@@ -175,6 +182,8 @@ class ComputeActor(Actor):
         spiller: Spiller | None = None,
         window: int = DEFAULT_WINDOW,
         block_rows: int = 1 << 16,
+        checkpoint_storage=None,
+        restore_checkpoint: int | None = None,
     ):
         super().__init__()
         self.task = task
@@ -207,32 +216,147 @@ class ComputeActor(Actor):
             for chs in groups.values()
         ]
 
+        # ---- checkpoint state (IDqTaskRunner Save/Load analog) ----
+        self.checkpoint_storage = checkpoint_storage
+        self.coordinator_target: ActorId | None = None
+        self._source_iter = None
+        self._source_pos = 0          # blocks consumed from sources
+        self._source_done = not sources
+        self._aligned: dict[int, set] = {}        # ckpt id -> channels
+        self._post_barrier: dict[int, list] = {}  # buffered post-align
+        if restore_checkpoint is not None and checkpoint_storage:
+            state = checkpoint_storage.load_task(
+                restore_checkpoint, task.task_id)
+            if state is not None:
+                self._acc = [
+                    payload_to_block(p, self.compiled.mid_schema)
+                    for p in state["acc"]
+                ]
+                self._source_pos = state["source_pos"]
+                self.block_rows = state["block_rows"]
+                self._in_finished = set(state["in_finished"])
+
     # ---- input side ----
 
     def receive(self, message, sender):
+        from ydb_tpu.dq.checkpoint import InjectCheckpoint
+
         if isinstance(message, StartTask):
-            self._consume_source()
+            self._start_source()
+        elif isinstance(message, _PumpSource):
+            self._pump_source()
+        elif isinstance(message, InjectCheckpoint):
+            # source-side barrier injection: snapshot between blocks
+            self._take_checkpoint(message.checkpoint_id)
         elif isinstance(message, ChannelData):
             self.send(sender, ChannelAck(message.channel_id, message.seq))
-            if message.payload is not None:
-                blk = payload_to_block(message.payload,
-                                       self.compiled.in_schema)
-                self._ingest(blk)
-            if message.finished:
-                self._in_finished.add(message.channel_id)
-                if self._in_finished >= set(self.task.input_channels):
-                    self._finish_input()
+            self._on_channel_data(message)
         elif isinstance(message, ChannelAck):
             self._on_ack(message)
         else:
             raise TypeError(message)
 
-    def _consume_source(self):
-        for source in self.sources:
-            for blk in source.blocks(self.block_rows):
-                self._ingest(blk)
-        if not self.task.input_channels:
+    def _on_channel_data(self, message: ChannelData):
+        from ydb_tpu.dq.checkpoint import BARRIER_KEY
+
+        payload = message.payload
+        if payload is not None and BARRIER_KEY in payload:
+            self._on_barrier(int(payload[BARRIER_KEY]),
+                             message.channel_id)
+            return
+        # a block from a channel already aligned for a pending
+        # checkpoint belongs to the NEXT epoch: buffer until snapshot
+        for cid, chans in self._aligned.items():
+            if message.channel_id in chans:
+                self._post_barrier[cid].append(message)
+                return
+        self._apply_channel_data(message)
+
+    def _apply_channel_data(self, message: ChannelData):
+        if message.payload is not None:
+            blk = payload_to_block(message.payload,
+                                   self.compiled.in_schema)
+            self._ingest(blk)
+        if message.finished:
+            self._in_finished.add(message.channel_id)
+            self._check_alignment()  # finished counts as aligned
+            if self._in_finished >= set(self.task.input_channels):
+                self._finish_input()
+
+    # ---- checkpoint protocol ----
+
+    def _on_barrier(self, checkpoint_id: int, channel_id: int):
+        chans = self._aligned.setdefault(checkpoint_id, set())
+        self._post_barrier.setdefault(checkpoint_id, [])
+        chans.add(channel_id)
+        self._check_alignment()
+
+    def _check_alignment(self):
+        need = set(self.task.input_channels)
+        for cid in sorted(self._aligned):
+            chans = self._aligned[cid] | self._in_finished
+            if chans >= need:
+                self._take_checkpoint(cid)
+
+    def _take_checkpoint(self, checkpoint_id: int):
+        from ydb_tpu.dq.checkpoint import BARRIER_KEY, TaskCheckpointed
+
+        if self.checkpoint_storage is not None:
+            self.checkpoint_storage.save_task(checkpoint_id,
+                                              self.task.task_id, {
+                "acc": [block_to_payload(b) for b in self._acc],
+                # position is counted in BLOCKS of this block size; the
+                # restore pins block_rows so the count stays meaningful
+                "source_pos": self._source_pos,
+                "block_rows": self.block_rows,
+                "in_finished": sorted(self._in_finished),
+            })
+        # forward the barrier in band on EVERY output channel (parks
+        # behind pending data, so it cannot overtake blocks)
+        if not isinstance(self.task.stage_spec.output, ResultOutput):
+            # numpy value so the credit queue/spiller treat the barrier
+            # exactly like a (tiny) data payload
+            barrier = {BARRIER_KEY: np.asarray(checkpoint_id)}
+            for ch in self.task.output_channels:
+                self._send_channel(ch, barrier)
+        if self.coordinator_target is not None:
+            self.send(self.coordinator_target,
+                      TaskCheckpointed(self.task.task_id, checkpoint_id))
+        buffered = self._post_barrier.pop(checkpoint_id, [])
+        self._aligned.pop(checkpoint_id, None)
+        for msg in buffered:
+            self._apply_channel_data(msg)
+
+    # ---- source streaming ----
+
+    def _start_source(self):
+        def blocks(skip: int):
+            # checkpoint resume: seek in O(1) per source rather than
+            # materializing and discarding consumed blocks
+            for source in self.sources:
+                nb = source.n_blocks(self.block_rows)
+                if skip >= nb:
+                    skip -= nb
+                    continue
+                yield from source.blocks(self.block_rows,
+                                         start_block=skip)
+                skip = 0
+
+        self._source_iter = blocks(self._source_pos)
+        if self.sources:
+            self.send(self.self_id, _PumpSource())
+        elif not self.task.input_channels:
             self._finish_input()
+
+    def _pump_source(self):
+        blk = next(self._source_iter, None)
+        if blk is None:
+            if not self.task.input_channels:
+                self._finish_input()
+            return
+        self._source_pos += 1
+        self._ingest(blk)
+        self.send(self.self_id, _PumpSource())
 
     def _ingest(self, block: TableBlock):
         spec = self.task.stage_spec
@@ -353,7 +477,28 @@ class ResultCollector(Actor):
         )
 
 
-def run_stage_graph(
+@dataclasses.dataclass
+class GraphHandle:
+    """A built-but-not-finished dataflow: the executer's live view."""
+
+    actors: list
+    actor_of_task: dict
+    collector: "ResultCollector"
+    collector_id: ActorId
+    systems: list
+    tasks: list
+    result_stage: int
+    coordinator: object = None
+    coordinator_id: ActorId | None = None
+
+    def start(self):
+        sys_by_node = {s.node: s for s in self.systems}
+        for t in self.tasks:
+            aid = self.actor_of_task[t.task_id]
+            sys_by_node[aid.node].send(aid, StartTask())
+
+
+def build_stage_graph(
     stages: list[StageSpec],
     sources: dict[str, list[ColumnSource]],
     runtime,
@@ -361,10 +506,14 @@ def run_stage_graph(
     key_spaces=None,
     spill_quota_bytes: int = 64 << 20,
     window: int = DEFAULT_WINDOW,
-) -> OracleTable:
+    checkpoint_storage=None,
+    restore_checkpoint: int | None = None,
+) -> GraphHandle:
     """Compile stages, place tasks round-robin over the runtime's nodes,
-    run to completion, return the result (the executer-actor shape,
-    kqp_executer_impl.h:120 + planner kqp_planner.cpp:116)."""
+    wire channels (the executer-actor shape, kqp_executer_impl.h:120 +
+    planner kqp_planner.cpp:116). With ``checkpoint_storage``, a
+    CheckpointCoordinator is attached; with ``restore_checkpoint``,
+    every task loads its saved state and sources resume mid-stream."""
     # schemas flow source -> downstream
     compiled: list[_CompiledStage] = []
     for si, spec in enumerate(stages):
@@ -412,6 +561,8 @@ def run_stage_graph(
             spiller=Spiller(mem_quota_bytes=spill_quota_bytes,
                             prefix=f"spill/task{t.task_id}"),
             window=window,
+            checkpoint_storage=checkpoint_storage,
+            restore_checkpoint=restore_checkpoint,
         )
         sys_i = systems[i % len(systems)]
         actor_of_task[t.task_id] = sys_i.register(a)
@@ -419,15 +570,47 @@ def run_stage_graph(
     for a in actors:
         for ch in a.task.output_channels:
             a.channel_targets[ch] = actor_of_task[chan_by_id[ch].dst_task]
-    sys_by_node = {s.node: s for s in systems}
-    for t in tasks:
-        aid = actor_of_task[t.task_id]
-        sys_by_node[aid.node].send(aid, StartTask())
 
+    handle = GraphHandle(actors, actor_of_task, collector, collector_id,
+                         systems, tasks, result_stage)
+    if checkpoint_storage is not None:
+        from ydb_tpu.dq.checkpoint import CheckpointCoordinator
+
+        source_task_ids = [
+            actor_of_task[t.task_id] for t in tasks
+            if any(isinstance(i, SourceInput) for i in t.stage_spec.inputs)
+        ]
+        coord = CheckpointCoordinator(
+            checkpoint_storage, source_task_ids, n_tasks=len(tasks),
+            start_id=restore_checkpoint or 0)
+        coord_id = systems[0].register(coord)
+        for a in actors:
+            a.coordinator_target = coord_id
+        handle.coordinator = coord
+        handle.coordinator_id = coord_id
+    return handle
+
+
+def run_stage_graph(
+    stages: list[StageSpec],
+    sources: dict[str, list[ColumnSource]],
+    runtime,
+    dicts=None,
+    key_spaces=None,
+    spill_quota_bytes: int = 64 << 20,
+    window: int = DEFAULT_WINDOW,
+    checkpoint_storage=None,
+    restore_checkpoint: int | None = None,
+) -> OracleTable:
+    """Build + run to completion, return the result table."""
+    handle = build_stage_graph(
+        stages, sources, runtime, dicts, key_spaces, spill_quota_bytes,
+        window, checkpoint_storage, restore_checkpoint)
+    handle.start()
     if hasattr(runtime, "dispatch"):
         runtime.dispatch()
     else:
         runtime.run()
-    if not collector.done:
+    if not handle.collector.done:
         raise RuntimeError("stage graph did not complete")
-    return collector.table()
+    return handle.collector.table()

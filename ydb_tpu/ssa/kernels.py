@@ -209,6 +209,14 @@ def scatter_first(values: jax.Array, valid_row, gid, num_groups: int):
 
 def scatter_sum(values, valid_row, gid, num_groups: int, dtype=None):
     dtype = dtype or values.dtype
+    # TPU fast path: one-hot reduction kernel instead of a serialized
+    # scatter (ydb_tpu/ssa/pallas_kernels.py); exact-dtype gated
+    from ydb_tpu.ssa import pallas_kernels
+
+    if pallas_kernels.enabled() and pallas_kernels.supported(
+            dtype, num_groups):
+        return pallas_kernels.scatter_sum_pallas(
+            values, valid_row, gid, num_groups, dtype)
     idx = jnp.where(valid_row, gid, num_groups)
     out = jnp.zeros((num_groups,), dtype=dtype)
     return out.at[idx].add(values.astype(dtype), mode="drop")
