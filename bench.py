@@ -1,17 +1,28 @@
-"""Benchmark: TPC-H Q1 scan+filter+group-by throughput on the device.
+"""Benchmark: TPC-H Q1/Q6 scan+filter+aggregate throughput on the device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-Config (BASELINE.md config 1/2): TPC-H Q1 at SF (default 1.0 — ~6M
+Config (BASELINE.md config 2): TPC-H Q1 and Q6 at SF (default 10 — ~60M
 lineitem rows), executed by the block-streamed columnar engine on the
-default JAX device (the real TPU chip under the driver). The baseline is
-the single-threaded CPU reference engine (ydb_tpu.engine.oracle) on the
-identical data — the stand-in for the reference's single-node CPU KQP
-baseline, which BASELINE.md notes must be measured, not copied (the
-reference publishes no numbers and its 2M-LoC C++ server cannot be built
-in this image).
+default JAX device (the real TPU chip under the driver).
 
-Env knobs: YDB_TPU_BENCH_SF (default 1.0), YDB_TPU_BENCH_ITERS (default 5),
+Metrics:
+  * primary  — Q1 steady-state scan rows/s/chip (data resident in HBM,
+    the engine's steady state; the scan reads 7 columns per row).
+  * extra.q6_rows_per_sec       — Q6 (filter + global agg) rows/s/chip.
+  * extra.ingest_rows_per_sec   — host->HBM transfer included (cold data).
+  * extra.hbm_gb_per_sec        — effective HBM read bandwidth of the Q1
+    scan (7 x int64/int32 columns), for roofline context.
+  * extra.cpu_q1_rows_per_sec   — the CPU baseline actually measured.
+
+Baseline: a tight vectorized single-pass numpy implementation of the same
+queries (mask + bincount) on the identical host — an Arrow-compute-class
+columnar CPU engine, NOT the repo's interpretive oracle. BASELINE.md
+requires the CPU number to be measured, not copied (the reference
+publishes none and its 2M-LoC C++ server cannot be built in this image).
+Results are cross-checked engine-vs-baseline before timing is reported.
+
+Env knobs: YDB_TPU_BENCH_SF (default 10), YDB_TPU_BENCH_ITERS (default 5),
 YDB_TPU_BENCH_BLOCK_ROWS (default 2^21).
 """
 
@@ -22,14 +33,49 @@ import time
 import numpy as np
 
 
+def cpu_q1(li, cutoff):
+    """Vectorized single-pass numpy Q1 (the CPU columnar baseline)."""
+    m = li["l_shipdate"] <= cutoff
+    nls = int(li["l_linestatus"].max()) + 1
+    rf = li["l_returnflag"][m].astype(np.int64)
+    ls = li["l_linestatus"][m].astype(np.int64)
+    gid = rf * nls + ls
+    ng = int(gid.max()) + 1
+    qty = li["l_quantity"][m]
+    price = li["l_extendedprice"][m]
+    disc = li["l_discount"][m]
+    tax = li["l_tax"][m]
+    disc_price = price * (100 - disc)          # scale 4
+    charge = disc_price * (100 + tax)          # scale 6
+    out = {
+        "count": np.bincount(gid, minlength=ng),
+    }
+    for name, col in (("sum_qty", qty), ("sum_base_price", price),
+                      ("sum_disc_price", disc_price),
+                      ("sum_charge", charge), ("sum_disc", disc)):
+        out[name] = np.bincount(gid, weights=col.astype(np.float64),
+                                minlength=ng)
+    keep = out["count"] > 0
+    out = {k: v[keep] for k, v in out.items()}
+    out["gid"] = np.flatnonzero(keep)
+    return out, int(m.sum()), nls
+
+
+def cpu_q6(li, d0, d1):
+    m = ((li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)
+         & (li["l_discount"] >= 5) & (li["l_discount"] <= 7)
+         & (li["l_quantity"] < 2400))
+    return int(np.sum(li["l_extendedprice"][m] * li["l_discount"][m]))
+
+
 def main():
-    sf = float(os.environ.get("YDB_TPU_BENCH_SF", "1.0"))
+    sf = float(os.environ.get("YDB_TPU_BENCH_SF", "10"))
     iters = int(os.environ.get("YDB_TPU_BENCH_ITERS", "5"))
-    block_rows = int(os.environ.get("YDB_TPU_BENCH_BLOCK_ROWS", str(1 << 21)))
+    block_rows = int(os.environ.get("YDB_TPU_BENCH_BLOCK_ROWS",
+                                    str(1 << 21)))
 
     import jax
 
-    from ydb_tpu.engine.oracle import OracleTable, run_oracle
     from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
     from ydb_tpu.workload import tpch
 
@@ -39,52 +85,92 @@ def main():
     src = ColumnSource(
         columns=li, schema=tpch.LINEITEM_SCHEMA, dicts=data.dicts
     )
-    prog = tpch.q1_program()
 
-    ex = ScanExecutor(prog, src, block_rows=block_rows)
-    # preload device-resident blocks (the engine's steady state: data lives
-    # in HBM portions; host->HBM transfer is the ingest path, not the scan)
+    ex1 = ScanExecutor(tpch.q1_program(), src, block_rows=block_rows)
+    ex6 = ScanExecutor(tpch.q6_program(), src, block_rows=block_rows)
+    # one resident block set covering both queries' columns (Q6's are a
+    # subset of Q1's); ingest = the host->HBM transfer of those columns
+    read_cols = tuple(dict.fromkeys(ex1.read_cols + ex6.read_cols))
+    t0 = time.perf_counter()
     blocks = [
-        jax.device_put(b) for b in src.blocks(block_rows, ex.read_cols)
+        jax.device_put(b) for b in src.blocks(block_rows, read_cols)
     ]
     jax.block_until_ready(blocks)
+    ingest_dt = time.perf_counter() - t0
+    nbytes = sum(
+        c.data.nbytes + c.validity.nbytes
+        for b in blocks for c in b.columns.values()
+    )
 
-    def run_once():
-        partials = [ex.run_block(b) for b in blocks]
-        out = ex.finalize(partials)
-        jax.block_until_ready(out.length)
+    def run(ex):
+        out = ex.finalize([ex.run_block(b) for b in blocks])
+        jax.block_until_ready(out)
         return out
 
-    run_once()  # compile
+    def timed(ex):
+        run(ex)  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run(ex)
+        dt = (time.perf_counter() - t0) / iters
+        return out, n_rows / dt, dt
+
+    out1, q1_rps, q1_dt = timed(ex1)
+    out6, q6_rps, _ = timed(ex6)
+
+    # ---- CPU baseline (vectorized numpy single pass, same data) ----
+    cutoff = tpch._days("1998-12-01") - 90
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = run_once()
-    dt = (time.perf_counter() - t0) / iters
-    device_rps = n_rows / dt
-
-    # CPU baseline (single-thread numpy reference engine, same data)
-    oracle_tbl = OracleTable(
-        {n: (v, np.ones(len(v), dtype=bool)) for n, v in li.items()},
-        tpch.LINEITEM_SCHEMA,
-    )
+    base1, _, nls = cpu_q1(li, cutoff)
+    cpu_q1_dt = time.perf_counter() - t0
+    cpu_q1_rps = n_rows / cpu_q1_dt
     t0 = time.perf_counter()
-    ora = run_oracle(prog, oracle_tbl, data.dicts)
-    cpu_dt = time.perf_counter() - t0
-    cpu_rps = n_rows / cpu_dt
+    base6 = cpu_q6(li, tpch._days("1994-01-01"), tpch._days("1995-01-01"))
+    cpu_q6_dt = time.perf_counter() - t0
 
-    # sanity: engine result matches oracle
-    res = ex.finalize([ex.run_block(b) for b in blocks])
-    res_host = np.asarray(res.columns["count_order"].data)[: int(res.length)]
-    ora_host = ora.cols["count_order"][0]
-    assert sorted(res_host.tolist()) == sorted(ora_host.tolist()), (
-        "engine/oracle mismatch"
+    # ---- cross-check engine vs baseline before reporting ----
+    res1 = out1.to_numpy()
+    n1 = int(out1.length)
+    # associate engine rows with baseline rows BY GROUP KEY (same dict
+    # ids on both sides), so a value/key misassociation cannot pass
+    eng_gid = (res1["l_returnflag"][:n1].astype(np.int64) * nls
+               + res1["l_linestatus"][:n1].astype(np.int64))
+    eng_order = np.argsort(eng_gid)
+    assert np.array_equal(eng_gid[eng_order], base1["gid"]), (
+        "engine/baseline group keys differ")
+    for eng_col, base_col in (("count_order", "count"),
+                              ("sum_qty", "sum_qty"),
+                              ("sum_base_price", "sum_base_price"),
+                              ("sum_disc_price", "sum_disc_price"),
+                              ("sum_charge", "sum_charge")):
+        ev = np.asarray(res1[eng_col][:n1], dtype=np.float64)[eng_order]
+        assert np.allclose(ev, base1[base_col], rtol=1e-9), (
+            f"engine/baseline mismatch on {eng_col}")
+    rev = int(np.asarray(out6.to_numpy()["revenue"])[0])
+    assert rev == base6, f"Q6 mismatch {rev} != {base6}"
+
+    q1_bytes = sum(
+        c.data.nbytes + c.validity.nbytes
+        for b in blocks for name, c in b.columns.items()
+        if name in ex1.read_cols
     )
-
     print(json.dumps({
         "metric": f"tpch_q1_sf{sf:g}_scan_rows_per_sec",
-        "value": round(device_rps),
+        "value": round(q1_rps),
         "unit": "rows/s",
-        "vs_baseline": round(device_rps / cpu_rps, 3),
+        "vs_baseline": round(q1_rps / cpu_q1_rps, 3),
+        "extra": {
+            "sf": sf,
+            "rows": n_rows,
+            "q6_rows_per_sec": round(q6_rps),
+            "q6_vs_cpu": round(q6_rps / (n_rows / cpu_q6_dt), 3),
+            "ingest_rows_per_sec": round(n_rows / ingest_dt),
+            "ingest_gb_per_sec": round(nbytes / ingest_dt / 1e9, 3),
+            "hbm_gb_per_sec": round(q1_bytes / q1_dt / 1e9, 1),
+            "cpu_q1_rows_per_sec": round(cpu_q1_rps),
+            "baseline": "vectorized numpy single-pass (mask+bincount), "
+                        "same host",
+        },
     }))
 
 

@@ -139,6 +139,43 @@ def test_full_sql_cluster_on_erasure_coded_storage():
     assert list(out.column("id")) == [1, 2, 3, 4]
 
 
+def test_failed_overwrite_keeps_previous_version():
+    """A failed overwrite during an outage must leave the old, still-
+    valid version readable (versioned blob ids; no in-place part
+    overwrite)."""
+    group = GroupInfo(11, "block42")
+    proxy = DSProxy(group)
+    proxy.put("doc", b"version one")
+    for i in range(3):
+        group.disks[i].down = True
+    with pytest.raises(IOError):
+        proxy.put("doc", b"version two")
+    for i in range(3):
+        group.disks[i].down = False
+    assert proxy.get("doc") == b"version one"
+    # successful overwrite supersedes
+    proxy.put("doc", b"version three")
+    assert proxy.get("doc") == b"version three"
+
+
+def test_mirror3_put_requires_all_replicas_placed():
+    """mirror3 must place all 3 replicas (handoff onto survivors when a
+    disk is down), never accept a 1-replica put as quorum."""
+    group = GroupInfo(12, "mirror3")
+    proxy = DSProxy(group)
+    group.disks[0].down = True
+    proxy.put("m", b"data")
+    # all three replica parts exist despite the dead disk
+    n_parts = 0
+    for disk in group.disks:
+        if disk.down:
+            continue
+        for part in range(3):
+            n_parts += len(disk.list_parts(part, prefix="m@"))
+    assert n_parts == 3
+    assert proxy.get("m") == b"data"
+
+
 def test_failed_put_rolls_back_and_self_heal_skips_garbage():
     group = GroupInfo(9, "block42")
     proxy = DSProxy(group)

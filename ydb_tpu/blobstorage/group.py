@@ -56,11 +56,12 @@ class VDisk:
             raise DiskDown(self.disk_id)
         self.backing.delete(self._key(blob_id, part))
 
-    def list_parts(self, part: int) -> list[str]:
+    def list_parts(self, part: int, prefix: str = "") -> list[str]:
         if self.down:
             raise DiskDown(self.disk_id)
-        prefix = f"vdisk/{self.disk_id}/{part}/"
-        return [k[len(prefix):] for k in self.backing.list(prefix)]
+        full = f"vdisk/{self.disk_id}/{part}/{prefix}"
+        skip = len(full) - len(prefix)
+        return [k[skip:] for k in self.backing.list(full)]
 
 
 class GroupInfo:
@@ -90,7 +91,18 @@ def hash_rotation(blob_id: str, n: int) -> int:
 
 
 class DSProxy:
-    """Per-group client: erasure put/get with quorum + restore-on-read."""
+    """Per-group client: erasure put/get with quorum + restore-on-read.
+
+    Blobs are stored under versioned ids (``blob_id@seq``, the TLogoBlobID
+    analog: reference blobs are immutable and never overwritten in place),
+    so an overwrite — or a failed overwrite during a disk outage — never
+    touches the parts of the previous version. Parts that cannot land on
+    their designated disk go to handoff slots on surviving disks (the
+    reference's handoff placement, dsproxy_put.cpp); the write quorum
+    demands every part written AND at least total-max_lost distinct
+    disks, so the advertised loss tolerance is real for a healthy group
+    and degrades only as far as the live topology forces it to.
+    """
 
     META_PART = 255  # per-blob metadata (orig length) replicated broadly
 
@@ -98,123 +110,213 @@ class DSProxy:
         self.group = group
         self.codec = group.codec
 
-    # ---- put: encode, place parts, demand a write quorum ----
+    @staticmethod
+    def _vid(blob_id: str, seq: int) -> str:
+        return f"{blob_id}@{seq:016x}"
+
+    def _seqs(self, blob_id: str) -> list[int]:
+        """All stored versions of blob_id, newest first."""
+        seqs = set()
+        pref = blob_id + "@"
+        for disk in self.group.disks:
+            try:
+                for vid in disk.list_parts(self.META_PART, prefix=pref):
+                    seqs.add(int(vid[len(pref):], 16))
+            except DiskDown:
+                continue
+        return sorted(seqs, reverse=True)
+
+    # ---- put: encode, place parts (handoff), demand a write quorum ----
 
     def put(self, blob_id: str, data: bytes) -> None:
         parts = self.codec.encode(data)
+        # next version = one past the highest stored version of THIS blob
+        # (not a process counter: ordering must survive process restarts
+        # over persistent backing)
+        seq = max(self._seqs(blob_id), default=0) + 1
+        vid = self._vid(blob_id, seq)
         meta = json.dumps({"len": len(data)}).encode()
-        written = 0
+        n = len(self.group.disks)
+        rot = hash_rotation(blob_id, n)
+        used: set[int] = set()
+        placed: list[tuple[VDisk, int]] = []
         for i, part in enumerate(parts):
-            disk = self.group.disk_for(blob_id, i)
-            try:
-                disk.put_part(blob_id, i, part)
-                disk.put_part(blob_id, self.META_PART, meta)
-                written += 1
-            except DiskDown:
-                pass
-        # quorum: enough surviving parts that max_lost MORE failures
-        # still leave the blob readable
-        need = len(parts) - self.codec.max_lost
-        if written < need:
-            # roll back the partial write: a sub-quorum blob would list
-            # as existing but be unreconstructable, poisoning self-heal
-            self.delete(blob_id)
+            # designated slot first, then handoff slots in rotation
+            # order; prefer disks not already holding a part of this
+            # blob, double up only when the live topology is smaller
+            # than the part count
+            slots = [(i + rot + off) % n for off in range(n)]
+            for only_fresh in (True, False):
+                done = False
+                for slot in slots:
+                    if only_fresh and slot in used:
+                        continue
+                    disk = self.group.disks[slot]
+                    try:
+                        disk.put_part(vid, i, part)
+                        disk.put_part(vid, self.META_PART, meta)
+                    except DiskDown:
+                        continue
+                    used.add(slot)
+                    placed.append((disk, i))
+                    done = True
+                    break
+                if done:
+                    break
+        # quorum needs (a) every part placed and (b) enough DISTINCT
+        # disks that any two successful write quorums intersect — a
+        # strict majority — so version numbering (seq = max seen + 1)
+        # always observes the previous successful write even across
+        # disjoint outages. For block42 the erasure bound (4) is already
+        # a majority of 6; mirror3 gets majority 2-of-3.
+        need_disks = max(self.codec.total_parts - self.codec.max_lost,
+                         len(self.group.disks) // 2 + 1)
+        if len(placed) < len(parts) or len(used) < need_disks:
+            # roll back this version's parts only — the previous
+            # version, living under its own vid, is untouched
+            for disk, i in placed:
+                try:
+                    disk.delete_part(vid, i)
+                    disk.delete_part(vid, self.META_PART)
+                except DiskDown:
+                    continue
             raise IOError(
-                f"write quorum failed: {written}/{len(parts)} parts "
-                f"(need {need})")
+                f"write quorum failed: {len(placed)}/{len(parts)} parts "
+                f"on {len(used)} disks (need all parts on >= "
+                f"{need_disks} disks)")
+        # supersede older versions (best effort; down disks may keep
+        # stale parts but get() always prefers the newest readable seq)
+        for old in self._seqs(blob_id):
+            if old != seq:
+                self._delete_version(blob_id, old)
 
     # ---- get: collect parts, reconstruct when disks are down ----
 
-    def get(self, blob_id: str) -> bytes:
+    def _gather(self, vid: str):
         parts: dict[int, bytes] = {}
         meta = None
-        for i in range(self.codec.total_parts):
-            disk = self.group.disk_for(blob_id, i)
+        for disk in self.group.disks:
             try:
-                if meta is None and disk.has_part(blob_id,
-                                                  self.META_PART):
+                if meta is None and disk.has_part(vid, self.META_PART):
                     meta = json.loads(
-                        disk.get_part(blob_id, self.META_PART).decode())
-                if disk.has_part(blob_id, i):
-                    parts[i] = disk.get_part(blob_id, i)
+                        disk.get_part(vid, self.META_PART).decode())
+                for i in range(self.codec.total_parts):
+                    if i not in parts and disk.has_part(vid, i):
+                        parts[i] = disk.get_part(vid, i)
             except DiskDown:
                 continue
-        if meta is None:
+        return parts, meta
+
+    def get(self, blob_id: str) -> bytes:
+        seqs = self._seqs(blob_id)
+        if not seqs:
             raise KeyError(blob_id)
-        if not parts:
-            raise KeyError(blob_id)
-        return self.codec.decode(parts, meta["len"])
+        err: Exception | None = None
+        for seq in seqs:
+            parts, meta = self._gather(self._vid(blob_id, seq))
+            if meta is None or not parts:
+                continue
+            try:
+                return self.codec.decode(parts, meta["len"])
+            except ValueError as e:
+                err = e  # undecodable at this version; try older
+        raise err if err is not None else KeyError(blob_id)
 
     def exists(self, blob_id: str) -> bool:
-        for i in range(self.codec.total_parts):
-            disk = self.group.disk_for(blob_id, i)
+        return bool(self._seqs(blob_id))
+
+    def _delete_version(self, blob_id: str, seq: int) -> None:
+        vid = self._vid(blob_id, seq)
+        for disk in self.group.disks:
             try:
-                if disk.has_part(blob_id, self.META_PART):
-                    return True
+                for i in range(self.codec.total_parts):
+                    disk.delete_part(vid, i)
+                disk.delete_part(vid, self.META_PART)
             except DiskDown:
                 continue
-        return False
 
     def delete(self, blob_id: str) -> None:
-        for i in range(self.codec.total_parts):
-            disk = self.group.disk_for(blob_id, i)
-            try:
-                disk.delete_part(blob_id, i)
-                disk.delete_part(blob_id, self.META_PART)
-            except DiskDown:
-                continue
+        for seq in self._seqs(blob_id):
+            self._delete_version(blob_id, seq)
 
     def list(self, prefix: str = "") -> list[str]:
         seen = set()
         for disk in self.group.disks:
             try:
-                for blob_id in disk.list_parts(self.META_PART):
-                    if blob_id.startswith(prefix):
-                        seen.add(blob_id)
+                for vid in disk.list_parts(self.META_PART, prefix=prefix):
+                    seen.add(vid.rsplit("@", 1)[0])
             except DiskDown:
                 continue
         return sorted(seen)
 
-    # ---- self-heal: replace a dead disk, rebuild its parts ----
+    # ---- self-heal: replace a dead disk, rebuild missing parts ----
 
     def self_heal(self, disk_index: int,
                   replacement: VDisk | None = None) -> int:
         """Swap in a fresh disk for group slot disk_index and rebuild
-        every part the old disk held (BSC self-heal + vdisk repl).
+        every part the group is missing (BSC self-heal + vdisk repl).
         Returns the number of parts rebuilt."""
         old = self.group.disks[disk_index]
         new = replacement if replacement is not None else VDisk(
             old.disk_id + "'")
         self.group.disks[disk_index] = new
+        n = len(self.group.disks)
         rebuilt = 0
-        # every known blob: if its part maps to this slot, reconstruct
         for blob_id in self.list():
-            rot = hash_rotation(blob_id, len(self.group.disks))
-            part_idx = (disk_index - rot) % len(self.group.disks)
-            if part_idx >= self.codec.total_parts:
-                continue
-            parts: dict[int, bytes] = {}
-            meta = None
-            for i in range(self.codec.total_parts):
-                disk = self.group.disk_for(blob_id, i)
-                try:
-                    if meta is None and disk.has_part(blob_id,
-                                                      self.META_PART):
-                        meta = json.loads(disk.get_part(
-                            blob_id, self.META_PART).decode())
-                    if disk.has_part(blob_id, i):
-                        parts[i] = disk.get_part(blob_id, i)
-                except DiskDown:
+            rot = hash_rotation(blob_id, n)
+            for seq in self._seqs(blob_id):
+                vid = self._vid(blob_id, seq)
+                parts, meta = self._gather(vid)
+                if meta is None:
                     continue
-            if meta is None:
-                continue
-            try:
-                part = self.codec.reconstruct_part(parts, part_idx,
-                                                   meta["len"])
-            except ValueError:
-                continue  # unreconstructable blob: skip, keep healing
-            new.put_part(blob_id, part_idx, part)
-            new.put_part(blob_id, self.META_PART,
-                         json.dumps({"len": meta["len"]}).encode())
-            rebuilt += 1
+                # restore every part onto its designated live disk —
+                # this both fills the replacement disk and repatriates
+                # handoff copies written while disks were down, so the
+                # group's full loss tolerance comes back after heal
+                for i in range(self.codec.total_parts):
+                    disk = self.group.disks[(i + rot) % n]
+                    try:
+                        on_designated = disk.has_part(vid, i)
+                    except DiskDown:
+                        continue
+                    if not on_designated:
+                        if i in parts:
+                            part = parts[i]
+                        else:
+                            try:
+                                part = self.codec.reconstruct_part(
+                                    parts, i, meta["len"])
+                            except ValueError:
+                                break  # unreconstructable: heal the rest
+                        try:
+                            disk.put_part(vid, i, part)
+                            disk.put_part(
+                                vid, self.META_PART,
+                                json.dumps({"len": meta["len"]}).encode())
+                        except DiskDown:
+                            continue
+                        rebuilt += 1
+                    # drop now-redundant handoff copies of this part
+                    for other in self.group.disks:
+                        if other is disk:
+                            continue
+                        try:
+                            other.delete_part(vid, i)
+                        except DiskDown:
+                            continue
+                # META stays only on disks still holding a part
+                held = set()
+                for d in self.group.disks:
+                    try:
+                        if any(d.has_part(vid, i)
+                               for i in range(self.codec.total_parts)):
+                            held.add(d.disk_id)
+                    except DiskDown:
+                        held.add(d.disk_id)  # unknown: keep its META
+                for d in self.group.disks:
+                    if d.disk_id not in held:
+                        try:
+                            d.delete_part(vid, self.META_PART)
+                        except DiskDown:
+                            continue
         return rebuilt

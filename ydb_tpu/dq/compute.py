@@ -222,8 +222,10 @@ class ComputeActor(Actor):
         self._source_iter = None
         self._source_pos = 0          # blocks consumed from sources
         self._source_done = not sources
-        self._aligned: dict[int, set] = {}        # ckpt id -> channels
-        self._post_barrier: dict[int, list] = {}  # buffered post-align
+        self._aligned: dict[int, set] = {}   # ckpt id -> aligned channels
+        self._barrier_of: dict[int, int] = {}  # channel -> pending ckpt
+        # channel -> post-barrier msgs (FIFO; drained with popleft)
+        self._held: dict[int, collections.deque] = {}
         if restore_checkpoint is not None and checkpoint_storage:
             state = checkpoint_storage.load_task(
                 restore_checkpoint, task.task_id)
@@ -259,17 +261,19 @@ class ComputeActor(Actor):
     def _on_channel_data(self, message: ChannelData):
         from ydb_tpu.dq.checkpoint import BARRIER_KEY
 
+        ch = message.channel_id
+        # anything arriving on a channel that already delivered a
+        # barrier for a pending checkpoint belongs to a later epoch:
+        # hold it, in arrival order, until that checkpoint is taken.
+        # Per-channel FIFO keeps multiple in-flight checkpoints
+        # consistent — each release stops at the channel's next barrier.
+        if ch in self._barrier_of:
+            self._held.setdefault(ch, collections.deque()).append(message)
+            return
         payload = message.payload
         if payload is not None and BARRIER_KEY in payload:
-            self._on_barrier(int(payload[BARRIER_KEY]),
-                             message.channel_id)
+            self._register_barrier(int(payload[BARRIER_KEY]), ch)
             return
-        # a block from a channel already aligned for a pending
-        # checkpoint belongs to the NEXT epoch: buffer until snapshot
-        for cid, chans in self._aligned.items():
-            if message.channel_id in chans:
-                self._post_barrier[cid].append(message)
-                return
         self._apply_channel_data(message)
 
     def _apply_channel_data(self, message: ChannelData):
@@ -285,18 +289,20 @@ class ComputeActor(Actor):
 
     # ---- checkpoint protocol ----
 
-    def _on_barrier(self, checkpoint_id: int, channel_id: int):
-        chans = self._aligned.setdefault(checkpoint_id, set())
-        self._post_barrier.setdefault(checkpoint_id, [])
-        chans.add(channel_id)
+    def _register_barrier(self, checkpoint_id: int, channel_id: int):
+        self._barrier_of[channel_id] = checkpoint_id
+        self._aligned.setdefault(checkpoint_id, set()).add(channel_id)
         self._check_alignment()
 
     def _check_alignment(self):
         need = set(self.task.input_channels)
-        for cid in sorted(self._aligned):
-            chans = self._aligned[cid] | self._in_finished
-            if chans >= need:
-                self._take_checkpoint(cid)
+        while self._aligned:
+            # checkpoints must be taken in id order; per-channel FIFO
+            # guarantees the smallest pending id aligns first
+            cid = min(self._aligned)
+            if not (self._aligned[cid] | self._in_finished) >= need:
+                return
+            self._take_checkpoint(cid)
 
     def _take_checkpoint(self, checkpoint_id: int):
         from ydb_tpu.dq.checkpoint import BARRIER_KEY, TaskCheckpointed
@@ -322,10 +328,22 @@ class ComputeActor(Actor):
         if self.coordinator_target is not None:
             self.send(self.coordinator_target,
                       TaskCheckpointed(self.task.task_id, checkpoint_id))
-        buffered = self._post_barrier.pop(checkpoint_id, [])
-        self._aligned.pop(checkpoint_id, None)
-        for msg in buffered:
-            self._apply_channel_data(msg)
+        # release each aligned channel's held messages up to (and
+        # registering) that channel's next barrier, in arrival order
+        chans = self._aligned.pop(checkpoint_id, set())
+        for ch in sorted(chans):
+            if self._barrier_of.get(ch) == checkpoint_id:
+                del self._barrier_of[ch]
+            q = self._held.get(ch, collections.deque())
+            while q:
+                msg = q.popleft()
+                payload = msg.payload
+                if payload is not None and BARRIER_KEY in payload:
+                    self._register_barrier(int(payload[BARRIER_KEY]), ch)
+                    break
+                self._apply_channel_data(msg)
+            if not q:
+                self._held.pop(ch, None)
 
     # ---- source streaming ----
 

@@ -18,6 +18,7 @@ threads (conveyor analog) when overlap matters.
 
 from __future__ import annotations
 
+import bisect
 import os
 import tempfile
 
@@ -40,23 +41,39 @@ class BlobStore:
 
 
 class MemBlobStore(BlobStore):
+    """In-memory store with a sorted key index: ``list(prefix)`` is
+    O(log n + matches), not a full scan — every hot path above this
+    (DSProxy versions, WAL replay ranges, portion listings) leans on
+    prefix listing."""
+
     def __init__(self):
         self._data: dict[str, bytes] = {}
+        self._keys: list[str] = []  # sorted key index
 
     def put(self, blob_id, data):
+        if blob_id not in self._data:
+            bisect.insort(self._keys, blob_id)
         self._data[blob_id] = bytes(data)
 
     def get(self, blob_id):
         return self._data[blob_id]
 
     def delete(self, blob_id):
-        self._data.pop(blob_id, None)
+        if blob_id in self._data:
+            del self._data[blob_id]
+            i = bisect.bisect_left(self._keys, blob_id)
+            if i < len(self._keys) and self._keys[i] == blob_id:
+                self._keys.pop(i)
 
     def exists(self, blob_id):
         return blob_id in self._data
 
     def list(self, prefix=""):
-        return sorted(k for k in self._data if k.startswith(prefix))
+        if not prefix:
+            return list(self._keys)
+        lo = bisect.bisect_left(self._keys, prefix)
+        hi = bisect.bisect_left(self._keys, prefix + "￿")
+        return self._keys[lo:hi]
 
 
 class DirBlobStore(BlobStore):

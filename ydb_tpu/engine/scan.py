@@ -24,10 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu import dtypes
-from ydb_tpu.blocks.block import TableBlock, concat_blocks
+from ydb_tpu.blocks.block import Column, TableBlock, concat_blocks
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.oracle import OracleTable
-from ydb_tpu.ssa import twophase
+from ydb_tpu.ssa import kernels, twophase
 from ydb_tpu.ssa.compiler import compile_program
 from ydb_tpu.ssa.program import Program
 
@@ -74,6 +74,27 @@ class ColumnSource:
                     for m in names if m in self.validity
                 }
             yield TableBlock.from_numpy(arrays, sch, validity, capacity=cap)
+
+
+def merge_blocks_device(blocks: list[TableBlock]) -> TableBlock:
+    """Trace-time concat of blocks (live rows compacted to the front).
+
+    The device twin of ``concat_blocks``: everything stays on the chip —
+    no host round trip, which matters enormously when the device sits
+    behind a network tunnel (each to_numpy costs a full RTT)."""
+    if len(blocks) == 1:
+        return blocks[0]
+    schema = blocks[0].schema
+    live = jnp.concatenate([b.row_mask() for b in blocks])
+    cols = {}
+    for n in schema.names:
+        data = jnp.concatenate([b.columns[n].data for b in blocks])
+        val = jnp.concatenate([b.columns[n].validity for b in blocks])
+        cols[n] = Column(data, val)
+    # live rows sit at each segment's start, not in one prefix: give the
+    # concat full-capacity length so compact's row_mask covers them all
+    blk = TableBlock(cols, jnp.int32(live.shape[0]), schema)
+    return kernels.compact(blk, live)
 
 
 def required_columns(program: Program, schema: dtypes.Schema) -> tuple[str, ...]:
@@ -164,9 +185,19 @@ class ScanExecutor:
                 k: jnp.asarray(v) for k, v in self.final.aux.items()
             }
             self.out_schema = self.final.out_schema
+            final_run = self.final.run
+
+            @jax.jit
+            def _finalize(parts, aux):
+                return final_run(merge_blocks_device(list(parts)), aux)
+
+            self._finalize_jit = _finalize
         else:
             self.final = None
             self.out_schema = self.partial.out_schema
+            self._final_aux = {}
+            self._finalize_jit = jax.jit(
+                lambda parts, aux: merge_blocks_device(list(parts)))
 
     def detach(self) -> "ScanExecutor":
         """Drop the source reference: compiled state only. Callers that
@@ -179,12 +210,11 @@ class ScanExecutor:
         return self._partial_jit(block, self._partial_aux)
 
     def finalize(self, partials: list[TableBlock]) -> TableBlock:
-        merged = (
-            partials[0] if len(partials) == 1 else concat_blocks(partials)
-        )
-        if self.final is None:
-            return merged
-        return self._final_jit(merged, self._final_aux)
+        """Merge per-block partial results and run the final program —
+        one jitted device computation end to end."""
+        if self.final is None and len(partials) == 1:
+            return partials[0]
+        return self._finalize_jit(tuple(partials), self._final_aux)
 
     def execute(self) -> OracleTable:
         partials = [

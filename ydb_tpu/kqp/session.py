@@ -563,6 +563,14 @@ class Cluster:
             if isinstance(item.expr, ast.Name) and item.alias and \
                     item.alias != item.expr.column:
                 alias_map[item.alias] = item.expr.column
+            elif (isinstance(item.expr, ast.FuncCall)
+                  and item.expr.name in ("min", "max", "some")
+                  and len(item.expr.args) == 1
+                  and isinstance(item.expr.args[0], ast.Name)
+                  and item.alias):
+                # MIN/MAX/SOME over a string column carry the source
+                # column's dictionary into the output
+                alias_map[item.alias] = item.expr.args[0].column
         entry = (p, alias_map)
         self._plan_cache[sql] = entry
         while len(self._plan_cache) > self._plan_cache_size:

@@ -200,8 +200,38 @@ def group_ids_sorted(
     return gid, n_groups.astype(jnp.int32)
 
 
+#: Below this many groups the one-hot masked reduction beats any scatter:
+#: XLA lowers it to ONE vectorized pass over the rows with the groups on
+#: the lane axis — no serialization, exact in every dtype. This is the
+#: within-block analog of BlockCombineHashed's small-key fast path
+#: (mkql_block_agg.cpp:1637); TPUs have no scatter unit, so "hash table"
+#: becomes "lane-broadcast compare + reduce".
+ONEHOT_GROUP_LIMIT = 512
+
+
+def _onehot_hits(valid_row, gid, num_groups: int):
+    groups = jnp.arange(num_groups, dtype=jnp.int32)
+    return (gid[:, None] == groups[None, :]) & valid_row[:, None]
+
+
+def _onehot_reduce(values, valid_row, gid, num_groups: int, fill,
+                   reduce_fn):
+    """Masked (rows x groups) reduction — the shared one-hot fast path."""
+    hit = _onehot_hits(valid_row, gid, num_groups)
+    vals = jnp.where(hit, values[:, None],
+                     jnp.asarray(fill, dtype=values.dtype))
+    return reduce_fn(vals, axis=0)
+
+
 def scatter_first(values: jax.Array, valid_row, gid, num_groups: int):
     """Per-group 'some' value: any valid row's value wins (scatter, drop OOB)."""
+    if num_groups <= ONEHOT_GROUP_LIMIT and values.ndim == 1:
+        n = values.shape[0]
+        rows = jnp.arange(n, dtype=jnp.int32)
+        hit = _onehot_hits(valid_row, gid, num_groups)
+        first = jnp.min(jnp.where(hit, rows[:, None], n), axis=0)
+        return jnp.where(first < n, values[jnp.minimum(first, n - 1)],
+                         jnp.zeros((), dtype=values.dtype))
     idx = jnp.where(valid_row, gid, num_groups)
     out = jnp.zeros((num_groups,) + values.shape[1:], dtype=values.dtype)
     return out.at[idx].set(values, mode="drop")
@@ -209,8 +239,11 @@ def scatter_first(values: jax.Array, valid_row, gid, num_groups: int):
 
 def scatter_sum(values, valid_row, gid, num_groups: int, dtype=None):
     dtype = dtype or values.dtype
-    # TPU fast path: one-hot reduction kernel instead of a serialized
-    # scatter (ydb_tpu/ssa/pallas_kernels.py); exact-dtype gated
+    if num_groups <= ONEHOT_GROUP_LIMIT:
+        return _onehot_reduce(values.astype(dtype), valid_row, gid,
+                              num_groups, 0, jnp.sum)
+    # larger group counts: one-hot tile kernel when eligible
+    # (ydb_tpu/ssa/pallas_kernels.py), else the XLA scatter
     from ydb_tpu.ssa import pallas_kernels
 
     if pallas_kernels.enabled() and pallas_kernels.supported(
@@ -223,15 +256,21 @@ def scatter_sum(values, valid_row, gid, num_groups: int, dtype=None):
 
 
 def scatter_min(values, valid_row, gid, num_groups: int):
-    idx = jnp.where(valid_row, gid, num_groups)
     init = _extreme(values.dtype, maximum=True)
+    if num_groups <= ONEHOT_GROUP_LIMIT:
+        return _onehot_reduce(values, valid_row, gid, num_groups, init,
+                              jnp.min)
+    idx = jnp.where(valid_row, gid, num_groups)
     out = jnp.full((num_groups,), init, dtype=values.dtype)
     return out.at[idx].min(values, mode="drop")
 
 
 def scatter_max(values, valid_row, gid, num_groups: int):
-    idx = jnp.where(valid_row, gid, num_groups)
     init = _extreme(values.dtype, maximum=False)
+    if num_groups <= ONEHOT_GROUP_LIMIT:
+        return _onehot_reduce(values, valid_row, gid, num_groups, init,
+                              jnp.max)
+    idx = jnp.where(valid_row, gid, num_groups)
     out = jnp.full((num_groups,), init, dtype=values.dtype)
     return out.at[idx].max(values, mode="drop")
 

@@ -63,6 +63,11 @@ class Transaction:
         pass
 
 
+class FencedError(Exception):
+    """A higher generation has taken over this tablet; the caller is a
+    zombie leader and must stop (blob-barrier analog)."""
+
+
 class TabletExecutor:
     SNAP_EVERY = 64  # commits between automatic checkpoints
 
@@ -106,19 +111,47 @@ class TabletExecutor:
         tx.complete(self)
         return tx
 
+    def _superseded(self) -> bool:
+        """True when the store shows a higher generation has booted —
+        this executor is a fenced-out zombie. Both log and snapshot keys
+        encode the generation, so this is a listing, not blob reads."""
+        for kind in ("log", "snap"):
+            for blob_id in self.store.list(f"{self._prefix()}{kind}/"):
+                g = int(blob_id.rsplit("/", 1)[1].split(".")[0])
+                if g > self.generation:
+                    return True
+        return False
+
     def checkpoint(self) -> None:
+        # A stale leader must never snapshot: its snapshot would bake in
+        # zombie writes past the successor's fence and boot would then
+        # skip the successor's redo records (version <= snapshot
+        # version). Verify we are still the highest generation before
+        # writing or truncating anything.
+        if self._superseded():
+            raise FencedError(
+                f"tablet {self.tablet_id} gen {self.generation} "
+                "superseded; refusing checkpoint")
         snap = {
             "gen": self.generation,
             "version": self.version,
             "log_index": self.log_index,
             "db": self.db.dump(),
         }
-        self.store.put(f"{self._prefix()}snap/{self.version:012d}",
-                       json.dumps(snap).encode())
+        # key carries (gen, version): two generations snapshotting at the
+        # same version must not collide on one blob id
+        self.store.put(
+            f"{self._prefix()}snap/{self.generation:08d}.{self.version:012d}",
+            json.dumps(snap).encode())
         # truncate redo records covered by the snapshot
         for blob_id in self.store.list(f"{self._prefix()}log/"):
             gen, idx = blob_id.rsplit("/", 1)[1].split(".")
             if (int(gen), int(idx)) < (self.generation, self.log_index):
+                self.store.delete(blob_id)
+        # prune superseded snapshots (this one covers them)
+        for blob_id in self.store.list(f"{self._prefix()}snap/"):
+            gen, ver = blob_id.rsplit("/", 1)[1].split(".")
+            if (int(gen), int(ver)) < (self.generation, self.version):
                 self.store.delete(blob_id)
         self._since_snap = 0
 
@@ -128,9 +161,26 @@ class TabletExecutor:
     def boot(cls, tablet_id: str, store: BlobStore) -> "TabletExecutor":
         prefix = f"tablet/{tablet_id}/"
         db, version, log_index, gen = LocalDb(), 0, 0, 0
+        by_gen: dict[int, list] = {}
+        for blob_id in store.list(f"{prefix}log/"):
+            rec = json.loads(store.get(blob_id).decode())
+            g, idx = blob_id.rsplit("/", 1)[1].split(".")
+            by_gen.setdefault(int(g), []).append((int(idx), rec))
+        first_version = {
+            g: min(rec["version"] for _, rec in recs)
+            for g, recs in by_gen.items()
+        }
+        # Snapshot selection applies the same fence as replay: a
+        # snapshot written by generation g whose version reaches at or
+        # past the first version a higher generation wrote was taken by
+        # a fenced-out zombie and has its writes baked in — skip it.
         best_snap, best_key = None, (-1, -1)
         for blob_id in store.list(f"{prefix}snap/"):
             snap = json.loads(store.get(blob_id).decode())
+            fence = min((fv for h, fv in first_version.items()
+                         if h > snap["gen"]), default=None)
+            if fence is not None and snap["version"] >= fence:
+                continue  # zombie-tainted snapshot
             key = (snap["gen"], snap["version"])
             if key > best_key:
                 best_snap, best_key = snap, key
@@ -144,15 +194,6 @@ class TabletExecutor:
         # higher generation wrote — the successor booted without seeing
         # anything past that point, so later g-writes are a fenced-out
         # leader's and must be discarded (the blob-barrier analog).
-        by_gen: dict[int, list] = {}
-        for blob_id in store.list(f"{prefix}log/"):
-            rec = json.loads(store.get(blob_id).decode())
-            g, idx = blob_id.rsplit("/", 1)[1].split(".")
-            by_gen.setdefault(int(g), []).append((int(idx), rec))
-        first_version = {
-            g: min(rec["version"] for _, rec in recs)
-            for g, recs in by_gen.items()
-        }
         for g in sorted(by_gen):
             if g < gen:
                 continue  # pre-snapshot stale generation
