@@ -60,6 +60,9 @@ class ShardConfig:
     # checkpoint every N WAL records
     checkpoint_interval: int = 64
     scan_block_rows: int = 1 << 20
+    # compaction output portions are capped at this many rows so the
+    # streaming reader's working set stays bounded (out-of-core scans)
+    max_portion_rows: int = 1 << 20
 
 
 class ColumnShard:
@@ -72,12 +75,19 @@ class ColumnShard:
         ttl_column: str | None = None,
         config: ShardConfig | None = None,
         dicts: DictionarySet | None = None,
+        upsert: bool = False,
     ):
         self.shard_id = shard_id
         self.schema = schema
         self.store = store
         self.pk_column = pk_column
         self.ttl_column = ttl_column
+        # upsert: PK semantics — a re-written key shadows the old row;
+        # scans merge portions by PK with newest-wins dedup
+        # (plain_reader/iterator/merge.cpp:10 NArrow::NMerger analog)
+        if upsert and not pk_column:
+            raise ValueError("upsert semantics require a pk_column")
+        self.upsert = upsert
         self.config = config or ShardConfig()
         # dicts may be shared table-wide across shards (ids must agree for
         # cross-shard merges); sharing implies single-process ingest
@@ -201,6 +211,19 @@ class ColumnShard:
         return snap
 
     def _add_portion(self, cols, validity, snap, removed=None) -> PortionMeta:
+        # portions are PK-sorted on disk (the reference sorts at
+        # indexation) so scans can K-way merge them without re-sorting;
+        # under upsert, equal keys within one commit collapse last-wins
+        if self.pk_column and self.pk_column in cols and \
+                len(cols[self.pk_column]):
+            pk = cols[self.pk_column]
+            order = np.argsort(pk, kind="stable")
+            if self.upsert:
+                sorted_pk = pk[order]
+                keep = np.r_[sorted_pk[1:] != sorted_pk[:-1], True]
+                order = order[keep]
+            cols = {n: a[order] for n, a in cols.items()}
+            validity = {n: a[order] for n, a in (validity or {}).items()}
         pid = self.next_portion_id
         self.next_portion_id += 1
         blob_id = f"{self.shard_id}/portion/{pid}"
@@ -298,10 +321,17 @@ class ColumnShard:
         self, program: Program, snap: int | None = None,
         key_spaces: dict[str, int] | None = None,
     ) -> OracleTable:
+        """Streamed scan: portion-granular fetch -> (PK merge/dedup) ->
+        fixed-capacity device blocks -> compiled program. Host memory is
+        bounded by the largest PK-overlap cluster, not the table
+        (fetching.h/scanner.h analog; ydb_tpu.engine.reader)."""
+        from ydb_tpu.engine.reader import PortionStreamSource
         from ydb_tpu.engine.scan import execute_scan, required_columns
 
         cols = required_columns(program, self.schema)
-        src = self.source_at(snap, cols)
+        src = PortionStreamSource(
+            self, self.visible_portions(snap), columns=cols
+        )
         return execute_scan(
             program, src, self.config.scan_block_rows, key_spaces
         )
@@ -327,21 +357,68 @@ class ColumnShard:
         return s
 
     def compact(self) -> None:
-        """Merge all visible portions into one, PK-sorted."""
+        """Merge visible portions cluster-by-cluster, PK-sorted, into
+        output portions of at most ``max_portion_rows`` rows.
+
+        Only one PK-overlap cluster is resident at a time (the
+        general_compaction.cpp granule-local pattern), so compaction is
+        as out-of-core as the scan path; under upsert semantics the
+        merge drops shadowed row versions for good.
+        """
+        from ydb_tpu.engine.reader import PortionStreamSource, plan_clusters
+
         metas = self.visible_portions()
         if len(metas) <= 1:
             return
-        cols, valid = self._materialize(metas)
-        if self.pk_column:
-            order = np.argsort(cols[self.pk_column], kind="stable")
-            cols = {n: a[order] for n, a in cols.items()}
-            valid = {n: a[order] for n, a in valid.items()}
+        cap = self.config.max_portion_rows
+        # pack PK-adjacent clusters into jobs of ~cap rows: overlapping
+        # clusters must merge, and runs of small disjoint portions
+        # coalesce into fewer, bigger portions (small-portion merge)
+        jobs: list[list] = []
+        cur: list = []
+        cur_rows = 0
+        for c in plan_clusters(metas, dedup=bool(self.pk_column)):
+            rows = sum(m.num_rows for m in c)
+            if cur and cur_rows + rows > cap:
+                jobs.append(cur)
+                cur, cur_rows = [], 0
+            cur.extend(c)
+            cur_rows += rows
+        if cur:
+            jobs.append(cur)
+        clusters = [
+            job for job in jobs
+            if len(job) > 1 or any(m.num_rows > cap for m in job)
+        ]
+        if not clusters:
+            return  # every portion already compact and bounded
         snap = self._advance_snap()
-        removed = []
-        for m in metas:
-            m.removed_snap = snap
-            removed.append(m.portion_id)
-        self._add_portion(cols, valid, snap, removed=removed)
+        for cluster in clusters:
+            reader = PortionStreamSource(
+                self, cluster, dedup=self.upsert, prefetch=False
+            )
+            cols, valid = reader._load_cluster(cluster, self.schema.names)
+            if self.pk_column and not self.upsert:
+                # dedup path is already PK-ordered; append path is not
+                order = np.argsort(cols[self.pk_column], kind="stable")
+                cols = {n: a[order] for n, a in cols.items()}
+                valid = {n: a[order] for n, a in valid.items()}
+            removed = [m.portion_id for m in cluster]
+            for m in cluster:
+                m.removed_snap = snap
+            total = len(next(iter(cols.values()))) if cols else 0
+            if total == 0:
+                for pid in removed:
+                    self._log({"op": "remove_portion", "snap": snap,
+                               "portion_id": pid})
+                continue
+            for off in range(0, total, cap):
+                hi = min(off + cap, total)
+                chunk_c = {n: a[off:hi] for n, a in cols.items()}
+                chunk_v = {n: a[off:hi] for n, a in valid.items()}
+                self._add_portion(chunk_c, chunk_v, snap,
+                                  removed=removed)
+                removed = []  # tombstones logged once per cluster
 
     def evict_ttl(self, cutoff: int) -> int:
         """Drop rows whose TTL column < cutoff. Returns rows evicted."""

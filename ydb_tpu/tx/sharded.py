@@ -54,11 +54,16 @@ class ShardedTable:
         config: ShardConfig | None = None,
         dicts: DictionarySet | None = None,
         boot: bool = False,
+        upsert: bool = False,
     ):
         self.name = name
         self.schema = schema
         self.coordinator = coordinator
         self.pk_column = pk_column or schema.names[0]
+        # upsert: PK rewrite shadows the old row. Rows route by PK hash,
+        # so one key always lands on one shard and per-shard newest-wins
+        # dedup (engine.reader) is globally correct.
+        self.upsert = upsert
         self.dicts = dicts if dicts is not None else DictionarySet()
         if boot:
             # reboot from the blob store (snapshot + WAL per shard); the
@@ -71,12 +76,14 @@ class ShardedTable:
                 )
                 for i in range(n_shards)
             ]
+            for s in self.shards:
+                s.upsert = upsert
         else:
             self.shards = [
                 ColumnShard(
                     f"{name}/{i}", schema, store,
                     pk_column=self.pk_column, ttl_column=ttl_column,
-                    config=config, dicts=self.dicts,
+                    config=config, dicts=self.dicts, upsert=upsert,
                 )
                 for i in range(n_shards)
             ]
@@ -150,10 +157,14 @@ class ShardedTable:
     ) -> OracleTable:
         """Fan out per shard, merge partials (the DQ scan fan-out shape)."""
         snap = self.coordinator.read_snapshot() if snap is None else snap
+        from ydb_tpu.engine.reader import PortionStreamSource
         from ydb_tpu.engine.scan import required_columns
 
         cols = required_columns(program, self.schema)
-        sources = [s.source_at(snap, cols) for s in self.shards]
+        sources = [
+            PortionStreamSource(s, s.visible_portions(snap), columns=cols)
+            for s in self.shards
+        ]
         ex = ScanExecutor(program, sources[0], block_rows, key_spaces)
         partials = []
         for src in sources:
