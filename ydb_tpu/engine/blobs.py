@@ -30,6 +30,11 @@ class BlobStore:
     def get(self, blob_id: str) -> bytes:
         raise NotImplementedError
 
+    def get_range(self, blob_id: str, off: int, length: int) -> bytes:
+        """Ranged read (the DSProxy TEvGet shift/size analog). Backends
+        that can seek override this; the default slices a full get."""
+        return self.get(blob_id)[off:off + length]
+
     def delete(self, blob_id: str) -> None:
         raise NotImplementedError
 
@@ -103,6 +108,11 @@ class DirBlobStore(BlobStore):
     def get(self, blob_id):
         with open(self._path(blob_id), "rb") as f:
             return f.read()
+
+    def get_range(self, blob_id, off, length):
+        with open(self._path(blob_id), "rb") as f:
+            f.seek(off)
+            return f.read(length)
 
     def delete(self, blob_id):
         try:

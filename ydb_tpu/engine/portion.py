@@ -5,17 +5,23 @@ plus metadata (row count, PK min/max, snapshot) grouped into granules
 (TPortionInfo, engines/portion_info.h; SURVEY.md §2.7). Scans plan by
 intersecting portion PK ranges with the query range at a snapshot.
 
-Here a portion serializes all columns into one npz blob (validity masks
-included for nullable columns); metadata lives in the shard's WAL/snapshot
-(not in the blob), so planning never touches blob storage. Column data is
-the *physical* encoding (dict ids, scaled decimals) — dictionaries are
-table-level state owned by the shard.
+Here a portion serializes into one blob of PK-consecutive row-group
+*chunks* (each chunk an npz of the column slices + validity masks), with
+a JSON header indexing {offset, rows, pk_min, pk_max} per chunk so
+readers can fetch one chunk at a time via ranged gets — the streaming
+K-way merge (ydb_tpu.engine.reader) keeps at most a few chunks per
+portion resident, never a whole portion. Metadata lives in the shard's
+WAL/snapshot (not in the blob), so planning never touches blob storage.
+Column data is the *physical* encoding (dict ids, scaled decimals) —
+dictionaries are table-level state owned by the shard.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import io
+import json
+import struct
 
 import numpy as np
 
@@ -55,27 +61,23 @@ class PortionMeta:
         return PortionMeta(**d)
 
 
-def write_portion_blob(
-    store: BlobStore,
-    blob_id: str,
-    columns: dict[str, np.ndarray],
-    validity: dict[str, np.ndarray] | None = None,
-) -> None:
+PORTION_MAGIC = b"YDBP0001"
+DEFAULT_CHUNK_ROWS = 1 << 16
+
+
+def _pack_chunk(columns, validity, lo, hi) -> bytes:
     buf = io.BytesIO()
-    payload = dict(columns)
+    payload = {n: a[lo:hi] for n, a in columns.items()}
     if validity:
         for name, v in validity.items():
-            payload[f"__valid__{name}"] = v
+            payload[f"__valid__{name}"] = v[lo:hi]
     np.savez(buf, **payload)
-    store.put(blob_id, buf.getvalue())
+    return buf.getvalue()
 
 
-def read_portion_blob(
-    store: BlobStore, blob_id: str
-) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray]]:
-    with np.load(io.BytesIO(store.get(blob_id))) as z:
-        cols = {}
-        valid = {}
+def _unpack_chunk(data: bytes) -> tuple[dict, dict]:
+    with np.load(io.BytesIO(data)) as z:
+        cols, valid = {}, {}
         for name in z.files:
             if name.startswith("__valid__"):
                 valid[name[len("__valid__"):]] = z[name]
@@ -84,7 +86,136 @@ def read_portion_blob(
     return cols, valid
 
 
+def write_portion_blob(
+    store: BlobStore,
+    blob_id: str,
+    columns: dict[str, np.ndarray],
+    validity: dict[str, np.ndarray] | None = None,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    pk_column: str | None = None,
+) -> None:
+    """Serialize columns as a chunk-indexed blob.
+
+    Layout: MAGIC | u64 header_len | header JSON | chunk payloads.
+    Chunks are consecutive row slices; when ``pk_column`` is given (and
+    rows are PK-sorted, which the shard guarantees) each chunk's header
+    entry carries PK bounds so ranged scans can skip whole chunks
+    (reader._chunk_in_range) without fetching them.
+    """
+    n = len(next(iter(columns.values()))) if columns else 0
+    chunks = []
+    payloads = []
+    off = 0
+    for lo in range(0, max(n, 1), chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        if hi <= lo and n > 0:
+            break
+        data = _pack_chunk(columns, validity, lo, hi)
+        entry = {"off": off, "len": len(data), "rows": hi - lo}
+        if pk_column is not None and pk_column in columns and hi > lo:
+            pk = columns[pk_column]
+            if np.issubdtype(pk.dtype, np.integer):
+                entry["pk_min"] = int(pk[lo])
+                entry["pk_max"] = int(pk[hi - 1])
+        chunks.append(entry)
+        payloads.append(data)
+        off += len(data)
+        if n == 0:
+            break
+    header = json.dumps({"chunks": chunks}).encode()
+    blob = b"".join([PORTION_MAGIC, struct.pack("<Q", len(header)),
+                     header] + payloads)
+    store.put(blob_id, blob)
+
+
+class PortionChunkReader:
+    """Chunk-granular reader over one portion blob (ranged gets)."""
+
+    def __init__(self, store: BlobStore, blob_id: str):
+        self.store = store
+        self.blob_id = blob_id
+        head = store.get_range(blob_id, 0, 16)
+        if head[:8] != PORTION_MAGIC:
+            # legacy single-npz blob: treat as one chunk
+            self._legacy = store.get(blob_id)
+            self.chunks = [None]
+            self._base = 0
+            return
+        self._legacy = None
+        (hlen,) = struct.unpack("<Q", head[8:16])
+        header = json.loads(store.get_range(blob_id, 16, hlen).decode())
+        self.chunks = header["chunks"]
+        self._base = 16 + hlen
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.chunks)
+
+    def chunk_meta(self, i: int) -> dict:
+        c = self.chunks[i]
+        return {"rows": None, "pk_min": None, "pk_max": None} \
+            if c is None else c
+
+    def read_chunk(self, i: int) -> tuple[dict, dict]:
+        if self._legacy is not None:
+            return _unpack_chunk(self._legacy)
+        c = self.chunks[i]
+        data = self.store.get_range(
+            self.blob_id, self._base + c["off"], c["len"])
+        return _unpack_chunk(data)
+
+
+def read_portion_blob(
+    store: BlobStore, blob_id: str
+) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray]]:
+    """Whole-portion read: all chunks concatenated."""
+    rd = PortionChunkReader(store, blob_id)
+    parts = [rd.read_chunk(i) for i in range(rd.n_chunks)]
+    if len(parts) == 1:
+        return parts[0]
+    cols = {n: np.concatenate([p[0][n] for p in parts])
+            for n in parts[0][0]}
+    valid_names = set()
+    for p in parts:
+        valid_names.update(p[1])
+    valid = {}
+    for n in valid_names:
+        valid[n] = np.concatenate([
+            p[1].get(n, np.ones(len(next(iter(p[0].values()))), dtype=bool))
+            for p in parts
+        ])
+    return cols, valid
+
+
 def column_stats(arr: np.ndarray) -> tuple[int | None, int | None]:
     if arr.size == 0 or not np.issubdtype(arr.dtype, np.integer):
         return None, None
     return int(arr.min()), int(arr.max())
+
+
+def project_chunk(
+    schema,
+    column_added: dict[str, int],
+    meta: PortionMeta,
+    names,
+    cols_raw: dict[str, np.ndarray],
+    valid_raw: dict[str, np.ndarray],
+) -> tuple[dict, dict]:
+    """Project raw chunk columns to ``names`` with schema-evolution nulls.
+
+    The single home of the rule: a column only reads from portions at
+    least as new as the schema version that (re)added it — DROP then ADD
+    of the same name must not resurrect old bytes; older portions read
+    the column as NULL.
+    """
+    n_rows = len(next(iter(cols_raw.values()))) if cols_raw else 0
+    cols, valid = {}, {}
+    for n in names:
+        if n in cols_raw and meta.schema_version >= column_added.get(n, 1):
+            cols[n] = cols_raw[n]
+            valid[n] = valid_raw.get(
+                n, np.ones(len(cols_raw[n]), dtype=bool))
+        else:
+            cols[n] = np.zeros(n_rows, dtype=schema.field(n).type.physical)
+            valid[n] = np.zeros(n_rows, dtype=bool)
+    return cols, valid

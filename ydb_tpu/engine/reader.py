@@ -5,15 +5,20 @@ reference's scan fetching script + K-way PK merge
 (engines/reader/plain_reader/iterator/fetching.h:12, scanner.h:69,
 merge.cpp:10 NArrow::NMerger):
 
-  * portions are planned into **clusters** by PK-range overlap; only a
-    cluster is ever resident at once, so host memory is bounded by the
-    largest cluster (compaction keeps clusters small), not the table;
-  * within a cluster, rows merge by PK with newest-wins dedup (portions
-    ordered oldest -> newest by commit snapshot; the native
-    ``ydbtpu_kway_merge`` or its numpy twin does the heavy lifting —
+  * portions are planned into **clusters** by PK-range overlap; within a
+    cluster rows merge by PK with newest-wins dedup (portions ordered
+    oldest -> newest by commit snapshot; the native ``ydbtpu_kway_merge``
+    or its numpy twin does the batch merging —
     ydb_tpu/native/src/ydbtpu_native.cpp);
-  * the next cluster's blobs are prefetched on a worker thread while the
-    current one streams to the device (the conveyor-offload pattern,
+  * the merge is **incremental**: each portion blob is chunk-indexed
+    (engine/portion.py) and a per-run cursor keeps only a couple of
+    chunks buffered, so host memory is bounded by
+    O(runs x chunk_rows) even when every portion overlaps every other
+    (uniform-random upserts) — the interval-bounded merge of the
+    reference's TScanHead (plain_reader/iterator/scanner.h:69), not a
+    cluster materialization;
+  * the next payload is prefetched on a worker thread while the current
+    one streams to the device (the conveyor-offload pattern,
     tx/conveyor/service/service.h:73);
   * output blocks all share one fixed capacity, so a single compiled
     program serves the whole stream.
@@ -28,8 +33,55 @@ import numpy as np
 
 from ydb_tpu import dtypes
 from ydb_tpu.blocks.block import TableBlock
-from ydb_tpu.engine.portion import PortionMeta, read_portion_blob
+from ydb_tpu.engine.portion import (
+    PortionChunkReader,
+    PortionMeta,
+    project_chunk,
+    read_portion_blob,
+)
 from ydb_tpu import native
+
+
+def _chunk_in_range(meta: dict, pk_range) -> bool:
+    """Chunk-level PK pruning off the blob header bounds."""
+    if pk_range is None:
+        return True
+    lo, hi = pk_range
+    cmin, cmax = meta.get("pk_min"), meta.get("pk_max")
+    if lo is not None and cmax is not None and cmax < lo:
+        return False
+    if hi is not None and cmin is not None and cmin > hi:
+        return False
+    return True
+
+
+def rechunk(payloads, names, cap: int):
+    """Re-cut a stream of (cols, valid) payloads into exactly-``cap``-row
+    pieces (last piece partial). Shared by the block stream and
+    compaction output cutting."""
+    buf: list[tuple[dict, dict]] = []
+    buf_n = 0
+
+    def flush():
+        return ({m: np.concatenate([b[0][m] for b in buf]) for m in names},
+                {m: np.concatenate([b[1][m] for b in buf]) for m in names})
+
+    for cols, valid in payloads:
+        n = len(next(iter(cols.values()))) if cols else 0
+        off = 0
+        while off < n:
+            take = min(cap - buf_n, n - off)
+            buf.append((
+                {m: cols[m][off:off + take] for m in names},
+                {m: valid[m][off:off + take] for m in names},
+            ))
+            buf_n += take
+            off += take
+            if buf_n == cap:
+                yield flush()
+                buf, buf_n = [], 0
+    if buf_n:
+        yield flush()
 
 
 def plan_clusters(
@@ -67,6 +119,88 @@ def plan_clusters(
     return clusters
 
 
+class _RunCursor:
+    """Chunk-granular cursor over one PK-sorted portion (a merge run).
+
+    Buffers whole chunks; ``pop`` releases merged rows from the front.
+    Schema-evolution nulls match ColumnShard._materialize: a column only
+    reads from portions at least as new as the version that added it.
+    """
+
+    def __init__(self, source: "PortionStreamSource", meta: PortionMeta,
+                 names: tuple[str, ...]):
+        self.source = source
+        self.meta = meta
+        self.names = names
+        self.reader = PortionChunkReader(source.shard.store, meta.blob_id)
+        self.next_chunk = 0
+        self.cols = {n: [] for n in names}   # buffered chunk slices
+        self.valid = {n: [] for n in names}
+        self.pk_buf = np.empty(0, dtype=np.int64)
+
+    @property
+    def done(self) -> bool:
+        return self.next_chunk >= self.reader.n_chunks
+
+    @property
+    def size(self) -> int:
+        return len(self.pk_buf)
+
+    @property
+    def last_pk(self) -> int:
+        return int(self.pk_buf[-1])
+
+    def _read_chunk(self, i: int) -> tuple[dict, dict]:
+        c, v = self.reader.read_chunk(i)
+        self.source.chunks_read += 1
+        shard = self.source.shard
+        return project_chunk(shard.schema, shard.column_added, self.meta,
+                             self.names, c, v)
+
+    def fill_more(self) -> None:
+        """Append the next chunk to the buffer (PK-pruned chunks skip)."""
+        i = self.next_chunk
+        self.next_chunk += 1
+        if not _chunk_in_range(self.reader.chunk_meta(i),
+                               self.source.pk_range):
+            return
+        cols, valid = self._read_chunk(i)
+        for n in self.names:
+            self.cols[n].append(cols[n])
+            self.valid[n].append(valid[n])
+        pk = self.source.shard.pk_column
+        self.pk_buf = np.concatenate([
+            self.pk_buf,
+            np.ascontiguousarray(cols[pk], dtype=np.int64),
+        ])
+
+    def fill(self) -> None:
+        """Ensure the buffer is non-empty (or the run is exhausted)."""
+        while self.size == 0 and not self.done:
+            self.fill_more()
+
+    def take(self, bound: int | None) -> int:
+        """Rows at the buffer front with pk <= bound (all when None)."""
+        if bound is None:
+            return self.size
+        return int(np.searchsorted(self.pk_buf, bound, side="right"))
+
+    def slices(self, k: int) -> tuple[dict, dict]:
+        cat_c = {n: (np.concatenate(a) if len(a) != 1 else a[0])
+                 for n, a in self.cols.items()}
+        cat_v = {n: (np.concatenate(a) if len(a) != 1 else a[0])
+                 for n, a in self.valid.items()}
+        self.cols = {n: [cat_c[n]] for n in self.names}
+        self.valid = {n: [cat_v[n]] for n in self.names}
+        return ({n: cat_c[n][:k] for n in self.names},
+                {n: cat_v[n][:k] for n in self.names})
+
+    def pop(self, k: int) -> None:
+        self.cols = {n: [self.cols[n][0][k:]] for n in self.names}
+        self.valid = {n: [self.valid[n][0][k:]] for n in self.names}
+        self.pk_buf = self.pk_buf[k:]
+
+
 class PortionStreamSource:
     """ColumnSource-compatible streaming reader over shard portions.
 
@@ -82,9 +216,13 @@ class PortionStreamSource:
         columns: tuple[str, ...] | None = None,
         dedup: bool | None = None,
         prefetch: bool = True,
+        pk_range: tuple[int | None, int | None] | None = None,
     ):
         self.shard = shard
         self.metas = list(metas)
+        # chunk-granular PK pruning window (coarse: callers still filter)
+        self.pk_range = pk_range
+        self.chunks_read = 0  # observability: chunk fetches actually done
         names = columns if columns is not None else shard.schema.names
         self.columns_read = tuple(names)
         self.schema = shard.schema.select(self.columns_read)
@@ -106,47 +244,98 @@ class PortionStreamSource:
         """One portion's columns + validity with schema-evolution nulls
         (same semantics as ColumnShard._materialize)."""
         c, v = read_portion_blob(self.shard.store, meta.blob_id)
-        n_rows = len(next(iter(c.values()))) if c else meta.num_rows
-        cols, valid = {}, {}
-        for n in names:
-            if n in c and meta.schema_version >= \
-                    self.shard.column_added.get(n, 1):
-                cols[n] = c[n]
-                valid[n] = v.get(n, np.ones(len(c[n]), dtype=bool))
-            else:
-                cols[n] = np.zeros(
-                    n_rows, dtype=self.shard.schema.field(n).type.physical)
-                valid[n] = np.zeros(n_rows, dtype=bool)
-        return cols, valid
+        return project_chunk(self.shard.schema, self.shard.column_added,
+                             meta, names, c, v)
 
-    def _load_cluster(self, cluster: list[PortionMeta], names):
-        """Materialize ONE cluster, merged + deduped when required."""
+    def _iter_merged(self, cluster: list[PortionMeta], names):
+        """Incremental K-way newest-wins merge over a PK-overlap cluster.
+
+        Yields bounded (cols, valid) payloads in global PK order. At each
+        step the *bound* is the smallest last-buffered PK over unfinished
+        runs: every row with pk <= bound is provably buffered (runs are
+        PK-sorted, and runs still at the bound are extended first), so a
+        batch merge of the <=bound prefixes is final — the incremental
+        analog of the reference's interval merge (scanner.h:69).
+        """
         pk = self.shard.pk_column
-        need_pk = self.dedup and len(cluster) > 0 and pk is not None
         read_names = tuple(names)
-        if need_pk and pk not in read_names:
+        if pk not in read_names:
             read_names = read_names + (pk,)
-        if not (self.dedup and pk is not None):
-            # plain streaming: portions emit in portion order
-            parts = [self._read_portion(m, read_names) for m in cluster]
-            cols = {n: np.concatenate([p[0][n] for p in parts])
-                    for n in read_names} if parts else {}
-            valid = {n: np.concatenate([p[1][n] for p in parts])
-                     for n in read_names} if parts else {}
-            return ({n: cols[n] for n in names},
-                    {n: valid[n] for n in names})
-        # newest-wins merge: runs ordered oldest -> newest
         ordered = sorted(cluster, key=lambda m: (m.commit_snap,
                                                  m.portion_id))
-        parts = [self._read_portion(m, read_names) for m in ordered]
-        runs = [np.ascontiguousarray(p[0][pk], dtype=np.int64)
-                for p in parts]
-        run_idx, row_idx = native.kway_merge(runs, dedup=True)
-        offsets = np.cumsum([0] + [len(r) for r in runs])[:-1]
-        gidx = offsets[run_idx] + row_idx
-        cols = {n: np.concatenate([p[0][n] for p in parts])[gidx]
+        cursors = [_RunCursor(self, m, read_names) for m in ordered]
+        while True:
+            for c in cursors:
+                c.fill()
+            if not any(c.size for c in cursors):
+                return
+            not_done = [c for c in cursors if not c.done]
+            bound = (min(c.last_pk for c in not_done)
+                     if not_done else None)
+            if bound is not None:
+                # duplicates of the bound key may straddle a chunk edge:
+                # extend runs until their buffers pass the bound
+                for c in cursors:
+                    while not c.done and c.last_pk <= bound:
+                        c.fill_more()
+            takes = [c.take(bound) for c in cursors]
+            parts = []
+            runs = []
+            for c, k in zip(cursors, takes):
+                if k == 0:
+                    continue
+                parts.append(c.slices(k))
+                runs.append(c.pk_buf[:k])
+            run_idx, row_idx = native.kway_merge(runs, dedup=True)
+            offsets = np.cumsum([0] + [len(r) for r in runs])[:-1]
+            gidx = offsets[run_idx] + row_idx
+            cols = {n: np.concatenate([p[0][n] for p in parts])[gidx]
+                    for n in names}
+            valid = {n: np.concatenate([p[1][n] for p in parts])[gidx]
+                     for n in names}
+            for c, k in zip(cursors, takes):
+                if k:
+                    c.pop(k)
+            yield cols, valid
+
+    def _iter_plain(self, cluster: list[PortionMeta], names):
+        """No-merge streaming: portion chunks emit in portion order."""
+        for m in cluster:
+            rd = PortionChunkReader(self.shard.store, m.blob_id)
+            for i in range(rd.n_chunks):
+                if not _chunk_in_range(rd.chunk_meta(i), self.pk_range):
+                    continue
+                c, v = rd.read_chunk(i)
+                self.chunks_read += 1
+                yield project_chunk(self.shard.schema,
+                                    self.shard.column_added,
+                                    m, names, c, v)
+
+    def payload_stream(self, clusters, names):
+        """All clusters as a stream of bounded (cols, valid) payloads."""
+        pk = self.shard.pk_column
+        for cl in clusters:
+            if self.dedup and pk is not None and len(cl) > 1:
+                yield from self._iter_merged(cl, names)
+            else:
+                yield from self._iter_plain(cl, names)
+
+    def _load_cluster(self, cluster: list[PortionMeta], names):
+        """Materialize ONE cluster (compaction of bounded jobs; tests).
+        The scan path streams via payload_stream instead."""
+        pk = self.shard.pk_column
+        if self.dedup and pk is not None and len(cluster) > 1:
+            payloads = list(self._iter_merged(cluster, names))
+        else:
+            payloads = list(self._iter_plain(cluster, names))
+        if not payloads:
+            empty_c = {n: np.empty(
+                0, dtype=self.shard.schema.field(n).type.physical)
                 for n in names}
-        valid = {n: np.concatenate([p[1][n] for p in parts])[gidx]
+            return empty_c, {n: np.empty(0, dtype=bool) for n in names}
+        cols = {n: np.concatenate([p[0][n] for p in payloads])
+                for n in names}
+        valid = {n: np.concatenate([p[1][n] for p in payloads])
                  for n in names}
         return cols, valid
 
@@ -162,64 +351,97 @@ class PortionStreamSource:
         sch = self.shard.schema.select(names)
         cap = min(block_rows, max(self.num_rows, 1))
         clusters = plan_clusters(self.metas, self.dedup)
-
-        def gen_rows():
-            """Yield (cols, valid) cluster payloads with 1-deep prefetch."""
-            if not self.prefetch or len(clusters) <= 1:
-                for cl in clusters:
-                    yield self._load_cluster(cl, names)
-                return
-            with concurrent.futures.ThreadPoolExecutor(1) as pool:
-                fut = pool.submit(self._load_cluster, clusters[0], names)
-                for nxt in clusters[1:]:
-                    cur = fut.result()
-                    fut = pool.submit(self._load_cluster, nxt, names)
-                    yield cur
-                yield fut.result()
-
-        # re-chunk cluster payloads into fixed-capacity blocks
-        buf_c: list[dict] = []
-        buf_n = 0
-        emitted = 0
-
-        def make_block(cols, valid):
-            nonlocal emitted
-            emitted += 1
-            if emitted - 1 < start_block:
-                return None  # checkpoint-resume seek: skip cheaply
-            return TableBlock.from_numpy(cols, sch, valid, capacity=cap)
-
-        for cols, valid in gen_rows():
-            n = len(next(iter(cols.values()))) if cols else 0
-            off = 0
-            while off < n:
-                take = min(cap - buf_n, n - off)
-                buf_c.append((
-                    {m: cols[m][off:off + take] for m in names},
-                    {m: valid[m][off:off + take] for m in names},
-                ))
-                buf_n += take
-                off += take
-                if buf_n == cap:
-                    cc = {m: np.concatenate([b[0][m] for b in buf_c])
-                          for m in names}
-                    vv = {m: np.concatenate([b[1][m] for b in buf_c])
-                          for m in names}
-                    blk = make_block(cc, vv)
-                    if blk is not None:
-                        yield blk
-                    buf_c, buf_n = [], 0
-        if buf_n or emitted == 0:
-            cc = {m: (np.concatenate([b[0][m] for b in buf_c]) if buf_c
-                      else np.empty(0, dtype=sch.field(m).type.physical))
-                  for m in names}
-            vv = {m: (np.concatenate([b[1][m] for b in buf_c]) if buf_c
-                      else np.empty(0, dtype=bool))
-                  for m in names}
-            blk = make_block(cc, vv)
-            if blk is not None:
-                yield blk
+        yield from stream_blocks(
+            self.payload_stream(clusters, names), names, sch, cap,
+            start_block=start_block, prefetch=self.prefetch,
+        )
 
     # NOTE deliberately no n_blocks(): with dedup the emitted block count
     # is only known after merging, so any count-based resume arithmetic
     # (DQ checkpoint seek) must count actual emissions, not estimate.
+
+
+def stream_blocks(payloads, names, sch, cap: int,
+                  start_block: int = 0,
+                  prefetch: bool = True) -> Iterator[TableBlock]:
+    """(cols, valid) payload stream -> fixed-capacity TableBlocks, with a
+    1-deep thread prefetch so blob IO + host merge overlap the
+    device-side consumption. Always emits at least one (possibly empty)
+    block: consumers size their compiled programs off the stream."""
+    _SENTINEL = object()
+
+    def gen_rows():
+        if not prefetch:
+            yield from payloads
+            return
+        it = iter(payloads)
+        with concurrent.futures.ThreadPoolExecutor(1) as pool:
+            fut = pool.submit(next, it, _SENTINEL)
+            while True:
+                cur = fut.result()
+                if cur is _SENTINEL:
+                    return
+                fut = pool.submit(next, it, _SENTINEL)
+                yield cur
+
+    emitted = 0
+    for cols, valid in rechunk(gen_rows(), names, cap):
+        emitted += 1
+        if emitted - 1 < start_block:
+            continue  # checkpoint-resume seek: skip cheaply
+        yield TableBlock.from_numpy(cols, sch, valid, capacity=cap)
+    if emitted == 0 and start_block == 0:
+        yield TableBlock.from_numpy(
+            {m: np.empty(0, dtype=sch.field(m).type.physical)
+             for m in names},
+            sch, {m: np.empty(0, dtype=bool) for m in names},
+            capacity=cap)
+
+
+class MultiShardStreamSource:
+    """Streaming ColumnSource over every shard of a sharded table at one
+    snapshot — the SQL path's scan source. Per-shard portion streams
+    (PK-merged + deduped under upsert) concatenate into one
+    fixed-capacity block stream; nothing materializes beyond the merge
+    working set, so SELECTs inherit the same out-of-core bound as direct
+    shard scans (the KQP scan fan-out shape, kqp_scan_executer.cpp)."""
+
+    def __init__(self, shards, schema, dicts, snap=None,
+                 columns: tuple[str, ...] | None = None):
+        names = columns if columns is not None else schema.names
+        self.columns_read = tuple(names)
+        self._base_schema = schema
+        self.schema = schema.select(self.columns_read)
+        self.dicts = dicts
+        self.subs = [
+            PortionStreamSource(s, s.visible_portions(snap),
+                                columns=self.columns_read)
+            for s in shards
+        ]
+
+    @property
+    def num_rows(self) -> int:
+        """Pre-dedup upper bound across all shards."""
+        return sum(sub.num_rows for sub in self.subs)
+
+    @property
+    def chunks_read(self) -> int:
+        return sum(sub.chunks_read for sub in self.subs)
+
+    def blocks(
+        self,
+        block_rows: int,
+        columns: tuple[str, ...] | None = None,
+        start_block: int = 0,
+    ) -> Iterator[TableBlock]:
+        names = columns if columns is not None else self.columns_read
+        sch = self._base_schema.select(names)
+        cap = min(block_rows, max(self.num_rows, 1))
+
+        def payloads():
+            for sub in self.subs:
+                clusters = plan_clusters(sub.metas, sub.dedup)
+                yield from sub.payload_stream(clusters, names)
+
+        yield from stream_blocks(payloads(), names, sch, cap,
+                                 start_block=start_block)

@@ -63,6 +63,10 @@ class ShardConfig:
     # compaction output portions are capped at this many rows so the
     # streaming reader's working set stays bounded (out-of-core scans)
     max_portion_rows: int = 1 << 20
+    # row-group chunk size inside portion blobs: the K-way merge buffers
+    # O(overlapping_portions x chunk_rows) rows, so smaller chunks bound
+    # memory tighter for heavily-overlapping (random-upsert) workloads
+    portion_chunk_rows: int = 1 << 16
 
 
 class ColumnShard:
@@ -106,6 +110,11 @@ class ColumnShard:
         self.snap: int = 0           # last committed snapshot
         self.next_portion_id = 1
         self.portions: dict[int, PortionMeta] = {}
+        # WAL-replay holding pen for staged compaction outputs: they only
+        # activate when the cluster's compact_commit record arrives, so a
+        # crash mid-compaction loses nothing and duplicates nothing
+        self._staged: dict[int, PortionMeta] = {}
+        self._in_compaction = False
         self._insert_buffer: dict[int, dict] = {}  # write_id -> batch
         self._next_write_id = 1
         self._wal_seq = 0
@@ -210,7 +219,8 @@ class ColumnShard:
         self._add_portion(cols, validity, snap)
         return snap
 
-    def _add_portion(self, cols, validity, snap, removed=None) -> PortionMeta:
+    def _add_portion(self, cols, validity, snap, removed=None,
+                     staged=False) -> PortionMeta:
         # portions are PK-sorted on disk (the reference sorts at
         # indexation) so scans can K-way merge them without re-sorting;
         # under upsert, equal keys within one commit collapse last-wins
@@ -227,7 +237,9 @@ class ColumnShard:
         pid = self.next_portion_id
         self.next_portion_id += 1
         blob_id = f"{self.shard_id}/portion/{pid}"
-        write_portion_blob(self.store, blob_id, cols, validity)
+        write_portion_blob(self.store, blob_id, cols, validity,
+                           chunk_rows=self.config.portion_chunk_rows,
+                           pk_column=self.pk_column)
         meta = PortionMeta(
             portion_id=pid,
             blob_id=blob_id,
@@ -240,9 +252,12 @@ class ColumnShard:
         if self.ttl_column and self.ttl_column in cols:
             meta.ttl_min, meta.ttl_max = column_stats(cols[self.ttl_column])
         self.portions[pid] = meta
-        self._log({"op": "add_portion", "meta": meta.to_json(),
-                   "snap": snap, "removed": removed or [],
-                   "dict_delta": self._dict_delta()})
+        rec = {"op": "add_portion", "meta": meta.to_json(),
+               "snap": snap, "removed": removed or [],
+               "dict_delta": self._dict_delta()}
+        if staged:
+            rec["staged"] = True
+        self._log(rec)
         return meta
 
     def _dict_delta(self) -> dict:
@@ -392,33 +407,52 @@ class ColumnShard:
         ]
         if not clusters:
             return  # every portion already compact and bounded
+        from ydb_tpu.engine.reader import rechunk
+
         snap = self._advance_snap()
-        for cluster in clusters:
-            reader = PortionStreamSource(
-                self, cluster, dedup=self.upsert, prefetch=False
-            )
-            cols, valid = reader._load_cluster(cluster, self.schema.names)
-            if self.pk_column and not self.upsert:
-                # dedup path is already PK-ordered; append path is not
-                order = np.argsort(cols[self.pk_column], kind="stable")
-                cols = {n: a[order] for n, a in cols.items()}
-                valid = {n: a[order] for n, a in valid.items()}
-            removed = [m.portion_id for m in cluster]
-            for m in cluster:
-                m.removed_snap = snap
-            total = len(next(iter(cols.values()))) if cols else 0
-            if total == 0:
-                for pid in removed:
-                    self._log({"op": "remove_portion", "snap": snap,
-                               "portion_id": pid})
-                continue
-            for off in range(0, total, cap):
-                hi = min(off + cap, total)
-                chunk_c = {n: a[off:hi] for n, a in cols.items()}
-                chunk_v = {n: a[off:hi] for n, a in valid.items()}
-                self._add_portion(chunk_c, chunk_v, snap,
-                                  removed=removed)
-                removed = []  # tombstones logged once per cluster
+        # output portions are WAL-staged and only activate at the
+        # cluster's compact_commit record, which also carries the removal
+        # tombstones: a crash anywhere mid-stream replays to the exact
+        # pre-compaction state (no lost rows, no duplicates). Checkpoints
+        # are deferred while staged records are in flight.
+        self._in_compaction = True
+        try:
+            for cluster in clusters:
+                reader = PortionStreamSource(
+                    self, cluster, dedup=self.upsert, prefetch=False
+                )
+                names = self.schema.names
+                if self.upsert and self.pk_column:
+                    # streamed merge: payloads arrive globally PK-ordered,
+                    # so output portions of <= cap rows are cut
+                    # incrementally — an all-overlapping cluster never
+                    # materializes
+                    payloads = reader.payload_stream([cluster], names)
+                else:
+                    # append path: job size is bounded by cap (plan
+                    # above), so a host sort of the materialized job is
+                    # fine
+                    cols, valid = reader._load_cluster(cluster, names)
+                    if self.pk_column:
+                        order = np.argsort(cols[self.pk_column],
+                                           kind="stable")
+                        cols = {n: a[order] for n, a in cols.items()}
+                        valid = {n: a[order] for n, a in valid.items()}
+                    payloads = iter([(cols, valid)])
+                added = [
+                    self._add_portion(chunk_c, chunk_v, snap,
+                                      staged=True).portion_id
+                    for chunk_c, chunk_v in rechunk(payloads, names, cap)
+                ]
+                removed = [m.portion_id for m in cluster]
+                for m in cluster:
+                    m.removed_snap = snap
+                self._log({"op": "compact_commit", "snap": snap,
+                           "adds": added, "removed": removed})
+        finally:
+            self._in_compaction = False
+        if self._records_since_checkpoint >= self.config.checkpoint_interval:
+            self.checkpoint()
 
     def evict_ttl(self, cutoff: int) -> int:
         """Drop rows whose TTL column < cutoff. Returns rows evicted."""
@@ -474,7 +508,11 @@ class ColumnShard:
             json.dumps(record).encode(),
         )
         self._records_since_checkpoint += 1
-        if self._records_since_checkpoint >= self.config.checkpoint_interval:
+        if self._records_since_checkpoint >= \
+                self.config.checkpoint_interval and \
+                not self._in_compaction:
+            # a checkpoint between a staged add and its compact_commit
+            # would persist half a compaction; defer until commit
             self.checkpoint()
 
     def checkpoint(self) -> None:
@@ -546,6 +584,11 @@ class ColumnShard:
             shard._replay(rec)
         for col in shard.dicts.columns():
             shard._dict_durable_sizes[col] = len(shard.dicts[col])
+        # orphaned staged outputs = a compaction that never committed:
+        # drop their blobs, the old portions are still fully live
+        for meta in shard._staged.values():
+            store.delete(meta.blob_id)
+        shard._staged = {}
         return shard
 
     def _replay(self, rec: dict) -> None:
@@ -554,7 +597,11 @@ class ColumnShard:
         self.snap = max(self.snap, rec.get("snap", 0))
         if op == "add_portion":
             meta = PortionMeta.from_json(rec["meta"])
-            self.portions[meta.portion_id] = meta
+            if rec.get("staged"):
+                # compaction output: inert until compact_commit arrives
+                self._staged[meta.portion_id] = meta
+            else:
+                self.portions[meta.portion_id] = meta
             self.next_portion_id = max(self.next_portion_id,
                                        meta.portion_id + 1)
             for pid in rec.get("removed", []):
@@ -565,6 +612,14 @@ class ColumnShard:
                     d = self.dicts.for_column(col)
                     for v in values:
                         d.add(v.encode("latin1"))
+        elif op == "compact_commit":
+            for pid in rec["adds"]:
+                meta = self._staged.pop(pid, None)
+                if meta is not None:
+                    self.portions[pid] = meta
+            for pid in rec["removed"]:
+                if pid in self.portions:
+                    self.portions[pid].removed_snap = rec["snap"]
         elif op == "remove_portion":
             pid = rec["portion_id"]
             if pid in self.portions:

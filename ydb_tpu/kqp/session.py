@@ -120,7 +120,10 @@ class Cluster:
             (s.snap for t in self.tables.values() for s in t.shards),
             default=0,
         )
-        self.coordinator = Coordinator(start_step=max_snap)
+        # durable clock: plan-step reservations persist in the store, so
+        # a coordinator reboot resumes past every step it may have issued
+        # even if some shard never saw it (coordinator__plan_step analog)
+        self.coordinator = Coordinator(self.store, start_step=max_snap)
         for t in self.tables.values():
             t.coordinator = self.coordinator
             for s in t.shards:
@@ -190,7 +193,7 @@ class Cluster:
                 name, desc.schema, self.store, self.coordinator,
                 n_shards=desc.n_shards, pk_column=desc.primary_key[0],
                 ttl_column=desc.ttl_column, dicts=self.dicts, boot=boot,
-                config=shard_config,
+                config=shard_config, upsert=desc.upsert,
             )
         t.alter_schema(desc.schema, desc.schema_version, desc.column_added)
         # dict ids must be durable BEFORE any shard WAL references them:
@@ -220,7 +223,7 @@ class Cluster:
         pk = stmt.primary_key or (fields[0].name,)
         opts = dict(stmt.options)
         unknown = set(opts) - {"shards", "store", "ttl_column",
-                               "changefeed"}
+                               "changefeed", "upsert"}
         if unknown:
             raise PlanError(f"unknown WITH option(s): {sorted(unknown)}")
         try:
@@ -239,6 +242,10 @@ class Cluster:
         if "ttl_column" in opts and opts["ttl_column"] not in schema:
             raise PlanError(f"ttl_column {opts['ttl_column']!r} not in "
                             f"schema")
+        upsert = opts.get("upsert", "off") in ("on", "true", "1")
+        if upsert and store_kind != "column":
+            raise PlanError("upsert semantics apply to column tables"
+                            " (row tables always upsert by PK)")
         changefeed = opts.get("changefeed", "off") in ("on", "true", "1")
         if changefeed and store_kind != "row":
             raise PlanError("changefeed requires a row-store table")
@@ -252,6 +259,7 @@ class Cluster:
             store=store_kind,
             ttl_column=opts.get("ttl_column"),
             changefeed=changefeed,
+            upsert=upsert,
         )
         try:
             self.scheme.create_table(desc)
@@ -612,21 +620,13 @@ class _SysLazySources(dict):
         return src
 
 
-def _merge_shard_sources(t: ShardedTable, snap: int) -> ColumnSource:
-    parts = [s.source_at(snap) for s in t.shards]
-    cols = {
-        n: np.concatenate([p.columns[n] for p in parts])
-        for n in t.schema.names
-    }
-    validity = {}
-    for n in t.schema.names:
-        vs = [
-            p.validity[n] if p.validity and n in p.validity
-            else np.ones(len(p.columns[n]), dtype=bool)
-            for p in parts
-        ]
-        validity[n] = np.concatenate(vs)
-    return ColumnSource(cols, t.schema, t.dicts, validity)
+def _merge_shard_sources(t: ShardedTable, snap: int):
+    """Streaming scan source over all shards at a snapshot: SELECTs read
+    through the portion/blob/merge path (engine.reader), never a
+    materialized table — dedup under upsert included."""
+    from ydb_tpu.engine.reader import MultiShardStreamSource
+
+    return MultiShardStreamSource(t.shards, t.schema, t.dicts, snap)
 
 
 def _coerce(value, from_t: dtypes.LogicalType, to_t: dtypes.LogicalType):

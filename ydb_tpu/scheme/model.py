@@ -52,6 +52,10 @@ class TableDescription:
     column_added: dict = dataclasses.field(default_factory=dict)
     # row tables: emit a CDC changefeed topic "<name>_changefeed"
     changefeed: bool = False
+    # column tables: PK upsert semantics (a re-written key shadows the
+    # old row; scans merge by PK newest-wins) — the reference's OLAP
+    # REPLACE/BulkUpsert write model
+    upsert: bool = False
 
     def to_json(self) -> dict:
         return {
@@ -64,6 +68,7 @@ class TableDescription:
             "schema_version": self.schema_version,
             "column_added": dict(self.column_added),
             "changefeed": self.changefeed,
+            "upsert": self.upsert,
         }
 
     @classmethod
@@ -78,4 +83,5 @@ class TableDescription:
             schema_version=d.get("schema_version", 1),
             column_added=dict(d.get("column_added", {})),
             changefeed=d.get("changefeed", False),
+            upsert=d.get("upsert", False),
         )

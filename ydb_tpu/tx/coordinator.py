@@ -19,6 +19,13 @@ process — the coordinator is the single source of global time:
 
 The multi-node version replaces direct calls with the runtime actor shim
 (ydb_tpu.runtime) carrying the same messages.
+
+Durability: the reference coordinator persists planned steps before
+handing them out (tx/coordinator/coordinator__plan_step.cpp); here a
+``Coordinator(store)`` write-ahead-reserves step ranges in the blob store
+(hi-lo allocation: one put per ``reserve`` steps, not per tx), so a
+rebooted coordinator resumes strictly after every step it might ever have
+assigned — shard snapshots stay monotonic across coordinator crashes.
 """
 
 from __future__ import annotations
@@ -46,12 +53,22 @@ class Coordinator:
     committed, so readers never see a torn cross-shard transaction.
     """
 
-    def __init__(self, start_step: int = 0):
+    STEP_KEY = "coordinator/plan_step"
+
+    def __init__(self, store=None, start_step: int = 0, reserve: int = 64):
         self._lock = threading.Lock()
         self._commit_lock = threading.Lock()
+        self._store = store
+        self._reserve = max(1, int(reserve))
+        if store is not None and store.exists(self.STEP_KEY):
+            start_step = max(start_step,
+                             int(store.get(self.STEP_KEY).decode()))
         self._step = start_step
         self._completed = start_step
-        self._next_txid = 1
+        # persisted ceiling: every handed-out step is <= _reserved before
+        # it leaves plan(), so recovery never re-assigns a used step
+        self._reserved = start_step
+        self._next_txid = start_step + 1
 
     @property
     def last_step(self) -> int:
@@ -66,6 +83,10 @@ class Coordinator:
         """Assign (txid, step) for a new transaction."""
         with self._lock:
             self._step += 1
+            if self._store is not None and self._step > self._reserved:
+                self._reserved = self._step + self._reserve - 1
+                self._store.put(self.STEP_KEY,
+                                str(self._reserved).encode())
             txid = self._next_txid
             self._next_txid += 1
             return txid, self._step
