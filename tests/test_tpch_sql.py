@@ -1,0 +1,680 @@
+"""TPC-H q1-q22 through SQL parse -> plan -> device execution,
+verified against independent numpy reference implementations computed
+straight off the generated tables (the canondata pattern,
+ydb/tests/functional/tpc + SURVEY.md §7.1.4 oracle strategy)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from ydb_tpu.plan import Database, execute_plan, to_host
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.sql.parser import parse
+from ydb_tpu.sql.planner import Catalog, plan_select_full
+from ydb_tpu.workload import tpch
+from ydb_tpu.workload.queries import TPCH
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch.TpchData(sf=SF, seed=11)
+
+
+@pytest.fixture(scope="module")
+def db(data):
+    return Database(
+        sources={
+            t: ColumnSource(cols, data.schema(t), data.dicts)
+            for t, cols in data.tables.items()
+        },
+        dicts=data.dicts,
+    )
+
+
+@pytest.fixture(scope="module")
+def catalog(data):
+    return Catalog(
+        schemas={t: data.schema(t) for t in data.tables},
+        primary_keys=dict(tpch.PRIMARY_KEYS),
+        dicts=data.dicts,
+    )
+
+
+def run_q(name, catalog, db):
+    def scalar_exec(plan, t):
+        out = to_host(execute_plan(plan, db))
+        col = out.schema.names[0]
+        v, ok = out.cols[col]
+        assert len(v) == 1, f"scalar subquery returned {len(v)} rows"
+        return v[0].item(), bool(ok[0])
+
+    pq = plan_select_full(parse(TPCH[name]), catalog, scalar_exec)
+    res = to_host(execute_plan(pq.plan, db))
+    res.dicts = db.dicts
+    res.dict_aliases = pq.dict_aliases
+    return res
+
+
+def dec(data, table, col):
+    """Decode a dictionary-encoded string column to a bytes object array."""
+    d = data.dicts[col]
+    vals = np.array(d.values + [b""], dtype=object)
+    return vals[data.tables[table][col]]
+
+
+def col_out(res, name):
+    """Engine output column as float (decimals descaled) or raw array."""
+    v, ok = res.cols[name]
+    t = res.schema.field(name).type
+    if t.is_decimal:
+        return np.asarray(v, dtype=np.float64) / 10.0 ** t.scale
+    return np.asarray(v)
+
+
+def strings_out(res, name):
+    src = getattr(res, "dict_aliases", {}).get(name, name)
+    d = res.dicts[src]
+    return np.array(d.decode(np.asarray(res.cols[name][0])), dtype=object)
+
+
+def _days(s):
+    return tpch._days(s)
+
+
+def pk_map(keys, values):
+    return dict(zip(keys.tolist(), values.tolist()))
+
+
+def gather(mapping, keys, default=None):
+    return np.array([mapping.get(k, default) for k in keys.tolist()])
+
+
+# ---------------- the tests ----------------
+
+
+def test_q1(data, catalog, db):
+    res = run_q("q1", catalog, db)
+    li = data.tables["lineitem"]
+    m = li["l_shipdate"] <= _days("1998-12-01") - 90
+    rf = dec(data, "lineitem", "l_returnflag")[m]
+    ls = dec(data, "lineitem", "l_linestatus")[m]
+    groups = sorted(set(zip(rf.tolist(), ls.tolist())))
+    assert res.num_rows == len(groups)
+    got_rf = strings_out(res, "l_returnflag")
+    got_ls = strings_out(res, "l_linestatus")
+    assert list(zip(got_rf, got_ls)) == groups
+    qty = li["l_quantity"][m]
+    for i, (a, b) in enumerate(groups):
+        g = (rf == a) & (ls == b)
+        np.testing.assert_allclose(
+            col_out(res, "sum_qty")[i], qty[g].sum() / 100, rtol=1e-12)
+        np.testing.assert_allclose(
+            col_out(res, "avg_disc")[i],
+            (li["l_discount"][m][g] / 100).mean(), rtol=1e-12)
+        assert col_out(res, "count_order")[i] == int(g.sum())
+
+
+def test_q2(data, catalog, db):
+    res = run_q("q2", catalog, db)
+    p, s, ps, n, r = (data.tables[t] for t in
+                      ("part", "supplier", "partsupp", "nation", "region"))
+    ptype = dec(data, "part", "p_type")
+    pm = (p["p_size"] == 15) & np.array(
+        [t.endswith(b"BRASS") for t in ptype])
+    eur_regions = {r["r_regionkey"][i] for i in range(len(r["r_regionkey"]))
+                   if dec(data, "region", "r_name")[i] == b"EUROPE"}
+    nat_eur = {n["n_nationkey"][i] for i in range(25)
+               if n["n_regionkey"][i] in eur_regions}
+    supp_nat = pk_map(s["s_suppkey"], s["s_nationkey"])
+    # min supplycost per part over european suppliers
+    best: dict = {}
+    for pk, sk, cost in zip(ps["ps_partkey"].tolist(),
+                            ps["ps_suppkey"].tolist(),
+                            ps["ps_supplycost"].tolist()):
+        if supp_nat[sk] in nat_eur:
+            best[pk] = min(best.get(pk, 1 << 60), cost)
+    want = []
+    sname = dec(data, "supplier", "s_name")
+    nname = dec(data, "nation", "n_name")
+    for pk, sk, cost in zip(ps["ps_partkey"].tolist(),
+                            ps["ps_suppkey"].tolist(),
+                            ps["ps_supplycost"].tolist()):
+        i = pk - 1
+        if not pm[i] or supp_nat[sk] not in nat_eur:
+            continue
+        if cost != best.get(pk):
+            continue
+        si = sk - 1
+        want.append((-s["s_acctbal"][si], nname[supp_nat[sk]],
+                     sname[si], pk))
+    want.sort()
+    want = want[:100]
+    assert res.num_rows == len(want)
+    got = list(zip(-col_out(res, "s_acctbal") * 100,
+                   strings_out(res, "n_name"),
+                   strings_out(res, "s_name"),
+                   col_out(res, "p_partkey")))
+    for g, w in zip(got, want):
+        assert (int(g[0]), g[1], g[2], int(g[3])) == (
+            int(w[0]), w[1], w[2], int(w[3]))
+
+
+def test_q4(data, catalog, db):
+    res = run_q("q4", catalog, db)
+    o = data.tables["orders"]
+    li = data.tables["lineitem"]
+    late = set(li["l_orderkey"][
+        li["l_commitdate"] < li["l_receiptdate"]].tolist())
+    d0 = _days("1993-07-01")
+    d1 = _days("1993-10-01")
+    m = (o["o_orderdate"] >= d0) & (o["o_orderdate"] < d1) & np.isin(
+        o["o_orderkey"], list(late))
+    pri = dec(data, "orders", "o_orderpriority")[m]
+    cnt = collections.Counter(pri.tolist())
+    got = dict(zip(strings_out(res, "o_orderpriority"),
+                   col_out(res, "order_count")))
+    assert {k: int(v) for k, v in got.items()} == dict(cnt)
+    assert list(strings_out(res, "o_orderpriority")) == sorted(cnt)
+
+
+def test_q5(data, catalog, db):
+    res = run_q("q5", catalog, db)
+    c, o, li, s, n, r = (data.tables[t] for t in (
+        "customer", "orders", "lineitem", "supplier", "nation", "region"))
+    asia = {r["r_regionkey"][i] for i in range(5)
+            if dec(data, "region", "r_name")[i] == b"ASIA"}
+    nat_asia = {n["n_nationkey"][i] for i in range(25)
+                if n["n_regionkey"][i] in asia}
+    cust_nat = pk_map(c["c_custkey"], c["c_nationkey"])
+    supp_nat = pk_map(s["s_suppkey"], s["s_nationkey"])
+    d0, d1 = _days("1994-01-01"), _days("1995-01-01")
+    omask = (o["o_orderdate"] >= d0) & (o["o_orderdate"] < d1)
+    order_cust = pk_map(o["o_orderkey"][omask], o["o_custkey"][omask])
+    nname = dec(data, "nation", "n_name")
+    rev = collections.defaultdict(int)
+    for ok_, sk, price, disc in zip(li["l_orderkey"].tolist(),
+                                    li["l_suppkey"].tolist(),
+                                    li["l_extendedprice"].tolist(),
+                                    li["l_discount"].tolist()):
+        ck = order_cust.get(ok_)
+        if ck is None:
+            continue
+        nat = supp_nat[sk]
+        if nat not in nat_asia or cust_nat[ck] != nat:
+            continue
+        rev[nname[nat]] += price * (100 - disc)
+    want = sorted(rev.items(), key=lambda kv: -kv[1])
+    got = list(zip(strings_out(res, "n_name"),
+                   (col_out(res, "revenue") * 1e4).round().astype(np.int64)))
+    assert [w[0] for w in want] == [g[0] for g in got]
+    for (wn, wv), (gn, gv) in zip(want, got):
+        assert wv == int(gv), (wn, wv, int(gv))
+
+
+def test_q7(data, catalog, db):
+    res = run_q("q7", catalog, db)
+    c, o, li, s, n = (data.tables[t] for t in (
+        "customer", "orders", "lineitem", "supplier", "nation"))
+    nname = dec(data, "nation", "n_name")
+    cust_nat = pk_map(c["c_custkey"], c["c_nationkey"])
+    order_cust = pk_map(o["o_orderkey"], o["o_custkey"])
+    supp_nat = pk_map(s["s_suppkey"], s["s_nationkey"])
+    d0, d1 = _days("1995-01-01"), _days("1996-12-31")
+    rev = collections.defaultdict(int)
+    for ok_, sk, sd, price, disc in zip(
+            li["l_orderkey"].tolist(), li["l_suppkey"].tolist(),
+            li["l_shipdate"].tolist(), li["l_extendedprice"].tolist(),
+            li["l_discount"].tolist()):
+        if not (d0 <= sd <= d1):
+            continue
+        sn = nname[supp_nat[sk]]
+        cn = nname[cust_nat[order_cust[ok_]]]
+        if (sn, cn) not in ((b"FRANCE", b"GERMANY"),
+                            (b"GERMANY", b"FRANCE")):
+            continue
+        year = (np.datetime64("1970-01-01") + sd).astype(
+            "datetime64[Y]").astype(int) + 1970
+        rev[(sn, cn, int(year))] += price * (100 - disc)
+    want = sorted(rev.items())
+    got = list(zip(strings_out(res, "supp_nation"),
+                   strings_out(res, "cust_nation"),
+                   col_out(res, "l_year"),
+                   (col_out(res, "revenue") * 1e4).round().astype(np.int64)))
+    assert len(got) == len(want)
+    for (wk, wv), g in zip(want, got):
+        assert wk == (g[0], g[1], int(g[2]))
+        assert wv == int(g[3])
+
+
+def test_q8(data, catalog, db):
+    res = run_q("q8", catalog, db)
+    p, c, o, li, s, n, r = (data.tables[t] for t in (
+        "part", "customer", "orders", "lineitem", "supplier", "nation",
+        "region"))
+    nname = dec(data, "nation", "n_name")
+    america = {r["r_regionkey"][i] for i in range(5)
+               if dec(data, "region", "r_name")[i] == b"AMERICA"}
+    nat_am = {n["n_nationkey"][i] for i in range(25)
+              if n["n_regionkey"][i] in america}
+    steel = {p["p_partkey"][i] for i in range(len(p["p_partkey"]))
+             if dec(data, "part", "p_type")[i] == b"ECONOMY ANODIZED STEEL"}
+    cust_nat = pk_map(c["c_custkey"], c["c_nationkey"])
+    d0, d1 = _days("1995-01-01"), _days("1996-12-31")
+    om = (o["o_orderdate"] >= d0) & (o["o_orderdate"] <= d1)
+    order_cust = pk_map(o["o_orderkey"][om], o["o_custkey"][om])
+    order_date = pk_map(o["o_orderkey"][om], o["o_orderdate"][om])
+    supp_nat = pk_map(s["s_suppkey"], s["s_nationkey"])
+    tot = collections.defaultdict(int)
+    bra = collections.defaultdict(int)
+    for ok_, pk, sk, price, disc in zip(
+            li["l_orderkey"].tolist(), li["l_partkey"].tolist(),
+            li["l_suppkey"].tolist(), li["l_extendedprice"].tolist(),
+            li["l_discount"].tolist()):
+        if pk not in steel or ok_ not in order_cust:
+            continue
+        if cust_nat[order_cust[ok_]] not in nat_am:
+            continue
+        year = (np.datetime64("1970-01-01") + order_date[ok_]).astype(
+            "datetime64[Y]").astype(int) + 1970
+        v = price * (100 - disc)
+        tot[int(year)] += v
+        if nname[supp_nat[sk]] == b"BRAZIL":
+            bra[int(year)] += v
+    want = {y: bra[y] / t for y, t in tot.items() if t}
+    got = dict(zip(col_out(res, "o_year").tolist(),
+                   col_out(res, "mkt_share").tolist()))
+    assert set(got) == set(want)
+    for y in want:
+        np.testing.assert_allclose(got[y], want[y], rtol=1e-9)
+
+
+def test_q9(data, catalog, db):
+    res = run_q("q9", catalog, db)
+    p, li, s, ps, o, n = (data.tables[t] for t in (
+        "part", "lineitem", "supplier", "partsupp", "orders", "nation"))
+    nname = dec(data, "nation", "n_name")
+    green = {p["p_partkey"][i] for i in range(len(p["p_partkey"]))
+             if b"green" in dec(data, "part", "p_name")[i]}
+    supp_nat = pk_map(s["s_suppkey"], s["s_nationkey"])
+    order_date = pk_map(o["o_orderkey"], o["o_orderdate"])
+    ps_cost = {
+        (a, b): c for a, b, c in zip(ps["ps_partkey"].tolist(),
+                                     ps["ps_suppkey"].tolist(),
+                                     ps["ps_supplycost"].tolist())
+    }
+    profit = collections.defaultdict(int)
+    for ok_, pk, sk, qty, price, disc in zip(
+            li["l_orderkey"].tolist(), li["l_partkey"].tolist(),
+            li["l_suppkey"].tolist(), li["l_quantity"].tolist(),
+            li["l_extendedprice"].tolist(), li["l_discount"].tolist()):
+        if pk not in green or (pk, sk) not in ps_cost:
+            continue
+        year = (np.datetime64("1970-01-01") + order_date[ok_]).astype(
+            "datetime64[Y]").astype(int) + 1970
+        amount = price * (100 - disc) - ps_cost[(pk, sk)] * qty
+        profit[(nname[supp_nat[sk]], int(year))] += amount
+    want = sorted(profit.items(), key=lambda kv: (kv[0][0], -kv[0][1]))
+    got = list(zip(strings_out(res, "nation"),
+                   col_out(res, "o_year"),
+                   (col_out(res, "sum_profit") * 1e4).round().astype(
+                       np.int64)))
+    assert len(got) == len(want)
+    for (wk, wv), g in zip(want, got):
+        assert wk == (g[0], int(g[1]))
+        assert wv == int(g[2])
+
+
+def test_q10(data, catalog, db):
+    res = run_q("q10", catalog, db)
+    c, o, li, n = (data.tables[t] for t in (
+        "customer", "orders", "lineitem", "nation"))
+    d0, d1 = _days("1993-10-01"), _days("1994-01-01")
+    om = (o["o_orderdate"] >= d0) & (o["o_orderdate"] < d1)
+    order_cust = pk_map(o["o_orderkey"][om], o["o_custkey"][om])
+    rflag = dec(data, "lineitem", "l_returnflag")
+    rev = collections.defaultdict(int)
+    for i, ok_ in enumerate(li["l_orderkey"].tolist()):
+        if rflag[i] != b"R" or ok_ not in order_cust:
+            continue
+        rev[order_cust[ok_]] += (
+            li["l_extendedprice"][i] * (100 - li["l_discount"][i]))
+    want = sorted(rev.items(), key=lambda kv: (-kv[1], kv[0]))[:20]
+    got = list(zip(col_out(res, "c_custkey").astype(np.int64),
+                   (col_out(res, "revenue") * 1e4).round().astype(np.int64)))
+    assert [(int(a), int(b)) for a, b in got] == want
+    # spot-check the carried customer attributes on the top row
+    if want:
+        ck = want[0][0]
+        i = ck - 1
+        assert strings_out(res, "c_name")[0] == dec(
+            data, "customer", "c_name")[i]
+        assert strings_out(res, "c_phone")[0] == dec(
+            data, "customer", "c_phone")[i]
+
+
+def test_q11(data, catalog, db):
+    res = run_q("q11", catalog, db)
+    ps, s, n = (data.tables[t] for t in ("partsupp", "supplier", "nation"))
+    nname = dec(data, "nation", "n_name")
+    supp_nat = pk_map(s["s_suppkey"], s["s_nationkey"])
+    val = collections.defaultdict(int)
+    total = 0
+    for pk, sk, cost, qty in zip(ps["ps_partkey"].tolist(),
+                                 ps["ps_suppkey"].tolist(),
+                                 ps["ps_supplycost"].tolist(),
+                                 ps["ps_availqty"].tolist()):
+        if nname[supp_nat[sk]] != b"GERMANY":
+            continue
+        val[pk] += cost * qty
+        total += cost * qty
+    cut = total * 0.0001  # exact in integers: v > total/10000
+    want = sorted(((k, v) for k, v in val.items() if v * 10000 > total),
+                  key=lambda kv: -kv[1])
+    got = list(zip(col_out(res, "ps_partkey").astype(np.int64),
+                   (col_out(res, "value") * 100).round().astype(np.int64)))
+    assert len(got) == len(want), (len(got), len(want), cut)
+    assert sorted((int(a), int(b)) for a, b in got) == sorted(want)
+    vv = [b for _, b in got]
+    assert all(vv[i] >= vv[i + 1] for i in range(len(vv) - 1))
+
+
+def test_q12(data, catalog, db):
+    res = run_q("q12", catalog, db)
+    o, li = data.tables["orders"], data.tables["lineitem"]
+    pri = dec(data, "orders", "o_orderpriority")
+    order_pri = pk_map(o["o_orderkey"], np.arange(len(pri)))
+    mode = dec(data, "lineitem", "l_shipmode")
+    d0, d1 = _days("1994-01-01"), _days("1995-01-01")
+    hi = collections.defaultdict(int)
+    lo = collections.defaultdict(int)
+    for i, ok_ in enumerate(li["l_orderkey"].tolist()):
+        if mode[i] not in (b"MAIL", b"SHIP"):
+            continue
+        if not (li["l_commitdate"][i] < li["l_receiptdate"][i]
+                and li["l_shipdate"][i] < li["l_commitdate"][i]
+                and d0 <= li["l_receiptdate"][i] < d1):
+            continue
+        p = pri[order_pri[ok_]]
+        if p in (b"1-URGENT", b"2-HIGH"):
+            hi[mode[i]] += 1
+        else:
+            lo[mode[i]] += 1
+    modes = sorted(set(hi) | set(lo))
+    assert list(strings_out(res, "l_shipmode")) == modes
+    for i, m in enumerate(modes):
+        assert int(col_out(res, "high_line_count")[i]) == hi[m]
+        assert int(col_out(res, "low_line_count")[i]) == lo[m]
+
+
+def test_q13(data, catalog, db):
+    res = run_q("q13", catalog, db)
+    c, o = data.tables["customer"], data.tables["orders"]
+    comments = dec(data, "orders", "o_comment")
+    import re
+
+    rx = re.compile(rb"special.*requests", re.S)
+    cnt = collections.defaultdict(int)
+    for ck, cm in zip(o["o_custkey"].tolist(), comments):
+        if rx.search(cm) is None:
+            cnt[ck] += 1
+    dist = collections.Counter(
+        cnt.get(ck, 0) for ck in c["c_custkey"].tolist())
+    want = sorted(dist.items(), key=lambda kv: (-kv[1], -kv[0]))
+    got = list(zip(col_out(res, "c_count").astype(np.int64),
+                   col_out(res, "custdist").astype(np.int64)))
+    assert [(int(a), int(b)) for a, b in got] == want
+
+
+def test_q14(data, catalog, db):
+    res = run_q("q14", catalog, db)
+    li, p = data.tables["lineitem"], data.tables["part"]
+    ptype = dec(data, "part", "p_type")
+    promo = {p["p_partkey"][i] for i in range(len(ptype))
+             if ptype[i].startswith(b"PROMO")}
+    d0, d1 = _days("1995-09-01"), _days("1995-10-01")
+    m = (li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)
+    tot = promo_rev = 0
+    for i in np.flatnonzero(m):
+        v = li["l_extendedprice"][i] * (100 - li["l_discount"][i])
+        tot += v
+        if li["l_partkey"][i] in promo:
+            promo_rev += v
+    want = 100.0 * (promo_rev / tot)
+    np.testing.assert_allclose(
+        col_out(res, "promo_revenue")[0], want, rtol=1e-9)
+
+
+def test_q15(data, catalog, db):
+    res = run_q("q15", catalog, db)
+    li, s = data.tables["lineitem"], data.tables["supplier"]
+    d0, d1 = _days("1996-01-01"), _days("1996-04-01")
+    rev = collections.defaultdict(int)
+    for i in np.flatnonzero(
+            (li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)):
+        rev[li["l_suppkey"][i].item()] += (
+            li["l_extendedprice"][i] * (100 - li["l_discount"][i]))
+    best = max(rev.values())
+    want = sorted(k for k, v in rev.items() if v == best)
+    got = col_out(res, "s_suppkey").astype(np.int64).tolist()
+    assert got == want
+    np.testing.assert_allclose(
+        col_out(res, "total_revenue"), best / 1e4, rtol=1e-12)
+    assert strings_out(res, "s_name")[0] == dec(
+        data, "supplier", "s_name")[want[0] - 1]
+
+
+def test_q16(data, catalog, db):
+    res = run_q("q16", catalog, db)
+    ps, p, s = (data.tables[t] for t in ("partsupp", "part", "supplier"))
+    brand = dec(data, "part", "p_brand")
+    ptype = dec(data, "part", "p_type")
+    sizes = {49, 14, 23, 45, 19, 3, 36, 9}
+    import re
+
+    bad_supp = {s["s_suppkey"][i] for i in range(len(s["s_suppkey"]))
+                if re.search(rb"Customer.*Complaints",
+                             dec(data, "supplier", "s_comment")[i])}
+    groups = collections.defaultdict(set)
+    for pk, sk in zip(ps["ps_partkey"].tolist(), ps["ps_suppkey"].tolist()):
+        i = pk - 1
+        if brand[i] == b"Brand#45" or ptype[i].startswith(
+                b"MEDIUM POLISHED") or p["p_size"][i] not in sizes:
+            continue
+        if sk in bad_supp:
+            continue
+        groups[(brand[i], ptype[i], int(p["p_size"][i]))].add(sk)
+    want = sorted(((k, len(v)) for k, v in groups.items()),
+                  key=lambda kv: (-kv[1], kv[0]))
+    got = list(zip(strings_out(res, "p_brand"),
+                   strings_out(res, "p_type"),
+                   col_out(res, "p_size").astype(np.int64),
+                   col_out(res, "supplier_cnt").astype(np.int64)))
+    assert len(got) == len(want)
+    for (wk, wc), g in zip(want, got):
+        assert wk == (g[0], g[1], int(g[2]))
+        assert wc == int(g[3])
+
+
+def test_q17(data, catalog, db):
+    res = run_q("q17", catalog, db)
+    li, p = data.tables["lineitem"], data.tables["part"]
+    brand = dec(data, "part", "p_brand")
+    cont = dec(data, "part", "p_container")
+    sel = {p["p_partkey"][i] for i in range(len(brand))
+           if brand[i] == b"Brand#23" and cont[i] == b"MED BOX"}
+    by_part = collections.defaultdict(list)
+    for pk, qty in zip(li["l_partkey"].tolist(), li["l_quantity"].tolist()):
+        by_part[pk].append(qty)
+    total = 0
+    for i in range(len(li["l_partkey"])):
+        pk = li["l_partkey"][i].item()
+        if pk not in sel:
+            continue
+        qs = by_part[pk]
+        avg = (sum(qs) / 100.0) / len(qs)
+        if li["l_quantity"][i] / 100.0 < 0.2 * avg:
+            total += li["l_extendedprice"][i]
+    want = (total / 100.0) / 7.0
+    got = col_out(res, "avg_yearly")[0]
+    if want == 0:
+        assert res.cols["avg_yearly"][1][0] == False or got == 0  # noqa: E712
+    else:
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_q18(data, catalog, db):
+    res = run_q("q18", catalog, db)
+    c, o, li = (data.tables[t] for t in ("customer", "orders", "lineitem"))
+    qty_by_order = collections.defaultdict(int)
+    for ok_, qty in zip(li["l_orderkey"].tolist(),
+                        li["l_quantity"].tolist()):
+        qty_by_order[ok_] += qty
+    big = {k for k, v in qty_by_order.items() if v > 300 * 100}
+    order_cust = pk_map(o["o_orderkey"], o["o_custkey"])
+    order_price = pk_map(o["o_orderkey"], o["o_totalprice"])
+    order_date = pk_map(o["o_orderkey"], o["o_orderdate"])
+    want = sorted(
+        ((-order_price[k], order_date[k], k, order_cust[k],
+          qty_by_order[k]) for k in big),
+    )[:100]
+    got_rows = list(zip(col_out(res, "o_orderkey").astype(np.int64),
+                        col_out(res, "c_custkey").astype(np.int64),
+                        (col_out(res, "total_qty") * 100).round().astype(
+                            np.int64)))
+    assert len(got_rows) == len(want)
+    for w, g in zip(want, got_rows):
+        assert (w[2], w[3], w[4]) == (int(g[0]), int(g[1]), int(g[2]))
+
+
+def test_q19(data, catalog, db):
+    res = run_q("q19", catalog, db)
+    li, p = data.tables["lineitem"], data.tables["part"]
+    brand = dec(data, "part", "p_brand")
+    cont = dec(data, "part", "p_container")
+    mode = dec(data, "lineitem", "l_shipmode")
+    instr = dec(data, "lineitem", "l_shipinstruct")
+    spec = [
+        (b"Brand#12", {b"SM CASE", b"SM BOX", b"SM PACK", b"SM PKG"},
+         100, 1100, 5),
+        (b"Brand#23", {b"MED BAG", b"MED BOX", b"MED PKG", b"MED PACK"},
+         1000, 2000, 10),
+        (b"Brand#34", {b"LG CASE", b"LG BOX", b"LG PACK", b"LG PKG"},
+         2000, 3000, 15),
+    ]
+    total = 0
+    for i in range(len(li["l_partkey"])):
+        if mode[i] not in (b"AIR", b"REG AIR") or \
+                instr[i] != b"DELIVER IN PERSON":
+            continue
+        pk = li["l_partkey"][i].item()
+        j = pk - 1
+        q = li["l_quantity"][i]
+        for b, cs, qlo, qhi, smax in spec:
+            if (brand[j] == b and cont[j] in cs and qlo <= q <= qhi
+                    and 1 <= p["p_size"][j] <= smax):
+                total += li["l_extendedprice"][i] * (
+                    100 - li["l_discount"][i])
+                break
+    got = (col_out(res, "revenue")[0] * 1e4).round()
+    assert int(got) == total
+
+
+def test_q20(data, catalog, db):
+    res = run_q("q20", catalog, db)
+    s, n, ps, p, li = (data.tables[t] for t in (
+        "supplier", "nation", "partsupp", "part", "lineitem"))
+    nname = dec(data, "nation", "n_name")
+    pname = dec(data, "part", "p_name")
+    forest = {p["p_partkey"][i] for i in range(len(pname))
+              if pname[i].startswith(b"forest")}
+    d0, d1 = _days("1994-01-01"), _days("1995-01-01")
+    shipped = collections.defaultdict(int)
+    for i in np.flatnonzero(
+            (li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)):
+        shipped[(li["l_partkey"][i].item(),
+                 li["l_suppkey"][i].item())] += li["l_quantity"][i]
+    good_supp = set()
+    for pk, sk, av in zip(ps["ps_partkey"].tolist(),
+                          ps["ps_suppkey"].tolist(),
+                          ps["ps_availqty"].tolist()):
+        if pk not in forest or (pk, sk) not in shipped:
+            continue
+        # availqty > 0.5 * sum(qty): exact integer compare at scale 3
+        if av * 1000 > 5 * shipped[(pk, sk)]:
+            good_supp.add(sk)
+    supp_nat = pk_map(s["s_suppkey"], s["s_nationkey"])
+    sname = dec(data, "supplier", "s_name")
+    want = sorted(sname[sk - 1] for sk in good_supp
+                  if nname[supp_nat[sk]] == b"CANADA")
+    got = list(strings_out(res, "s_name"))
+    assert got == want
+
+
+def test_q21(data, catalog, db):
+    res = run_q("q21", catalog, db)
+    s, li, o, n = (data.tables[t] for t in (
+        "supplier", "lineitem", "orders", "nation"))
+    nname = dec(data, "nation", "n_name")
+    sname = dec(data, "supplier", "s_name")
+    supp_nat = pk_map(s["s_suppkey"], s["s_nationkey"])
+    status = dec(data, "orders", "o_orderstatus")
+    f_orders = {o["o_orderkey"][i].item() for i in range(len(status))
+                if status[i] == b"F"}
+    by_order = collections.defaultdict(set)
+    late_by_order = collections.defaultdict(set)
+    for ok_, sk, rd, cd in zip(li["l_orderkey"].tolist(),
+                               li["l_suppkey"].tolist(),
+                               li["l_receiptdate"].tolist(),
+                               li["l_commitdate"].tolist()):
+        by_order[ok_].add(sk)
+        if rd > cd:
+            late_by_order[ok_].add(sk)
+    cnt = collections.Counter()
+    for ok_, sk, rd, cd in zip(li["l_orderkey"].tolist(),
+                               li["l_suppkey"].tolist(),
+                               li["l_receiptdate"].tolist(),
+                               li["l_commitdate"].tolist()):
+        if rd <= cd or ok_ not in f_orders:
+            continue
+        if nname[supp_nat[sk]] != b"SAUDI ARABIA":
+            continue
+        if not (by_order[ok_] - {sk}):
+            continue  # no other supplier in the order
+        if late_by_order[ok_] - {sk}:
+            continue  # another supplier was late too
+        cnt[sname[sk - 1]] += 1
+    want = sorted(cnt.items(), key=lambda kv: (-kv[1], kv[0]))[:100]
+    got = list(zip(strings_out(res, "s_name"),
+                   col_out(res, "numwait").astype(np.int64)))
+    assert [(a, int(b)) for a, b in got] == want
+
+
+def test_q22(data, catalog, db):
+    res = run_q("q22", catalog, db)
+    c, o = data.tables["customer"], data.tables["orders"]
+    phones = dec(data, "customer", "c_phone")
+    codes = {b"13", b"31", b"23", b"29", b"30", b"18", b"17"}
+    in_codes = np.array([ph[:2] in codes for ph in phones])
+    pos = in_codes & (c["c_acctbal"] > 0)
+    avg = c["c_acctbal"][pos].astype(np.float64).sum() / int(pos.sum())
+    has_order = set(o["o_custkey"].tolist())
+    out = collections.defaultdict(lambda: [0, 0])
+    for i in np.flatnonzero(in_codes):
+        if c["c_acctbal"][i] / 100.0 <= avg / 100.0:
+            continue
+        if c["c_custkey"][i].item() in has_order:
+            continue
+        cc = phones[i][:2]
+        out[cc][0] += 1
+        out[cc][1] += c["c_acctbal"][i]
+    want = sorted(out.items())
+    got = list(zip(strings_out(res, "cntrycode"),
+                   col_out(res, "numcust").astype(np.int64),
+                   (col_out(res, "totacctbal") * 100).round().astype(
+                       np.int64)))
+    assert len(got) == len(want)
+    for (wk, (wn, wv)), g in zip(want, got):
+        assert (wk, wn, wv) == (g[0], int(g[1]), int(g[2]))

@@ -120,6 +120,11 @@ def run_oracle(
 
 
 def _const_array(c: Const, n: int) -> ColT:
+    if c.value is None:  # typed NULL (CASE without ELSE)
+        return (
+            np.zeros(n, dtype=c.type.physical),
+            np.zeros(n, dtype=bool),
+        )
     return (
         np.full(n, c.value, dtype=c.type.physical),
         np.ones(n, dtype=bool),
@@ -127,10 +132,20 @@ def _const_array(c: Const, n: int) -> ColT:
 
 
 def _eval(expr, cols, types, dicts, n) -> ColT:
+    from ydb_tpu.ssa.program import DictMap
+
     if isinstance(expr, Col):
         return cols[expr.name]
     if isinstance(expr, Const):
         return _const_array(expr, n)
+    if isinstance(expr, DictMap):
+        from ydb_tpu.ssa.compiler import dict_map_table
+
+        d = dicts[expr.column]
+        out_d = dicts.for_column(expr.out_column)
+        table = dict_map_table(d, out_d, expr.kind, expr.args)
+        ids, ok = cols[expr.column]
+        return table[np.clip(ids, 0, len(table) - 1)], ok.copy()
     if isinstance(expr, DictPredicate):
         d = dicts[expr.column]
         ids, ok = cols[expr.column]
@@ -189,9 +204,30 @@ def _align_dec(op, args, ts):
     return out
 
 
+def _descale_mixed_np(args, ts):
+    """decimal op float -> both float (matches compiler._descale_mixed)."""
+    if len(ts) != 2:
+        return args, ts
+    a, b = ts
+    if not ((a.is_decimal and b.is_floating)
+            or (b.is_decimal and a.is_floating)):
+        return args, ts
+    out = list(args)
+    t_out = list(ts)
+    for i, t in enumerate(ts):
+        if t.is_decimal:
+            v, ok = out[i]
+            out[i] = (v.astype(np.float64) / 10.0 ** t.scale, ok)
+            t_out[i] = dtypes.DOUBLE
+    return out, t_out
+
+
 def _apply_op(op, expr, args, ts, cols, types, dicts, n) -> ColT:
     # decimal MUL multiplies unscaled values (scales add); only additive and
     # comparison ops align operand scales
+    if op in (Op.ADD, Op.SUB, Op.MUL, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT,
+              Op.GE, Op.DIV):
+        args, ts = _descale_mixed_np(args, ts)
     if op in (Op.ADD, Op.SUB, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT,
               Op.GE, Op.MOD):
         args = _align_dec(op, args, ts)

@@ -100,8 +100,8 @@ def merge_blocks_device(blocks: list[TableBlock]) -> TableBlock:
 def required_columns(program: Program, schema: dtypes.Schema) -> tuple[str, ...]:
     """Input columns the program actually reads (scan projection pushdown)."""
     from ydb_tpu.ssa.program import (
-        AssignStep, Call, Col, DictPredicate, FilterStep, GroupByStep,
-        ProjectStep, SortStep,
+        AssignStep, Call, Col, DictMap, DictPredicate, FilterStep,
+        GroupByStep, ProjectStep, SortStep,
     )
 
     used: set[str] = set()
@@ -114,7 +114,7 @@ def required_columns(program: Program, schema: dtypes.Schema) -> tuple[str, ...]
         elif isinstance(e, Call):
             for a in e.args:
                 walk(a)
-        elif isinstance(e, DictPredicate):
+        elif isinstance(e, (DictPredicate, DictMap)):
             if e.column not in assigned:
                 used.add(e.column)
 
@@ -153,7 +153,19 @@ def required_columns(program: Program, schema: dtypes.Schema) -> tuple[str, ...]
 
 
 class ScanExecutor:
-    """Compiles a program against a source and executes block-streamed."""
+    """Compiles a program against a source and executes block-streamed.
+
+    Memory discipline (the TChunksLimiter credit idea,
+    ydb/library/chunks_limiter/chunks_limiter.h:7, re-expressed for XLA's
+    async dispatch): the block loop keeps at most ``inflight_blocks``
+    dispatched-but-unfinished device computations — each in-flight
+    execution pins its input block's buffers, so an unbounded dispatch
+    queue (slow device / starved host) would retain the whole table.
+    Aggregation partials additionally fold incrementally every
+    ``combine_every`` blocks through the associative combine program
+    (twophase.combine_of) whenever the group layout is shape-stable, so
+    the partials list never grows with the table either.
+    """
 
     def __init__(
         self,
@@ -161,9 +173,13 @@ class ScanExecutor:
         source: ColumnSource,
         block_rows: int = DEFAULT_BLOCK_ROWS,
         key_spaces: dict[str, int] | None = None,
+        inflight_blocks: int = 4,
+        combine_every: int = 8,
     ):
         self.source = source
         self.block_rows = block_rows
+        self.inflight_blocks = inflight_blocks
+        self.combine_every = combine_every
         self.read_cols = required_columns(program, source.schema)
         in_schema = source.schema.select(self.read_cols)
         self.partial_prog, self.final_prog = twophase.split(program)
@@ -174,6 +190,27 @@ class ScanExecutor:
         self._partial_aux = {
             k: jnp.asarray(v) for k, v in self.partial.aux.items()
         }
+        self._combine_jit = None
+        self._combine_aux = {}
+        if self.final_prog is not None and self.partial.group_layout[0] in (
+            "keyless", "dense", "dense_slots"
+        ):
+            combine_prog = twophase.combine_of(program)
+            comb = compile_program(
+                combine_prog, self.partial.out_schema, source.dicts,
+                key_spaces,
+                dict_aliases=twophase.dict_aliases(self.partial_prog),
+            )
+            comb_run = comb.run
+
+            @jax.jit
+            def _combine(parts, aux):
+                return comb_run(merge_blocks_device(list(parts)), aux)
+
+            self._combine_jit = _combine
+            self._combine_aux = {
+                k: jnp.asarray(v) for k, v in comb.aux.items()
+            }
         if self.final_prog is not None:
             self.final = compile_program(
                 self.final_prog, self.partial.out_schema, source.dicts,
@@ -216,15 +253,41 @@ class ScanExecutor:
             return partials[0]
         return self._finalize_jit(tuple(partials), self._final_aux)
 
-    def execute(self) -> OracleTable:
-        partials = [
-            self.run_block(b)
-            for b in self.source.blocks(self.block_rows, self.read_cols)
-        ]
+    def run_stream(self, blocks) -> TableBlock:
+        """Drive a block stream with bounded in-flight work; returns the
+        result block (merged partials finalized, or concatenated rows)."""
+        import collections
+
+        window: collections.deque = collections.deque()
+        partials: list[TableBlock] = []
+
+        def admit(out):
+            partials.append(out)
+            window.append(out)
+            if len(window) > self.inflight_blocks:
+                jax.block_until_ready(window.popleft())
+
+        for b in blocks:
+            admit(self.run_block(b))
+            if (
+                self._combine_jit is not None
+                and len(partials) >= self.combine_every
+            ):
+                merged = self._combine_jit(
+                    tuple(partials), self._combine_aux
+                )
+                partials = []
+                admit(merged)
         if self.final is None:
             # pure filter/project program: block outputs concatenate
-            return OracleTable.from_block(concat_blocks(partials))
-        return OracleTable.from_block(self.finalize(partials))
+            return (partials[0] if len(partials) == 1
+                    else concat_blocks(partials))
+        return self.finalize(partials)
+
+    def execute(self) -> OracleTable:
+        return OracleTable.from_block(self.run_stream(
+            self.source.blocks(self.block_rows, self.read_cols)
+        ))
 
 
 def execute_scan(

@@ -117,6 +117,8 @@ class ColumnShard:
         self._in_compaction = False
         self._insert_buffer: dict[int, dict] = {}  # write_id -> batch
         self._next_write_id = 1
+        # compiled-scan cache: (program, key_spaces) -> (executor, sizes)
+        self._scan_cache: dict = {}
         self._wal_seq = 0
         self._records_since_checkpoint = 0
         # per-column dictionary size already made durable; portions carry
@@ -339,17 +341,34 @@ class ColumnShard:
         """Streamed scan: portion-granular fetch -> (PK merge/dedup) ->
         fixed-capacity device blocks -> compiled program. Host memory is
         bounded by the largest PK-overlap cluster, not the table
-        (fetching.h/scanner.h analog; ydb_tpu.engine.reader)."""
+        (fetching.h/scanner.h analog; ydb_tpu.engine.reader).
+
+        Compiled executors cache per (program, key_spaces) — the
+        pattern-cache analog (mkql_computation_pattern_cache.h) — and
+        invalidate when any dictionary grows (plan-time dict tables bake
+        into the compiled aux)."""
         from ydb_tpu.engine.reader import PortionStreamSource
-        from ydb_tpu.engine.scan import execute_scan, required_columns
+        from ydb_tpu.engine.scan import ScanExecutor, required_columns
 
         cols = required_columns(program, self.schema)
         src = PortionStreamSource(
             self, self.visible_portions(snap), columns=cols
         )
-        return execute_scan(
-            program, src, self.config.scan_block_rows, key_spaces
+        key = (program, tuple(sorted((key_spaces or {}).items())))
+        sizes = tuple(
+            (c, len(self.dicts[c])) for c in sorted(self.dicts.columns())
         )
+        hit = self._scan_cache.get(key)
+        if hit is not None and hit[1] == sizes:
+            ex = hit[0]
+        else:
+            ex = ScanExecutor(
+                program, src, self.config.scan_block_rows, key_spaces
+            ).detach()
+            self._scan_cache[key] = (ex, sizes)
+        return OracleTable.from_block(ex.run_stream(
+            src.blocks(self.config.scan_block_rows, ex.read_cols)
+        ))
 
     # ---------------- background: compaction / TTL ----------------
 

@@ -31,7 +31,12 @@ from ydb_tpu.engine.scan import ColumnSource
 from ydb_tpu.plan import Database, execute_plan, to_host
 from ydb_tpu.sql import ast
 from ydb_tpu.sql.parser import parse
-from ydb_tpu.sql.planner import Catalog, PlanError, plan_select
+from ydb_tpu.sql.planner import (
+    Catalog,
+    PlanError,
+    plan_select,
+    plan_select_full,
+)
 from ydb_tpu.tx import Coordinator, ShardedTable
 from ydb_tpu.tx.coordinator import TxResult
 
@@ -563,26 +568,34 @@ class Cluster:
         stmt = parse(sql)
         if not isinstance(stmt, ast.Select):
             return stmt
-        p = plan_select(stmt, self.catalog())
-        # output alias -> source column, for per-result dictionary
-        # binding of aliased string columns (SELECT name AS n)
-        alias_map = {}
-        for item in stmt.items:
-            if isinstance(item.expr, ast.Name) and item.alias and \
-                    item.alias != item.expr.column:
-                alias_map[item.alias] = item.expr.column
-            elif (isinstance(item.expr, ast.FuncCall)
-                  and item.expr.name in ("min", "max", "some")
-                  and len(item.expr.args) == 1
-                  and isinstance(item.expr.args[0], ast.Name)
-                  and item.alias):
-                # MIN/MAX/SOME over a string column carry the source
-                # column's dictionary into the output
-                alias_map[item.alias] = item.expr.args[0].column
-        entry = (p, alias_map)
-        self._plan_cache[sql] = entry
-        while len(self._plan_cache) > self._plan_cache_size:
-            self._plan_cache.popitem(last=False)
+
+        # one snapshot Database for the whole statement: scalar-subquery
+        # precompute and (if any ran) the outer execution read the same
+        # state, preserving statement-level read consistency
+        stmt_db: list = [None]
+
+        def scalar_exec(plan_node, t):
+            # uncorrelated scalar subqueries run eagerly at plan time
+            # (KQP precompute-phase analog)
+            if stmt_db[0] is None:
+                stmt_db[0] = self.snapshot_db(
+                    include_sys=self.flags.enable_sys_views)
+            out = to_host(execute_plan(plan_node, stmt_db[0]))
+            col = out.schema.names[0]
+            v, ok = out.cols[col]
+            if len(v) != 1:
+                raise PlanError(
+                    f"scalar subquery returned {len(v)} rows")
+            return v[0].item(), bool(ok[0])
+
+        pq = plan_select_full(stmt, self.catalog(), scalar_exec)
+        entry = (pq.plan, dict(pq.dict_aliases), stmt_db[0])
+        if not pq.used_scalar_exec:
+            # plans with baked-in subquery results are snapshot-bound:
+            # never serve them from the cache
+            self._plan_cache[sql] = entry
+            while len(self._plan_cache) > self._plan_cache_size:
+                self._plan_cache.popitem(last=False)
         return entry
 
     def result_dicts(self, out_schema, alias_map: dict) -> DictionarySet:
@@ -722,8 +735,10 @@ class Session:
             return self.cluster.update(planned)
         if isinstance(planned, ast.Delete):
             return self.cluster.delete(planned)
-        p, alias_map = planned
-        db = self.cluster.snapshot_db(
+        p, alias_map, plan_db = planned
+        # reuse the plan-time snapshot when scalar subqueries precomputed
+        # against it (statement-level read consistency)
+        db = plan_db if plan_db is not None else self.cluster.snapshot_db(
             include_sys=self.cluster.flags.enable_sys_views)
         out = to_host(execute_plan(p, db))
         out.dicts = self.cluster.result_dicts(out.schema, alias_map)

@@ -58,7 +58,21 @@ def _materialize(source: ColumnSource, columns) -> TableBlock:
     return blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
 
 
-def execute_plan(plan: PlanNode, db: Database) -> TableBlock:
+def execute_plan(plan: PlanNode, db: Database,
+                 _memo: dict | None = None) -> TableBlock:
+    """Bottom-up plan walk. ``_memo`` dedupes shared subtrees (a CTE
+    referenced from several places executes once per statement)."""
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(plan))
+    if hit is not None:
+        return hit
+    out = _execute_node(plan, db, _memo)
+    _memo[id(plan)] = out
+    return out
+
+
+def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
     if isinstance(plan, TableScan):
         src = db.sources[plan.table]
         if plan.program is None:
@@ -71,17 +85,10 @@ def execute_plan(plan: PlanNode, db: Database) -> TableBlock:
                 key_spaces=db.key_spaces,
             ).detach()  # cache compiled state, not the source arrays
             db._compile_cache[key] = ex
-        partials = [
-            ex.run_block(b)
-            for b in src.blocks(1 << 22, ex.read_cols)
-        ]
-        out = ex.finalize(partials) if ex.final is not None else (
-            partials[0] if len(partials) == 1 else concat_blocks(partials)
-        )
-        return out
+        return ex.run_stream(src.blocks(1 << 22, ex.read_cols))
     if isinstance(plan, LookupJoin):
-        probe = execute_plan(plan.probe, db)
-        build = execute_plan(plan.build, db)
+        probe = execute_plan(plan.probe, db, _memo)
+        build = execute_plan(plan.build, db, _memo)
         joined, found = join_kernels.lookup_join(
             probe, build, list(plan.probe_keys), list(plan.build_keys),
             list(plan.payload), plan.suffix,
@@ -96,25 +103,27 @@ def execute_plan(plan: PlanNode, db: Database) -> TableBlock:
             return kernels.compact(probe, ~found & probe.row_mask())
         raise ValueError(plan.kind)
     if isinstance(plan, ExpandJoin):
-        probe = execute_plan(plan.probe, db)
-        build = execute_plan(plan.build, db)
+        probe = execute_plan(plan.probe, db, _memo)
+        build = execute_plan(plan.build, db, _memo)
         cap = max(int(probe.capacity * plan.fanout_hint), 1024)
         while True:
             out, total = join_kernels.expand_join(
                 probe, build, list(plan.probe_keys), list(plan.build_keys),
                 list(plan.probe_payload), list(plan.build_payload),
                 out_capacity=cap, build_suffix=plan.build_suffix,
+                kind=plan.kind,
             )
             if int(total) <= cap:
                 return out
             cap = int(int(total) + 1023) // 1024 * 1024  # exact retry
     if isinstance(plan, Transform):
-        block = execute_plan(plan.input, db)
-        key = (plan.program, block.schema)
+        block = execute_plan(plan.input, db, _memo)
+        key = (plan.program, plan.dict_aliases, block.schema)
         hit = db._compile_cache.get(key)
         if hit is None:
             cp = compile_program(
-                plan.program, block.schema, db.dicts, db.key_spaces
+                plan.program, block.schema, db.dicts, db.key_spaces,
+                dict_aliases=dict(plan.dict_aliases),
             )
             hit = (jax.jit(cp.run),
                    {k: jnp.asarray(v) for k, v in cp.aux.items()})

@@ -40,7 +40,7 @@ class LookupJoin:
 
 @dataclasses.dataclass(frozen=True)
 class ExpandJoin:
-    """N:M inner equi-join via static-capacity expansion."""
+    """N:M equi-join via static-capacity expansion (inner | left)."""
 
     probe: "PlanNode"
     build: "PlanNode"
@@ -50,12 +50,17 @@ class ExpandJoin:
     build_payload: tuple[str, ...]
     fanout_hint: float = 4.0
     build_suffix: str = ""
+    kind: str = "inner"
 
 
 @dataclasses.dataclass(frozen=True)
 class Transform:
     input: "PlanNode"
     program: Program
+    # (renamed_column -> source column) pairs: string columns renamed by
+    # join suffixing / derived-table aliasing still resolve their
+    # dictionaries at compile time
+    dict_aliases: tuple[tuple[str, str], ...] = ()
 
 
 PlanNode = Union[TableScan, LookupJoin, ExpandJoin, Transform]

@@ -47,6 +47,7 @@ class FuncCall:
     name: str
     args: tuple["Expr", ...]
     star: bool = False  # count(*)
+    distinct: bool = False  # count(distinct x)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -83,13 +84,40 @@ class Case:
     else_: "Expr | None"
 
 
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery:
+    """(SELECT single-expr ...) used as a value. Uncorrelated ones execute
+    eagerly at plan time; correlated ones decorrelate into aggregate
+    joins (the DqBuildJoin-style subquery rewrites, kqp_opt_phy)."""
+
+    select: "Select"
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery:
+    expr: "Expr"
+    select: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists:
+    select: "Select"
+    negated: bool = False
+
+
 Expr = Union[Name, Literal, BinOp, UnOp, FuncCall, Between, InList, Like,
-             IsNull, Case]
+             IsNull, Case, ScalarSubquery, InSubquery, Exists]
+
+
+@dataclasses.dataclass(frozen=True)
+class Star:
+    """SELECT * (allowed in EXISTS subqueries and plain selects)."""
 
 
 @dataclasses.dataclass(frozen=True)
 class SelectItem:
-    expr: Expr
+    expr: "Expr | Star"
     alias: str | None
 
 
@@ -100,14 +128,22 @@ class TableRef:
 
 
 @dataclasses.dataclass(frozen=True)
+class SubquerySource:
+    """Derived table: (SELECT ...) AS alias in FROM."""
+
+    select: "Select"
+    alias: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Join:
     left: "FromItem"
-    right: TableRef
+    right: "TableRef | SubquerySource"
     on: Expr | None
     kind: str = "inner"  # inner | left
 
 
-FromItem = Union[TableRef, Join]
+FromItem = Union[TableRef, SubquerySource, Join]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,6 +162,8 @@ class Select:
     order_by: tuple[OrderItem, ...]
     limit: int | None
     distinct: bool = False
+    # WITH name AS (select), ...: CTEs usable as FROM sources downstream
+    ctes: tuple[tuple[str, "Select"], ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -34,7 +34,7 @@ _KEYWORDS = {
     "values", "create", "table", "primary", "key", "case", "when", "then",
     "else", "end", "date", "interval", "true", "false", "distinct",
     "outer", "exists", "cast", "drop", "alter", "add", "column", "with",
-    "update", "set", "delete",
+    "update", "set", "delete", "extract", "substring", "for",
 }
 
 
@@ -113,7 +113,7 @@ class Parser:
     # -- statements --
 
     def parse_statement(self) -> ast.Statement:
-        if self.peek().value == "select":
+        if self.peek().value in ("select", "with"):
             stmt = self.parse_select()
         elif self.peek().value == "insert":
             stmt = self.parse_insert()
@@ -133,6 +133,16 @@ class Parser:
         return stmt
 
     def parse_select(self) -> ast.Select:
+        ctes: list[tuple[str, ast.Select]] = []
+        if self.kw("with"):
+            while True:
+                name = self.expect("name").value
+                self.expect("kw", "as")
+                self.expect("op", "(")
+                ctes.append((name, self.parse_select()))
+                self.expect("op", ")")
+                if not self.accept("op", ","):
+                    break
         self.expect("kw", "select")
         distinct = self.kw("distinct")
         items = [self.parse_select_item()]
@@ -161,9 +171,12 @@ class Parser:
         if self.kw("limit"):
             limit = int(self.expect("number").value)
         return ast.Select(tuple(items), from_, where, group_by, having,
-                          order_by, limit, distinct)
+                          order_by, limit, distinct, tuple(ctes))
 
     def parse_select_item(self) -> ast.SelectItem:
+        if self.peek().kind == "op" and self.peek().value == "*":
+            self.next()
+            return ast.SelectItem(ast.Star(), None)
         expr = self.parse_expr()
         alias = None
         if self.kw("as"):
@@ -197,7 +210,14 @@ class Parser:
                 on = self.parse_expr()
             left = ast.Join(left, right, on, kind)
 
-    def parse_table_ref(self) -> ast.TableRef:
+    def parse_table_ref(self) -> "ast.TableRef | ast.SubquerySource":
+        if self.accept("op", "("):
+            # derived table: ( SELECT ... ) [AS] alias
+            sub = self.parse_select()
+            self.expect("op", ")")
+            self.kw("as")
+            alias = self.expect("name").value
+            return ast.SubquerySource(sub, alias)
         name = self.expect("name").value
         alias = None
         if self.kw("as"):
@@ -397,6 +417,10 @@ class Parser:
         if t.kind == "kw" and t.value == "in":
             self.next()
             self.expect("op", "(")
+            if self.peek().value in ("select", "with"):
+                sub = self.parse_select()
+                self.expect("op", ")")
+                return ast.InSubquery(e, sub, negated)
             items = [self.parse_expr()]
             while self.accept("op", ","):
                 items.append(self.parse_expr())
@@ -446,6 +470,10 @@ class Parser:
         t = self.peek()
         if t.kind == "op" and t.value == "(":
             self.next()
+            if self.peek().value in ("select", "with"):
+                sub = self.parse_select()
+                self.expect("op", ")")
+                return ast.ScalarSubquery(sub)
             e = self.parse_expr()
             self.expect("op", ")")
             return e
@@ -476,6 +504,37 @@ class Parser:
                     "interval",
                     (ast.Literal(s, "string"), ast.Literal(unit, "string")),
                 )
+            if t.value == "exists":
+                self.next()
+                self.expect("op", "(")
+                sub = self.parse_select()
+                self.expect("op", ")")
+                return ast.Exists(sub)
+            if t.value == "extract":
+                # extract(year|month from expr)
+                self.next()
+                self.expect("op", "(")
+                part = self.next().value.lower()
+                self.expect("kw", "from")
+                e = self.parse_expr()
+                self.expect("op", ")")
+                return ast.FuncCall(part, (e,))
+            if t.value == "substring":
+                # substring(x, start, len) | substring(x from start for len)
+                self.next()
+                self.expect("op", "(")
+                e = self.parse_expr()
+                if self.kw("from"):
+                    start = self.parse_expr()
+                    self.expect("kw", "for")
+                    length = self.parse_expr()
+                else:
+                    self.expect("op", ",")
+                    start = self.parse_expr()
+                    self.expect("op", ",")
+                    length = self.parse_expr()
+                self.expect("op", ")")
+                return ast.FuncCall("substring", (e, start, length))
             if t.value == "case":
                 return self.parse_case()
             if t.value == "cast":
@@ -493,13 +552,15 @@ class Parser:
                 if self.accept("op", "*"):
                     self.expect("op", ")")
                     return ast.FuncCall(t.value.lower(), (), star=True)
+                distinct = self.kw("distinct")
                 args = []
                 if not (self.peek().kind == "op" and self.peek().value == ")"):
                     args.append(self.parse_expr())
                     while self.accept("op", ","):
                         args.append(self.parse_expr())
                 self.expect("op", ")")
-                return ast.FuncCall(t.value.lower(), tuple(args))
+                return ast.FuncCall(t.value.lower(), tuple(args),
+                                    distinct=distinct)
             parts = [t.value]
             while self.accept("op", "."):
                 parts.append(self.expect("name").value)

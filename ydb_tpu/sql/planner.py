@@ -1,11 +1,15 @@
-"""SQL AST → logical plan (binding, pushdown, join + aggregate planning).
+"""SQL AST → logical plan (binding, pushdown, join + subquery planning).
 
 The compact analog of the reference's KQP compile pipeline (SURVEY.md
 §3.2): name binding and type derivation (kqp_type_ann), predicate
 pushdown into table scans (the OLAP pushdown shape,
 opt/physical/kqp_opt_phy_olap_filter.cpp), join planning over FK->PK
 lookup joins vs N:M expansion (CBO-lite: keyed on catalog primary keys),
-aggregate/HAVING/ORDER BY lowering into SSA programs, projection naming.
+subquery planning — EXISTS/IN lower to semi/anti joins, correlated
+scalar subqueries decorrelate into aggregate joins, uncorrelated ones
+execute eagerly as a prior phase (the kqp "precompute" phase shape,
+kqp_opt_phy_precompute.cpp) — derived tables / CTEs compose as plan
+subtrees, aggregate/HAVING/ORDER BY lowering into SSA programs.
 
 Output is a ydb_tpu.plan tree; the same tree drives the single-chip and
 mesh executors.
@@ -28,6 +32,7 @@ from ydb_tpu.ssa.program import (
     Call,
     Col,
     Const,
+    DictMap,
     DictPredicate,
     FilterStep,
     GroupByStep,
@@ -60,72 +65,22 @@ class PlanError(Exception):
     pass
 
 
-# ---------------- binding ----------------
-
-
 @dataclasses.dataclass
-class _Binding:
-    """alias -> table; column -> owning alias (unique or qualified)."""
+class PlannedQuery:
+    """A planned SELECT with its statically-derived output description."""
 
-    tables: list[tuple[str, str]]  # (alias, table) in FROM order
-    col_owner: dict[str, str]      # unqualified column -> alias
-    ambiguous: set[str]
-    catalog: Catalog
-
-    def resolve(self, name: ast.Name) -> tuple[str, str]:
-        """-> (alias, column)"""
-        if len(name.parts) == 2:
-            alias, col = name.parts
-            for a, t in self.tables:
-                if a == alias:
-                    if col not in self.catalog.schemas[t]:
-                        raise PlanError(f"no column {col} in {t}")
-                    return a, col
-            raise PlanError(f"unknown table alias {alias}")
-        col = name.parts[0]
-        if col in self.ambiguous:
-            raise PlanError(f"ambiguous column {col}")
-        if col not in self.col_owner:
-            raise PlanError(f"unknown column {col}")
-        return self.col_owner[col], col
-
-    def column_type(self, col: str) -> dtypes.LogicalType:
-        alias = self.col_owner[col]
-        table = dict(self.tables)[alias]
-        return self.catalog.schemas[table].field(col).type
+    plan: object
+    out_names: tuple[str, ...]
+    out_types: dict[str, dtypes.LogicalType]
+    dict_aliases: dict[str, str]  # out column -> dictionary source column
+    unique_key: tuple[str, ...] | None  # cols the output is unique on
+    # True when an uncorrelated scalar subquery was executed eagerly and
+    # its RESULT baked into the plan as a constant: such plans are bound
+    # to the planning-time snapshot and must not be cached across writes
+    used_scalar_exec: bool = False
 
 
-def _flatten_from(f: ast.FromItem) -> tuple[list[ast.TableRef], list]:
-    """-> ([tables in order], [(right_index, on_expr, kind)])"""
-    if isinstance(f, ast.TableRef):
-        return [f], []
-    tables, joins = _flatten_from(f.left)
-    tables.append(f.right)
-    joins.append((len(tables) - 1, f.on, f.kind))
-    return tables, joins
-
-
-def _bind(sel: ast.Select, catalog: Catalog) -> tuple[_Binding, list, list]:
-    if sel.from_ is None:
-        raise PlanError("SELECT without FROM is not supported")
-    refs, join_specs = _flatten_from(sel.from_)
-    tables = []
-    for r in refs:
-        if r.name not in catalog.schemas:
-            raise PlanError(f"unknown table {r.name}")
-        tables.append((r.alias or r.name, r.name))
-    seen: dict[str, str] = {}
-    ambiguous: set[str] = set()
-    for alias, t in tables:
-        for f in catalog.schemas[t].fields:
-            if f.name in seen and seen[f.name] != alias:
-                ambiguous.add(f.name)
-            else:
-                seen[f.name] = alias
-    return _Binding(tables, seen, ambiguous, catalog), refs, join_specs
-
-
-# ---------------- expression lowering ----------------
+# ---------------- helpers ----------------
 
 
 def _conjuncts(e: ast.Expr | None) -> list[ast.Expr]:
@@ -136,466 +91,8 @@ def _conjuncts(e: ast.Expr | None) -> list[ast.Expr]:
     return [e]
 
 
-def _expr_columns(e: ast.Expr, binding: _Binding) -> set[str]:
-    """Aliases of tables referenced by an expression."""
-    out: set[str] = set()
-
-    def walk(x):
-        if isinstance(x, ast.Name):
-            out.add(binding.resolve(x)[0])
-        elif isinstance(x, ast.BinOp):
-            walk(x.left); walk(x.right)
-        elif isinstance(x, ast.UnOp):
-            walk(x.operand)
-        elif isinstance(x, ast.FuncCall):
-            for a in x.args:
-                walk(a)
-        elif isinstance(x, ast.Between):
-            walk(x.expr); walk(x.low); walk(x.high)
-        elif isinstance(x, ast.InList):
-            walk(x.expr)
-            for a in x.items:
-                walk(a)
-        elif isinstance(x, (ast.Like, ast.IsNull)):
-            walk(x.expr)
-        elif isinstance(x, ast.Case):
-            for c, v in x.whens:
-                walk(c); walk(v)
-            if x.else_ is not None:
-                walk(x.else_)
-
-    walk(e)
-    return out
-
-
 def _days(s: str) -> int:
     return int(np.datetime64(s, "D").astype(np.int32))
-
-
-class _Lower:
-    """AST expr -> SSA expr against a column-type environment."""
-
-    def __init__(self, types: dict[str, dtypes.LogicalType],
-                 dicts: DictionarySet | None):
-        self.types = types
-        self.dicts = dicts
-
-    def type_of(self, e) -> dtypes.LogicalType | None:
-        try:
-            return infer_type(e, None, self.types)
-        except Exception:
-            return None
-
-    def lower(self, e: ast.Expr):
-        if isinstance(e, ast.Name):
-            col = e.column
-            if col not in self.types:
-                raise PlanError(f"column {col} not in scope")
-            return Col(col)
-        if isinstance(e, ast.Literal):
-            return self._literal(e)
-        if isinstance(e, ast.UnOp):
-            if e.op == "not":
-                return Call(Op.NOT, self.lower(e.operand))
-            if e.op == "neg":
-                return Call(Op.NEG, self.lower(e.operand))
-            raise PlanError(f"unary {e.op}")
-        if isinstance(e, ast.BinOp):
-            return self._binop(e)
-        if isinstance(e, ast.Between):
-            lo = ast.BinOp("ge", e.expr, e.low)
-            hi = ast.BinOp("le", e.expr, e.high)
-            both = Call(Op.AND, self._binop(lo), self._binop(hi))
-            return Call(Op.NOT, both) if e.negated else both
-        if isinstance(e, ast.InList):
-            return self._in_list(e)
-        if isinstance(e, ast.Like):
-            col = self._string_col(e.expr, "LIKE")
-            p = DictPredicate(col, "like", e.pattern)
-            return Call(Op.NOT, p) if e.negated else p
-        if isinstance(e, ast.IsNull):
-            inner = self.lower(e.expr)
-            return Call(Op.IS_NOT_NULL if e.negated else Op.IS_NULL, inner)
-        if isinstance(e, ast.Case):
-            if e.else_ is None:
-                raise PlanError("CASE without ELSE is not supported yet")
-            out = self.lower(e.else_)
-            for cond, val in reversed(e.whens):
-                out = Call(Op.IF, self.lower(cond), self.lower(val), out)
-            return out
-        if isinstance(e, ast.FuncCall):
-            return self._func(e)
-        raise PlanError(f"cannot lower {e}")
-
-    def _literal(self, e: ast.Literal):
-        if e.kind == "int":
-            return Const(e.value, dtypes.INT64)
-        if e.kind == "decimal":
-            from ydb_tpu.ssa.program import decimal_lit
-
-            scale = len(e.value.split(".")[1]) if "." in e.value else 0
-            return decimal_lit(e.value, scale)
-        if e.kind == "bool":
-            return Const(e.value, dtypes.BOOL)
-        if e.kind == "string":
-            raise PlanError(
-                f"string literal {e.value!r} outside a string comparison"
-            )
-        raise PlanError(f"literal {e.kind}")
-
-    def _string_col(self, e: ast.Expr, what: str) -> str:
-        if isinstance(e, ast.Name) and self.types.get(
-                e.column, dtypes.INT64).is_string:
-            return e.column
-        raise PlanError(f"{what} needs a string column operand")
-
-    def _binop(self, e: ast.BinOp):
-        if e.op in ("and", "or"):
-            return Call(Op.AND if e.op == "and" else Op.OR,
-                        self.lower(e.left), self.lower(e.right))
-        if e.op in _CMP:
-            # string column vs string literal -> dictionary predicate
-            lit_side = col_side = None
-            if isinstance(e.right, ast.Literal) and e.right.kind == "string":
-                col_side, lit_side, op = e.left, e.right, e.op
-            elif isinstance(e.left, ast.Literal) and e.left.kind == "string":
-                col_side, lit_side = e.right, e.left
-                op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(
-                    e.op, e.op)
-            if lit_side is not None:
-                col = self._string_col(col_side, "string comparison")
-                if op == "eq":
-                    return DictPredicate(col, "eq", lit_side.value)
-                if op == "ne":
-                    return DictPredicate(col, "ne", lit_side.value)
-                # ordered string compare: lowered by the compiler via a
-                # plan-time dictionary mask (_custom_dict_mask)
-                if not (self.dicts and col in self.dicts):
-                    raise PlanError(
-                        f"ordered string compare on {col} needs dictionary")
-                val = lit_side.value.encode() if isinstance(
-                    lit_side.value, str) else lit_side.value
-                return DictPredicate(col, "custom", ("ord", op, val))
-            return Call(_CMP[e.op], self.lower(e.left), self.lower(e.right))
-        if e.op in _ARITH:
-            return Call(_ARITH[e.op], self.lower(e.left),
-                        self.lower(e.right))
-        raise PlanError(f"binop {e.op}")
-
-    def _in_list(self, e: ast.InList):
-        if all(isinstance(i, ast.Literal) and i.kind == "string"
-               for i in e.items):
-            col = self._string_col(e.expr, "IN")
-            kind = "not_in_set" if e.negated else "in_set"
-            return DictPredicate(col, kind,
-                                 tuple(i.value for i in e.items))
-        inner = self.lower(e.expr)
-        consts = []
-        for i in e.items:
-            c = self.lower(i)
-            if not isinstance(c, Const):
-                raise PlanError("IN items must be literals")
-            consts.append(c)
-        call = Call(Op.IN_SET, inner, *consts)
-        return Call(Op.NOT, call) if e.negated else call
-
-    def _func(self, e: ast.FuncCall):
-        if e.name in _AGG_FUNCS or (e.name == "count" and e.star):
-            raise PlanError(f"aggregate {e.name} in scalar context")
-        if e.name == "date":
-            return Const(_days(e.args[0].value), dtypes.DATE)
-        if e.name == "interval":
-            n = int(e.args[0].value)
-            unit = e.args[1].value
-            days = {"day": 1, "week": 7}.get(unit)
-            if days is None:
-                raise PlanError(f"interval unit {unit}")
-            return Const(n * days, dtypes.INT32)
-        if e.name in ("year", "month"):
-            op = Op.YEAR if e.name == "year" else Op.MONTH
-            return Call(op, self.lower(e.args[0]))
-        if e.name.startswith("cast_"):
-            target = e.name[5:]
-            op = {"int32": Op.CAST_INT32, "int64": Op.CAST_INT64,
-                  "bigint": Op.CAST_INT64, "float": Op.CAST_FLOAT,
-                  "double": Op.CAST_DOUBLE}.get(target)
-            if op is None:
-                raise PlanError(f"cast to {target}")
-            return Call(op, self.lower(e.args[0]))
-        simple = {"abs": Op.ABS, "sqrt": Op.SQRT, "exp": Op.EXP,
-                  "ln": Op.LN, "floor": Op.FLOOR, "ceil": Op.CEIL,
-                  "round": Op.ROUND, "coalesce": Op.COALESCE}
-        if e.name in simple:
-            return Call(simple[e.name], *[self.lower(a) for a in e.args])
-        raise PlanError(f"unknown function {e.name}")
-
-
-def _contains_agg(e: ast.Expr) -> bool:
-    if isinstance(e, ast.FuncCall):
-        if e.name in _AGG_FUNCS or (e.name == "count" and e.star):
-            return True
-        return any(_contains_agg(a) for a in e.args)
-    if isinstance(e, ast.BinOp):
-        return _contains_agg(e.left) or _contains_agg(e.right)
-    if isinstance(e, ast.UnOp):
-        return _contains_agg(e.operand)
-    if isinstance(e, ast.Between):
-        return any(_contains_agg(x) for x in (e.expr, e.low, e.high))
-    if isinstance(e, (ast.Like, ast.IsNull)):
-        return _contains_agg(e.expr)
-    if isinstance(e, ast.InList):
-        return _contains_agg(e.expr)
-    if isinstance(e, ast.Case):
-        return any(
-            _contains_agg(c) or _contains_agg(v) for c, v in e.whens
-        ) or (e.else_ is not None and _contains_agg(e.else_))
-    return False
-
-
-# ---------------- the planner ----------------
-
-
-def plan_select(sel: ast.Select, catalog: Catalog):
-    binding, refs, join_specs = _bind(sel, catalog)
-    alias_to_table = dict(binding.tables)
-
-    # right sides of LEFT JOINs: WHERE on them filters AFTER the join
-    # (pushing into the scan would keep NULL-extended rows WHERE should
-    # drop), so their single-table conjuncts stay residual
-    left_right_aliases = {
-        binding.tables[idx][0]
-        for idx, _, kind in join_specs if kind == "left"
-    }
-
-    # classify WHERE conjuncts
-    pushdown: dict[str, list[ast.Expr]] = {a: [] for a, _ in binding.tables}
-    join_conds: list[tuple[str, str, str, str]] = []  # (la, lc, ra, rc)
-    residual: list[ast.Expr] = []
-    for c in _conjuncts(sel.where):
-        aliases = _expr_columns(c, binding)
-        if len(aliases) <= 1:
-            target = next(iter(aliases)) if aliases else binding.tables[0][0]
-            if target in left_right_aliases:
-                residual.append(c)
-                continue
-            pushdown[target].append(c)
-        elif (
-            len(aliases) == 2
-            and isinstance(c, ast.BinOp) and c.op == "eq"
-            and isinstance(c.left, ast.Name)
-            and isinstance(c.right, ast.Name)
-        ):
-            la, lc = binding.resolve(c.left)
-            ra, rc = binding.resolve(c.right)
-            if la in left_right_aliases or ra in left_right_aliases:
-                # folding a WHERE equi-cond into a LEFT JOIN's ON would
-                # keep NULL-extended rows that WHERE must drop
-                residual.append(c)
-            else:
-                join_conds.append((la, lc, ra, rc))
-        else:
-            residual.append(c)
-
-    # explicit ON conditions
-    on_conds: dict[int, list[tuple[str, str, str, str]]] = {}
-    for idx, on, kind in join_specs:
-        conds = []
-        for c in _conjuncts(on):
-            if not (isinstance(c, ast.BinOp) and c.op == "eq"
-                    and isinstance(c.left, ast.Name)
-                    and isinstance(c.right, ast.Name)):
-                raise PlanError("JOIN ON supports equi-conditions only")
-            la, lc = binding.resolve(c.left)
-            ra, rc = binding.resolve(c.right)
-            conds.append((la, lc, ra, rc))
-        on_conds[idx] = conds
-
-    # column demand per table: everything referenced anywhere
-    demand: dict[str, set[str]] = {a: set() for a, _ in binding.tables}
-
-    def demand_expr(e):
-        for x in _walk_names(e):
-            a, c = binding.resolve(x)
-            demand[a].add(c)
-
-    out_aliases = {
-        _item_name(item, i) for i, item in enumerate(sel.items)
-    }
-    for item in sel.items:
-        demand_expr(item.expr)
-    for e in sel.group_by:
-        demand_expr(e)
-    for o in sel.order_by:
-        # ORDER BY may reference select aliases, which are not table columns
-        if isinstance(o.expr, ast.Name) and o.expr.parts[-1] in out_aliases:
-            continue
-        demand_expr(o.expr)
-    if sel.having is not None:
-        demand_expr(sel.having)
-    for e in residual:
-        demand_expr(e)
-    for la, lc, ra, rc in join_conds:
-        demand[la].add(lc)
-        demand[ra].add(rc)
-    for conds in on_conds.values():
-        for la, lc, ra, rc in conds:
-            demand[la].add(lc)
-            demand[ra].add(rc)
-
-    # per-table scan with pushdown
-    def scan_for(alias: str) -> TableScan:
-        table = alias_to_table[alias]
-        sch = catalog.schemas[table]
-        types = {f.name: f.type for f in sch.fields}
-        low = _Lower(types, catalog.dicts)
-        steps = []
-        for c in pushdown[alias]:
-            steps.append(FilterStep(low.lower(c)))
-        cols = tuple(
-            n for n in sch.names
-            if n in demand[alias]
-        ) or sch.names[:1]
-        steps.append(ProjectStep(cols))
-        return TableScan(table, Program(tuple(steps)))
-
-    # left-deep join tree in FROM order
-    joined_aliases = [binding.tables[0][0]]
-    plan = scan_for(joined_aliases[0])
-    types: dict[str, dtypes.LogicalType] = {}
-    # joined output columns are keyed by bare name; owner tracks which
-    # alias a carried name actually came from so residual predicates can
-    # reject silent cross-alias mis-resolution on name collisions
-    owner: dict[str, str] = {}
-    a0, t0 = binding.tables[0]
-    for n in demand[a0] or set(catalog.schemas[t0].names[:1]):
-        types[n] = catalog.schemas[t0].field(n).type
-        owner[n] = a0
-
-    pending = join_conds[:]
-    for i in range(1, len(binding.tables)):
-        alias, table = binding.tables[i]
-        # orient every condition (ON or WHERE-derived) as
-        # (joined-side alias/col, new-table alias/col)
-        conds = []
-        for la, lc, ra, rc in on_conds.get(i, []):
-            if ra == alias and la in joined_aliases:
-                conds.append((la, lc, ra, rc))
-            elif la == alias and ra in joined_aliases:
-                conds.append((ra, rc, la, lc))
-            else:
-                raise PlanError(
-                    f"ON condition does not connect {alias} to the joined"
-                    f" tables: {la}.{lc} = {ra}.{rc}"
-                )
-        still = []
-        for la, lc, ra, rc in pending:
-            if ra == alias and la in joined_aliases:
-                conds.append((la, lc, ra, rc))
-            elif la == alias and ra in joined_aliases:
-                conds.append((ra, rc, la, lc))
-            else:
-                still.append((la, lc, ra, rc))
-        pending = still
-        if not conds:
-            raise PlanError(
-                f"no equi-join condition connects {alias}; cross joins are"
-                " not supported"
-            )
-        probe_keys = tuple(lc for la, lc, ra, rc in conds)
-        build_keys = tuple(rc for la, lc, ra, rc in conds)
-        kind = dict((j[0], j[2]) for j in join_specs).get(i, "inner")
-        payload = tuple(
-            n for n in catalog.schemas[table].names
-            if n in demand[alias] and n not in build_keys
-        )
-        # keep join keys when referenced downstream
-        payload += tuple(
-            n for n in build_keys
-            if n in demand[alias] and n not in payload
-            and n not in types  # probe side may already carry same name
-        )
-        pk = catalog.primary_keys.get(table)
-        unique_build = pk is not None and set(pk) <= set(build_keys)
-        if kind == "left" and not unique_build:
-            raise PlanError(
-                f"LEFT JOIN with non-unique build side {table} is not"
-                " supported yet (N:M left expansion)"
-            )
-        if not payload and kind == "inner" and unique_build:
-            # pure filtering join: multiplicity can't change (<=1 match)
-            plan = LookupJoin(plan, scan_for(alias), probe_keys, build_keys,
-                              (), "semi")
-        elif unique_build or kind == "left":
-            plan = LookupJoin(plan, scan_for(alias), probe_keys, build_keys,
-                              payload, kind)
-        else:
-            # non-unique build changes row multiplicity: expand exactly
-            probe_payload = tuple(types.keys())
-            plan = ExpandJoin(plan, scan_for(alias), probe_keys, build_keys,
-                              probe_payload, payload)
-        for n in payload:
-            types[n] = catalog.schemas[table].field(n).type
-            owner[n] = alias
-        joined_aliases.append(alias)
-    if pending:
-        raise PlanError(f"unplaced join conditions {pending}")
-
-    # final transform: residual filters, aggregation, having, order, project
-    if len(binding.tables) > 1:
-        for c in residual:
-            for x in _walk_names(c):
-                a, col = binding.resolve(x)
-                if col not in types or owner.get(col, a) != a:
-                    raise PlanError(
-                        f"predicate references {a}.{col}, which is not"
-                        " carried through the join under that name (name"
-                        " collision with another table); rename the column"
-                    )
-    low = _Lower(types, catalog.dicts)
-    steps: list = []
-    for c in residual:
-        steps.append(FilterStep(low.lower(c)))
-
-    has_agg = any(_contains_agg(i.expr) for i in sel.items) or (
-        sel.having is not None and _contains_agg(sel.having)
-    ) or bool(sel.group_by)
-
-    out_names: list[str] = []
-    if has_agg:
-        if sel.distinct:
-            raise PlanError("SELECT DISTINCT with aggregates is redundant"
-                            " or unsupported; drop DISTINCT")
-        steps, out_names = _plan_aggregate(sel, low, steps, binding)
-    else:
-        for idx, item in enumerate(sel.items):
-            name = _item_name(item, idx)
-            if isinstance(item.expr, ast.Name) and (
-                    item.alias is None
-                    or item.alias == item.expr.column):
-                out_names.append(item.expr.column)
-            else:
-                steps.append(AssignStep(name, low.lower(item.expr)))
-                out_names.append(name)
-        steps.append(ProjectStep(tuple(out_names)))
-        if sel.distinct:
-            # DISTINCT == group by every output column, no aggregates
-            steps.append(GroupByStep(tuple(out_names), ()))
-
-    if sel.order_by:
-        keys = []
-        desc = []
-        for o in sel.order_by:
-            if isinstance(o.expr, ast.Name) and o.expr.parts[-1] in out_names:
-                keys.append(o.expr.parts[-1])
-            else:
-                raise PlanError(
-                    "ORDER BY must reference output columns/aliases")
-            desc.append(o.descending)
-        steps.append(SortStep(tuple(keys), tuple(desc), sel.limit))
-    elif sel.limit is not None:
-        steps.append(SortStep((), (), sel.limit))
-
-    return Transform(plan, Program(tuple(steps)))
 
 
 def _walk_names(e):
@@ -627,6 +124,51 @@ def _walk_names(e):
             yield from _walk_names(e.else_)
 
 
+def _contains_agg(e) -> bool:
+    if isinstance(e, ast.FuncCall):
+        if e.name in _AGG_FUNCS or (e.name == "count" and e.star):
+            return True
+        return any(_contains_agg(a) for a in e.args)
+    if isinstance(e, ast.BinOp):
+        return _contains_agg(e.left) or _contains_agg(e.right)
+    if isinstance(e, ast.UnOp):
+        return _contains_agg(e.operand)
+    if isinstance(e, ast.Between):
+        return any(_contains_agg(x) for x in (e.expr, e.low, e.high))
+    if isinstance(e, (ast.Like, ast.IsNull)):
+        return _contains_agg(e.expr)
+    if isinstance(e, ast.InList):
+        return _contains_agg(e.expr)
+    if isinstance(e, ast.Case):
+        return any(
+            _contains_agg(c) or _contains_agg(v) for c, v in e.whens
+        ) or (e.else_ is not None and _contains_agg(e.else_))
+    return False
+
+
+def _contains_subquery(e) -> bool:
+    if isinstance(e, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
+        return True
+    if isinstance(e, ast.BinOp):
+        return _contains_subquery(e.left) or _contains_subquery(e.right)
+    if isinstance(e, ast.UnOp):
+        return _contains_subquery(e.operand)
+    if isinstance(e, ast.FuncCall):
+        return any(_contains_subquery(a) for a in e.args)
+    if isinstance(e, ast.Between):
+        return any(_contains_subquery(x) for x in (e.expr, e.low, e.high))
+    if isinstance(e, (ast.Like, ast.IsNull)):
+        return _contains_subquery(e.expr)
+    if isinstance(e, ast.InList):
+        return _contains_subquery(e.expr)
+    if isinstance(e, ast.Case):
+        return any(
+            _contains_subquery(c) or _contains_subquery(v)
+            for c, v in e.whens
+        ) or (e.else_ is not None and _contains_subquery(e.else_))
+    return False
+
+
 def _item_name(item: ast.SelectItem, idx: int) -> str:
     if item.alias:
         return item.alias
@@ -635,25 +177,1202 @@ def _item_name(item: ast.SelectItem, idx: int) -> str:
     return f"column{idx}"
 
 
-def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, binding):
-    """Lower GROUP BY + aggregates + HAVING into SSA steps."""
-    # group keys: plain columns stay; computed keys get pre-assigns
+def _try_const_date(e) -> int | None:
+    """Fold date '...' ± interval '...' unit chains to an int day count
+    at plan time (month/year intervals only exist inside such folds —
+    days-since-epoch columns cannot shift by calendar units at runtime)."""
+    if isinstance(e, ast.FuncCall) and e.name == "date":
+        return _days(e.args[0].value)
+    if isinstance(e, ast.BinOp) and e.op in ("add", "sub"):
+        base = _try_const_date(e.left)
+        if base is None:
+            return None
+        iv = e.right
+        if not (isinstance(iv, ast.FuncCall) and iv.name == "interval"):
+            return None
+        n = int(iv.args[0].value)
+        unit = iv.args[1].value
+        if e.op == "sub":
+            n = -n
+        d = np.datetime64("1970-01-01", "D") + base
+        if unit in ("day", "week"):
+            out = d + n * (7 if unit == "week" else 1)
+        elif unit == "month":
+            m = d.astype("datetime64[M]")
+            day_in_month = (d - m.astype("datetime64[D]")).astype(int)
+            out = (m + n).astype("datetime64[D]") + int(day_in_month)
+        elif unit == "year":
+            y = d.astype("datetime64[Y]")
+            day_in_year = (d - y.astype("datetime64[D]")).astype(int)
+            out = (y + n).astype("datetime64[D]") + int(day_in_year)
+        else:
+            return None
+        return int(out.astype("datetime64[D]").astype(np.int32))
+    return None
+
+
+def _strip_decimal_zeros(value: int, scale: int) -> tuple[int, int]:
+    while scale > 0 and value % 10 == 0:
+        value //= 10
+        scale -= 1
+    return value, scale
+
+
+# ---------------- scopes & binding ----------------
+
+
+@dataclasses.dataclass
+class _Scope:
+    """One FROM source: a base table or a planned derived query."""
+
+    alias: str
+    names: tuple[str, ...]
+    types: dict[str, dtypes.LogicalType]
+    dict_src: dict[str, str]       # col -> dictionary source column
+    table: str | None = None       # base table name
+    sub: PlannedQuery | None = None
+    pk: tuple[str, ...] | None = None
+
+
+@dataclasses.dataclass
+class _Binding:
+    scopes: list[_Scope]
+    col_owner: dict[str, str]
+    ambiguous: set[str]
+
+    def scope(self, alias: str) -> _Scope:
+        for s in self.scopes:
+            if s.alias == alias:
+                return s
+        raise PlanError(f"unknown table alias {alias}")
+
+    def resolve(self, name: ast.Name) -> tuple[str, str]:
+        """-> (alias, column)"""
+        if len(name.parts) == 2:
+            alias, col = name.parts
+            s = self.scope(alias)
+            if col not in s.types:
+                raise PlanError(f"no column {col} in {alias}")
+            return alias, col
+        col = name.parts[0]
+        if col in self.ambiguous:
+            raise PlanError(f"ambiguous column {col}")
+        if col not in self.col_owner:
+            raise PlanError(f"unknown column {col}")
+        return self.col_owner[col], col
+
+    def try_resolve(self, name: ast.Name):
+        try:
+            return self.resolve(name)
+        except PlanError:
+            return None
+
+
+def _flatten_from(f: ast.FromItem):
+    """-> ([TableRef|SubquerySource in order], [(right_idx, on, kind)])"""
+    if isinstance(f, (ast.TableRef, ast.SubquerySource)):
+        return [f], []
+    tables, joins = _flatten_from(f.left)
+    tables.append(f.right)
+    joins.append((len(tables) - 1, f.on, f.kind))
+    return tables, joins
+
+
+# ---------------- expression lowering ----------------
+
+
+class _Lower:
+    """AST expr -> SSA expr against a named-column environment.
+
+    ``resolve``  maps an ast.Name to the in-scope SSA column name.
+    ``dict_src`` maps in-scope string columns to the column whose
+                 dictionary carries their values (rename tracking).
+    ``emit``     appends auxiliary AssignSteps (hidden columns for
+                 string transforms like substring)."""
+
+    def __init__(self, types: dict[str, dtypes.LogicalType],
+                 dicts: DictionarySet | None,
+                 dict_src: dict[str, str] | None = None,
+                 resolve=None, emit=None):
+        self.types = types
+        self.dicts = dicts
+        self.dict_src = dict_src if dict_src is not None else {}
+        self._resolve = resolve
+        self._emit = emit
+
+    def name_of(self, e: ast.Name) -> str:
+        if self._resolve is not None:
+            return self._resolve(e)
+        col = e.column
+        if col not in self.types:
+            raise PlanError(f"column {col} not in scope")
+        return col
+
+    def dictionary_of(self, col: str):
+        src = self.dict_src.get(col, col)
+        if self.dicts is not None and src in self.dicts:
+            return self.dicts[src]
+        return None
+
+    def emit_assign(self, name: str, expr, t: dtypes.LogicalType):
+        if self._emit is None:
+            raise PlanError(
+                "string transform needs an assignment context")
+        self._emit(AssignStep(name, expr))
+        self.types[name] = t
+
+    def type_of(self, e) -> dtypes.LogicalType | None:
+        try:
+            return infer_type(e, None, self.types)
+        except Exception:
+            return None
+
+    # -- string-column helpers --
+
+    def _as_string_col(self, e, what: str) -> str:
+        """Column name of a string-valued operand; lowers substring()
+        to a hidden DictMap column on the fly."""
+        if isinstance(e, ast.Name):
+            col = self.name_of(e)
+            if not self.types.get(col, dtypes.INT64).is_string:
+                raise PlanError(f"{what} needs a string column operand")
+            return col
+        if isinstance(e, ast.FuncCall) and e.name == "substring":
+            lowered = self.lower(e)  # DictMap assign via emit
+            assert isinstance(lowered, Col)
+            return lowered.name
+        raise PlanError(f"{what} needs a string column operand")
+
+    def lower(self, e: ast.Expr):
+        if isinstance(e, ast.Name):
+            return Col(self.name_of(e))
+        if isinstance(e, ast.Literal):
+            return self._literal(e)
+        if isinstance(e, ast.UnOp):
+            if e.op == "not":
+                return Call(Op.NOT, self.lower(e.operand))
+            if e.op == "neg":
+                return Call(Op.NEG, self.lower(e.operand))
+            raise PlanError(f"unary {e.op}")
+        if isinstance(e, ast.BinOp):
+            return self._binop(e)
+        if isinstance(e, ast.Between):
+            lo = ast.BinOp("ge", e.expr, e.low)
+            hi = ast.BinOp("le", e.expr, e.high)
+            both = Call(Op.AND, self._binop(lo), self._binop(hi))
+            return Call(Op.NOT, both) if e.negated else both
+        if isinstance(e, ast.InList):
+            return self._in_list(e)
+        if isinstance(e, ast.Like):
+            col = self._as_string_col(e.expr, "LIKE")
+            p = DictPredicate(col, "like", e.pattern)
+            return Call(Op.NOT, p) if e.negated else p
+        if isinstance(e, ast.IsNull):
+            inner = self.lower(e.expr)
+            return Call(Op.IS_NOT_NULL if e.negated else Op.IS_NULL, inner)
+        if isinstance(e, ast.Case):
+            if e.else_ is None:
+                first = self.lower(e.whens[0][1])
+                t = infer_type(first, None, self.types)
+                out = Const(None, t)  # CASE without ELSE -> typed NULL
+            else:
+                out = self.lower(e.else_)
+            for cond, val in reversed(e.whens):
+                out = Call(Op.IF, self.lower(cond), self.lower(val), out)
+            return out
+        if isinstance(e, ast.FuncCall):
+            return self._func(e)
+        if isinstance(e, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
+            raise PlanError(
+                "subquery in an unsupported position (must be a WHERE/"
+                "HAVING conjunct or a comparison operand)")
+        raise PlanError(f"cannot lower {e}")
+
+    def _literal(self, e: ast.Literal):
+        if e.kind == "int":
+            return Const(e.value, dtypes.INT64)
+        if e.kind == "typed":  # planner-internal: pre-typed constant
+            value, t = e.value
+            return Const(value, t)
+        if e.kind == "decimal":
+            from ydb_tpu.ssa.program import decimal_lit
+
+            scale = len(e.value.split(".")[1]) if "." in e.value else 0
+            return decimal_lit(e.value, scale)
+        if e.kind == "bool":
+            return Const(e.value, dtypes.BOOL)
+        if e.kind == "string":
+            raise PlanError(
+                f"string literal {e.value!r} outside a string comparison"
+            )
+        raise PlanError(f"literal {e.kind}")
+
+    def _binop(self, e: ast.BinOp):
+        if e.op in ("and", "or"):
+            return Call(Op.AND if e.op == "and" else Op.OR,
+                        self.lower(e.left), self.lower(e.right))
+        if e.op in _CMP:
+            # string column vs string literal -> dictionary predicate
+            lit_side = col_side = None
+            if isinstance(e.right, ast.Literal) and e.right.kind == "string":
+                col_side, lit_side, op = e.left, e.right, e.op
+            elif isinstance(e.left, ast.Literal) and e.left.kind == "string":
+                col_side, lit_side = e.right, e.left
+                op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(
+                    e.op, e.op)
+            if lit_side is not None:
+                col = self._as_string_col(col_side, "string comparison")
+                if op == "eq":
+                    return DictPredicate(col, "eq", lit_side.value)
+                if op == "ne":
+                    return DictPredicate(col, "ne", lit_side.value)
+                # ordered string compare: lowered by the compiler via a
+                # plan-time dictionary mask (_custom_dict_mask)
+                if self.dictionary_of(col) is None:
+                    raise PlanError(
+                        f"ordered string compare on {col} needs dictionary")
+                val = lit_side.value.encode() if isinstance(
+                    lit_side.value, str) else lit_side.value
+                return DictPredicate(col, "custom", ("ord", op, val))
+            # string column vs string column (q21-style) unsupported here
+            return Call(_CMP[e.op], self.lower(e.left), self.lower(e.right))
+        if e.op in _ARITH:
+            folded = _try_const_date(e)
+            if folded is not None:
+                return Const(folded, dtypes.DATE)
+            return Call(_ARITH[e.op], self.lower(e.left),
+                        self.lower(e.right))
+        raise PlanError(f"binop {e.op}")
+
+    def _in_list(self, e: ast.InList):
+        if all(isinstance(i, ast.Literal) and i.kind == "string"
+               for i in e.items):
+            col = self._as_string_col(e.expr, "IN")
+            kind = "not_in_set" if e.negated else "in_set"
+            return DictPredicate(col, kind,
+                                 tuple(i.value for i in e.items))
+        inner = self.lower(e.expr)
+        consts = []
+        for i in e.items:
+            c = self.lower(i)
+            if not isinstance(c, Const):
+                raise PlanError("IN items must be literals")
+            consts.append(c)
+        call = Call(Op.IN_SET, inner, *consts)
+        return Call(Op.NOT, call) if e.negated else call
+
+    def _func(self, e: ast.FuncCall):
+        if e.name in _AGG_FUNCS or (e.name == "count" and e.star):
+            raise PlanError(f"aggregate {e.name} in scalar context")
+        if e.name == "date":
+            return Const(_days(e.args[0].value), dtypes.DATE)
+        if e.name == "interval":
+            n = int(e.args[0].value)
+            unit = e.args[1].value
+            days = {"day": 1, "week": 7}.get(unit)
+            if days is None:
+                raise PlanError(
+                    f"interval unit {unit} only folds against constant"
+                    " dates")
+            return Const(n * days, dtypes.INT32)
+        if e.name in ("year", "month"):
+            op = Op.YEAR if e.name == "year" else Op.MONTH
+            return Call(op, self.lower(e.args[0]))
+        if e.name == "substring":
+            col = self._as_string_col(e.args[0], "substring")
+            if not (isinstance(e.args[1], ast.Literal)
+                    and isinstance(e.args[2], ast.Literal)):
+                raise PlanError("substring bounds must be literals")
+            start, length = int(e.args[1].value), int(e.args[2].value)
+            src_dict = self.dict_src.get(col, col)
+            hidden = f"__substr_{col}_{start}_{length}"
+            if hidden not in self.types:
+                self.emit_assign(
+                    hidden,
+                    DictMap(col, "substr", (start, length), hidden),
+                    dtypes.STRING,
+                )
+                # DictMap registers the output dictionary under `hidden`
+                self.dict_src[hidden] = hidden
+            return Col(hidden)
+        if e.name.startswith("cast_"):
+            target = e.name[5:]
+            op = {"int32": Op.CAST_INT32, "int64": Op.CAST_INT64,
+                  "bigint": Op.CAST_INT64, "float": Op.CAST_FLOAT,
+                  "double": Op.CAST_DOUBLE}.get(target)
+            if op is None:
+                raise PlanError(f"cast to {target}")
+            return Call(op, self.lower(e.args[0]))
+        simple = {"abs": Op.ABS, "sqrt": Op.SQRT, "exp": Op.EXP,
+                  "ln": Op.LN, "floor": Op.FLOOR, "ceil": Op.CEIL,
+                  "round": Op.ROUND, "coalesce": Op.COALESCE}
+        if e.name in simple:
+            return Call(simple[e.name], *[self.lower(a) for a in e.args])
+        raise PlanError(f"unknown function {e.name}")
+
+
+# ---------------- the planner ----------------
+
+
+def plan_select(sel: ast.Select, catalog: Catalog, scalar_exec=None):
+    """Plan a SELECT; returns the plan tree (back-compat surface)."""
+    return plan_select_full(sel, catalog, scalar_exec).plan
+
+
+def plan_select_full(
+    sel: ast.Select,
+    catalog: Catalog,
+    scalar_exec=None,
+    ctes: dict[str, PlannedQuery] | None = None,
+) -> PlannedQuery:
+    """Plan a SELECT fully: plan tree + output names/types/dict-aliases.
+
+    ``scalar_exec(plan_node, out_type) -> (value, valid)`` executes an
+    uncorrelated scalar subquery eagerly (the KQP precompute-phase
+    analog); without it such subqueries raise PlanError.
+    """
+    return _SelectPlanner(catalog, scalar_exec, dict(ctes or {})).plan(sel)
+
+
+class _SelectPlanner:
+    def __init__(self, catalog: Catalog, scalar_exec, ctes):
+        self.catalog = catalog
+        self.scalar_exec = scalar_exec
+        self.ctes: dict[str, PlannedQuery] = ctes
+        self._sq_n = 0
+        self.used_scalar_exec = False
+
+    # -- recursion helper --
+
+    def _sub(self, sel: ast.Select) -> PlannedQuery:
+        sub = _SelectPlanner(
+            self.catalog, self.scalar_exec, dict(self.ctes)
+        ).plan(sel)
+        self.used_scalar_exec |= sub.used_scalar_exec
+        return sub
+
+    # -- FROM binding --
+
+    def _bind(self, sel: ast.Select) -> tuple[_Binding, list]:
+        if sel.from_ is None:
+            raise PlanError("SELECT without FROM is not supported")
+        refs, join_specs = _flatten_from(sel.from_)
+        scopes: list[_Scope] = []
+        for r in refs:
+            if isinstance(r, ast.SubquerySource):
+                sub = self._sub(r.select)
+                scopes.append(_Scope(
+                    alias=r.alias, names=sub.out_names,
+                    types=dict(sub.out_types),
+                    dict_src=dict(sub.dict_aliases),
+                    sub=sub, pk=sub.unique_key,
+                ))
+                continue
+            name, alias = r.name, (r.alias or r.name)
+            if name in self.ctes:
+                sub = self.ctes[name]
+                scopes.append(_Scope(
+                    alias=alias, names=sub.out_names,
+                    types=dict(sub.out_types),
+                    dict_src=dict(sub.dict_aliases),
+                    sub=sub, pk=sub.unique_key,
+                ))
+                continue
+            if name not in self.catalog.schemas:
+                raise PlanError(f"unknown table {name}")
+            sch = self.catalog.schemas[name]
+            scopes.append(_Scope(
+                alias=alias, names=sch.names,
+                types={f.name: f.type for f in sch.fields},
+                dict_src={f.name: f.name for f in sch.fields
+                          if f.type.is_string},
+                table=name, pk=self.catalog.primary_keys.get(name),
+            ))
+        seen: dict[str, str] = {}
+        ambiguous: set[str] = set()
+        for s in scopes:
+            for n in s.names:
+                if n in seen and seen[n] != s.alias:
+                    ambiguous.add(n)
+                else:
+                    seen[n] = s.alias
+        return _Binding(scopes, seen, ambiguous), join_specs
+
+    # -- subquery rewrites --
+
+    def _correlations(self, sub: ast.Select, outer: _Binding,
+                      allow_ne: bool = False):
+        """Split inner WHERE into correlated pairs and local conjuncts.
+
+        Correlated conjunct shape: outer_col = inner_col (either order);
+        with ``allow_ne``, outer_col <> inner_col is also collected (the
+        q21 shape, decorrelated via the counting rewrite).
+        Returns ([(outer Name, inner col)] eq pairs,
+                 [(outer Name, inner col)] ne pairs,
+                 local_where_conjuncts).
+        """
+        inner_binding, _ = self._bind(sub)
+        corr: list[tuple[ast.Name, str]] = []
+        ne_corr: list[tuple[ast.Name, str]] = []
+        local: list[ast.Expr] = []
+        for c in _conjuncts(sub.where):
+            names = list(_walk_names(c))
+            outer_names = [
+                n for n in names
+                if inner_binding.try_resolve(n) is None
+                and outer.try_resolve(n) is not None
+            ]
+            if not outer_names:
+                local.append(c)
+                continue
+            ops = ("eq", "ne") if allow_ne else ("eq",)
+            if not (isinstance(c, ast.BinOp) and c.op in ops
+                    and isinstance(c.left, ast.Name)
+                    and isinstance(c.right, ast.Name)):
+                raise PlanError(
+                    "correlated subquery conditions must be equality"
+                    f" (got {c})")
+            left_outer = inner_binding.try_resolve(c.left) is None
+            o, i = (c.left, c.right) if left_outer else (c.right, c.left)
+            if inner_binding.try_resolve(i) is None:
+                raise PlanError(
+                    "correlated condition does not reference the"
+                    " subquery's tables")
+            (corr if c.op == "eq" else ne_corr).append((o, i.column))
+        return corr, ne_corr, local
+
+    @staticmethod
+    def _check_plain_exists(sub: ast.Select) -> None:
+        """The EXISTS rewrites rebuild the inner SELECT from its FROM and
+        WHERE only; refuse shapes whose dropped clauses would change the
+        result instead of silently mis-evaluating them."""
+        if sub.group_by or sub.having is not None or sub.limit is not None:
+            raise PlanError(
+                "EXISTS subqueries with GROUP BY/HAVING/LIMIT are not"
+                " supported")
+
+    def _plan_exists_like(self, sub: ast.Select, corr, local):
+        """Plan an EXISTS/IN subquery body projecting its correlation
+        columns. ``corr``/``local`` come from the caller's
+        ``_correlations`` pass (binding the inner FROM is not repeated)."""
+        self._check_plain_exists(sub)
+        where = None
+        for c in local:
+            where = c if where is None else ast.BinOp("and", where, c)
+        items = tuple(
+            ast.SelectItem(ast.Name((col,)), None)
+            for col in dict.fromkeys(c for _, c in corr)
+        )
+        rewritten = ast.Select(
+            items=items, from_=sub.from_, where=where, group_by=(),
+            having=None, order_by=(), limit=None, ctes=sub.ctes,
+        )
+        return self._sub(rewritten)
+
+    def _plan_count_sub(self, sub: ast.Select, local, group_cols,
+                        name: str) -> PlannedQuery:
+        """COUNT(*) of the subquery's rows grouped by correlation columns
+        (the counting decorrelation of non-equi EXISTS, q21)."""
+        self._check_plain_exists(sub)
+        where = None
+        for c in local:
+            where = c if where is None else ast.BinOp("and", where, c)
+        cols = tuple(dict.fromkeys(group_cols))
+        items = (
+            ast.SelectItem(ast.FuncCall("count", (), star=True), name),
+        ) + tuple(ast.SelectItem(ast.Name((c,)), None) for c in cols)
+        rewritten = ast.Select(
+            items=items, from_=sub.from_, where=where,
+            group_by=tuple(ast.Name((c,)) for c in cols),
+            having=None, order_by=(), limit=None, ctes=sub.ctes,
+        )
+        return self._sub(rewritten)
+
+    # ---------------- main planning ----------------
+
+    def plan(self, sel: ast.Select) -> PlannedQuery:
+        for name, sub in sel.ctes:
+            self.ctes[name] = self._sub(sub)
+
+        binding, join_specs = self._bind(sel)
+        scopes = binding.scopes
+
+        # right sides of LEFT JOINs: WHERE on them filters AFTER the join
+        left_right_aliases = {
+            scopes[idx].alias for idx, _, kind in join_specs
+            if kind == "left"
+        }
+
+        # --- subquery rewrites over WHERE conjuncts + HAVING ---
+        semi_joins: list = []    # (kind, [(outer Name, build col)], sub)
+        scalar_joins: list = []  # (name, [(outer Name, build col)], sub)
+        synthetic: dict[str, dtypes.LogicalType] = {}
+        syn_dict_src: dict[str, str] = {}
+
+        def new_sq_name() -> str:
+            self._sq_n += 1
+            return f"__sq{self._sq_n - 1}"
+
+        def rewrite_scalars(e):
+            """Replace ScalarSubquery nodes inside an expression."""
+            if isinstance(e, ast.ScalarSubquery):
+                return self._rewrite_scalar(
+                    e.select, binding, scalar_joins, synthetic,
+                    syn_dict_src, new_sq_name)
+            if isinstance(e, ast.BinOp):
+                return ast.BinOp(e.op, rewrite_scalars(e.left),
+                                 rewrite_scalars(e.right))
+            if isinstance(e, ast.UnOp):
+                return ast.UnOp(e.op, rewrite_scalars(e.operand))
+            if isinstance(e, ast.FuncCall):
+                return ast.FuncCall(
+                    e.name, tuple(rewrite_scalars(a) for a in e.args),
+                    e.star, e.distinct)
+            if isinstance(e, ast.Between):
+                return ast.Between(
+                    rewrite_scalars(e.expr), rewrite_scalars(e.low),
+                    rewrite_scalars(e.high), e.negated)
+            return e
+
+        where_conjuncts: list[ast.Expr] = []
+        for c in _conjuncts(sel.where):
+            neg = False
+            while isinstance(c, ast.UnOp) and c.op == "not" and isinstance(
+                    c.operand, (ast.Exists, ast.InSubquery)):
+                neg = not neg
+                c = c.operand
+            if isinstance(c, ast.Exists):
+                negated = neg != c.negated
+                eq, ne_pairs, local = self._correlations(
+                    c.select, binding, allow_ne=True)
+                if not eq:
+                    raise PlanError(
+                        "uncorrelated EXISTS is not supported (constant)")
+                if ne_pairs:
+                    # counting decorrelation (q21):
+                    #   EXISTS(k = o.k AND j <> o.j AND f)
+                    #   <=> cnt_f(k) > cnt_f(k, j=o.j)
+                    name_a, name_b = new_sq_name(), new_sq_name()
+                    sub_a = self._plan_count_sub(
+                        c.select, local, [i for _, i in eq], name_a)
+                    sub_b = self._plan_count_sub(
+                        c.select, local,
+                        [i for _, i in eq] + [i for _, i in ne_pairs],
+                        name_b)
+                    scalar_joins.append((name_a, eq, sub_a))
+                    scalar_joins.append((name_b, eq + ne_pairs, sub_b))
+                    synthetic[name_a] = dtypes.INT64
+                    synthetic[name_b] = dtypes.INT64
+                    zero = ast.Literal(0, "int")
+                    ca = ast.FuncCall(
+                        "coalesce", (ast.Name((name_a,)), zero))
+                    cb = ast.FuncCall(
+                        "coalesce", (ast.Name((name_b,)), zero))
+                    where_conjuncts.append(
+                        ast.BinOp("eq" if negated else "gt", ca, cb))
+                    continue
+                sub = self._plan_exists_like(c.select, eq, local)
+                semi_joins.append(
+                    ("anti" if negated else "semi", eq, sub))
+                continue
+            if isinstance(c, ast.InSubquery):
+                negated = neg != c.negated
+                if not isinstance(c.expr, ast.Name):
+                    raise PlanError("IN (subquery) needs a column operand")
+                sub_sel = c.select
+                if len(sub_sel.items) != 1 or isinstance(
+                        sub_sel.items[0].expr, ast.Star):
+                    raise PlanError(
+                        "IN subquery must select exactly one column")
+                sub = self._plan_in_subquery(sub_sel, binding)
+                build_col = sub.out_names[0]
+                semi_joins.append((
+                    "anti" if negated else "semi",
+                    [(c.expr, build_col)], sub,
+                ))
+                continue
+            if neg:
+                c = ast.UnOp("not", c)
+            if _contains_subquery(c):
+                c = rewrite_scalars(c)
+            where_conjuncts.append(c)
+
+        having = sel.having
+        if having is not None and _contains_subquery(having):
+            having = rewrite_scalars(having)
+
+        # --- classify WHERE conjuncts ---
+        pushdown: dict[str, list[ast.Expr]] = {s.alias: [] for s in scopes}
+        join_conds: list[tuple[str, str, str, str]] = []
+        residual: list[ast.Expr] = []
+
+        def expr_aliases(e) -> tuple[set, bool]:
+            """(aliases referenced, uses_synthetic)"""
+            out, syn = set(), False
+            for x in _walk_names(e):
+                if len(x.parts) == 1 and x.parts[0] in synthetic:
+                    syn = True
+                    continue
+                out.add(binding.resolve(x)[0])
+            return out, syn
+
+        for c in where_conjuncts:
+            aliases, syn = expr_aliases(c)
+            if syn:
+                residual.append(c)
+                continue
+            if len(aliases) <= 1:
+                target = next(iter(aliases)) if aliases else scopes[0].alias
+                if target in left_right_aliases:
+                    residual.append(c)
+                    continue
+                pushdown[target].append(c)
+            elif (
+                len(aliases) == 2
+                and isinstance(c, ast.BinOp) and c.op == "eq"
+                and isinstance(c.left, ast.Name)
+                and isinstance(c.right, ast.Name)
+            ):
+                la, lc = binding.resolve(c.left)
+                ra, rc = binding.resolve(c.right)
+                if la in left_right_aliases or ra in left_right_aliases:
+                    residual.append(c)
+                else:
+                    join_conds.append((la, lc, ra, rc))
+            else:
+                hoisted = self._hoist_or_equi(c, binding)
+                join_conds.extend(hoisted)
+                residual.append(c)
+
+        # explicit ON conditions
+        on_conds: dict[int, list[tuple[str, str, str, str]]] = {}
+        for idx, on, kind in join_specs:
+            conds = []
+            for c in _conjuncts(on):
+                if (isinstance(c, ast.BinOp) and c.op == "eq"
+                        and isinstance(c.left, ast.Name)
+                        and isinstance(c.right, ast.Name)):
+                    la, lc = binding.resolve(c.left)
+                    ra, rc = binding.resolve(c.right)
+                    conds.append((la, lc, ra, rc))
+                    continue
+                aliases, syn = expr_aliases(c)
+                if syn or len(aliases) > 1:
+                    raise PlanError(
+                        "JOIN ON supports equi-conditions plus"
+                        " single-table filters only")
+                target = next(iter(aliases)) if aliases else None
+                if target == scopes[idx].alias:
+                    # build-side ON filter: restricts matches, which for
+                    # LEFT keeps the probe row with NULLs — push into the
+                    # build scan
+                    pushdown[target].append(c)
+                elif kind == "left":
+                    raise PlanError(
+                        "probe-side ON filters in LEFT JOIN are not"
+                        " supported")
+                elif target is not None:
+                    pushdown[target].append(c)
+            on_conds[idx] = conds
+
+        # --- demand per scope ---
+        demand: dict[str, set[str]] = {s.alias: set() for s in scopes}
+        out_aliases = {
+            _item_name(item, i) for i, item in enumerate(sel.items)
+        }
+
+        def demand_expr(e):
+            for x in _walk_names(e):
+                if len(x.parts) == 1 and x.parts[0] in synthetic:
+                    continue
+                try:
+                    a, c = binding.resolve(x)
+                except PlanError:
+                    # select aliases (GROUP BY initial) demand nothing:
+                    # the aliased expression is walked via its item
+                    if len(x.parts) == 1 and x.parts[0] in out_aliases:
+                        continue
+                    raise
+                demand[a].add(c)
+        for item in sel.items:
+            if isinstance(item.expr, ast.Star):
+                raise PlanError("SELECT * is only allowed inside EXISTS")
+            demand_expr(item.expr)
+        for e in sel.group_by:
+            demand_expr(e)
+        for o in sel.order_by:
+            if isinstance(o.expr, ast.Name) and o.expr.parts[-1] in out_aliases:
+                continue
+            demand_expr(o.expr)
+        if having is not None:
+            demand_expr(having)
+        for e in residual:
+            demand_expr(e)
+        for la, lc, ra, rc in join_conds:
+            demand[la].add(lc)
+            demand[ra].add(rc)
+        for conds in on_conds.values():
+            for la, lc, ra, rc in conds:
+                demand[la].add(lc)
+                demand[ra].add(rc)
+        for _, corr, _sub in semi_joins:
+            for o, _ in corr:
+                a, c = binding.resolve(o)
+                demand[a].add(c)
+        for _, corr, _sub in scalar_joins:
+            for o, _ in corr:
+                a, c = binding.resolve(o)
+                demand[a].add(c)
+
+        # --- per-scope scan plans (pushdown + projection) ---
+        def scan_for(scope: _Scope):
+            types = dict(scope.types)
+            dict_src = dict(scope.dict_src)
+            steps: list = []
+            low = _Lower(types, self.catalog.dicts, dict_src,
+                         emit=steps.append)
+            for c in pushdown[scope.alias]:
+                steps.append(FilterStep(low.lower(c)))
+            cols = tuple(
+                n for n in scope.names if n in demand[scope.alias]
+            ) or scope.names[:1]
+            steps.append(ProjectStep(cols))
+            prog = Program(tuple(steps))
+            if scope.table is not None:
+                return TableScan(scope.table, prog)
+            aliases = tuple(sorted(
+                (k, v) for k, v in scope.dict_src.items() if k != v
+            ))
+            return Transform(scope.sub.plan, prog, aliases)
+
+        # --- left-deep join tree with (alias, col) -> out-name map ---
+        colmap: dict[tuple[str, str], str] = {}
+        types: dict[str, dtypes.LogicalType] = {}
+        dict_src: dict[str, str] = {}
+
+        s0 = scopes[0]
+        plan = scan_for(s0)
+        first_cols = tuple(
+            n for n in s0.names if n in demand[s0.alias]
+        ) or s0.names[:1]
+        for n in first_cols:
+            colmap[(s0.alias, n)] = n
+            types[n] = s0.types[n]
+            if n in s0.dict_src:
+                dict_src[n] = s0.dict_src[n]
+        joined_aliases = [s0.alias]
+
+        # greedy connectivity ordering (CBO-lite): FROM order may list a
+        # table before the one that connects it (q2 lists supplier before
+        # partsupp); always join the next FROM-ordered scope that has an
+        # equi-condition into the already-joined set
+        pending = join_conds[:]
+        remaining = list(range(1, len(scopes)))
+
+        def connects(i: int, joined: list[str]) -> bool:
+            alias = scopes[i].alias
+            for la, lc, ra, rc in on_conds.get(i, []):
+                if (ra == alias and la in joined) or (
+                        la == alias and ra in joined):
+                    return True
+            for la, lc, ra, rc in pending:
+                if (ra == alias and la in joined) or (
+                        la == alias and ra in joined):
+                    return True
+            return False
+
+        join_order: list[int] = []
+        while remaining:
+            pick = next(
+                (i for i in remaining if connects(i, joined_aliases
+                                                  + [scopes[j].alias
+                                                     for j in join_order])),
+                None,
+            )
+            if pick is None:
+                pick = remaining[0]  # will raise "no equi-join" below
+            join_order.append(pick)
+            remaining.remove(pick)
+
+        for i in join_order:
+            scope = scopes[i]
+            alias = scope.alias
+            conds = []
+            for la, lc, ra, rc in on_conds.get(i, []):
+                if ra == alias and la in joined_aliases:
+                    conds.append((la, lc, ra, rc))
+                elif la == alias and ra in joined_aliases:
+                    conds.append((ra, rc, la, lc))
+                else:
+                    raise PlanError(
+                        f"ON condition does not connect {alias} to the"
+                        f" joined tables: {la}.{lc} = {ra}.{rc}"
+                    )
+            still = []
+            for la, lc, ra, rc in pending:
+                if ra == alias and la in joined_aliases:
+                    conds.append((la, lc, ra, rc))
+                elif la == alias and ra in joined_aliases:
+                    conds.append((ra, rc, la, lc))
+                else:
+                    still.append((la, lc, ra, rc))
+            pending = still
+            # the same equi-cond can arrive twice (hoisted from an OR
+            # plus explicit): dedupe
+            conds = list(dict.fromkeys(conds))
+            if not conds:
+                raise PlanError(
+                    f"no equi-join condition connects {alias}; cross"
+                    " joins are not supported"
+                )
+            probe_keys = tuple(colmap[(la, lc)] for la, lc, ra, rc in conds)
+            build_keys = tuple(rc for la, lc, ra, rc in conds)
+            kind = dict((j[0], j[2]) for j in join_specs).get(i, "inner")
+            demanded = [
+                n for n in scope.names
+                if n in demand[alias] and n not in build_keys
+            ]
+            # keep build-side join keys if referenced downstream and not
+            # already carried under the same name from the probe side
+            demanded += [
+                n for n in build_keys
+                if n in demand[alias] and n not in demanded
+                and n not in types
+            ]
+            taken = set(types)
+            suffix = ""
+            if any(n in taken for n in demanded):
+                suffix = f"_{alias}"
+            payload = tuple(demanded)
+            for n in payload:
+                out_n = n + suffix
+                if out_n in taken:
+                    raise PlanError(
+                        f"cannot disambiguate column {n} from {alias}")
+            unique_build = scope.pk is not None and set(scope.pk) <= set(
+                build_keys)
+            build_plan = scan_for(scope)
+            if not payload and kind == "inner" and unique_build:
+                plan = LookupJoin(plan, build_plan, probe_keys, build_keys,
+                                  (), "semi")
+            elif unique_build:
+                plan = LookupJoin(plan, build_plan, probe_keys, build_keys,
+                                  payload, kind, suffix)
+            elif kind == "left":
+                probe_payload = tuple(types.keys())
+                plan = ExpandJoin(plan, build_plan, probe_keys, build_keys,
+                                  probe_payload, payload,
+                                  build_suffix=suffix, kind="left")
+            else:
+                probe_payload = tuple(types.keys())
+                plan = ExpandJoin(plan, build_plan, probe_keys, build_keys,
+                                  probe_payload, payload,
+                                  build_suffix=suffix)
+            for n in payload:
+                out_n = n + suffix
+                colmap[(alias, n)] = out_n
+                types[out_n] = scope.types[n]
+                if n in scope.dict_src:
+                    dict_src[out_n] = scope.dict_src[n]
+            # build keys equal probe keys on matched rows: make them
+            # resolvable under the build alias too (inner joins only —
+            # left-join NULL-extended rows diverge)
+            for (la, lc, ra, rc), pk_name in zip(conds, probe_keys):
+                if kind != "left" and (alias, rc) not in colmap:
+                    colmap[(alias, rc)] = pk_name
+            joined_aliases.append(alias)
+        if pending:
+            raise PlanError(f"unplaced join conditions {pending}")
+
+        # --- scalar-subquery aggregate joins (decorrelated) ---
+        for name, corr, sub in scalar_joins:
+            probe_keys = tuple(
+                colmap[binding.resolve(o)] for o, _ in corr
+            )
+            build_keys = tuple(c for _, c in corr)
+            plan = LookupJoin(
+                plan, sub.plan, probe_keys, build_keys,
+                (name,), "left",
+            )
+            types[name] = synthetic[name]
+            colmap[(None, name)] = name
+
+        # --- semi/anti joins from EXISTS / IN subqueries ---
+        for kind, corr, sub in semi_joins:
+            probe_keys = tuple(
+                colmap[binding.resolve(o)] for o, _ in corr
+            )
+            build_keys = tuple(c for _, c in corr)
+            plan = LookupJoin(plan, sub.plan, probe_keys, build_keys,
+                              (), kind)
+
+        # --- final transform ---
+        def resolve_out(x: ast.Name) -> str:
+            if len(x.parts) == 1 and x.parts[0] in synthetic:
+                return x.parts[0]
+            a, c = binding.resolve(x)
+            key = (a, c)
+            if key not in colmap:
+                raise PlanError(
+                    f"column {a}.{c} is not carried through the joins")
+            return colmap[key]
+
+        if len(scopes) == 1:
+            # single-table: everything references scan output names
+            for n in first_cols:
+                dict_src.setdefault(n, s0.dict_src.get(n, n))
+
+        steps: list = []
+        low = _Lower(types, self.catalog.dicts, dict_src,
+                     resolve=resolve_out, emit=steps.append)
+        for c in residual:
+            steps.append(FilterStep(low.lower(c)))
+
+        has_agg = any(
+            _contains_agg(i.expr) for i in sel.items
+        ) or (having is not None and _contains_agg(having)) or bool(
+            sel.group_by)
+
+        out_names: list[str] = []
+        out_types: dict[str, dtypes.LogicalType] = {}
+        out_dict_aliases: dict[str, str] = {}
+        unique_key: tuple[str, ...] | None = None
+        project = None  # deferred final projection (non-agg path)
+        if has_agg:
+            if sel.distinct:
+                raise PlanError(
+                    "SELECT DISTINCT with aggregates is redundant"
+                    " or unsupported; drop DISTINCT")
+            steps, out_names, out_types, key_outs = _plan_aggregate(
+                sel, low, steps, having)
+            unique_key = (
+                tuple(key_outs) if key_outs and all(key_outs) else None
+            )
+        else:
+            for idx, item in enumerate(sel.items):
+                name = _item_name(item, idx)
+                if isinstance(item.expr, ast.Name):
+                    src = resolve_out(item.expr)
+                    if src == name:
+                        out_names.append(src)
+                        out_types[src] = types[src]
+                        continue
+                    steps.append(AssignStep(name, Col(src)))
+                    low.types[name] = types[src]
+                    if src in dict_src:
+                        low.dict_src[name] = dict_src[src]
+                    out_names.append(name)
+                    out_types[name] = types[src]
+                    continue
+                lowered = low.lower(item.expr)
+                t = infer_type(lowered, None, low.types)
+                steps.append(AssignStep(name, lowered))
+                low.types[name] = t
+                if isinstance(lowered, Col) and lowered.name in low.dict_src:
+                    low.dict_src[name] = low.dict_src[lowered.name]
+                elif isinstance(lowered, DictMap):
+                    low.dict_src[name] = lowered.out_column
+                out_names.append(name)
+                out_types[name] = t
+            project = ProjectStep(tuple(out_names))
+            if sel.distinct:
+                steps.append(project)
+                steps.append(GroupByStep(tuple(out_names), ()))
+                unique_key = tuple(out_names)
+                project = None
+
+        if sel.order_by:
+            keys = []
+            desc = []
+            hidden_sort = False
+            for o in sel.order_by:
+                if isinstance(o.expr, ast.Name) and \
+                        o.expr.parts[-1] in out_names:
+                    keys.append(o.expr.parts[-1])
+                elif not has_agg and isinstance(o.expr, ast.Name):
+                    # plain SELECT may order by a non-projected column:
+                    # sort first, project after
+                    keys.append(resolve_out(o.expr))
+                    hidden_sort = True
+                else:
+                    raise PlanError(
+                        "ORDER BY must reference output columns/aliases")
+                desc.append(o.descending)
+            sort = SortStep(tuple(keys), tuple(desc), sel.limit)
+            if not has_agg and not sel.distinct:
+                if hidden_sort:
+                    steps.extend([sort, project])
+                else:
+                    steps.extend([project, sort])
+            else:
+                steps.append(sort)
+        else:
+            if not has_agg and not sel.distinct and project is not None:
+                steps.append(project)
+            if sel.limit is not None:
+                steps.append(SortStep((), (), sel.limit))
+
+        for n in out_names:
+            if n in low.dict_src and low.dict_src[n] != n:
+                out_dict_aliases[n] = low.dict_src[n]
+
+        aliases = tuple(sorted(
+            (k, v) for k, v in low.dict_src.items() if k != v
+        ))
+        out_plan = Transform(plan, Program(tuple(steps)), aliases)
+        return PlannedQuery(
+            plan=out_plan,
+            out_names=tuple(out_names),
+            out_types=out_types,
+            dict_aliases=out_dict_aliases,
+            unique_key=unique_key,
+            used_scalar_exec=self.used_scalar_exec,
+        )
+
+    # -- helpers used by plan() --
+
+    def _plan_in_subquery(self, sub_sel: ast.Select,
+                          outer: _Binding) -> PlannedQuery:
+        """Plan the body of IN (SELECT col ...). Correlated conjuncts are
+        not supported here (TPC-H IN-subqueries are uncorrelated)."""
+        return self._sub(sub_sel)
+
+    def _rewrite_scalar(self, sub: ast.Select, binding: _Binding,
+                        scalar_joins, synthetic, syn_dict_src,
+                        new_sq_name):
+        """ScalarSubquery -> Literal (uncorrelated, eager exec) or
+        Name(__sqN) backed by a decorrelated aggregate join."""
+        corr, ne_corr, local = self._correlations(sub, binding)
+        if ne_corr:
+            raise PlanError(
+                "non-equi correlation in a scalar subquery")
+        if not corr:
+            if self.scalar_exec is None:
+                raise PlanError(
+                    "uncorrelated scalar subquery needs an executor"
+                    " (scalar_exec)")
+            if len(sub.items) != 1:
+                raise PlanError("scalar subquery must select one value")
+            self.used_scalar_exec = True
+            planned = self._sub(sub)
+            t = planned.out_types[planned.out_names[0]]
+            value, valid = self.scalar_exec(planned.plan, t)
+            if not valid:
+                value = None
+            elif t.is_decimal:
+                value, scale = _strip_decimal_zeros(int(value), t.scale)
+                t = dtypes.decimal(scale)
+            return ast.Literal((value, t), "typed")
+        # correlated: rewrite into GROUP BY over the correlation columns
+        if len(sub.items) != 1:
+            raise PlanError("scalar subquery must select one value")
+        if not _contains_agg(sub.items[0].expr):
+            raise PlanError(
+                "correlated scalar subquery must be an aggregate")
+        name = new_sq_name()
+        where = None
+        for c in local:
+            where = c if where is None else ast.BinOp("and", where, c)
+        corr_cols = list(dict.fromkeys(c for _, c in corr))
+        items = (ast.SelectItem(sub.items[0].expr, name),) + tuple(
+            ast.SelectItem(ast.Name((c,)), None) for c in corr_cols
+        )
+        rewritten = ast.Select(
+            items=items, from_=sub.from_, where=where,
+            group_by=tuple(ast.Name((c,)) for c in corr_cols),
+            having=None, order_by=(), limit=None, ctes=sub.ctes,
+        )
+        planned = self._sub(rewritten)
+        scalar_joins.append((name, corr, planned))
+        synthetic[name] = planned.out_types[name]
+        return ast.Name((name,))
+
+    def _hoist_or_equi(self, c, binding) -> list[tuple[str, str, str, str]]:
+        """For an OR-of-conjunctions where EVERY branch contains the same
+        two-table equality (q19's (p=l and ...) or (p=l and ...) shape),
+        hoist that equality as a join condition; the OR stays residual."""
+        def branches(e):
+            if isinstance(e, ast.BinOp) and e.op == "or":
+                return branches(e.left) + branches(e.right)
+            return [e]
+
+        brs = branches(c)
+        if len(brs) < 2:
+            return []
+        common: set | None = None
+        for b in brs:
+            eqs = set()
+            for cj in _conjuncts(b):
+                if (isinstance(cj, ast.BinOp) and cj.op == "eq"
+                        and isinstance(cj.left, ast.Name)
+                        and isinstance(cj.right, ast.Name)):
+                    la = binding.try_resolve(cj.left)
+                    ra = binding.try_resolve(cj.right)
+                    if la and ra and la[0] != ra[0]:
+                        eqs.add((la + ra))
+                        eqs.add((ra + la))
+            common = eqs if common is None else (common & eqs)
+            if not common:
+                return []
+        out = []
+        seen = set()
+        for la, lc, ra, rc in common:
+            if (ra, rc, la, lc) in seen:
+                continue
+            seen.add((la, lc, ra, rc))
+            out.append((la, lc, ra, rc))
+        return out
+
+
+def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, having):
+    """Lower GROUP BY + aggregates + HAVING into SSA steps.
+
+    Returns (steps, out_names, out_types, group_key_out_names)."""
+    # group keys may be select aliases of computed exprs (q7's l_year
+    # aliases extract(...)) — resolve through the alias map
+    alias_exprs = {
+        item.alias: item.expr for item in sel.items if item.alias
+    }
+
+    def assign_key(name: str, expr) -> None:
+        lowered = low.lower(expr)
+        steps.append(AssignStep(name, lowered))
+        low.types[name] = infer_type(lowered, None, low.types)
+        if isinstance(lowered, Col) and lowered.name in low.dict_src:
+            low.dict_src[name] = low.dict_src[lowered.name]
+        elif isinstance(lowered, DictMap):
+            low.dict_src[name] = lowered.out_column
+
     key_names: list[str] = []
-    key_exprs: dict = {}  # ast expr -> key column name
+    key_exprs: dict = {}
     for i, g in enumerate(sel.group_by):
         if isinstance(g, ast.Name):
-            key_names.append(g.column)
-            key_exprs[g] = g.column
+            nm = g.parts[-1]
+            try:
+                name = low.name_of(g)
+            except PlanError:
+                if len(g.parts) == 1 and nm in alias_exprs:
+                    expr = alias_exprs[nm]
+                    if isinstance(expr, ast.Name):
+                        name = low.name_of(expr)
+                    else:
+                        assign_key(nm, expr)
+                        name = nm
+                    # the aliased expression itself is this key too
+                    key_exprs[expr] = name
+                else:
+                    raise
+            key_names.append(name)
+            key_exprs[g] = name
         else:
             name = f"__key{i}"
-            steps.append(AssignStep(name, low.lower(g)))
-            low.types[name] = infer_type(
-                steps[-1].expr, None, low.types)
+            assign_key(name, g)
             key_names.append(name)
             key_exprs[g] = name
 
     agg_specs: list[AggSpec] = []
-    agg_map: dict = {}  # ast.FuncCall (by repr) -> out name
+    agg_map: dict = {}
+    distinct_cols: list[str] = []
 
     def register_agg(fc: ast.FuncCall) -> str:
         key = repr(fc)
@@ -666,22 +1385,36 @@ def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, binding):
             func = _AGG_FUNCS[fc.name]
             arg = fc.args[0]
             if isinstance(arg, ast.Name):
-                col = arg.column
+                col = low.name_of(arg)
             else:
                 col = f"__arg{len(agg_specs)}"
-                assign = AssignStep(col, low.lower(arg))
-                steps.append(assign)
-                low.types[col] = infer_type(assign.expr, None, low.types)
+                lowered = low.lower(arg)
+                steps.append(AssignStep(col, lowered))
+                low.types[col] = infer_type(lowered, None, low.types)
+            if fc.distinct:
+                if fc.name != "count":
+                    raise PlanError(
+                        "DISTINCT is supported for COUNT only")
+                distinct_cols.append(col)
             agg_specs.append(AggSpec(func, col, name))
         agg_map[key] = name
         return name
 
-    def rewrite(e: ast.Expr) -> ast.Expr:
-        """Replace group-key expressions and aggregate calls with
-        references to their group-by outputs (SQL: every select expr is a
-        function of group keys and aggregates)."""
+    def key_of_name(e: ast.Name) -> str | None:
+        if len(e.parts) == 1 and e.parts[0] in key_names:
+            return e.parts[0]
+        try:
+            nm = low.name_of(e)
+        except PlanError:
+            return None
+        return nm if nm in key_names else None
+
+    def rewrite(e):
         if e in key_exprs:
             return ast.Name((key_exprs[e],))
+        if isinstance(e, ast.Name):
+            nm = key_of_name(e)
+            return ast.Name((nm,)) if nm is not None else e
         if isinstance(e, ast.FuncCall) and (
                 e.name in _AGG_FUNCS or (e.name == "count" and e.star)):
             return ast.Name((register_agg(e),))
@@ -691,41 +1424,68 @@ def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, binding):
             return ast.UnOp(e.op, rewrite(e.operand))
         if isinstance(e, ast.FuncCall):
             return ast.FuncCall(e.name, tuple(rewrite(a) for a in e.args),
-                                e.star)
+                                e.star, e.distinct)
         return e
 
     post_items: list[tuple[str, ast.Expr]] = []
     out_names: list[str] = []
+    key_out: dict[str, str] = {}  # group key -> its projected out name
     for idx, item in enumerate(sel.items):
         name = _item_name(item, idx)
         if isinstance(item.expr, ast.Name):
-            col = item.expr.column
-            if col not in key_names:
+            col = key_of_name(item.expr)
+            if col is None:
                 raise PlanError(
-                    f"column {col} is neither aggregated nor a group key")
+                    f"column {item.expr.column} is neither aggregated nor"
+                    " a group key")
             out_names.append(col if item.alias in (None, col) else name)
-            post_items.append((out_names[-1], item.expr))
+            key_out[col] = out_names[-1]
+            post_items.append((out_names[-1], ast.Name((col,))))
             continue
         out_names.append(name)
         post_items.append((name, rewrite(item.expr)))
-    having_rw = rewrite(sel.having) if sel.having is not None else None
+    having_rw = rewrite(having) if having is not None else None
 
+    if distinct_cols:
+        if any(s.func is not Agg.COUNT or s.column not in distinct_cols
+               for s in agg_specs):
+            raise PlanError(
+                "COUNT(DISTINCT) cannot mix with other aggregates yet")
+        # dedup pass: group by (keys + distinct cols) with no aggregates,
+        # then COUNT over the deduplicated rows
+        steps.append(GroupByStep(
+            tuple(key_names) + tuple(dict.fromkeys(distinct_cols)), ()))
     steps.append(GroupByStep(tuple(key_names), tuple(agg_specs)))
-    # post-aggregation scope: keys + agg outputs
+
     from ydb_tpu.ssa.program import agg_result_type
 
     post_types = {k: low.types[k] for k in key_names}
+    post_dict_src = dict(low.dict_src)
     for spec in agg_specs:
         post_types[spec.out_name] = agg_result_type(spec, None, low.types)
-    post_low = _Lower(post_types, low.dicts)
+    post_low = _Lower(post_types, low.dicts, post_dict_src)
+    for spec in agg_specs:
+        # MIN/MAX/SOME over a string column: the output carries the
+        # source column's dictionary
+        if spec.column is not None and post_types[
+                spec.out_name].is_string:
+            post_dict_src[spec.out_name] = low.dict_src.get(
+                spec.column, spec.column)
 
     if having_rw is not None:
         steps.append(FilterStep(post_low.lower(having_rw)))
     for name, e in post_items:
         if isinstance(e, ast.Name) and e.parts[-1] == name:
             continue
-        steps.append(AssignStep(name, post_low.lower(e)))
-        post_low.types[name] = infer_type(steps[-1].expr, None,
-                                          post_low.types)
+        lowered = post_low.lower(e)
+        steps.append(AssignStep(name, lowered))
+        post_low.types[name] = infer_type(lowered, None, post_low.types)
+        if isinstance(lowered, Col) and lowered.name in post_low.dict_src:
+            post_low.dict_src[name] = post_low.dict_src[lowered.name]
     steps.append(ProjectStep(tuple(out_names)))
-    return steps, out_names
+    out_types = {n: post_low.types[n] for n in out_names}
+    # propagate dictionary renames for downstream consumers
+    low.dict_src.update(post_low.dict_src)
+    # the output names the group keys survive under (None if projected out)
+    key_outs = [key_out.get(k) for k in key_names]
+    return steps, out_names, out_types, key_outs

@@ -32,6 +32,7 @@ from ydb_tpu.ssa.program import (
     Call,
     Col,
     Const,
+    DictMap,
     DictPredicate,
     Expr,
     FilterStep,
@@ -52,6 +53,7 @@ class CompiledProgram:
     merging (ydb_tpu.parallel):
       ("dense_slots", n)  — uncompacted fixed slots, psum-mergeable
       ("keyless", 1)      — single-row global aggregate, psum-mergeable
+      ("dense", n)        — dense ids, compacted but shape-stable (n slots)
       ("compact", None)   — compacted rows; merge via all_gather + re-agg
       (None, None)        — no group-by in the program
     """
@@ -145,12 +147,17 @@ def compile_program(
             def lower_const(env, aux, _t=t, _v=val):
                 any_col = next(iter(env.values()))
                 n = any_col.data.shape[0]
+                if _v is None:  # typed NULL (CASE without ELSE)
+                    return Column(jnp.zeros((n,), dtype=_t.physical),
+                                  jnp.zeros((n,), dtype=bool))
                 data = jnp.full((n,), _v, dtype=_t.physical)
                 return Column(data, jnp.ones((n,), dtype=bool))
 
             return lower_const, t
         if isinstance(expr, DictPredicate):
             return _resolve_dict_predicate(ctx, expr, cur_types)
+        if isinstance(expr, DictMap):
+            return _resolve_dict_map(ctx, expr, cur_types)
         assert isinstance(expr, Call)
         return _resolve_call(ctx, expr, cur_types, resolve_expr)
 
@@ -311,6 +318,40 @@ def _resolve_dict_predicate(ctx: _Lowering, p: DictPredicate, cur_types):
     return lower, dtypes.BOOL
 
 
+def dict_map_table(d, out_d, kind: str, args: tuple) -> np.ndarray:
+    """id->id gather table for a string transform: apply the transform to
+    every dictionary value, register results in the output dictionary.
+    Shared by the JAX lowering and the CPU oracle (identical id
+    assignment: first-seen order over the source dictionary)."""
+    if kind == "substr":
+        start, length = args  # SQL 1-based start
+        lo = start - 1
+        out = [out_d.add(v[lo:lo + length]) for v in d.values]
+    else:
+        raise NotImplementedError(f"dict map kind {kind}")
+    return np.asarray(out or [0], dtype=np.int32)
+
+
+def _resolve_dict_map(ctx: _Lowering, m: DictMap, cur_types):
+    t = cur_types[m.column]
+    if not t.is_string:
+        raise TypeError(f"dict map on non-string column {m.column}")
+    d = ctx.dictionary(m.column)
+    if d is None:
+        raise ValueError(f"no dictionary for column {m.column}")
+    if ctx.dicts is None:
+        raise ValueError("dict map needs a shared DictionarySet")
+    out_d = ctx.dicts.for_column(m.out_column)
+    table = dict_map_table(d, out_d, m.kind, m.args)
+    key = ctx.add_aux(f"map.{m.column}.{m.kind}", table)
+    col = m.column
+
+    def lower(env, aux, _key=key, _col=col):
+        return kernels.dict_gather(aux[_key], env[_col])
+
+    return lower, dtypes.STRING
+
+
 def _custom_dict_mask(d, pattern) -> np.ndarray:
     """Plan-time masks beyond the fixed kinds. ("ord", op, val) = ordered
     byte-string comparison evaluated over the dictionary values."""
@@ -361,6 +402,12 @@ def _resolve_call(ctx: _Lowering, call: Call, cur_types, resolve_expr):
     ts = [r[1] for r in resolved]
     out_t = infer_type(call, ctx.schema, cur_types)
 
+    # mixed decimal x float: descale the decimal side to float (the
+    # comparison/arithmetic then runs in double — exactness is already
+    # lost the moment a float entered)
+    if op in (Op.ADD, Op.SUB, Op.MUL, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT,
+              Op.GE, Op.DIV):
+        fns, ts = _descale_mixed(fns, ts)
     # rescale decimal operands to a common scale for add/sub/compare
     if op in (Op.ADD, Op.SUB, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE,
               Op.MOD):
@@ -523,6 +570,33 @@ def _resolve_call(ctx: _Lowering, call: Call, cur_types, resolve_expr):
     raise NotImplementedError(f"lowering for op {op}")
 
 
+def _descale_mixed(fns, ts):
+    """decimal op float -> both float (scaled-int decimals descale)."""
+    if len(ts) != 2:
+        return fns, ts
+    a, b = ts
+    if not ((a.is_decimal and b.is_floating)
+            or (b.is_decimal and a.is_floating)):
+        return fns, ts
+
+    def descaled(fn, scale):
+        div = 10.0 ** scale
+
+        def lower(env, aux, _fn=fn, _d=div):
+            c = _fn(env, aux)
+            return Column(c.data.astype(jnp.float64) / _d, c.validity)
+
+        return lower
+
+    out = list(fns)
+    t_out = list(ts)
+    for i, t in enumerate(ts):
+        if t.is_decimal:
+            out[i] = descaled(fns[i], t.scale)
+            t_out[i] = dtypes.DOUBLE
+    return out, t_out
+
+
 def _align_decimals(op, call, fns, ts):
     """Rescale decimal operands to a common scale (exact, compile-time)."""
     if len(ts) != 2:
@@ -631,6 +705,11 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
         ctx.group_layout = ("keyless", 1)
     elif keep_slots:
         ctx.group_layout = ("dense_slots", num_groups)
+    elif dense:
+        # dense group-ids, compacted output: array shape is num_groups
+        # regardless of input capacity, so partial states are shape-stable
+        # and can fold incrementally (ScanExecutor combine path)
+        ctx.group_layout = ("dense", num_groups)
     else:
         ctx.group_layout = ("compact", None)
 

@@ -135,15 +135,22 @@ def expand_join(
     build_payload: list[str],
     out_capacity: int,
     build_suffix: str = "",
+    kind: str = "inner",
 ) -> tuple[TableBlock, jax.Array]:
-    """N:M inner equi-join with static output capacity.
+    """N:M equi-join with static output capacity.
 
-    Returns (joined block, total_matches). Rows beyond ``out_capacity``
-    are truncated — callers check ``total_matches <= out_capacity`` (host
+    ``kind``: "inner" emits matches only; "left" additionally emits every
+    unmatched live probe row once with NULL build payload (LEFT OUTER).
+    Returns (joined block, total rows). Rows beyond ``out_capacity``
+    are truncated — callers check ``total <= out_capacity`` (host
     side) and retry bigger or pre-partition (grace) if exceeded.
     """
     pk, plive = _join_keys_live(probe, probe_keys)
     bk, blive = _join_keys_live(build, build_keys)
+    # LEFT JOIN keeps probe rows whose key is NULL too (they just match
+    # nothing): row liveness for emission is the block mask, while
+    # _join_keys_live's plive already excludes NULL keys from matching
+    row_live = probe.row_mask()
 
     order, bk_sorted, n_live = _sorted_build(bk, blive)
     lo = jnp.searchsorted(bk_sorted, pk, side="left")
@@ -153,7 +160,11 @@ def expand_join(
     hi = jnp.minimum(hi, n_live)
     # int64 accounting: skewed keys can exceed 2^31 matches, and a wrapped
     # total would defeat the overflow-retry protocol
-    counts = jnp.where(plive, (hi - lo).astype(jnp.int64), jnp.int64(0))
+    matches = jnp.where(plive, (hi - lo).astype(jnp.int64), jnp.int64(0))
+    if kind == "left":
+        counts = jnp.where(row_live, jnp.maximum(matches, 1), 0)
+    else:
+        counts = matches
     offsets = jnp.cumsum(counts)  # inclusive
     total = offsets[-1] if counts.shape[0] else jnp.int64(0)
     starts = offsets - counts
@@ -164,6 +175,9 @@ def expand_join(
     i = jnp.clip(i, 0, probe.capacity - 1)
     valid_out = j < jnp.minimum(total, out_capacity)
     k = j - starts[i]
+    # matched: this output slot carries a real build match (a left join's
+    # pad slot for an unmatched probe row has k == 0 == matches[i])
+    matched = valid_out & (k < matches[i])
     b_src = order[jnp.clip(lo[i] + k, 0, build.capacity - 1)]
 
     from ydb_tpu import dtypes
@@ -177,7 +191,7 @@ def expand_join(
     for name in build_payload:
         c = build.columns[name]
         out_name = name + build_suffix
-        cols[out_name] = Column(c.data[b_src], c.validity[b_src] & valid_out)
+        cols[out_name] = Column(c.data[b_src], c.validity[b_src] & matched)
         f = build.schema.field(name)
         fields.append(dtypes.Field(out_name, f.type))
     length = jnp.minimum(total, out_capacity).astype(jnp.int32)

@@ -60,7 +60,21 @@ class DictPredicate:
     pattern: Any  # bytes | str | tuple for in_set
 
 
-Expr = Union[Col, Const, Call, DictPredicate]
+@dataclasses.dataclass(frozen=True)
+class DictMap:
+    """A string->string transform resolved against the column dictionary
+    at compile time (substring etc.): builds the OUTPUT dictionary for
+    ``out_column`` plus an id->id gather table shipped to the device.
+    The device op is a pure int gather; the new dictionary registers in
+    the shared DictionarySet so downstream group-by/sort/decode see it."""
+
+    column: str
+    kind: str       # "substr"
+    args: tuple     # substr: (start_1based, length)
+    out_column: str
+
+
+Expr = Union[Col, Const, Call, DictPredicate, DictMap]
 
 
 def lit(value, typ: dtypes.LogicalType | None = None) -> Const:
@@ -175,6 +189,8 @@ def infer_type(
         return expr.type
     if isinstance(expr, DictPredicate):
         return dtypes.BOOL
+    if isinstance(expr, DictMap):
+        return dtypes.STRING
     assert isinstance(expr, Call)
     op = expr.op
     if op in _CMP or op in _LOGIC or op in _PRED:
@@ -205,6 +221,10 @@ def infer_type(
 
 def _numeric_result(op: Op, ts: list[dtypes.LogicalType]) -> dtypes.LogicalType:
     a, b = ts[0], ts[1]
+    if (a.is_decimal and b.is_floating) or (b.is_decimal and a.is_floating):
+        # mixed decimal x float: the decimal operand descales to float
+        # (compiler _descale_mixed); exact decimal arithmetic is lost
+        return dtypes.DOUBLE
     if a.is_decimal or b.is_decimal:
         sa = a.scale if a.is_decimal else 0
         sb = b.scale if b.is_decimal else 0

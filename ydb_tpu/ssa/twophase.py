@@ -50,6 +50,22 @@ def dict_aliases(partial: Program) -> dict[str, str]:
     }
 
 
+def combine_of(program: Program) -> Program | None:
+    """The associative merge step of a two-phase split: a program that maps
+    a batch of partial-state blocks to ONE partial-state block with the
+    same columns (SUM of SUMs, MIN of MINs, ...). Because it is closed
+    over the partial form and associative, scans can fold partials
+    incrementally (tree reduction) instead of retaining every per-block
+    partial until the end — the memory-bound analog of the reference's
+    streaming combiner (mkql_block_agg.cpp BlockCombineHashed)."""
+    partial, final = split(program)
+    if final is None:
+        return None
+    gb = final.steps[0]
+    assert isinstance(gb, GroupByStep)
+    return Program((gb,))
+
+
 def split(
     program: Program, with_row_counts: bool = False
 ) -> tuple[Program, Program | None]:

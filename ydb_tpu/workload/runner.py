@@ -8,16 +8,9 @@ import time
 from ydb_tpu.engine.scan import ColumnSource
 from ydb_tpu.plan import Database, execute_plan, to_host
 from ydb_tpu.sql.parser import parse
-from ydb_tpu.sql.planner import Catalog, plan_select
+from ydb_tpu.sql.planner import Catalog, plan_select_full
 from ydb_tpu.workload import tpch
 from ydb_tpu.workload.queries import TPCH
-
-TPCH_PRIMARY_KEYS = {
-    "orders": ("o_orderkey",), "customer": ("c_custkey",),
-    "supplier": ("s_suppkey",), "nation": ("n_nationkey",),
-    "region": ("r_regionkey",),
-    "lineitem": ("l_orderkey", "l_linenumber"),
-}
 
 
 def tpch_database(data: tpch.TpchData) -> tuple[Database, Catalog]:
@@ -30,10 +23,23 @@ def tpch_database(data: tpch.TpchData) -> tuple[Database, Catalog]:
     )
     catalog = Catalog(
         schemas={t: data.schema(t) for t in data.tables},
-        primary_keys=dict(TPCH_PRIMARY_KEYS),
+        primary_keys=dict(tpch.PRIMARY_KEYS),
         dicts=data.dicts,
     )
     return db, catalog
+
+
+def scalar_exec_for(db: Database):
+    """Uncorrelated-scalar-subquery executor bound to a Database."""
+    def scalar_exec(plan_node, t):
+        out = to_host(execute_plan(plan_node, db))
+        col = out.schema.names[0]
+        v, ok = out.cols[col]
+        if len(v) != 1:
+            raise ValueError(f"scalar subquery returned {len(v)} rows")
+        return v[0].item(), bool(ok[0])
+
+    return scalar_exec
 
 
 def run_tpch(sf: float = 0.01, queries: list[str] | None = None,
@@ -47,7 +53,8 @@ def run_tpch(sf: float = 0.01, queries: list[str] | None = None,
     results = []
     for name in names:
         sql = TPCH[name]
-        plan = plan_select(parse(sql), catalog)
+        plan = plan_select_full(parse(sql), catalog,
+                                scalar_exec_for(db)).plan
         out = to_host(execute_plan(plan, db))  # warmup/compile
         best = float("inf")
         for _ in range(max(1, iterations)):

@@ -67,19 +67,46 @@ ORDERS_SCHEMA = dtypes.schema(
     ("o_orderdate", dtypes.DATE, False),
     ("o_orderpriority", dtypes.STRING, False),
     ("o_shippriority", dtypes.INT32, False),
+    ("o_comment", dtypes.STRING, False),
 )
 
 CUSTOMER_SCHEMA = dtypes.schema(
     ("c_custkey", dtypes.INT64, False),
+    ("c_name", dtypes.STRING, False),
+    ("c_address", dtypes.STRING, False),
     ("c_nationkey", dtypes.INT32, False),
-    ("c_mktsegment", dtypes.STRING, False),
+    ("c_phone", dtypes.STRING, False),
     ("c_acctbal", DEC2, False),
+    ("c_mktsegment", dtypes.STRING, False),
+    ("c_comment", dtypes.STRING, False),
 )
 
 SUPPLIER_SCHEMA = dtypes.schema(
     ("s_suppkey", dtypes.INT64, False),
+    ("s_name", dtypes.STRING, False),
+    ("s_address", dtypes.STRING, False),
     ("s_nationkey", dtypes.INT32, False),
+    ("s_phone", dtypes.STRING, False),
     ("s_acctbal", DEC2, False),
+    ("s_comment", dtypes.STRING, False),
+)
+
+PART_SCHEMA = dtypes.schema(
+    ("p_partkey", dtypes.INT64, False),
+    ("p_name", dtypes.STRING, False),
+    ("p_mfgr", dtypes.STRING, False),
+    ("p_brand", dtypes.STRING, False),
+    ("p_type", dtypes.STRING, False),
+    ("p_size", dtypes.INT32, False),
+    ("p_container", dtypes.STRING, False),
+    ("p_retailprice", DEC2, False),
+)
+
+PARTSUPP_SCHEMA = dtypes.schema(
+    ("ps_partkey", dtypes.INT64, False),
+    ("ps_suppkey", dtypes.INT64, False),
+    ("ps_availqty", dtypes.INT32, False),
+    ("ps_supplycost", DEC2, False),
 )
 
 NATION_SCHEMA = dtypes.schema(
@@ -111,11 +138,79 @@ INSTRUCTS = [b"DELIVER IN PERSON", b"COLLECT COD", b"NONE",
 PRIORITIES = [b"1-URGENT", b"2-HIGH", b"3-MEDIUM", b"4-NOT SPECIFIED",
               b"5-LOW"]
 
+# dbgen text grammar stand-ins: bounded pools keep dictionary sizes (and
+# plan-time LIKE-mask evaluation) independent of SF while preserving the
+# patterns the TPC-H predicates probe for (p_name '%green%', o_comment
+# '%special%requests%', s_comment '%Customer%Complaints%', p_type
+# '%BRASS' / 'PROMO%', ...). Reference grammar: dbgen dists.dss via
+# ydb/library/workload/tpch_workload.cpp data generators.
+COLORS = [
+    b"almond", b"antique", b"aquamarine", b"azure", b"beige", b"bisque",
+    b"black", b"blanched", b"blue", b"blush", b"brown", b"burlywood",
+    b"burnished", b"chartreuse", b"chiffon", b"chocolate", b"coral",
+    b"cornflower", b"cornsilk", b"cream", b"cyan", b"dark", b"deep",
+    b"dim", b"dodger", b"drab", b"firebrick", b"floral", b"forest",
+    b"frosted", b"gainsboro", b"ghost", b"goldenrod", b"green", b"grey",
+    b"honeydew", b"hot", b"indian", b"ivory", b"khaki", b"lace",
+    b"lavender", b"lawn", b"lemon", b"light", b"lime", b"linen",
+    b"magenta", b"maroon", b"medium", b"metallic", b"midnight", b"mint",
+    b"misty", b"moccasin", b"navajo", b"navy", b"olive", b"orange",
+    b"orchid", b"pale", b"papaya", b"peach", b"peru", b"pink", b"plum",
+    b"powder", b"puff", b"purple", b"red", b"rose", b"rosy", b"royal",
+    b"saddle", b"salmon", b"sandy", b"seashell", b"sienna", b"sky",
+    b"slate", b"smoke", b"snow", b"spring", b"steel", b"tan", b"thistle",
+    b"tomato", b"turquoise", b"violet", b"wheat", b"white", b"yellow",
+]
+TYPE_SYL1 = [b"STANDARD", b"SMALL", b"MEDIUM", b"LARGE", b"ECONOMY",
+             b"PROMO"]
+TYPE_SYL2 = [b"ANODIZED", b"BURNISHED", b"PLATED", b"POLISHED", b"BRUSHED"]
+TYPE_SYL3 = [b"TIN", b"NICKEL", b"BRASS", b"STEEL", b"COPPER"]
+CONTAINER_SYL1 = [b"SM", b"LG", b"MED", b"JUMBO", b"WRAP"]
+CONTAINER_SYL2 = [b"CASE", b"BOX", b"BAG", b"JAR", b"PKG", b"PACK", b"CAN",
+                  b"DRUM"]
+COMMENT_WORDS = [
+    b"furiously", b"carefully", b"quickly", b"blithely", b"slyly",
+    b"express", b"regular", b"final", b"ironic", b"pending", b"bold",
+    b"unusual", b"even", b"special", b"silent", b"daring", b"requests",
+    b"accounts", b"packages", b"deposits", b"instructions", b"theodolites",
+    b"dependencies", b"excuses", b"platelets", b"asymptotes", b"somas",
+    b"dugouts", b"sleep", b"nag", b"haggle", b"wake", b"cajole", b"detect",
+    b"integrate", b"Customer", b"Complaints", b"above", b"against",
+    b"along",
+]
+
 
 def _register(dicts: DictionarySet, col: str, values) -> np.ndarray:
     d = dicts.for_column(col)
     return np.fromiter((d.add(v) for v in values), dtype=np.int32,
                        count=len(values))
+
+
+def _encode_pool(dicts: DictionarySet, col: str, pool: list[bytes],
+                 picks: np.ndarray) -> np.ndarray:
+    """Bulk dictionary encode: register the pool once, map pick indices."""
+    ids = _register(dicts, col, pool)
+    return ids[picks]
+
+
+def _make_comment_pool(rng, size: int, n_words: int = 5) -> list[bytes]:
+    """Bounded pool of pseudo-dbgen comments (word-chain grammar)."""
+    words = np.array(COMMENT_WORDS, dtype=object)
+    out = []
+    for _ in range(size):
+        k = rng.integers(2, n_words + 1)
+        out.append(b" ".join(words[rng.integers(0, len(words), k)]))
+    return out
+
+
+def _encode_values(dicts: DictionarySet, col: str, values) -> np.ndarray:
+    """Bulk encode a (possibly huge, mostly-distinct) value list: register
+    each distinct value once, then map by index — O(n log n) instead of n
+    Python dict probes."""
+    arr = np.asarray(values, dtype=object)
+    uniq, inv = np.unique(arr, return_inverse=True)
+    ids = _register(dicts, col, list(uniq))
+    return ids[inv].astype(np.int32)
 
 
 class TpchData:
@@ -129,6 +224,7 @@ class TpchData:
         self._gen_orders_lineitem(rng)
         self._gen_customer(rng)
         self._gen_supplier(rng)
+        self._gen_part_partsupp(rng)
         self._gen_nation_region()
 
     # dbgen cardinalities: orders = 1.5M * SF; lineitem ~ 4 lines/order
@@ -215,6 +311,11 @@ class TpchData:
         os_ids = np.array([osd.add(b"O"), osd.add(b"F"), osd.add(b"P")],
                           dtype=np.int32)
         status = rng.integers(0, 3, n_orders)
+        # o_comment pool: ~2% of entries carry the q13 'special…requests'
+        # chain, the rest are plain word chains
+        pool = _make_comment_pool(rng, 2048)
+        for i in range(0, len(pool), 50):
+            pool[i] = pool[i] + b" special handling requests " + pool[i]
         self.tables["orders"] = {
             "o_orderkey": o_orderkey,
             "o_custkey": o_custkey,
@@ -224,26 +325,116 @@ class TpchData:
             "o_orderdate": o_orderdate,
             "o_orderpriority": pr_ids[pr],
             "o_shippriority": np.zeros(n_orders, dtype=np.int32),
+            "o_comment": _encode_pool(
+                self.dicts, "o_comment", pool,
+                rng.integers(0, len(pool), n_orders)),
         }
+
+    @staticmethod
+    def _phones(rng, nationkey: np.ndarray) -> list[bytes]:
+        """dbgen phone format: 'CC-xxx-xxx-xxxx', CC = 10 + nationkey
+        (q22 reads substring(c_phone, 1, 2) as the country code)."""
+        digits = rng.integers(0, 10, (len(nationkey), 10))
+        return [
+            b"%d-%d%d%d-%d%d%d-%d%d%d%d" % ((10 + int(nk),) + tuple(d))
+            for nk, d in zip(nationkey, digits)
+        ]
 
     def _gen_customer(self, rng):
         n = max(int(150_000 * self.sf), 1)
         seg = rng.integers(0, len(SEGMENTS), n)
         sd = self.dicts.for_column("c_mktsegment")
         seg_ids = np.array([sd.add(v) for v in SEGMENTS], dtype=np.int32)
+        nationkey = rng.integers(0, 25, n, dtype=np.int32)
+        addr_pool = _make_comment_pool(rng, 512, n_words=3)
         self.tables["customer"] = {
             "c_custkey": np.arange(1, n + 1, dtype=np.int64),
-            "c_nationkey": rng.integers(0, 25, n, dtype=np.int32),
-            "c_mktsegment": seg_ids[seg],
+            "c_name": _encode_values(
+                self.dicts, "c_name",
+                [b"Customer#%09d" % k for k in range(1, n + 1)]),
+            "c_address": _encode_pool(
+                self.dicts, "c_address", addr_pool,
+                rng.integers(0, len(addr_pool), n)),
+            "c_nationkey": nationkey,
+            "c_phone": _encode_values(
+                self.dicts, "c_phone", self._phones(rng, nationkey)),
             "c_acctbal": rng.integers(-999_99, 9999_99, n, dtype=np.int64),
+            "c_mktsegment": seg_ids[seg],
+            "c_comment": _encode_pool(
+                self.dicts, "c_comment", _make_comment_pool(rng, 1024),
+                rng.integers(0, 1024, n)),
         }
 
     def _gen_supplier(self, rng):
         n = max(int(10_000 * self.sf), 1)
+        nationkey = rng.integers(0, 25, n, dtype=np.int32)
+        addr_pool = _make_comment_pool(rng, 256, n_words=3)
+        # ~1.6% of suppliers carry the q16 'Customer Complaints' chain
+        comment_pool = _make_comment_pool(rng, 512)
+        for i in range(0, len(comment_pool), 64):
+            comment_pool[i] = comment_pool[i] + b" Customer loud Complaints"
         self.tables["supplier"] = {
             "s_suppkey": np.arange(1, n + 1, dtype=np.int64),
-            "s_nationkey": rng.integers(0, 25, n, dtype=np.int32),
+            "s_name": _encode_values(
+                self.dicts, "s_name",
+                [b"Supplier#%09d" % k for k in range(1, n + 1)]),
+            "s_address": _encode_pool(
+                self.dicts, "s_address", addr_pool,
+                rng.integers(0, len(addr_pool), n)),
+            "s_nationkey": nationkey,
+            "s_phone": _encode_values(
+                self.dicts, "s_phone", self._phones(rng, nationkey)),
             "s_acctbal": rng.integers(-999_99, 9999_99, n, dtype=np.int64),
+            "s_comment": _encode_pool(
+                self.dicts, "s_comment", comment_pool,
+                rng.integers(0, len(comment_pool), n)),
+        }
+
+    def _gen_part_partsupp(self, rng):
+        n = max(int(200_000 * self.sf), 1)
+        # p_name: 3 colors joined (dbgen: 5 of 92); pool bounded by combos
+        picks = rng.integers(0, len(COLORS), (n, 3))
+        names = [b" ".join((COLORS[a], COLORS[b], COLORS[c]))
+                 for a, b, c in picks]
+        mfgr = rng.integers(1, 6, n)
+        brand = mfgr * 10 + rng.integers(1, 6, n)
+        t1 = rng.integers(0, len(TYPE_SYL1), n)
+        t2 = rng.integers(0, len(TYPE_SYL2), n)
+        t3 = rng.integers(0, len(TYPE_SYL3), n)
+        types = [b" ".join((TYPE_SYL1[a], TYPE_SYL2[b], TYPE_SYL3[c]))
+                 for a, b, c in zip(t1, t2, t3)]
+        c1 = rng.integers(0, len(CONTAINER_SYL1), n)
+        c2 = rng.integers(0, len(CONTAINER_SYL2), n)
+        containers = [b" ".join((CONTAINER_SYL1[a], CONTAINER_SYL2[b]))
+                      for a, b in zip(c1, c2)]
+        self.tables["part"] = {
+            "p_partkey": np.arange(1, n + 1, dtype=np.int64),
+            "p_name": _encode_values(self.dicts, "p_name", names),
+            "p_mfgr": _encode_values(
+                self.dicts, "p_mfgr",
+                [b"Manufacturer#%d" % m for m in mfgr]),
+            "p_brand": _encode_values(
+                self.dicts, "p_brand", [b"Brand#%d" % b for b in brand]),
+            "p_type": _encode_values(self.dicts, "p_type", types),
+            "p_size": rng.integers(1, 51, n, dtype=np.int32),
+            "p_container": _encode_values(
+                self.dicts, "p_container", containers),
+            "p_retailprice": (90_000 + (np.arange(1, n + 1) % 20_001)
+                              ).astype(np.int64),
+        }
+        # partsupp: each part has 4 suppliers (dbgen), pk (partkey, suppkey)
+        n_supp = max(int(10_000 * self.sf), 1)
+        ps_partkey = np.repeat(np.arange(1, n + 1, dtype=np.int64), 4)
+        ps_suppkey = (
+            (ps_partkey + np.tile(np.arange(4, dtype=np.int64), n)
+             * max(n_supp // 4, 1)) % n_supp + 1
+        )
+        m = len(ps_partkey)
+        self.tables["partsupp"] = {
+            "ps_partkey": ps_partkey,
+            "ps_suppkey": ps_suppkey,
+            "ps_availqty": rng.integers(1, 10_000, m, dtype=np.int32),
+            "ps_supplycost": rng.integers(100, 1000_00, m, dtype=np.int64),
         }
 
     def _gen_nation_region(self):
@@ -263,9 +454,24 @@ class TpchData:
             "orders": ORDERS_SCHEMA,
             "customer": CUSTOMER_SCHEMA,
             "supplier": SUPPLIER_SCHEMA,
+            "part": PART_SCHEMA,
+            "partsupp": PARTSUPP_SCHEMA,
             "nation": NATION_SCHEMA,
             "region": REGION_SCHEMA,
         }[table]
+
+
+#: catalog primary keys (FK->PK lookup-join planning; schemeshard analog)
+PRIMARY_KEYS = {
+    "lineitem": ("l_orderkey", "l_linenumber"),
+    "orders": ("o_orderkey",),
+    "customer": ("c_custkey",),
+    "supplier": ("s_suppkey",),
+    "part": ("p_partkey",),
+    "partsupp": ("ps_partkey", "ps_suppkey"),
+    "nation": ("n_nationkey",),
+    "region": ("r_regionkey",),
+}
 
 
 # ---------------- queries as SSA programs ----------------
