@@ -69,40 +69,108 @@ def _relocal(block: TableBlock) -> TableBlock:
     return TableBlock(cols, block.length[None], block.schema)
 
 
+def _merge_states(cols_in, merge_kinds, rank_tables, red_max, red_min,
+                  red_sum, red_any):
+    """Shared state-merge core: per-column masked reduction by aggregate
+    kind, with string MIN/MAX ids re-packed as (lexicographic rank << 32
+    | id) around the reduction (ids do not order like the strings;
+    ``rank_tables`` ships the plan-time rank arrays). The reduction ops
+    are injected: mesh collectives for the cross-shard merge
+    (_merge_slots), elementwise folds for the streaming pairwise merge
+    (_merge_pair) — one logic, two execution shapes."""
+    cols = {}
+    for name, (d, v) in cols_in.items():
+        kind = merge_kinds[name]
+        packed = kind in (Agg.MIN, Agg.MAX) and name in rank_tables
+        if packed:
+            rt = rank_tables[name]
+            rank = rt[jnp.clip(d, 0, rt.shape[0] - 1)]
+            d = (rank.astype(jnp.int64) << 32) | d.astype(jnp.int64)
+        if kind in ("key", Agg.SOME, Agg.MAX):
+            lo = _neutral(d.dtype, maximum=False)
+            d = red_max(jnp.where(v, d, lo))
+        elif kind is Agg.MIN:
+            hi = _neutral(d.dtype, maximum=True)
+            d = red_min(jnp.where(v, d, hi))
+        else:  # SUM / COUNT / COUNT_ALL states
+            d = red_sum(jnp.where(v, d, jnp.zeros_like(d)))
+        v = red_any(v)
+        if packed:
+            d = (d & 0xFFFFFFFF).astype(jnp.int32)
+        cols[name] = Column(d, v)
+    return cols
+
+
 def _merge_slots(
     block: TableBlock,
     merge_kinds: dict[str, Agg | str],
     rank_tables: dict[str, jax.Array],
 ):
-    """Elementwise merge of slot-aligned partial states across the mesh.
-
-    String MIN/MAX states hold dictionary ids; ids do not order like the
-    strings, so those columns re-pack as (lexicographic rank << 32 | id)
-    before pmin/pmax and unpack after (``rank_tables`` ships the plan-time
-    rank arrays)."""
-    cols = {}
-    for name, col in block.columns.items():
-        kind = merge_kinds[name]
-        d, v = col.data, col.validity
-        packed = kind in (Agg.MIN, Agg.MAX) and name in rank_tables
-        if packed:
-            rank = rank_tables[name][jnp.clip(d, 0, rank_tables[name].shape[0] - 1)]
-            d = (rank.astype(jnp.int64) << 32) | d.astype(jnp.int64)
-        if kind in ("key", Agg.SOME, Agg.MAX):
-            lo = _neutral(d.dtype, maximum=False)
-            d = jax.lax.pmax(jnp.where(v, d, lo), SHARD_AXIS)
-            v = jax.lax.pmax(v, SHARD_AXIS)
-        elif kind is Agg.MIN:
-            hi = _neutral(d.dtype, maximum=True)
-            d = jax.lax.pmin(jnp.where(v, d, hi), SHARD_AXIS)
-            v = jax.lax.pmax(v, SHARD_AXIS)
-        else:  # SUM / COUNT / COUNT_ALL states
-            d = jax.lax.psum(jnp.where(v, d, jnp.zeros_like(d)), SHARD_AXIS)
-            v = jax.lax.pmax(v, SHARD_AXIS)
-        if packed:
-            d = (d & 0xFFFFFFFF).astype(jnp.int32)
-        cols[name] = Column(d, v)
+    """Elementwise merge of slot-aligned partial states across the mesh."""
+    cols = _merge_states(
+        {n: (c.data, c.validity) for n, c in block.columns.items()},
+        merge_kinds, rank_tables,
+        red_max=lambda x: jax.lax.pmax(x, SHARD_AXIS),
+        red_min=lambda x: jax.lax.pmin(x, SHARD_AXIS),
+        red_sum=lambda x: jax.lax.psum(x, SHARD_AXIS),
+        red_any=lambda v: jax.lax.pmax(v, SHARD_AXIS),
+    )
     return TableBlock(cols, block.length, block.schema)
+
+
+def _live_prefix_host(block: TableBlock):
+    """(host arrays dict, host validity dict, schema) of the live rows."""
+    n = int(block.length)
+    arrays = {m: np.asarray(c.data)[:n] for m, c in block.columns.items()}
+    valid = {m: np.asarray(c.validity)[:n]
+             for m, c in block.columns.items()}
+    return arrays, valid, block.schema
+
+
+def _concat_states(parts: list) -> TableBlock:
+    """Concatenate host live-prefix states (from _live_prefix_host)."""
+    sch = parts[0][2]
+    arrays = {
+        n: np.concatenate([p[0][n] for p in parts]) for n in sch.names
+    }
+    validity = {
+        n: np.concatenate([p[1][n] for p in parts]) for n in sch.names
+    }
+    return TableBlock.from_numpy(arrays, sch, validity)
+
+
+def _pad_state(block: TableBlock, capacity: int) -> TableBlock:
+    if block.capacity == capacity:
+        return block
+    cols = {}
+    for n, c in block.columns.items():
+        pad = capacity - c.data.shape[0]
+        cols[n] = Column(
+            jnp.concatenate(
+                [c.data, jnp.zeros((pad,), dtype=c.data.dtype)]),
+            jnp.concatenate([c.validity, jnp.zeros((pad,), dtype=bool)]),
+        )
+    return TableBlock(cols, block.length, block.schema)
+
+
+def _merge_pair(a: TableBlock, b: TableBlock, merge_kinds, rank_tables):
+    """Pairwise (device-local) twin of _merge_slots: fold two slot-aligned
+    partial-state blocks into one. Drives the streaming per-shard state
+    accumulation — each shard folds its block stream into ONE bounded
+    state before the mesh-wide collective merge."""
+    cols = _merge_states(
+        {
+            n: (jnp.stack([ca.data, b.columns[n].data]),
+                jnp.stack([ca.validity, b.columns[n].validity]))
+            for n, ca in a.columns.items()
+        },
+        merge_kinds, rank_tables,
+        red_max=lambda x: jnp.max(x, axis=0),
+        red_min=lambda x: jnp.min(x, axis=0),
+        red_sum=lambda x: jnp.sum(x, axis=0),
+        red_any=lambda v: jnp.any(v, axis=0),
+    )
+    return TableBlock(cols, jnp.maximum(a.length, b.length), a.schema)
 
 
 def _neutral(dtype, maximum: bool):
@@ -142,6 +210,7 @@ class MeshScan:
         dicts: DictionarySet | None = None,
         key_spaces: dict[str, int] | None = None,
         mesh=None,
+        dict_aliases: dict[str, str] | None = None,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.read_cols = required_columns(program, schema)
@@ -149,13 +218,18 @@ class MeshScan:
         partial_prog, final_prog = twophase.split(
             program, with_row_counts=True
         )
+        aliases = dict(dict_aliases or {})
         self.partial = compile_program(
-            partial_prog, in_schema, dicts, key_spaces, partial_slots=True
+            partial_prog, in_schema, dicts, key_spaces, partial_slots=True,
+            dict_aliases=aliases,
         )
         self.final = (
             compile_program(final_prog, self.partial.out_schema, dicts,
                             key_spaces,
-                            dict_aliases=twophase.dict_aliases(partial_prog))
+                            dict_aliases={
+                                **aliases,
+                                **twophase.dict_aliases(partial_prog),
+                            })
             if final_prog is not None
             else None
         )
@@ -193,9 +267,7 @@ class MeshScan:
             else {}
         )
 
-        def step(stacked: TableBlock) -> TableBlock:
-            block = _local(stacked)
-            part = self.partial.run(block, paux)
+        def merge_final(part: TableBlock) -> TableBlock:
             if self.final is None:
                 return _gather_rows(part)
             if self._use_slots:
@@ -216,6 +288,11 @@ class MeshScan:
                 merged = _gather_rows(part)
             return self.final.run(merged, faux)
 
+        def step(stacked: TableBlock) -> TableBlock:
+            block = _local(stacked)
+            part = self.partial.run(block, paux)
+            return merge_final(part)
+
         self._step = jax.jit(
             jax.shard_map(
                 step,
@@ -225,6 +302,22 @@ class MeshScan:
                 check_vma=False,
             )
         )
+        # merge+final over PRE-COMPUTED per-shard partial states (the
+        # streaming driver computes states shard-locally block by block)
+        self._merge_final_step = jax.jit(
+            jax.shard_map(
+                lambda st: merge_final(_local(st)),
+                mesh=self.mesh,
+                in_specs=P(SHARD_AXIS),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        self._partial_jit = jax.jit(
+            lambda blk: self.partial.run(blk, paux))
+        self._pair_jit = jax.jit(
+            lambda a, b: _merge_pair(a, b, self._merge_kinds,
+                                     self._rank_tables))
 
     # ---- host-side drivers ----
 
@@ -233,6 +326,49 @@ class MeshScan:
         sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
         stacked = jax.device_put(stacked, sharding)
         return self._step(stacked)
+
+    def execute_sources(self, sources, block_rows: int = 1 << 20
+                        ) -> OracleTable:
+        """Streaming SPMD scan over per-shard block-stream sources (the
+        portion store feeding the mesh — VERDICT r4 item 4).
+
+        Each shard's stream (e.g. a PortionStreamSource over its on-disk
+        portions) folds block-by-block into ONE bounded partial state on
+        its device (slot layouts: pairwise merge; compact layouts:
+        concatenated partial rows), then a single collective step merges
+        states across the mesh and finalizes. Host memory per shard stays
+        bounded by the stream's working set — out-of-core and multi-chip
+        compose."""
+        n_shards = self.mesh.shape[SHARD_AXIS]
+        if len(sources) != n_shards:
+            raise ValueError(
+                f"{len(sources)} sources for a {n_shards}-shard mesh")
+        layout = self.partial.group_layout[0]
+        foldable = layout in ("keyless", "dense_slots")
+        states = []
+        for sub in sources:
+            st = None
+            parts = []
+            for blk in sub.blocks(block_rows, self.read_cols):
+                part = self._partial_jit(blk)
+                if not foldable:
+                    # keep only the live prefix ON HOST: holding every
+                    # full-capacity device block would grow device memory
+                    # linearly with the stream
+                    parts.append(_live_prefix_host(part))
+                elif st is None:
+                    st = part
+                else:
+                    st = self._pair_jit(st, part)
+            states.append(st if foldable else _concat_states(parts))
+        if not foldable:
+            # compact states vary in size shard-to-shard: pad to common
+            cap = max(s.capacity for s in states)
+            states = [_pad_state(s, cap) for s in states]
+        out = self._merge_final_step(
+            jax.device_put(stack_blocks(states),
+                           NamedSharding(self.mesh, P(SHARD_AXIS))))
+        return OracleTable.from_block(out)
 
     def execute(self, source: ColumnSource) -> OracleTable:
         """Partition a host table across the mesh and run one SPMD step."""

@@ -1,0 +1,309 @@
+"""Distributed plan execution over the device mesh: grace joins + SPMD
+aggregation driving the SAME logical plan trees the single-chip executor
+runs (ydb_tpu.plan.nodes).
+
+The reference distributes a query as stage tasks exchanging rows through
+hash-partition channels (kqp_tasks_graph.cpp:448; vectorized partition
+consumer dq_output_consumer.cpp:338) and joins with GraceJoin buckets
+(mkql_grace_join_imp.cpp). The TPU-native design maps those pieces onto
+mesh collectives:
+
+  * table scans run per shard (each mesh device owns a table partition;
+    filters/projections execute in the per-shard compiled scan),
+  * every equi-join hash-REPARTITIONS both sides over the ``shard`` axis
+    with ``jax.lax.all_to_all`` (parallel/shuffle.py) so matching keys
+    land on the same device, then joins device-locally with the
+    sort/searchsorted kernels (ssa/join.py) — the grace-join shape with
+    ICI as the spill fabric; bucket overflow retries with doubled
+    capacity (the respill protocol),
+  * the final Transform (aggregate/HAVING/ORDER BY) reuses the MeshScan
+    two-phase machinery: per-device partial states, psum/pmin/pmax or
+    all_gather merge, replicated finalization.
+
+Each stage is one jitted shard_map step; data stays device-resident and
+mesh-sharded between stages.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ydb_tpu.blocks.block import Column, TableBlock
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.engine.scan import ScanExecutor
+from ydb_tpu.parallel.dist import (
+    MeshScan,
+    _local,
+    _pad_state,
+    _relocal,
+    stack_blocks,
+)
+from ydb_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from ydb_tpu.parallel.shuffle import repartition
+from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, Transform
+from ydb_tpu.ssa import join as join_kernels
+from ydb_tpu.ssa import kernels
+from ydb_tpu.ssa.program import SortStep
+
+
+def _round_up(n: int, q: int = 64) -> int:
+    return max(q, (n + q - 1) // q * q)
+
+
+class MeshDatabase:
+    """Per-shard table partitions + shared dictionaries for mesh runs.
+
+    ``sources[table]`` is a list of per-shard ColumnSource /
+    PortionStreamSource objects, EXACTLY one per mesh device
+    (row-partitioned tables; partition a small table with empty-slice
+    sources for the extra devices).
+    """
+
+    def __init__(self, sources: dict[str, list], dicts=None,
+                 key_spaces=None):
+        self.sources = sources
+        self.dicts = dicts if dicts is not None else DictionarySet()
+        self.key_spaces = key_spaces
+
+
+class MeshPlanExecutor:
+    """Executes a logical plan tree SPMD over the mesh."""
+
+    def __init__(self, db: MeshDatabase, mesh=None):
+        self.db = db
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n = self.mesh.shape[SHARD_AXIS]
+        self._jit_cache: dict = {}
+
+    # ---- node execution (stacked, device-sharded results) ----
+
+    def execute(self, plan) -> OracleTable:
+        out = self._exec(plan, {}, root=True)
+        return OracleTable.from_block(out)
+
+    def _exec(self, plan, memo: dict, root: bool = False):
+        hit = memo.get(id(plan))
+        if hit is not None:
+            return hit
+        if isinstance(plan, TableScan):
+            out = self._scan(plan)
+        elif isinstance(plan, LookupJoin):
+            out = self._join(plan, memo, expand=False)
+        elif isinstance(plan, ExpandJoin):
+            out = self._join(plan, memo, expand=True)
+        elif isinstance(plan, Transform):
+            out = self._transform(plan, memo, root)
+        else:
+            raise NotImplementedError(plan)
+        memo[id(plan)] = out
+        return out
+
+    def _shard_it(self, stacked: TableBlock) -> TableBlock:
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        return jax.device_put(stacked, sharding)
+
+    def _scan(self, plan: TableScan) -> TableBlock:
+        """Per-shard scan: pushdown program runs in each shard's scan
+        executor; per-shard results pad-stack onto the mesh."""
+        subs = self.db.sources[plan.table]
+        if len(subs) != self.n:
+            # more sources than devices would silently drop every block
+            # past the first per device (sharded leading axis)
+            raise ValueError(
+                f"table {plan.table} has {len(subs)} shards for a"
+                f" {self.n}-device mesh (need exactly one per device)")
+        locals_: list[TableBlock] = []
+        for sub in subs:
+            if plan.program is None:
+                names = plan.columns or sub.schema.names
+                blks = list(sub.blocks(1 << 20, names))
+                blk = blks[0] if len(blks) == 1 else _concat(blks)
+            else:
+                ex = ScanExecutor(plan.program, sub, block_rows=1 << 20,
+                                  key_spaces=self.db.key_spaces)
+                blk = ex.run_stream(sub.blocks(1 << 20, ex.read_cols))
+            locals_.append(blk)
+        cap = _round_up(max(int(b.length) for b in locals_))
+        return self._shard_it(stack_blocks(
+            [_pad_state(self._slice(b, cap), cap) for b in locals_]))
+
+    @staticmethod
+    def _slice(block: TableBlock, cap: int) -> TableBlock:
+        if block.capacity <= cap:
+            return block
+        cols = {
+            n: Column(c.data[:cap], c.validity[:cap])
+            for n, c in block.columns.items()
+        }
+        return TableBlock(cols, block.length, block.schema)
+
+    def _join(self, plan, memo, expand: bool) -> TableBlock:
+        probe = self._exec(plan.probe, memo)
+        build = self._exec(plan.build, memo)
+        pkeys = list(plan.probe_keys)
+        bkeys = list(plan.build_keys)
+        probe = self._repartition(probe, pkeys)
+        build = self._repartition(build, bkeys)
+        if not expand:
+            return self._local_lookup(plan, probe, build)
+        return self._local_expand(plan, probe, build)
+
+    # -- repartition with overflow retry --
+
+    def _repartition(self, stacked: TableBlock, keys: list[str]):
+        cap = stacked.capacity
+        B = _round_up(2 * cap // self.n + 1)
+        while True:
+            key = ("repart", stacked.schema, tuple(keys), cap, B)
+            step = self._jit_cache.get(key)
+            if step is None:
+                n = self.n
+
+                def go(st):
+                    blk, over = repartition(
+                        _local(st), keys, n, bucket_rows=B,
+                        with_overflow=True)
+                    return _relocal(blk), over[None]
+
+                step = jax.jit(jax.shard_map(
+                    go, mesh=self.mesh, in_specs=P(SHARD_AXIS),
+                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    check_vma=False,
+                ))
+                self._jit_cache[key] = step
+            out, over = step(stacked)
+            if not bool(np.any(np.asarray(over))):
+                return self._tighten(out)
+            B *= 2  # grace respill: double the bucket and re-exchange
+
+    def _tighten(self, stacked: TableBlock) -> TableBlock:
+        """Slice a front-packed stacked block down to a tight capacity so
+        join/shuffle output capacities do not compound across stages."""
+        max_len = int(np.asarray(stacked.length).max())
+        cap = _round_up(max_len)
+        if cap >= stacked.capacity:
+            return stacked
+        cols = {
+            n: Column(c.data[:, :cap], c.validity[:, :cap])
+            for n, c in stacked.columns.items()
+        }
+        return TableBlock(cols, stacked.length, stacked.schema)
+
+    # -- local joins --
+
+    def _local_lookup(self, plan: LookupJoin, probe, build):
+        key = ("lookup", plan.probe_keys, plan.build_keys, plan.payload,
+               plan.kind, plan.suffix, probe.schema, build.schema,
+               probe.capacity, build.capacity)
+        step = self._jit_cache.get(key)
+        if step is None:
+            def go(pst, bst):
+                p, b = _local(pst), _local(bst)
+                joined, found = join_kernels.lookup_join(
+                    p, b, list(plan.probe_keys), list(plan.build_keys),
+                    list(plan.payload), plan.suffix)
+                if plan.kind == "inner":
+                    out = kernels.compact(joined, found)
+                elif plan.kind == "left":
+                    out = joined
+                elif plan.kind == "semi":
+                    out = kernels.compact(p, found)
+                elif plan.kind == "anti":
+                    out = kernels.compact(p, ~found & p.row_mask())
+                else:
+                    raise ValueError(plan.kind)
+                return _relocal(out)
+
+            step = jax.jit(jax.shard_map(
+                go, mesh=self.mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                out_specs=P(SHARD_AXIS), check_vma=False,
+            ))
+            self._jit_cache[key] = step
+        return self._tighten(step(probe, build))
+
+    def _local_expand(self, plan: ExpandJoin, probe, build):
+        cap = _round_up(max(int(probe.capacity * plan.fanout_hint), 1024))
+        while True:
+            key = ("expand", plan.probe_keys, plan.build_keys,
+                   plan.probe_payload, plan.build_payload, plan.kind,
+                   plan.build_suffix, probe.schema, build.schema,
+                   probe.capacity, build.capacity, cap)
+            step = self._jit_cache.get(key)
+            if step is None:
+                def go(pst, bst):
+                    out, total = join_kernels.expand_join(
+                        _local(pst), _local(bst),
+                        list(plan.probe_keys), list(plan.build_keys),
+                        list(plan.probe_payload), list(plan.build_payload),
+                        out_capacity=cap, build_suffix=plan.build_suffix,
+                        kind=plan.kind)
+                    return _relocal(out), total[None]
+
+                step = jax.jit(jax.shard_map(
+                    go, mesh=self.mesh,
+                    in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    check_vma=False,
+                ))
+                self._jit_cache[key] = step
+            out, totals = step(probe, build)
+            worst = int(np.asarray(totals).max())
+            if worst <= cap:
+                return self._tighten(out)
+            cap = _round_up(worst)
+
+    # -- final transform (two-phase over the mesh) --
+
+    def _transform(self, plan: Transform, memo, root: bool):
+        stacked = self._exec(plan.input, memo)
+        has_gb = plan.program.group_by is not None
+        has_sort = any(isinstance(s, SortStep) for s in plan.program.steps)
+        if not (has_gb or has_sort):
+            # distributed elementwise transform: stays sharded
+            key = ("xform", plan.program, plan.dict_aliases,
+                   stacked.schema, stacked.capacity)
+            step = self._jit_cache.get(key)
+            if step is None:
+                from ydb_tpu.ssa.compiler import compile_program
+
+                cp = compile_program(
+                    plan.program, stacked.schema, self.db.dicts,
+                    self.db.key_spaces,
+                    dict_aliases=dict(plan.dict_aliases))
+                aux = {k: jnp.asarray(v) for k, v in cp.aux.items()}
+
+                def go(st):
+                    return _relocal(cp.run(_local(st), aux))
+
+                step = jax.jit(jax.shard_map(
+                    go, mesh=self.mesh, in_specs=P(SHARD_AXIS),
+                    out_specs=P(SHARD_AXIS), check_vma=False,
+                ))
+                self._jit_cache[key] = step
+            return self._tighten(step(stacked))
+        if not root:
+            raise NotImplementedError(
+                "non-root aggregating Transform on the mesh")
+        key = ("final", plan.program, plan.dict_aliases, stacked.schema,
+               stacked.capacity)
+        scan = self._jit_cache.get(key)
+        if scan is None:
+            scan = MeshScan(
+                plan.program, stacked.schema, self.db.dicts,
+                self.db.key_spaces, mesh=self.mesh,
+                dict_aliases=dict(plan.dict_aliases),
+            )
+            self._jit_cache[key] = scan
+        # MeshScan's step expects the partial program's read columns only
+        return scan.run_stacked(stacked)
+
+
+def _concat(blocks: list[TableBlock]) -> TableBlock:
+    from ydb_tpu.blocks.block import concat_blocks
+
+    return concat_blocks(blocks)

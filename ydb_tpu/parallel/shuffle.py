@@ -47,12 +47,16 @@ def repartition(
     key_names: list[str],
     n_shards: int,
     bucket_rows: int | None = None,
-) -> TableBlock:
+    with_overflow: bool = False,
+) -> "TableBlock | tuple[TableBlock, jax.Array]":
     """Exchange rows so each shard owns hash(keys) % n_shards == its index.
 
     Must run inside shard_map over the ``shard`` axis. Returns a local
-    block of capacity n_shards * bucket_rows.
-    """
+    block of capacity n_shards * bucket_rows. With ``with_overflow``,
+    returns (block, overflowed: bool scalar) — True when any send bucket
+    exceeded ``bucket_rows`` and rows were dropped; callers retry with a
+    bigger bucket (the grace-join respill protocol,
+    mkql_grace_join_imp.cpp bucket overflow)."""
     cap = block.capacity
     B = bucket_rows if bucket_rows is not None else cap
     live = block.row_mask()
@@ -108,4 +112,10 @@ def repartition(
     )
     from ydb_tpu.ssa import kernels
 
-    return kernels.compact(big, mask)
+    out = kernels.compact(big, mask)
+    if not with_overflow:
+        return out
+    overflowed = jnp.any(counts[:n_shards] > B)
+    # a drop anywhere poisons every shard's result: reduce over the mesh
+    overflowed = jax.lax.pmax(overflowed, SHARD_AXIS)
+    return out, overflowed
