@@ -1,0 +1,113 @@
+"""SQL through the DQ stage graph: planned SELECTs lower to scan ->
+hash-partition channels -> grace-bucket join stages -> final aggregate,
+executed by the credit-flow compute actors on the simulated multi-node
+runtime — and match the single-chip executor (VERDICT r4 item 6)."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.kqp.dq_lower import (
+    execute_plan_dq,
+    partition_source,
+    plan_to_stages,
+)
+from ydb_tpu.plan import Database, execute_plan, to_host
+from ydb_tpu.runtime.test_runtime import SimRuntime
+from ydb_tpu.sql.parser import parse
+from ydb_tpu.sql.planner import Catalog, plan_select_full
+from ydb_tpu.workload import tpch
+from ydb_tpu.workload.queries import TPCH
+
+N_TASKS = 3
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch.TpchData(sf=0.004, seed=17)
+
+
+@pytest.fixture(scope="module")
+def catalog(data):
+    return Catalog(
+        schemas={t: data.schema(t) for t in data.tables},
+        primary_keys=dict(tpch.PRIMARY_KEYS),
+        dicts=data.dicts,
+    )
+
+
+@pytest.fixture(scope="module")
+def single_db(data):
+    return Database(
+        sources={
+            t: ColumnSource(cols, data.schema(t), data.dicts)
+            for t, cols in data.tables.items()
+        },
+        dicts=data.dicts,
+    )
+
+
+@pytest.fixture(scope="module")
+def dq_sources(data):
+    return {
+        t: partition_source(
+            ColumnSource(cols, data.schema(t), data.dicts), N_TASKS)
+        for t, cols in data.tables.items()
+    }
+
+
+def _run_both(name, catalog, single_db, dq_sources, data):
+    plan = plan_select_full(parse(TPCH[name]), catalog).plan
+    ref = to_host(execute_plan(plan, single_db))
+    rt = SimRuntime(n_nodes=2)
+    res = execute_plan_dq(plan, dq_sources, rt, dicts=data.dicts,
+                          n_tasks=N_TASKS, block_rows=1 << 12)
+    return res, ref
+
+
+def _match(res, ref, cols):
+    assert res.num_rows == ref.num_rows
+    for c in cols:
+        np.testing.assert_array_equal(
+            np.asarray(res.cols[c][0]), np.asarray(ref.cols[c][0]),
+            err_msg=c)
+
+
+def test_q1_through_dq(data, catalog, single_db, dq_sources):
+    res, ref = _run_both("q1", catalog, single_db, dq_sources, data)
+    _match(res, ref, ("l_returnflag", "l_linestatus", "sum_qty",
+                      "sum_charge", "count_order"))
+
+
+def test_q3_join_through_dq(data, catalog, single_db, dq_sources):
+    res, ref = _run_both("q3", catalog, single_db, dq_sources, data)
+    _match(res, ref, ("l_orderkey", "revenue", "o_orderdate",
+                      "o_shippriority"))
+
+
+def test_q5_join_chain_through_dq(data, catalog, single_db, dq_sources):
+    res, ref = _run_both("q5", catalog, single_db, dq_sources, data)
+    _match(res, ref, ("n_name", "revenue"))
+
+
+def test_q12_case_agg_through_dq(data, catalog, single_db, dq_sources):
+    res, ref = _run_both("q12", catalog, single_db, dq_sources, data)
+    _match(res, ref, ("l_shipmode", "high_line_count", "low_line_count"))
+
+
+def test_stage_graph_shape(catalog):
+    """q3 lowers to scan stages -> hash-partitioned join stages -> one
+    result transform; joins never get a whole-table UnionAll input."""
+    from ydb_tpu.dq.graph import HashPartition, ResultOutput
+
+    plan = plan_select_full(parse(TPCH["q3"]), catalog).plan
+    stages = plan_to_stages(plan, n_tasks=4)
+    joins = [s for s in stages if s.join is not None]
+    assert len(joins) >= 2
+    for s in joins:
+        assert s.tasks == 4
+        for inp in s.inputs:
+            up = stages[inp.from_stage]
+            assert isinstance(up.output, HashPartition)
+    assert isinstance(stages[-1].output, ResultOutput)
+    assert stages[-1].tasks == 1

@@ -120,13 +120,28 @@ def _split_by_hash(payload: dict, h: np.ndarray, n: int) -> list[dict]:
 
 
 class _CompiledStage:
-    """Per-stage compiled programs + schemas (shared by its tasks)."""
+    """Per-stage compiled programs + schemas (shared by its tasks).
 
-    def __init__(self, spec: StageSpec, in_schema, dicts, key_spaces):
+    ``in_schemas`` has one schema per stage input; join stages have two
+    (probe, build) and every other stage exactly one shared schema."""
+
+    def __init__(self, spec: StageSpec, in_schemas, dicts, key_spaces):
+        self.in_schemas = list(in_schemas)
+        in_schema = in_schemas[0]
         self.in_schema = in_schema
+        if spec.join is not None:
+            self.per_block = None
+            self.final = None
+            self.join = spec.join
+            self.out_schema = _join_out_schema(
+                spec.join, in_schemas[0], in_schemas[1])
+            self.mid_schema = self.out_schema
+            return
+        self.join = None
         if spec.program is not None:
             self.per_block = compile_program(
-                spec.program, in_schema, dicts, key_spaces
+                spec.program, in_schema, dicts, key_spaces,
+                dict_aliases=dict(spec.dict_aliases),
             )
             mid = self.per_block.out_schema
             self._pb_aux = {
@@ -139,10 +154,9 @@ class _CompiledStage:
         if spec.final_program is not None:
             from ydb_tpu.ssa import twophase
 
-            aliases = (
-                twophase.dict_aliases(spec.program)
-                if spec.program is not None else None
-            )
+            aliases = dict(spec.dict_aliases)
+            if spec.program is not None:
+                aliases.update(twophase.dict_aliases(spec.program))
             self.final = compile_program(
                 spec.final_program, mid, dicts, key_spaces,
                 dict_aliases=aliases,
@@ -159,6 +173,20 @@ class _CompiledStage:
         if self.per_block is None:
             return block
         return self.per_block.run(block, self._pb_aux)
+
+    def run_join(self, probe: TableBlock, build: TableBlock) -> TableBlock:
+        """Device-local join of this task's hash bucket (grace bucket
+        join, mkql_grace_join_imp.cpp bucket processing). Shares the
+        exact dispatch with the single-chip executor (run_equi_join)."""
+        from ydb_tpu.ssa import join as join_kernels
+
+        j = self.join
+        return join_kernels.run_equi_join(
+            probe, build, j.probe_keys, j.build_keys, kind=j.kind,
+            suffix=j.suffix, expand=j.expand, payload=j.payload,
+            probe_payload=j.probe_payload, build_payload=j.build_payload,
+            fanout_hint=j.fanout_hint,
+        )
 
     def run_final(self, blocks: list[TableBlock]) -> TableBlock:
         merged = blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
@@ -198,6 +226,9 @@ class ComputeActor(Actor):
 
         self._in_finished: set[int] = set()
         self._acc: list[TableBlock] = []  # agg stages accumulate
+        # join stages accumulate their hash bucket per side (payloads
+        # stay host-side until the single device-local bucket join)
+        self._join_acc: dict[int, list] = {0: [], 1: []}
         self._unacked: dict[int, int] = {c: 0 for c in task.output_channels}
         self._parked: dict[int, collections.deque] = {
             c: collections.deque() for c in task.output_channels
@@ -234,6 +265,10 @@ class ComputeActor(Actor):
                     payload_to_block(p, self.compiled.mid_schema)
                     for p in state["acc"]
                 ]
+                self._join_acc = {
+                    int(k): list(v)
+                    for k, v in state.get("join_acc", {}).items()
+                } or {0: [], 1: []}
                 self._source_pos = state["source_pos"]
                 self.block_rows = state["block_rows"]
                 self._in_finished = set(state["in_finished"])
@@ -278,9 +313,13 @@ class ComputeActor(Actor):
 
     def _apply_channel_data(self, message: ChannelData):
         if message.payload is not None:
-            blk = payload_to_block(message.payload,
-                                   self.compiled.in_schema)
-            self._ingest(blk)
+            if self.compiled.join is not None:
+                idx = self.channel_specs[message.channel_id].input_index
+                self._join_acc[idx].append(message.payload)
+            else:
+                blk = payload_to_block(message.payload,
+                                       self.compiled.in_schema)
+                self._ingest(blk)
         if message.finished:
             self._in_finished.add(message.channel_id)
             self._check_alignment()  # finished counts as aligned
@@ -311,6 +350,9 @@ class ComputeActor(Actor):
             self.checkpoint_storage.save_task(checkpoint_id,
                                               self.task.task_id, {
                 "acc": [block_to_payload(b) for b in self._acc],
+                # join stages: both sides' accumulated bucket payloads
+                "join_acc": {k: list(v)
+                             for k, v in self._join_acc.items()},
                 # position is counted in BLOCKS of this block size; the
                 # restore pins block_rows so the count stays meaningful
                 "source_pos": self._source_pos,
@@ -387,6 +429,15 @@ class ComputeActor(Actor):
 
     def _finish_input(self):
         spec = self.task.stage_spec
+        if self.compiled.join is not None:
+            probe = _assemble(self._join_acc[0],
+                              self.compiled.in_schemas[0])
+            build = _assemble(self._join_acc[1],
+                              self.compiled.in_schemas[1])
+            self._join_acc = {0: [], 1: []}
+            self._emit(self.compiled.run_join(probe, build))
+            self._finish_output()
+            return
         if spec.final_program is not None:
             if self._acc:
                 self._emit(self.compiled.run_final(self._acc))
@@ -464,6 +515,41 @@ class ComputeActor(Actor):
             self._dispatch(ch, None, finished=True)
 
 
+def _assemble(payloads: list[dict], schema: dtypes.Schema) -> TableBlock:
+    """Concat channel payloads into one block (capacity >= 1 so the join
+    kernels' searchsorted shapes stay valid on empty sides)."""
+    cols = {}
+    validity = {}
+    for f in schema.fields:
+        parts = [p[f.name] for p in payloads]
+        vparts = [p[f"__v_{f.name}"] for p in payloads]
+        cols[f.name] = (np.concatenate(parts) if parts
+                        else np.empty(0, dtype=f.type.physical))
+        validity[f.name] = (np.concatenate(vparts) if vparts
+                            else np.empty(0, dtype=bool))
+    n = len(next(iter(cols.values()))) if cols else 0
+    return TableBlock.from_numpy(cols, schema, validity,
+                                 capacity=max(n, 1))
+
+
+def _join_out_schema(j, probe_schema: dtypes.Schema,
+                     build_schema: dtypes.Schema) -> dtypes.Schema:
+    """Static output schema of a join stage."""
+    if not j.expand:
+        if j.kind in ("semi", "anti"):
+            return probe_schema
+        fields = list(probe_schema.fields)
+        for n in j.payload:
+            fields.append(dtypes.Field(n + j.suffix,
+                                       build_schema.field(n).type))
+        return dtypes.Schema(tuple(fields))
+    fields = [probe_schema.field(n) for n in j.probe_payload]
+    for n in j.build_payload:
+        fields.append(dtypes.Field(n + j.suffix,
+                                   build_schema.field(n).type))
+    return dtypes.Schema(tuple(fields))
+
+
 def _empty_block(schema: dtypes.Schema) -> TableBlock:
     cols = {
         f.name: np.empty(0, dtype=f.type.physical) for f in schema.fields
@@ -526,6 +612,7 @@ def build_stage_graph(
     window: int = DEFAULT_WINDOW,
     checkpoint_storage=None,
     restore_checkpoint: int | None = None,
+    block_rows: int = 1 << 16,
 ) -> GraphHandle:
     """Compile stages, place tasks round-robin over the runtime's nodes,
     wire channels (the executer-actor shape, kqp_executer_impl.h:120 +
@@ -543,7 +630,11 @@ def build_stage_graph(
                 in_schemas.append(compiled[inp.from_stage].out_schema)
         if not in_schemas:
             raise ValueError("stage with no inputs")
-        if any(s != in_schemas[0] for s in in_schemas[1:]):
+        if spec.join is not None:
+            if len(in_schemas) != 2:
+                raise ValueError(
+                    f"join stage {si} needs exactly (probe, build) inputs")
+        elif any(s != in_schemas[0] for s in in_schemas[1:]):
             # every channel payload decodes with one schema; unequal
             # upstream schemas would silently mislabel columns
             raise ValueError(
@@ -551,7 +642,7 @@ def build_stage_graph(
                 f"{[s.names for s in in_schemas]}"
             )
         compiled.append(
-            _CompiledStage(spec, in_schemas[0], dicts, key_spaces)
+            _CompiledStage(spec, in_schemas, dicts, key_spaces)
         )
 
     tasks, channels, result_stage = build_tasks(stages)
@@ -579,6 +670,7 @@ def build_stage_graph(
             spiller=Spiller(mem_quota_bytes=spill_quota_bytes,
                             prefix=f"spill/task{t.task_id}"),
             window=window,
+            block_rows=block_rows,
             checkpoint_storage=checkpoint_storage,
             restore_checkpoint=restore_checkpoint,
         )
@@ -619,11 +711,12 @@ def run_stage_graph(
     window: int = DEFAULT_WINDOW,
     checkpoint_storage=None,
     restore_checkpoint: int | None = None,
+    block_rows: int = 1 << 16,
 ) -> OracleTable:
     """Build + run to completion, return the result table."""
     handle = build_stage_graph(
         stages, sources, runtime, dicts, key_spaces, spill_quota_bytes,
-        window, checkpoint_storage, restore_checkpoint)
+        window, checkpoint_storage, restore_checkpoint, block_rows)
     handle.start()
     if hasattr(runtime, "dispatch"):
         runtime.dispatch()

@@ -58,16 +58,38 @@ class ResultOutput:
 
 
 @dataclasses.dataclass(frozen=True)
+class JoinSpec:
+    """A join stage's operator: input 0 is the probe side, input 1 the
+    build side; both arrive hash-partitioned on their join keys so each
+    task joins its bucket device-locally (the GraceJoin shape,
+    mkql_grace_join.cpp:558 — ICI/channels as the spill fabric)."""
+
+    probe_keys: tuple[str, ...]
+    build_keys: tuple[str, ...]
+    payload: tuple[str, ...] = ()          # lookup join: build columns
+    probe_payload: tuple[str, ...] = ()    # expand join
+    build_payload: tuple[str, ...] = ()
+    kind: str = "inner"  # inner | left | semi | anti (expand: inner|left)
+    suffix: str = ""
+    expand: bool = False  # N:M expansion vs N:1 lookup
+    fanout_hint: float = 4.0  # expand: initial output capacity multiple
+
+
+@dataclasses.dataclass(frozen=True)
 class StageSpec:
     """One stage: per-block ``program`` (map/partial phase), optional
     ``final_program`` applied to the accumulated inputs (aggregate merge),
-    input wiring, output routing and task parallelism."""
+    optional ``join`` operator (two inputs: probe, build), input wiring,
+    output routing and task parallelism."""
 
     program: Program | None
     inputs: tuple
     output: object
     tasks: int = 1
     final_program: Program | None = None
+    join: JoinSpec | None = None
+    # (renamed col -> dictionary source col) for program compilation
+    dict_aliases: tuple[tuple[str, str], ...] = ()
 
 
 @dataclasses.dataclass

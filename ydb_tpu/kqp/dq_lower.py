@@ -1,0 +1,129 @@
+"""Logical plan -> DQ stage graph: the distributed execution path for
+SQL statements.
+
+The reference builds a task graph from the physical plan — scan stages
+feeding hash-partition channels into join/aggregate stages and a result
+channel (kqp_tasks_graph.cpp:448,778; planner kqp_planner.cpp:116). This
+module is the TPU build's equivalent lowering over the SAME plan nodes
+the single-chip executor walks (ydb_tpu.plan.nodes):
+
+  TableScan   -> N-task stage reading table partitions, pushdown program
+  Lookup/Expand joins -> both inputs hash-repartition on their join keys
+                 over the channels; each task joins its grace bucket
+                 device-locally (join stages, dq/compute.py run_join)
+  Transform   -> two-phase split: per-block partial program on the
+                 stream, final merge program at the single result task
+
+Compared to the in-process recursive executor, joins never materialize a
+whole table in one place: each join task holds 1/N of each side (the
+GraceJoin memory shape), streamed in through credit-flow channels with
+spill-beyond-quota.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ydb_tpu.dq.graph import (
+    HashPartition,
+    JoinSpec,
+    ResultOutput,
+    SourceInput,
+    StageSpec,
+    UnionAll,
+    UnionAllInput,
+)
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, Transform
+from ydb_tpu.ssa import twophase
+
+
+def plan_to_stages(plan, n_tasks: int = 2) -> list[StageSpec]:
+    """Lower a logical plan tree to DQ stages (root must be a Transform,
+    which the SQL planner guarantees)."""
+    stages: list[dict] = []  # mutable specs; frozen at the end
+
+    def add(**kw) -> int:
+        stages.append(kw)
+        return len(stages) - 1
+
+    def set_output(si: int, out) -> None:
+        if stages[si]["output"] is None:
+            stages[si]["output"] = out
+            return
+        raise ValueError(
+            "stage feeds two consumers; duplicate the subtree instead")
+
+    def lower(node) -> int:
+        if isinstance(node, TableScan):
+            return add(program=node.program,
+                       inputs=(SourceInput(node.table),),
+                       output=None, tasks=n_tasks)
+        if isinstance(node, (LookupJoin, ExpandJoin)):
+            pi = lower(node.probe)
+            bi = lower(node.build)
+            set_output(pi, HashPartition(tuple(node.probe_keys)))
+            set_output(bi, HashPartition(tuple(node.build_keys)))
+            if isinstance(node, LookupJoin):
+                j = JoinSpec(node.probe_keys, node.build_keys,
+                             payload=node.payload, kind=node.kind,
+                             suffix=node.suffix)
+            else:
+                j = JoinSpec(node.probe_keys, node.build_keys,
+                             probe_payload=node.probe_payload,
+                             build_payload=node.build_payload,
+                             kind=node.kind, suffix=node.build_suffix,
+                             expand=True, fanout_hint=node.fanout_hint)
+            return add(program=None,
+                       inputs=(UnionAllInput(pi), UnionAllInput(bi)),
+                       output=None, tasks=n_tasks, join=j)
+        if isinstance(node, Transform):
+            ii = lower(node.input)
+            set_output(ii, UnionAll())
+            partial, final = twophase.split(node.program)
+            return add(program=partial, final_program=final,
+                       inputs=(UnionAllInput(ii),), output=None, tasks=1,
+                       dict_aliases=node.dict_aliases)
+        raise NotImplementedError(node)
+
+    root = lower(plan)
+    set_output(root, ResultOutput())
+    out = []
+    for kw in stages:
+        kw.setdefault("join", None)
+        kw.setdefault("final_program", None)
+        kw.setdefault("dict_aliases", ())
+        out.append(StageSpec(**kw))
+    return out
+
+
+def partition_source(src: ColumnSource, k: int) -> list[ColumnSource]:
+    """Round-robin row partitions of a host table (scan-task feeding)."""
+    out = []
+    for s in range(k):
+        cols = {n: v[s::k] for n, v in src.columns.items()}
+        validity = None
+        if src.validity:
+            validity = {n: v[s::k] for n, v in src.validity.items()}
+        out.append(ColumnSource(cols, src.schema, src.dicts, validity))
+    return out
+
+
+def execute_plan_dq(
+    plan,
+    sources: dict[str, list[ColumnSource]],
+    runtime,
+    dicts=None,
+    key_spaces=None,
+    n_tasks: int = 2,
+    **graph_kw,
+) -> OracleTable:
+    """Run a logical plan through the DQ stage graph on ``runtime``
+    (SimRuntime or a single ActorSystem). ``sources`` maps each table to
+    its partition list (see partition_source)."""
+    from ydb_tpu.dq.compute import run_stage_graph
+
+    stages = plan_to_stages(plan, n_tasks=n_tasks)
+    return run_stage_graph(stages, sources, runtime, dicts, key_spaces,
+                           **graph_kw)

@@ -202,20 +202,12 @@ class MeshPlanExecutor:
         step = self._jit_cache.get(key)
         if step is None:
             def go(pst, bst):
-                p, b = _local(pst), _local(bst)
-                joined, found = join_kernels.lookup_join(
-                    p, b, list(plan.probe_keys), list(plan.build_keys),
-                    list(plan.payload), plan.suffix)
-                if plan.kind == "inner":
-                    out = kernels.compact(joined, found)
-                elif plan.kind == "left":
-                    out = joined
-                elif plan.kind == "semi":
-                    out = kernels.compact(p, found)
-                elif plan.kind == "anti":
-                    out = kernels.compact(p, ~found & p.row_mask())
-                else:
-                    raise ValueError(plan.kind)
+                # shared dispatch with the single-chip executor/DQ path
+                # (lookup joins are jit-safe; no host retry involved)
+                out = join_kernels.run_equi_join(
+                    _local(pst), _local(bst), plan.probe_keys,
+                    plan.build_keys, kind=plan.kind, suffix=plan.suffix,
+                    payload=plan.payload)
                 return _relocal(out)
 
             step = jax.jit(jax.shard_map(

@@ -89,33 +89,20 @@ def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
     if isinstance(plan, LookupJoin):
         probe = execute_plan(plan.probe, db, _memo)
         build = execute_plan(plan.build, db, _memo)
-        joined, found = join_kernels.lookup_join(
-            probe, build, list(plan.probe_keys), list(plan.build_keys),
-            list(plan.payload), plan.suffix,
+        return join_kernels.run_equi_join(
+            probe, build, plan.probe_keys, plan.build_keys,
+            kind=plan.kind, suffix=plan.suffix, payload=plan.payload,
         )
-        if plan.kind == "inner":
-            return kernels.compact(joined, found)
-        if plan.kind == "left":
-            return joined
-        if plan.kind == "semi":
-            return kernels.compact(probe, found)
-        if plan.kind == "anti":
-            return kernels.compact(probe, ~found & probe.row_mask())
-        raise ValueError(plan.kind)
     if isinstance(plan, ExpandJoin):
         probe = execute_plan(plan.probe, db, _memo)
         build = execute_plan(plan.build, db, _memo)
-        cap = max(int(probe.capacity * plan.fanout_hint), 1024)
-        while True:
-            out, total = join_kernels.expand_join(
-                probe, build, list(plan.probe_keys), list(plan.build_keys),
-                list(plan.probe_payload), list(plan.build_payload),
-                out_capacity=cap, build_suffix=plan.build_suffix,
-                kind=plan.kind,
-            )
-            if int(total) <= cap:
-                return out
-            cap = int(int(total) + 1023) // 1024 * 1024  # exact retry
+        return join_kernels.run_equi_join(
+            probe, build, plan.probe_keys, plan.build_keys,
+            kind=plan.kind, suffix=plan.build_suffix, expand=True,
+            probe_payload=plan.probe_payload,
+            build_payload=plan.build_payload,
+            fanout_hint=plan.fanout_hint,
+        )
     if isinstance(plan, Transform):
         block = execute_plan(plan.input, db, _memo)
         key = (plan.program, plan.dict_aliases, block.schema)

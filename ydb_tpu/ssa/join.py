@@ -126,6 +126,55 @@ def lookup_join(
     return TableBlock(out_cols, probe.length, sch), found
 
 
+def run_equi_join(
+    probe: TableBlock,
+    build: TableBlock,
+    probe_keys,
+    build_keys,
+    kind: str = "inner",
+    suffix: str = "",
+    expand: bool = False,
+    payload=(),
+    probe_payload=(),
+    build_payload=(),
+    fanout_hint: float = 4.0,
+) -> TableBlock:
+    """One dispatch for every equi-join shape — the single-chip plan
+    executor and the DQ grace-bucket join call THIS so their semantics
+    cannot drift (test_sql_dq.py asserts bit parity between the paths).
+
+    Lookup (N:1) joins support inner/left/semi/anti; expand (N:M) joins
+    support inner/left and retry with exact capacity on overflow.
+    """
+    from ydb_tpu.ssa import kernels
+
+    if not expand:
+        joined, found = lookup_join(
+            probe, build, list(probe_keys), list(build_keys),
+            list(payload), suffix)
+        if kind == "inner":
+            return kernels.compact(joined, found)
+        if kind == "left":
+            return joined
+        if kind == "semi":
+            return kernels.compact(probe, found)
+        if kind == "anti":
+            return kernels.compact(probe, ~found & probe.row_mask())
+        raise ValueError(kind)
+    if kind not in ("inner", "left"):
+        # expand_join silently computes INNER for anything else
+        raise ValueError(f"expand join does not support kind {kind!r}")
+    cap = max(int(probe.capacity * fanout_hint), 1024)
+    while True:
+        out, total = expand_join(
+            probe, build, list(probe_keys), list(build_keys),
+            list(probe_payload), list(build_payload),
+            out_capacity=cap, build_suffix=suffix, kind=kind)
+        if int(total) <= cap:
+            return out
+        cap = int(int(total) + 1023) // 1024 * 1024  # exact retry
+
+
 def expand_join(
     probe: TableBlock,
     build: TableBlock,
