@@ -1,0 +1,160 @@
+"""Cross-PROCESS actor transport: two real OS processes, TCP sessions,
+handshake, undelivered notifications, and tablet-style failover — the
+actor system's node boundary stops being a simulation (VERDICT r4 item
+7; reference interconnect_tcp_proxy.h:20)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ydb_tpu.runtime.actors import Actor, ActorId, ActorSystem
+from ydb_tpu.runtime.interconnect import Interconnect, Undelivered
+
+CHILD = r"""
+import sys
+from ydb_tpu.engine.blobs import DirBlobStore
+from ydb_tpu.runtime.actors import Actor, ActorSystem
+from ydb_tpu.runtime.interconnect import Interconnect
+
+store_dir, port_file = sys.argv[1], sys.argv[2]
+
+
+class CounterTablet(Actor):
+    '''Minimal persistent tablet: WAL-append each increment, replay on
+    boot — killing the process loses nothing.'''
+
+    def __init__(self, store):
+        super().__init__()
+        self.store = store
+        self.n = 0
+        self.seq = 0
+        for bid in store.list("wal/"):
+            self.n += 1
+            self.seq += 1
+
+    def receive(self, message, sender):
+        if message == ("inc",):
+            self.seq += 1
+            self.store.put(f"wal/{self.seq:08d}", b"+1")
+            self.n += 1
+            self.send(sender, ("ack", self.n))
+        elif message == ("get",):
+            self.send(sender, ("val", self.n))
+
+
+system = ActorSystem(node=2)
+tablet = CounterTablet(DirBlobStore(store_dir))
+system.register(tablet)  # ActorId(2, 1)
+ic = Interconnect(system, listen_port=0)
+with open(port_file + ".tmp", "w") as f:
+    f.write(str(ic.port))
+import os
+os.replace(port_file + ".tmp", port_file)
+ic.serve()
+"""
+
+
+def _spawn_child(store_dir, port_file):
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", CHILD, str(store_dir), str(port_file)],
+        env=env,
+    )
+    deadline = time.monotonic() + 30
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise RuntimeError("child died during startup")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise TimeoutError("child did not report a port")
+        time.sleep(0.02)
+    with open(port_file) as f:
+        return proc, int(f.read())
+
+
+class Client(Actor):
+    def __init__(self):
+        super().__init__()
+        self.got = []
+
+    def receive(self, message, sender):
+        self.got.append(message)
+
+
+def _pump_until(ic, cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ic.pump(0.05)
+        if cond():
+            return
+    raise TimeoutError("condition not reached")
+
+
+def test_two_process_transport_and_failover(tmp_path):
+    store_dir = tmp_path / "tablet_store"
+    system = ActorSystem(node=1)
+    client = Client()
+    client_id = system.register(client)
+    ic = Interconnect(system, listen_port=0)
+    tablet_id = ActorId(2, 1)
+
+    proc1, port1 = _spawn_child(store_dir, str(tmp_path / "p1.port"))
+    try:
+        ic.add_peer(2, "127.0.0.1", port1)
+
+        # three increments over the wire, acked over the wire back
+        for _ in range(3):
+            system.send(tablet_id, ("inc",), sender=client_id)
+        _pump_until(ic, lambda: len(client.got) >= 3)
+        assert client.got[-1] == ("ack", 3)
+
+        # hard-kill the node: in-flight peer session dies
+        proc1.kill()
+        proc1.wait(timeout=10)
+
+        # sends now produce Undelivered notifications (pipes would
+        # retry). The FIRST send after a kill can still succeed locally
+        # (TCP buffers it; the RST arrives later), so keep sending until
+        # the session observes the dead peer.
+        client.got.clear()
+        deadline = time.monotonic() + 15
+        while not any(isinstance(m, Undelivered) for m in client.got):
+            if time.monotonic() > deadline:
+                raise TimeoutError("no Undelivered after peer death")
+            system.send(tablet_id, ("get",), sender=client_id)
+            ic.pump(0.05)
+
+        # failover: a NEW process boots the tablet from the same store
+        # (WAL replay) on a new port; the proxy re-establishes a session
+        proc2, port2 = _spawn_child(store_dir, str(tmp_path / "p2.port"))
+        try:
+            ic.add_peer(2, "127.0.0.1", port2)
+            client.got.clear()
+            system.send(tablet_id, ("get",), sender=client_id)
+            _pump_until(
+                ic, lambda: ("val", 3) in client.got)
+        finally:
+            proc2.kill()
+            proc2.wait(timeout=10)
+    finally:
+        if proc1.poll() is None:
+            proc1.kill()
+        ic.close()
+
+
+def test_unknown_peer_is_undelivered():
+    system = ActorSystem(node=1)
+    client = Client()
+    cid = system.register(client)
+    ic = Interconnect(system, listen_port=None)
+    try:
+        system.send(ActorId(9, 1), "hello", sender=cid)
+        system.run()
+        assert any(isinstance(m, Undelivered) for m in client.got)
+    finally:
+        ic.close()

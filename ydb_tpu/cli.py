@@ -130,6 +130,20 @@ def cmd_workload(args):
         print(f"{name:6} {seconds * 1000:9.1f} ms   {rows} rows")
 
 
+def cmd_clickbench(args):
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+    from ydb_tpu.workload.clickbench import run_clickbench
+
+    queries = args.queries.split(",") if args.queries else None
+    results = run_clickbench(rows=args.rows, queries=queries,
+                             iterations=args.iterations,
+                             verify=not args.no_verify)
+    for name, seconds, rows in results:
+        print(f"{name:6} {seconds * 1000:9.1f} ms   {rows} rows")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="ydb_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -187,6 +201,13 @@ def main(argv=None):
     wt.add_argument("--iterations", type=int, default=1)
     wt.add_argument("--platform", default="cpu")
     wt.set_defaults(fn=cmd_workload)
+    wc = wsub.add_parser("clickbench")
+    wc.add_argument("--rows", type=int, default=100_000)
+    wc.add_argument("--queries", default=None)
+    wc.add_argument("--iterations", type=int, default=1)
+    wc.add_argument("--platform", default="cpu")
+    wc.add_argument("--no-verify", action="store_true")
+    wc.set_defaults(fn=cmd_clickbench)
 
     args = ap.parse_args(argv)
     args.fn(args)

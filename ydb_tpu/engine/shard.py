@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 
 import numpy as np
 
@@ -119,6 +120,15 @@ class ColumnShard:
         self._next_write_id = 1
         # compiled-scan cache: (program, key_spaces) -> (executor, sizes)
         self._scan_cache: dict = {}
+        # serializes metadata mutations (portion map, WAL seq, snapshot)
+        # so conveyor-driven background work (compaction/TTL/GC) can run
+        # concurrently with foreground scans: critical sections cover
+        # metadata only, never blob IO or merging
+        self._meta_lock = threading.RLock()
+        # serializes whole background OPERATIONS against each other:
+        # compaction and TTL both rewrite the same visible portions, and
+        # overlapping them would merge rows the other just evicted
+        self._bg_lock = threading.Lock()
         self._wal_seq = 0
         self._records_since_checkpoint = 0
         # per-column dictionary size already made durable; portions carry
@@ -236,8 +246,9 @@ class ColumnShard:
                 order = order[keep]
             cols = {n: a[order] for n, a in cols.items()}
             validity = {n: a[order] for n, a in (validity or {}).items()}
-        pid = self.next_portion_id
-        self.next_portion_id += 1
+        with self._meta_lock:
+            pid = self.next_portion_id
+            self.next_portion_id += 1
         blob_id = f"{self.shard_id}/portion/{pid}"
         write_portion_blob(self.store, blob_id, cols, validity,
                            chunk_rows=self.config.portion_chunk_rows,
@@ -253,13 +264,14 @@ class ColumnShard:
             meta.pk_min, meta.pk_max = column_stats(cols[self.pk_column])
         if self.ttl_column and self.ttl_column in cols:
             meta.ttl_min, meta.ttl_max = column_stats(cols[self.ttl_column])
-        self.portions[pid] = meta
-        rec = {"op": "add_portion", "meta": meta.to_json(),
-               "snap": snap, "removed": removed or [],
-               "dict_delta": self._dict_delta()}
-        if staged:
-            rec["staged"] = True
-        self._log(rec)
+        with self._meta_lock:
+            self.portions[pid] = meta
+            rec = {"op": "add_portion", "meta": meta.to_json(),
+                   "snap": snap, "removed": removed or [],
+                   "dict_delta": self._dict_delta()}
+            if staged:
+                rec["staged"] = True
+            self._log(rec)
         return meta
 
     def _dict_delta(self) -> dict:
@@ -281,9 +293,11 @@ class ColumnShard:
         self, snap: int | None = None,
         pk_range: tuple[int | None, int | None] | None = None,
     ) -> list[PortionMeta]:
-        snap = self.snap if snap is None else snap
+        with self._meta_lock:
+            snap = self.snap if snap is None else snap
+            metas = list(self.portions.values())
         out = []
-        for meta in self.portions.values():
+        for meta in metas:
             if not meta.visible_at(snap):
                 continue
             if pk_range and meta.pk_min is not None:
@@ -379,16 +393,18 @@ class ColumnShard:
         return False
 
     def _advance_snap(self) -> int:
-        if self.snap_source is not None:
-            s = self.snap_source()
-            if s <= self.snap:
-                raise ValueError(
-                    f"snapshot source went backwards: {s} <= {self.snap}"
-                )
-        else:
-            s = self.snap + 1
-        self.snap = s
-        return s
+        with self._meta_lock:
+            if self.snap_source is not None:
+                s = self.snap_source()
+                if s <= self.snap:
+                    raise ValueError(
+                        f"snapshot source went backwards: {s} <="
+                        f" {self.snap}"
+                    )
+            else:
+                s = self.snap + 1
+            self.snap = s
+            return s
 
     def compact(self) -> None:
         """Merge visible portions cluster-by-cluster, PK-sorted, into
@@ -397,8 +413,14 @@ class ColumnShard:
         Only one PK-overlap cluster is resident at a time (the
         general_compaction.cpp granule-local pattern), so compaction is
         as out-of-core as the scan path; under upsert semantics the
-        merge drops shadowed row versions for good.
+        merge drops shadowed row versions for good. Background
+        operations (compaction/TTL) serialize per shard via _bg_lock:
+        overlapping them would merge rows the other just rewrote.
         """
+        with self._bg_lock:
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
         from ydb_tpu.engine.reader import PortionStreamSource, plan_clusters
 
         metas = self.visible_portions()
@@ -428,13 +450,13 @@ class ColumnShard:
             return  # every portion already compact and bounded
         from ydb_tpu.engine.reader import rechunk
 
+        self._in_compaction = True
         snap = self._advance_snap()
         # output portions are WAL-staged and only activate at the
         # cluster's compact_commit record, which also carries the removal
         # tombstones: a crash anywhere mid-stream replays to the exact
         # pre-compaction state (no lost rows, no duplicates). Checkpoints
         # are deferred while staged records are in flight.
-        self._in_compaction = True
         try:
             for cluster in clusters:
                 reader = PortionStreamSource(
@@ -464,10 +486,11 @@ class ColumnShard:
                     for chunk_c, chunk_v in rechunk(payloads, names, cap)
                 ]
                 removed = [m.portion_id for m in cluster]
-                for m in cluster:
-                    m.removed_snap = snap
-                self._log({"op": "compact_commit", "snap": snap,
-                           "adds": added, "removed": removed})
+                with self._meta_lock:
+                    for m in cluster:
+                        m.removed_snap = snap
+                    self._log({"op": "compact_commit", "snap": snap,
+                               "adds": added, "removed": removed})
         finally:
             self._in_compaction = False
         if self._records_since_checkpoint >= self.config.checkpoint_interval:
@@ -475,6 +498,10 @@ class ColumnShard:
 
     def evict_ttl(self, cutoff: int) -> int:
         """Drop rows whose TTL column < cutoff. Returns rows evicted."""
+        with self._bg_lock:
+            return self._evict_ttl_locked(cutoff)
+
+    def _evict_ttl_locked(self, cutoff: int) -> int:
         if not self.ttl_column:
             return 0
         evicted = 0
@@ -503,59 +530,71 @@ class ColumnShard:
     def gc_blobs(self, keep_snap: int) -> int:
         """Delete blobs of portions invisible at and after keep_snap
         (BlobStorage collect-garbage analog). Returns blobs deleted."""
-        dead = [
-            pid for pid, m in self.portions.items()
-            if m.removed_snap is not None and m.removed_snap <= keep_snap
-        ]
-        if not dead:
-            return 0
-        # log BEFORE deleting: a crash in between leaks blobs (re-collected
-        # later) instead of leaving metadata pointing at deleted blobs
-        self._log({"op": "gc", "portions": dead, "snap": self.snap})
-        for pid in dead:
-            self.store.delete(self.portions[pid].blob_id)
-            del self.portions[pid]
+        # ONE critical section from the dead-list to the metadata drop:
+        # a concurrent gc_blobs computing the same list would double-log
+        # and KeyError on the second delete
+        with self._meta_lock:
+            dead = [
+                pid for pid, m in self.portions.items()
+                if m.removed_snap is not None
+                and m.removed_snap <= keep_snap
+            ]
+            if not dead:
+                return 0
+            # log BEFORE deleting: a crash in between leaks blobs
+            # (re-collected later) instead of leaving metadata pointing
+            # at deleted blobs
+            self._log({"op": "gc", "portions": dead, "snap": self.snap})
+            blob_ids = [self.portions[pid].blob_id for pid in dead]
+            for pid in dead:
+                del self.portions[pid]
+        for bid in blob_ids:
+            self.store.delete(bid)
         return len(dead)
 
     # ---------------- durability: WAL + checkpoint + boot ----------------
 
     def _log(self, record: dict) -> None:
-        self._wal_seq += 1
-        record["seq"] = self._wal_seq
-        self.store.put(
-            f"{self.shard_id}/wal/{self._wal_seq:012d}",
-            json.dumps(record).encode(),
-        )
-        self._records_since_checkpoint += 1
-        if self._records_since_checkpoint >= \
-                self.config.checkpoint_interval and \
-                not self._in_compaction:
-            # a checkpoint between a staged add and its compact_commit
-            # would persist half a compaction; defer until commit
-            self.checkpoint()
+        with self._meta_lock:
+            self._wal_seq += 1
+            record["seq"] = self._wal_seq
+            self.store.put(
+                f"{self.shard_id}/wal/{self._wal_seq:012d}",
+                json.dumps(record).encode(),
+            )
+            self._records_since_checkpoint += 1
+            if self._records_since_checkpoint >= \
+                    self.config.checkpoint_interval and \
+                    not self._in_compaction:
+                # a checkpoint between a staged add and its compact_commit
+                # would persist half a compaction; defer until commit
+                self.checkpoint()
 
     def checkpoint(self) -> None:
-        state = {
-            "snap": self.snap,
-            "next_portion_id": self.next_portion_id,
-            "wal_seq": self._wal_seq,
-            "portions": [m.to_json() for m in self.portions.values()],
-            "dicts": {
-                col: [v.decode("latin1") for v in
-                      self.dicts[col].values]
-                for col in self.dicts.columns()
-            },
-        }
-        self.store.put(
-            f"{self.shard_id}/checkpoint",
-            json.dumps(state).encode(),
-        )
-        # WAL records up to wal_seq are now redundant
-        for bid in self.store.list(f"{self.shard_id}/wal/"):
-            self.store.delete(bid)
-        self._records_since_checkpoint = 0
-        for col in self.dicts.columns():
-            self._dict_durable_sizes[col] = len(self.dicts[col])
+        with self._meta_lock:
+            state = {
+                "snap": self.snap,
+                "next_portion_id": self.next_portion_id,
+                "wal_seq": self._wal_seq,
+                "portions": [
+                    m.to_json() for m in self.portions.values()
+                ],
+                "dicts": {
+                    col: [v.decode("latin1") for v in
+                          self.dicts[col].values]
+                    for col in self.dicts.columns()
+                },
+            }
+            self.store.put(
+                f"{self.shard_id}/checkpoint",
+                json.dumps(state).encode(),
+            )
+            # WAL records up to wal_seq are now redundant
+            for bid in self.store.list(f"{self.shard_id}/wal/"):
+                self.store.delete(bid)
+            self._records_since_checkpoint = 0
+            for col in self.dicts.columns():
+                self._dict_durable_sizes[col] = len(self.dicts[col])
 
     @staticmethod
     def boot(

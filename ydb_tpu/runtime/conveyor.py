@@ -1,0 +1,202 @@
+"""Background-task plane: conveyor worker pool + resource broker quotas.
+
+The reference never runs maintenance on the user path: compactions, TTL
+and GC are queued as tasks with categories and quotas through the
+resource broker (ydb/core/tablet/resource_broker.h) and executed by the
+conveyor's worker threads (ydb/core/tx/conveyor/service/service.h:73),
+with an ICSController test seam to stall/step background work
+(ydb/core/tx/columnshard/hooks/abstract/abstract.h:49).
+
+TPU-era position: background work is HOST work (blob IO, merges,
+metadata) — the accelerator never blocks on it. This module provides:
+
+  * ``ResourceBroker`` — per-queue concurrency quotas under one total
+  * ``Conveyor``       — worker threads draining a priority queue,
+                         gated per-task by the controller
+  * ``ConveyorController`` — the test seam: stall / step / resume
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import threading
+
+
+class ConveyorController:
+    """Test hook gating task execution (ICSController analog).
+
+    ``stall()`` blocks workers before each task body; ``step(n)`` lets
+    exactly n tasks through while stalled; ``resume()`` reopens fully.
+    """
+
+    def __init__(self):
+        self._open = threading.Event()
+        self._open.set()
+        self._steps = threading.Semaphore(0)
+        self._lock = threading.Lock()
+
+    def stall(self) -> None:
+        self._open.clear()
+
+    def resume(self) -> None:
+        self._open.set()
+
+    def step(self, n: int = 1) -> None:
+        for _ in range(n):
+            self._steps.release()
+
+    def _admit(self, stop: threading.Event | None = None) -> None:
+        while not self._open.is_set():
+            if stop is not None and stop.is_set():
+                raise _Cancelled()
+            # stalled: wait for either a step token or a resume, checking
+            # the gate between waits so resume() always unblocks
+            if self._steps.acquire(timeout=0.02):
+                return
+
+
+class _Cancelled(BaseException):
+    """Task admitted during shutdown: surfaced through the handle."""
+
+
+class ResourceBroker:
+    """Concurrency quotas per task queue under one total (the resource
+    broker's queue configuration, resource_broker.h)."""
+
+    def __init__(self, quotas: dict[str, int] | None = None,
+                 total: int | None = None):
+        self.quotas = dict(quotas or {})
+        self.total = total
+        self._running: dict[str, int] = {}
+        self._all = 0
+        self._lock = threading.Lock()
+        self._freed = threading.Condition(self._lock)
+
+    def acquire(self, queue: str,
+                stop: threading.Event | None = None) -> None:
+        with self._freed:
+            while not self._may_run(queue):
+                if stop is not None and stop.is_set():
+                    raise _Cancelled()
+                self._freed.wait(timeout=0.1)
+            self._running[queue] = self._running.get(queue, 0) + 1
+            self._all += 1
+
+    def _may_run(self, queue: str) -> bool:
+        if self.total is not None and self._all >= self.total:
+            return False
+        q = self.quotas.get(queue)
+        return q is None or self._running.get(queue, 0) < q
+
+    def release(self, queue: str) -> None:
+        with self._freed:
+            self._running[queue] -= 1
+            self._all -= 1
+            self._freed.notify_all()
+
+
+@dataclasses.dataclass
+class TaskHandle:
+    queue: str
+    done: threading.Event
+    result: object = None
+    error: BaseException | None = None
+
+    def wait(self, timeout: float | None = None):
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"background task ({self.queue}) pending")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class Conveyor:
+    """Worker pool for background jobs (compaction/TTL/GC off the commit
+    path). Priorities: lower value first; FIFO within a priority."""
+
+    def __init__(self, workers: int = 2,
+                 broker: ResourceBroker | None = None,
+                 controller: ConveyorController | None = None):
+        self.broker = broker or ResourceBroker()
+        self.controller = controller or ConveyorController()
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._stop_event = threading.Event()
+        self._active = 0
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True)
+            for _ in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, queue: str, fn, *args, priority: int = 10,
+               **kwargs) -> TaskHandle:
+        h = TaskHandle(queue, threading.Event())
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError("conveyor is shut down")
+            heapq.heappush(
+                self._heap,
+                (priority, next(self._seq), queue, fn, args, kwargs, h))
+            self._cv.notify()
+        return h
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._heap and not self._stopping:
+                    self._cv.wait()
+                if self._stopping and not self._heap:
+                    return
+                _, _, queue, fn, args, kwargs, h = heapq.heappop(
+                    self._heap)
+                self._active += 1
+            try:
+                try:
+                    # stop-aware gates: shutdown() while the controller
+                    # is stalled (or a quota is exhausted) cancels the
+                    # popped task instead of wedging the worker
+                    self.controller._admit(self._stop_event)
+                    self.broker.acquire(queue, self._stop_event)
+                except _Cancelled:
+                    h.error = RuntimeError(
+                        "conveyor shut down before the task ran")
+                    continue
+                try:
+                    h.result = fn(*args, **kwargs)
+                except BaseException as e:  # surfaced via handle.wait()
+                    h.error = e
+                finally:
+                    self.broker.release(queue)
+            finally:
+                h.done.set()
+                with self._cv:
+                    self._active -= 1
+                    self._cv.notify_all()
+
+    def wait_idle(self, timeout: float = 30.0) -> None:
+        deadline = threading.Event()
+        t = threading.Timer(timeout, deadline.set)
+        t.start()
+        try:
+            with self._cv:
+                while (self._heap or self._active) and not deadline.is_set():
+                    self._cv.wait(timeout=0.05)
+                if self._heap or self._active:
+                    raise TimeoutError("conveyor busy")
+        finally:
+            t.cancel()
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._cv:
+            self._stopping = True
+            self._stop_event.set()
+            self._cv.notify_all()
+        if wait:
+            for t in self._threads:
+                t.join(timeout=10)

@@ -1,0 +1,268 @@
+"""TCP interconnect between actor systems in different processes.
+
+The reference's interconnect runs a per-peer proxy actor owning a TCP
+session with handshake, reconnect and undelivered-notification semantics
+(interconnect_tcp_proxy.h:20, interconnect_handshake.cpp; SURVEY.md §2.2
+L2). This is the TPU build's control-plane equivalent: the ActorSystem's
+pluggable remote transport (actors.py set_remote_transport) backed by
+per-peer TCP sessions.
+
+Semantics mirrored from the reference:
+  * location transparency — senders address ActorId(node, local); the
+    proxy routes by node id, connecting lazily on first send
+  * per-peer SESSIONS with a hello handshake (node ids + session ids);
+    a reconnect starts a new session
+  * at-most-once delivery: on connection loss, queued/unsent envelopes
+    produce ``Undelivered`` notifications back to their senders (the
+    TEvUndelivered contract) — senders own retries, exactly like the
+    reference's tablet pipes do on NodeDisconnected
+  * frames are length-prefixed pickles — a Python↔Python wire for our
+    own processes, NOT a trust boundary (the reference's interconnect
+    likewise assumes a private cluster fabric; authn happens at the
+    gRPC API layer, not between nodes)
+
+Threading: reader threads inject envelopes into the target ActorSystem's
+queue (deque appends are GIL-atomic against the run loop's popleft);
+``pump()``/``serve()`` drive the cooperative run loop from the owner
+thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+from ydb_tpu.runtime.actors import ActorSystem, Envelope
+
+_HDR = struct.Struct("!I")
+
+
+@dataclasses.dataclass
+class Undelivered:
+    """Returned to the sender when a cross-node envelope could not be
+    handed to the peer (connection refused / lost before flush)."""
+
+    target: object  # ActorId
+    message: Any
+    reason: str
+
+
+def _send_frame(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket):
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    buf = io.BytesIO()
+    while buf.tell() < n:
+        chunk = sock.recv(n - buf.tell())
+        if not chunk:
+            return None
+        buf.write(chunk)
+    return buf.getvalue()
+
+
+class _Session:
+    """One peer's outbound session: lazy connect, handshake, reconnect
+    with bounded backoff, undelivered notification on failure."""
+
+    def __init__(self, ic: "Interconnect", peer_node: int,
+                 addr: tuple[str, int]):
+        self.ic = ic
+        self.peer_node = peer_node
+        self.addr = addr
+        self.sock: socket.socket | None = None
+        self.session_id = 0
+        self.lock = threading.Lock()
+
+    def send(self, env: Envelope) -> None:
+        with self.lock:
+            for attempt in range(self.ic.max_retries + 1):
+                try:
+                    if self.sock is None:
+                        self._connect()
+                    _send_frame(self.sock, ("env", env.target, env.sender,
+                                            env.message))
+                    return
+                except OSError as e:
+                    self._drop()
+                    if attempt >= self.ic.max_retries:
+                        self.ic._notify_undelivered(env, str(e))
+                        return
+                    time.sleep(self.ic.retry_delay * (attempt + 1))
+
+    def _connect(self) -> None:
+        s = socket.create_connection(self.addr, timeout=self.ic.timeout)
+        s.settimeout(self.ic.timeout)
+        self.session_id += 1
+        # the hello advertises our own listen port so the peer learns the
+        # reverse route from the same handshake (mutual discovery)
+        _send_frame(s, ("hello", self.ic.node, self.session_id,
+                        self.ic.port))
+        resp = _recv_frame(s)
+        if not (isinstance(resp, tuple) and resp[0] == "hello"):
+            s.close()
+            raise OSError(f"bad handshake from {self.addr}: {resp!r}")
+        self.sock = s
+
+    def _drop(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+
+class Interconnect:
+    """Wire transport for one ActorSystem ('node')."""
+
+    def __init__(self, system: ActorSystem, listen_port: int = 0,
+                 peers: dict[int, tuple[str, int]] | None = None,
+                 timeout: float = 5.0, max_retries: int = 2,
+                 retry_delay: float = 0.1):
+        self.system = system
+        self.node = system.node
+        self.peers = dict(peers or {})
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.retry_delay = retry_delay
+        self._sessions: dict[int, _Session] = {}
+        self._slock = threading.Lock()
+        self._listener: socket.socket | None = None
+        self.port: int | None = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        if listen_port is not None:
+            self._listen(listen_port)
+        system.set_remote_transport(self._send_remote)
+
+    # ---- outbound ----
+
+    def add_peer(self, node: int, host: str, port: int) -> None:
+        with self._slock:
+            addr = (host, port)
+            old = self._sessions.get(node)
+            if old is not None and old.addr == addr:
+                # same address (e.g. a peer's inbound reconnect): keep
+                # the healthy outbound session
+                self.peers[node] = addr
+                return
+            self.peers[node] = addr
+            if old is not None:
+                old._drop()  # close the socket; no fd leak
+                del self._sessions[node]
+
+    def _send_remote(self, env: Envelope) -> None:
+        addr = self.peers.get(env.target.node)
+        if addr is None:
+            self._notify_undelivered(env, f"unknown node {env.target.node}")
+            return
+        with self._slock:
+            sess = self._sessions.get(env.target.node)
+            if sess is None or sess.addr != addr:
+                if sess is not None:
+                    sess._drop()
+                sess = _Session(self, env.target.node, addr)
+                self._sessions[env.target.node] = sess
+        sess.send(env)
+
+    def _notify_undelivered(self, env: Envelope, reason: str) -> None:
+        if env.sender is not None and env.sender.node == self.node:
+            self.system.send(
+                env.sender, Undelivered(env.target, env.message, reason))
+        else:
+            self.system.dead_letters.append(env)
+
+    # ---- inbound ----
+
+    def _listen(self, port: int) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(16)
+        self._listener = srv
+        self.port = srv.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._reader, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _reader(self, conn: socket.socket) -> None:
+        try:
+            hello = _recv_frame(conn)
+            if not (isinstance(hello, tuple) and hello[0] == "hello"):
+                return
+            peer_node, peer_port = hello[1], hello[3]
+            if peer_port is not None:
+                # learn the reverse route (replies cross a new session)
+                self.add_peer(peer_node, conn.getpeername()[0], peer_port)
+            _send_frame(conn, ("hello", self.node, hello[2], self.port))
+            while not self._stop.is_set():
+                frame = _recv_frame(conn)
+                if frame is None:
+                    return
+                kind, target, sender, message = frame
+                if kind == "env":
+                    # GIL-atomic deque append; drained by pump()/serve()
+                    self.system.inject(
+                        Envelope(target, sender, message))
+        except OSError:
+            return
+        finally:
+            conn.close()
+
+    # ---- driving the cooperative loop alongside the network ----
+
+    def pump(self, duration: float = 0.5, idle_sleep: float = 0.005
+             ) -> None:
+        """Drive the actor run loop for ``duration`` seconds, interleaving
+        network-injected messages."""
+        deadline = time.monotonic() + duration
+        while time.monotonic() < deadline:
+            if self.system.run() == 0:
+                time.sleep(idle_sleep)
+
+    def serve(self) -> None:
+        """Run until close() — a node process's main loop."""
+        while not self._stop.is_set():
+            if self.system.run() == 0:
+                time.sleep(0.005)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            finally:
+                self._listener = None
+        with self._slock:
+            for s in self._sessions.values():
+                s._drop()
+            self._sessions.clear()

@@ -1181,7 +1181,11 @@ class _SelectPlanner:
                 unique_key = tuple(out_names)
                 project = None
 
-        if sel.order_by:
+        # the aggregate path builds its own sort/limit/projection inside
+        # _plan_aggregate (hidden post-agg sort columns)
+        if has_agg:
+            pass
+        elif sel.order_by:
             keys = []
             desc = []
             hidden_sort = False
@@ -1189,7 +1193,7 @@ class _SelectPlanner:
                 if isinstance(o.expr, ast.Name) and \
                         o.expr.parts[-1] in out_names:
                     keys.append(o.expr.parts[-1])
-                elif not has_agg and isinstance(o.expr, ast.Name):
+                elif isinstance(o.expr, ast.Name):
                     # plain SELECT may order by a non-projected column:
                     # sort first, project after
                     keys.append(resolve_out(o.expr))
@@ -1199,7 +1203,7 @@ class _SelectPlanner:
                         "ORDER BY must reference output columns/aliases")
                 desc.append(o.descending)
             sort = SortStep(tuple(keys), tuple(desc), sel.limit)
-            if not has_agg and not sel.distinct:
+            if not sel.distinct:
                 if hidden_sort:
                     steps.extend([sort, project])
                 else:
@@ -1207,7 +1211,7 @@ class _SelectPlanner:
             else:
                 steps.append(sort)
         else:
-            if not has_agg and not sel.distinct and project is not None:
+            if not sel.distinct and project is not None:
                 steps.append(project)
             if sel.limit is not None:
                 steps.append(SortStep((), (), sel.limit))
@@ -1482,7 +1486,41 @@ def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, having):
         post_low.types[name] = infer_type(lowered, None, post_low.types)
         if isinstance(lowered, Col) and lowered.name in post_low.dict_src:
             post_low.dict_src[name] = post_low.dict_src[lowered.name]
+
+    # ORDER BY: output aliases directly; aggregate EXPRESSIONS (ClickBench
+    # 'ORDER BY COUNT(*) DESC') lower into hidden post-agg columns sorted
+    # before the final projection drops them
+    if sel.order_by:
+        keys, desc = [], []
+        n_aggs_final = len(agg_specs)
+        for i, o in enumerate(sel.order_by):
+            if isinstance(o.expr, ast.Name) and \
+                    o.expr.parts[-1] in out_names:
+                keys.append(o.expr.parts[-1])
+            else:
+                rw = rewrite(o.expr)
+                if len(agg_specs) != n_aggs_final:
+                    # the GroupByStep (and post scope) snapshotted the
+                    # aggregate list already — a NEW aggregate here would
+                    # reference states that were never computed
+                    raise PlanError(
+                        "ORDER BY aggregate must also appear in the"
+                        " SELECT list")
+                if isinstance(rw, ast.Name) and rw.parts[-1] in out_names:
+                    keys.append(rw.parts[-1])
+                else:
+                    name = f"__ord{i}"
+                    lowered = post_low.lower(rw)
+                    steps.append(AssignStep(name, lowered))
+                    post_low.types[name] = infer_type(
+                        lowered, None, post_low.types)
+                    keys.append(name)
+            desc.append(o.descending)
+        steps.append(SortStep(tuple(keys), tuple(desc), sel.limit))
+    elif sel.limit is not None:
+        steps.append(SortStep((), (), sel.limit))
     steps.append(ProjectStep(tuple(out_names)))
+
     out_types = {n: post_low.types[n] for n in out_names}
     # propagate dictionary renames for downstream consumers
     low.dict_src.update(post_low.dict_src)

