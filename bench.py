@@ -319,7 +319,12 @@ def main():
             extra["sql_q1_warm_rows_per_sec"] = round(n_rows / swarm1)
             extra["sql_q6_warm_rows_per_sec"] = round(n_rows / swarm6)
 
-    if os.environ.get("YDB_TPU_BENCH_PALLAS_COMPARE"):
+    # Pallas one-hot group-by vs XLA scatter A/B: runs by default on the
+    # real chip (VERDICT r4 item 9); force with YDB_TPU_BENCH_PALLAS_COMPARE
+    flag = os.environ.get("YDB_TPU_BENCH_PALLAS_COMPARE")
+    enabled = (jax.default_backend() == "tpu" if flag is None
+               else flag not in ("0", "", "off"))
+    if enabled:
         extra.update(pallas_ab(sf, block_rows))
 
     extra["baseline"] = ("vectorized numpy single-pass (mask+bincount), "

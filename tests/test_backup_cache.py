@@ -1,0 +1,110 @@
+"""Backup/export-import (SURVEY §2.14 backup row) and the shared page
+cache (§2.4 shared page cache row)."""
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.backup import export_table, import_table, read_manifest
+from ydb_tpu.engine.blobs import CachedBlobStore, DirBlobStore, MemBlobStore
+from ydb_tpu.ssa.ops import Agg
+from ydb_tpu.ssa.program import AggSpec, GroupByStep, Program
+from ydb_tpu.tx.coordinator import Coordinator
+from ydb_tpu.tx.sharded import ShardedTable
+
+SCHEMA = dtypes.schema(
+    ("id", dtypes.INT64, False),
+    ("v", dtypes.INT64),
+    ("tag", dtypes.STRING),
+)
+
+COUNT = Program((GroupByStep(keys=(), aggs=(
+    AggSpec(Agg.COUNT_ALL, None, "n"),
+    AggSpec(Agg.SUM, "v", "s"),
+)),))
+
+
+def _table(store, n_shards=3, upsert=True):
+    return ShardedTable("t", SCHEMA, store, Coordinator(MemBlobStore()),
+                        n_shards=n_shards, pk_column="id", upsert=upsert)
+
+
+def test_backup_roundtrip_with_reshard(tmp_path):
+    t = _table(MemBlobStore())
+    t.insert({"id": np.arange(200, dtype=np.int64),
+              "v": np.arange(200, dtype=np.int64),
+              "tag": [b"a" if i % 2 else b"b" for i in range(200)]})
+    # upsert half the keys: backup must carry the LOGICAL rows
+    t.insert({"id": np.arange(0, 200, 2, dtype=np.int64),
+              "v": np.full(100, 1000, dtype=np.int64),
+              "tag": [b"c"] * 100})
+
+    dest = DirBlobStore(str(tmp_path / "bk"))
+    man = export_table(t, dest, "t_backup")
+    assert man["rows"] == 200  # deduped logical rows, not versions
+    assert read_manifest(dest, "t_backup")["pk_column"] == "id"
+
+    # import into a DIFFERENT shard count
+    t2 = import_table(dest, "t_backup", MemBlobStore(),
+                      Coordinator(MemBlobStore()), n_shards=5)
+    res = t2.scan(COUNT)
+    want_s = sum(1000 if i % 2 == 0 else i for i in range(200))
+    assert int(res.cols["n"][0][0]) == 200
+    assert int(res.cols["s"][0][0]) == want_s
+    # string dictionary survived: tag decode works
+    assert t2.dicts["tag"].get(b"c") is not None
+
+    # snapshot isolation: a write AFTER the export is absent
+    t.insert({"id": np.array([999], dtype=np.int64),
+              "v": np.array([1], dtype=np.int64), "tag": [b"z"]})
+    man2 = export_table(t, dest, "t_backup2",
+                        snap=man["snapshot"])
+    assert man2["rows"] == 200
+
+
+def test_page_cache_hits_and_invalidation(tmp_path):
+    base = DirBlobStore(str(tmp_path / "blobs"))
+    cache = CachedBlobStore(base, capacity_bytes=1 << 20)
+    cache.put("a", b"x" * 100)
+    assert cache.get("a") == b"x" * 100   # miss -> fill
+    assert cache.get("a") == b"x" * 100   # hit
+    s = cache.stats()
+    assert s["hits"] == 1 and s["misses"] == 1
+    cache.put("a", b"y" * 50)             # write-through invalidates
+    assert cache.get("a") == b"y" * 50
+    assert cache.get_range("a", 10, 5) == b"y" * 5
+    cache.delete("a")
+    assert not cache.exists("a")
+    assert cache.stats()["entries"] == 0
+
+    # eviction under the byte budget
+    small = CachedBlobStore(base, capacity_bytes=250)
+    for i in range(5):
+        small.put(f"b{i}", bytes([i]) * 100)
+        small.get(f"b{i}")
+    assert small.stats()["bytes"] <= 250
+
+
+def test_page_cache_under_shard_scan(tmp_path):
+    """A ColumnShard on a cached store: repeated scans hit the cache."""
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+
+    base = DirBlobStore(str(tmp_path / "shard"))
+    cache = CachedBlobStore(base)
+    shard = ColumnShard(
+        "s", dtypes.schema(("id", dtypes.INT64, False),
+                           ("v", dtypes.INT64)),
+        cache, pk_column="id", upsert=True,
+        config=ShardConfig(compact_portion_threshold=10 ** 9,
+                           portion_chunk_rows=256))
+    for i in range(4):
+        wid = shard.write({
+            "id": np.arange(i * 500, i * 500 + 500, dtype=np.int64),
+            "v": np.ones(500, dtype=np.int64)})
+        shard.commit([wid])
+    r1 = shard.scan(COUNT)
+    miss_after_first = cache.stats()["misses"]
+    r2 = shard.scan(COUNT)
+    assert int(r2.cols["n"][0][0]) == int(r1.cols["n"][0][0]) == 2000
+    s = cache.stats()
+    assert s["misses"] == miss_after_first  # second scan: all cached
+    assert s["hits"] > 0

@@ -1,0 +1,86 @@
+"""Shard split/merge (resharding): stream-copy into a new shard
+generation with an atomic scheme cutover, crash-orphan sweep on boot
+(VERDICT r4 missing #8; reference
+schemeshard__operation_split_merge.cpp)."""
+
+import numpy as np
+
+from ydb_tpu.kqp.session import Cluster
+from ydb_tpu.engine.blobs import MemBlobStore
+
+
+def _counts(s):
+    r = s.execute("select count(*) as n, sum(v) as t from kv")
+    return int(r.column("n")[0]), int(r.column("t")[0])
+
+
+def _mk(store):
+    c = Cluster(store=store)
+    s = c.session()
+    s.execute("create table kv (k bigint not null, v bigint, "
+              "primary key (k)) with (shards = 3)")
+    s.execute("insert into kv (k, v) values " + ", ".join(
+        f"({i}, {i * 2})" for i in range(200)))
+    return c, s
+
+
+def test_split_and_merge_preserve_data():
+    store = MemBlobStore()
+    c, s = _mk(store)
+    before = _counts(s)
+    assert before == (200, 2 * sum(range(200)))
+
+    # SPLIT 3 -> 6
+    gen = c.reshard_table("kv", 6)
+    assert gen == 1
+    assert len(c.tables["kv"].shards) == 6
+    assert _counts(s) == before
+    # every new shard holds some data (hash routing spreads keys)
+    assert all(
+        sh.visible_portions() for sh in c.tables["kv"].shards)
+    # old generation's storage is gone
+    assert not [b for b in store.list("kv/0/")]
+
+    # writes keep flowing after the cutover
+    s.execute("insert into kv (k, v) values (1000, 1)")
+    assert _counts(s) == (201, before[1] + 1)
+
+    # MERGE 6 -> 2
+    gen = c.reshard_table("kv", 2)
+    assert gen == 2
+    assert len(c.tables["kv"].shards) == 2
+    assert _counts(s) == (201, before[1] + 1)
+
+
+def test_reshard_survives_reboot():
+    store = MemBlobStore()
+    c, s = _mk(store)
+    c.reshard_table("kv", 5)
+    want = _counts(s)
+
+    # reboot the whole cluster from storage: the scheme journal carries
+    # (n_shards=5, gen=1)
+    c2 = Cluster(store=store)  # Cluster always boots from its store
+    s2 = c2.session()
+    assert len(c2.tables["kv"].shards) == 5
+    assert c2.tables["kv"].gen == 1
+    assert _counts(s2) == want
+
+
+def test_crashed_reshard_orphans_are_swept():
+    """A crash BEFORE the scheme cutover: the half-built generation's
+    blobs are orphans; boot sweeps them and serves the old generation."""
+    store = MemBlobStore()
+    c, s = _mk(store)
+    want = _counts(s)
+    t = c.tables["kv"]
+    # build the new generation but 'crash' before the scheme journal
+    t.reshard(8)
+    assert any(b.startswith("kv/g1/") for b in store.list("kv/"))
+
+    c2 = Cluster(store=store)  # Cluster always boots from its store
+    s2 = c2.session()
+    assert len(c2.tables["kv"].shards) == 3  # old generation serves
+    assert _counts(s2) == want
+    assert not any(
+        b.startswith("kv/g1/") for b in store.list("kv/"))  # swept

@@ -1,0 +1,120 @@
+"""Statistics service, audit log, quoter, CBO-lite join ordering
+(SURVEY §2.14 rows: statistics, audit, quoter; VERDICT r4 missing #5)."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu.kqp.session import Cluster
+from ydb_tpu.runtime.quoter import Quoter, ThrottledError
+from ydb_tpu.sql.parser import parse
+from ydb_tpu.sql.planner import Catalog, plan_select_full
+from ydb_tpu.plan.nodes import LookupJoin, TableScan
+from ydb_tpu.workload import tpch
+
+
+def _mk_cluster():
+    c = Cluster()
+    s = c.session()
+    s.execute("create table kv (k bigint not null, v bigint, "
+              "primary key (k))")
+    s.execute("insert into kv (k, v) values (1, 10), (2, 20), (3, 30)")
+    return c, s
+
+
+def test_table_stats_and_sys_views():
+    c, s = _mk_cluster()
+    r = s.execute("select table_name, rows from sys_table_stats")
+    assert r.strings("table_name") == [b"kv"]
+    assert int(r.column("rows")[0]) == 3
+    # audit: the CREATE and INSERT are recorded, SELECTs are not
+    r = s.execute("select kind, status from sys_audit order by kind")
+    kinds = r.strings("kind")
+    assert b"createtable" in kinds and b"insert" in kinds
+    assert all(v == b"ok" for v in r.strings("status"))
+    n_before = len(kinds)
+    s.execute("select count(*) as n from kv")
+    r = s.execute("select kind from sys_audit")
+    assert r.num_rows == n_before  # reads not audited
+
+
+def test_quoter_throttles_requests():
+    clock = [0.0]
+    q = Quoter(clock=lambda: clock[0])
+    q.configure("kqp", rate=1000.0, burst=1000.0)
+    q.configure("kqp/requests", rate=1.0, burst=2.0)
+    c, s = _mk_cluster()
+    c.quoter = q
+    s.execute("select count(*) as n from kv")
+    s.execute("select count(*) as n from kv")
+    with pytest.raises(ThrottledError):
+        s.execute("select count(*) as n from kv")
+    clock[0] += 1.0  # one token refills
+    assert s.execute("select count(*) as n from kv") is not None
+    # hierarchical: parent exhaustion throttles the child
+    q.configure("kqp", rate=0.0, burst=0.0)
+    clock[0] += 10.0
+    assert not q.try_acquire("kqp/requests")
+
+
+def test_cbo_orders_smallest_connectable_first():
+    """q5's FROM lists customer, orders, lineitem, supplier, nation,
+    region — with stats, the probe side starts from customer and joins
+    dimensions before fact expansions where connectivity allows."""
+    data = tpch.TpchData(sf=0.005, seed=9)
+    counts = {t: len(next(iter(cols.values())))
+              for t, cols in data.tables.items()}
+    catalog = Catalog(
+        schemas={t: data.schema(t) for t in data.tables},
+        primary_keys=dict(tpch.PRIMARY_KEYS),
+        dicts=data.dicts,
+        row_counts=counts,
+    )
+    from ydb_tpu.workload.queries import TPCH
+
+    pq = plan_select_full(parse(TPCH["q5"]), catalog)
+
+    # walk the left-deep probe spine: collect build-side scan tables
+    order = []
+
+    def walk(node):
+        if isinstance(node, TableScan):
+            order.append(node.table)
+            return
+        if hasattr(node, "probe"):
+            walk(node.probe)
+            b = node.build
+            while not isinstance(b, TableScan):
+                if hasattr(b, "probe"):
+                    b = b.probe
+                elif hasattr(b, "input"):
+                    b = b.input
+                else:
+                    return
+            order.append(b.table)
+        elif hasattr(node, "input"):
+            walk(node.input)
+
+    walk(pq.plan)
+    # supplier (small) joins before lineitem (the big fact expansion)
+    assert order.index("supplier") < order.index("lineitem")
+
+    # and the result still matches the no-stats plan
+    from ydb_tpu.engine.scan import ColumnSource
+    from ydb_tpu.plan import Database, execute_plan, to_host
+
+    db = Database(
+        sources={t: ColumnSource(cols, data.schema(t), data.dicts)
+                 for t, cols in data.tables.items()},
+        dicts=data.dicts)
+    res = to_host(execute_plan(pq.plan, db))
+    catalog2 = Catalog(
+        schemas=catalog.schemas, primary_keys=catalog.primary_keys,
+        dicts=catalog.dicts)
+    ref = to_host(execute_plan(
+        plan_select_full(parse(TPCH["q5"]), catalog2).plan, db))
+    np.testing.assert_array_equal(
+        np.asarray(res.cols["revenue"][0]),
+        np.asarray(ref.cols["revenue"][0]))
+    np.testing.assert_array_equal(
+        np.asarray(res.cols["n_name"][0]),
+        np.asarray(ref.cols["n_name"][0]))

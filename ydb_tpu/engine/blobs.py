@@ -49,36 +49,43 @@ class MemBlobStore(BlobStore):
     """In-memory store with a sorted key index: ``list(prefix)`` is
     O(log n + matches), not a full scan — every hot path above this
     (DSProxy versions, WAL replay ranges, portion listings) leans on
-    prefix listing."""
+    prefix listing. Thread-safe: conveyor background jobs (compaction
+    blob writes, GC deletes) run concurrently with foreground commits."""
 
     def __init__(self):
+        import threading
+
         self._data: dict[str, bytes] = {}
         self._keys: list[str] = []  # sorted key index
+        self._lock = threading.Lock()
 
     def put(self, blob_id, data):
-        if blob_id not in self._data:
-            bisect.insort(self._keys, blob_id)
-        self._data[blob_id] = bytes(data)
+        with self._lock:
+            if blob_id not in self._data:
+                bisect.insort(self._keys, blob_id)
+            self._data[blob_id] = bytes(data)
 
     def get(self, blob_id):
         return self._data[blob_id]
 
     def delete(self, blob_id):
-        if blob_id in self._data:
-            del self._data[blob_id]
-            i = bisect.bisect_left(self._keys, blob_id)
-            if i < len(self._keys) and self._keys[i] == blob_id:
-                self._keys.pop(i)
+        with self._lock:
+            if blob_id in self._data:
+                del self._data[blob_id]
+                i = bisect.bisect_left(self._keys, blob_id)
+                if i < len(self._keys) and self._keys[i] == blob_id:
+                    self._keys.pop(i)
 
     def exists(self, blob_id):
         return blob_id in self._data
 
     def list(self, prefix=""):
-        if not prefix:
-            return list(self._keys)
-        lo = bisect.bisect_left(self._keys, prefix)
-        hi = bisect.bisect_left(self._keys, prefix + "￿")
-        return self._keys[lo:hi]
+        with self._lock:
+            if not prefix:
+                return list(self._keys)
+            lo = bisect.bisect_left(self._keys, prefix)
+            hi = bisect.bisect_left(self._keys, prefix + "￿")
+            return self._keys[lo:hi]
 
 
 class DirBlobStore(BlobStore):
@@ -134,3 +141,114 @@ class DirBlobStore(BlobStore):
             if name.startswith(enc_prefix):
                 out.append(unquote(name))
         return sorted(out)
+
+
+class CachedBlobStore(BlobStore):
+    """Shared page cache over any backend (SURVEY §2.4 row 'shared page
+    cache'; reference ydb/core/tablet_flat shared_cache.cpp): a node-wide
+    byte-budget LRU over blob reads, shared by every shard on the node so
+    hot portions/chunks are fetched once. Writes/deletes invalidate
+    (write-through); ranged reads cache per (blob, off, len) page — the
+    chunk-granular scan reader hits exactly these.
+
+    Thread-safe: conveyor background jobs and foreground scans share it.
+    """
+
+    def __init__(self, base: BlobStore, capacity_bytes: int = 256 << 20):
+        import threading
+        from collections import OrderedDict
+
+        self.base = base
+        self.capacity_bytes = capacity_bytes
+        self._lru: "OrderedDict[tuple, bytes]" = OrderedDict()
+        self._by_blob: dict[str, set] = {}  # blob_id -> cached keys
+        self._bytes = 0
+        self._lock = threading.Lock()
+        # GLOBAL invalidation generation, bumped by every put/delete: a
+        # fill whose read STARTED before any invalidation is rejected,
+        # closing the read-miss / write / stale-fill TOCTOU race. One
+        # counter (not per-blob) keeps memory O(1); the cost is a
+        # conservatively-skipped fill when an unrelated blob was
+        # rewritten during the read — a missed optimization, never a
+        # stale result.
+        self._gen = 0
+        self.hits = 0
+        self.misses = 0
+
+    # -- cache core --
+
+    def _cache_get(self, key):
+        with self._lock:
+            data = self._lru.get(key)
+            if data is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return data, self._gen
+
+    def _cache_put(self, key, data: bytes, gen: int):
+        if len(data) > self.capacity_bytes:
+            return  # larger than the whole budget: never cache
+        with self._lock:
+            if self._gen != gen:
+                return  # an invalidation raced the fill: maybe stale
+            old = self._lru.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._lru[key] = data
+            self._by_blob.setdefault(key[0], set()).add(key)
+            self._bytes += len(data)
+            while self._bytes > self.capacity_bytes:
+                k, evicted = self._lru.popitem(last=False)
+                self._bytes -= len(evicted)
+                keys = self._by_blob.get(k[0])
+                if keys is not None:
+                    keys.discard(k)
+                    if not keys:
+                        del self._by_blob[k[0]]
+
+    def _invalidate(self, blob_id: str):
+        with self._lock:
+            self._gen += 1
+            for key in self._by_blob.pop(blob_id, ()):
+                data = self._lru.pop(key, None)
+                if data is not None:
+                    self._bytes -= len(data)
+
+    # -- BlobStore surface --
+
+    def put(self, blob_id, data):
+        self.base.put(blob_id, data)
+        self._invalidate(blob_id)
+
+    def get(self, blob_id):
+        key = (blob_id, None, None)
+        data, gen = self._cache_get(key)
+        if data is None:
+            data = self.base.get(blob_id)
+            self._cache_put(key, data, gen)
+        return data
+
+    def get_range(self, blob_id, off, length):
+        key = (blob_id, off, length)
+        data, gen = self._cache_get(key)
+        if data is None:
+            data = self.base.get_range(blob_id, off, length)
+            self._cache_put(key, data, gen)
+        return data
+
+    def delete(self, blob_id):
+        self.base.delete(blob_id)
+        self._invalidate(blob_id)
+
+    def exists(self, blob_id):
+        return self.base.exists(blob_id)
+
+    def list(self, prefix=""):
+        return self.base.list(prefix)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"bytes": self._bytes, "entries": len(self._lru),
+                    "hits": self.hits, "misses": self.misses}

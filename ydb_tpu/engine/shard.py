@@ -516,15 +516,20 @@ class ColumnShard:
             cols, valid = self._materialize([meta])
             keep = cols[self.ttl_column] >= cutoff
             evicted += int((~keep).sum())
-            meta.removed_snap = snap
             if keep.any():
                 kept_c = {n: a[keep] for n, a in cols.items()}
                 kept_v = {n: a[keep] for n, a in valid.items()}
-                self._add_portion(kept_c, kept_v, snap,
-                                  removed=[meta.portion_id])
+                # tombstone + replacement under ONE meta-lock section: a
+                # concurrent scan must never see neither portion
+                with self._meta_lock:
+                    meta.removed_snap = snap
+                    self._add_portion(kept_c, kept_v, snap,
+                                      removed=[meta.portion_id])
             else:
-                self._log({"op": "remove_portion", "snap": snap,
-                           "portion_id": meta.portion_id})
+                with self._meta_lock:
+                    meta.removed_snap = snap
+                    self._log({"op": "remove_portion", "snap": snap,
+                               "portion_id": meta.portion_id})
         return evicted
 
     def gc_blobs(self, keep_snap: int) -> int:

@@ -99,6 +99,12 @@ class Cluster:
         self.counters = CounterGroup({"component": "kqp"})
         self.tracer = Tracer()
         self.query_log: deque = deque(maxlen=256)
+        # audit trail of state-changing statements (audit log analog,
+        # ydb/core/audit; exposed through the sys_audit view)
+        self.audit_log: deque = deque(maxlen=1024)
+        # optional request-unit quoter (rate-limiter / kesus analog):
+        # when set, every statement consumes 1 unit from "kqp/requests"
+        self.quoter = None
         # live-tunable knobs (immediate control board)
         self.icb = ControlBoard()
         self.icb.register("rmw_retries", 5, 1, 100)
@@ -141,6 +147,11 @@ class Cluster:
             if t is not None and hasattr(t, "post_boot_sweep"):
                 t.post_boot_sweep()
             self.scheme.clear_strip(path)
+        # sweep shard generations orphaned by a crash mid-reshard (the
+        # scheme descriptor is the cutover truth; anything else is trash)
+        for t in self.tables.values():
+            if hasattr(t, "sweep_stale_generations"):
+                t.sweep_stale_generations()
 
     # ---- dict durability (cluster-wide journal) ----
 
@@ -199,6 +210,7 @@ class Cluster:
                 n_shards=desc.n_shards, pk_column=desc.primary_key[0],
                 ttl_column=desc.ttl_column, dicts=self.dicts, boot=boot,
                 config=shard_config, upsert=desc.upsert,
+                gen=desc.shard_gen,
             )
         t.alter_schema(desc.schema, desc.schema_version, desc.column_added)
         # dict ids must be durable BEFORE any shard WAL references them:
@@ -531,10 +543,35 @@ class Cluster:
         self._plan_cache.clear()
         return res
 
+    def reshard_table(self, name: str, n_shards: int) -> int:
+        """Split/merge a column table to ``n_shards`` shards: stream-copy
+        into a new shard generation, journal the cutover in the scheme
+        (the durable commit point), then GC the old generation. Returns
+        the new generation."""
+        from ydb_tpu.datashard.table import RowTable
+
+        t = self.tables.get(name)
+        if t is None:
+            raise PlanError(f"unknown table {name}")
+        if isinstance(t, RowTable):
+            raise PlanError("resharding row tables is not supported yet")
+        if n_shards < 1:
+            # validate BEFORE the destructive copy/swap, not after
+            raise PlanError("n_shards must be >= 1")
+        old_n = len(t.shards)
+        old_gen = t.gen
+        new_gen = t.reshard(n_shards)
+        # durable cutover: after this journal entry a reboot sees the
+        # new generation; before it, the new blobs are swept as orphans
+        self.scheme.reshard_table("/" + name, n_shards, new_gen)
+        t.drop_generation_storage(old_gen, old_n)
+        self._plan_cache.clear()
+        return new_gen
+
     # ---- query path ----
 
     def catalog(self) -> Catalog:
-        from ydb_tpu.obs.sysview import SYS_SCHEMAS
+        from ydb_tpu.obs.sysview import SYS_SCHEMAS, table_stats
 
         schemas = {n: t.schema for n, t in self.tables.items()}
         pks = {n: (t.pk_column,) for n, t in self.tables.items()}
@@ -542,8 +579,14 @@ class Cluster:
             for name, schema in SYS_SCHEMAS.items():
                 schemas.setdefault(name, schema)
                 pks.setdefault(name, (schema.names[0],))
+        # statistics feed for CBO-lite join ordering (cheap: portion
+        # metadata only, no scans)
+        counts = {
+            n: st["rows"] for n, st in table_stats(self).items()
+            if st["rows"] is not None
+        }
         return Catalog(schemas=schemas, primary_keys=pks,
-                       dicts=self.dicts)
+                       dicts=self.dicts, row_counts=counts)
 
     def snapshot_db(self, snap: int | None = None,
                     include_sys: bool = False) -> Database:
@@ -700,6 +743,12 @@ class Session:
         import time as _time
 
         c = self.cluster
+        if c.quoter is not None and not c.quoter.try_acquire(
+                "kqp/requests"):
+            from ydb_tpu.runtime.quoter import ThrottledError
+
+            c.counters.group(kind="throttled").counter("queries").inc()
+            raise ThrottledError("request rate limit exceeded")
         t0 = _time.monotonic()
         with c.tracer.trace("query", trace_id) as span:
             with span.child("plan") as plan_span:
@@ -714,6 +763,13 @@ class Session:
         rows = out.num_rows if isinstance(out, OracleTable) else 0
         c.query_log.append({"sql": sql, "kind": kind,
                             "seconds": seconds, "rows": rows})
+        if kind != "select":
+            # DDL/DML are audited; reads are not (the reference's
+            # audit_log records modifying operations by default)
+            c.audit_log.append({
+                "kind": kind, "sql": sql[:256], "status": "ok",
+                "duration_us": int(seconds * 1e6),
+            })
         g = c.counters.group(kind=kind)
         g.counter("queries").inc()
         g.histogram("latency_seconds").observe(seconds)

@@ -27,6 +27,17 @@ SYS_SCHEMAS = {
         ("duration_us", dtypes.INT64), ("result_rows", dtypes.INT64)),
     "sys_scheme_paths": dtypes.schema(
         ("path", dtypes.STRING), ("kind", dtypes.STRING)),
+    # statistics service analog (ydb/core/statistics): per-table stats
+    # for cost-based planning, collected from portion metadata (cheap —
+    # no scan)
+    "sys_table_stats": dtypes.schema(
+        ("table_name", dtypes.STRING), ("rows", dtypes.INT64),
+        ("portions", dtypes.INT64), ("pk_min", dtypes.INT64),
+        ("pk_max", dtypes.INT64)),
+    # audit log (ydb/core/audit): state-changing statements
+    "sys_audit": dtypes.schema(
+        ("kind", dtypes.STRING), ("sql", dtypes.STRING),
+        ("status", dtypes.STRING), ("duration_us", dtypes.INT64)),
 }
 
 
@@ -79,10 +90,70 @@ def _scheme_paths_rows(cluster):
     return [paths, kinds]
 
 
+def table_stats(cluster, cheap: bool = True) -> dict[str, dict]:
+    """Per-table statistics from portion metas (the statistics-service
+    collection path): row counts + PK bounds; feeds CBO join ordering
+    (Catalog.row_counts) and the sys_table_stats view.
+
+    ``cheap`` (the per-plan CBO feed) reads column-shard portion
+    METADATA only; row tables report rows=0 (unknown) rather than
+    paying a full page walk on every statement plan. The sys view
+    passes cheap=False for exact counts."""
+    out: dict[str, dict] = {}
+    for tname, t in cluster.tables.items():
+        rows = 0
+        unknown = False
+        portions = 0
+        pk_min = pk_max = None
+        for s in t.shards:
+            if not hasattr(s, "portions"):
+                if cheap:
+                    unknown = True  # no metadata count for row tables
+                else:
+                    # row table: page walk (exact, O(rows))
+                    rows += sum(
+                        len(page) for page in s.read(s.last_step))
+                continue
+            for m in s.visible_portions():
+                rows += m.num_rows
+                portions += 1
+                if m.pk_min is not None:
+                    pk_min = (m.pk_min if pk_min is None
+                              else min(pk_min, m.pk_min))
+                if m.pk_max is not None:
+                    pk_max = (m.pk_max if pk_max is None
+                              else max(pk_max, m.pk_max))
+        out[tname] = {"rows": None if unknown else rows,
+                      "portions": portions,
+                      "pk_min": pk_min, "pk_max": pk_max}
+    return out
+
+
+def _table_stats_rows(cluster):
+    st = table_stats(cluster, cheap=False)
+    names = sorted(st)
+    return [
+        names,
+        [st[n]["rows"] for n in names],
+        [st[n]["portions"] for n in names],
+        [st[n]["pk_min"] or 0 for n in names],
+        [st[n]["pk_max"] or 0 for n in names],
+    ]
+
+
+def _audit_rows(cluster):
+    log = list(cluster.audit_log)
+    return [[a["kind"] for a in log], [a["sql"] for a in log],
+            [a["status"] for a in log],
+            [a["duration_us"] for a in log]]
+
+
 _BUILDERS = {
     "sys_partition_stats": _partition_stats_rows,
     "sys_query_stats": _query_stats_rows,
     "sys_scheme_paths": _scheme_paths_rows,
+    "sys_table_stats": _table_stats_rows,
+    "sys_audit": _audit_rows,
 }
 
 

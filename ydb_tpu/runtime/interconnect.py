@@ -80,21 +80,58 @@ def _recv_exact(sock: socket.socket, n: int):
 
 
 class _Session:
-    """One peer's outbound session: lazy connect, handshake, reconnect
-    with bounded backoff, undelivered notification on failure."""
+    """One peer's outbound session: a dedicated sender thread drains a
+    queue, so the cooperative actor loop NEVER blocks on connects or
+    retries (a peer dropping SYNs stalls only this session's thread).
+    Lazy connect, handshake, bounded-backoff reconnect, undelivered
+    notification on final failure."""
 
     def __init__(self, ic: "Interconnect", peer_node: int,
                  addr: tuple[str, int]):
+        import queue
+
         self.ic = ic
         self.peer_node = peer_node
         self.addr = addr
         self.sock: socket.socket | None = None
         self.session_id = 0
         self.lock = threading.Lock()
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = threading.Event()
+        self._thread = threading.Thread(target=self._sender_loop,
+                                        daemon=True)
+        self._thread.start()
 
     def send(self, env: Envelope) -> None:
+        """Non-blocking enqueue (called from the actor run loop)."""
+        if self._closed.is_set():
+            self.ic._notify_undelivered(env, "session closed")
+            return
+        self._q.put(env)
+        if self._closed.is_set():
+            # close() may have drained BEFORE our put landed: nothing
+            # will ever read the queue again, so drain it ourselves
+            # (any queued envelope is equally undeliverable)
+            while True:
+                try:
+                    stranded = self._q.get_nowait()
+                except Exception:
+                    break
+                self.ic._notify_undelivered(stranded, "session closed")
+
+    def _sender_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                env = self._q.get(timeout=0.1)
+            except Exception:
+                continue
+            self._deliver(env)
+
+    def _deliver(self, env: Envelope) -> None:
         with self.lock:
             for attempt in range(self.ic.max_retries + 1):
+                if self._closed.is_set():
+                    break
                 try:
                     if self.sock is None:
                         self._connect()
@@ -107,6 +144,7 @@ class _Session:
                         self.ic._notify_undelivered(env, str(e))
                         return
                     time.sleep(self.ic.retry_delay * (attempt + 1))
+            self.ic._notify_undelivered(env, "session closed")
 
     def _connect(self) -> None:
         s = socket.create_connection(self.addr, timeout=self.ic.timeout)
@@ -128,6 +166,17 @@ class _Session:
                 self.sock.close()
             finally:
                 self.sock = None
+
+    def close(self) -> None:
+        self._closed.set()
+        # drain: anything still queued is undeliverable
+        while True:
+            try:
+                env = self._q.get_nowait()
+            except Exception:
+                break
+            self.ic._notify_undelivered(env, "session closed")
+        self._drop()
 
 
 class Interconnect:
@@ -166,7 +215,7 @@ class Interconnect:
                 return
             self.peers[node] = addr
             if old is not None:
-                old._drop()  # close the socket; no fd leak
+                old.close()  # stop the sender thread; no fd leak
                 del self._sessions[node]
 
     def _send_remote(self, env: Envelope) -> None:
@@ -178,7 +227,7 @@ class Interconnect:
             sess = self._sessions.get(env.target.node)
             if sess is None or sess.addr != addr:
                 if sess is not None:
-                    sess._drop()
+                    sess.close()
                 sess = _Session(self, env.target.node, addr)
                 self._sessions[env.target.node] = sess
         sess.send(env)
@@ -264,5 +313,5 @@ class Interconnect:
                 self._listener = None
         with self._slock:
             for s in self._sessions.values():
-                s._drop()
+                s.close()
             self._sessions.clear()

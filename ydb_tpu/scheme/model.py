@@ -56,6 +56,10 @@ class TableDescription:
     # old row; scans merge by PK newest-wins) — the reference's OLAP
     # REPLACE/BulkUpsert write model
     upsert: bool = False
+    # shard generation: bumped by RESHARD (split/merge); generation g>0
+    # stores shard state under <name>/g<g>/<i> so the cutover is an
+    # atomic descriptor update (datashard split/merge analog)
+    shard_gen: int = 0
 
     def to_json(self) -> dict:
         return {
@@ -69,6 +73,7 @@ class TableDescription:
             "column_added": dict(self.column_added),
             "changefeed": self.changefeed,
             "upsert": self.upsert,
+            "shard_gen": self.shard_gen,
         }
 
     @classmethod
@@ -84,4 +89,5 @@ class TableDescription:
             column_added=dict(d.get("column_added", {})),
             changefeed=d.get("changefeed", False),
             upsert=d.get("upsert", False),
+            shard_gen=d.get("shard_gen", 0),
         )

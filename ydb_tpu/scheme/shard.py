@@ -260,6 +260,40 @@ class SchemeShardCore:
         self._publish(path, d, pub["v"])
         return desc
 
+    def reshard_table(self, path: str, n_shards: int,
+                      shard_gen: int) -> TableDescription:
+        """Record a completed split/merge: the new shard count +
+        generation become THE durable truth in one journaled DDL tx
+        (the datashard split/merge commit point,
+        schemeshard__operation_split_merge.cpp)."""
+        path = _norm(path)
+        desc = self.describe(path)
+        if desc is None:
+            raise SchemeError(f"{path} is not a table")
+        if n_shards < 1:
+            raise SchemeError("n_shards must be >= 1")
+        if shard_gen <= desc.shard_gen:
+            raise SchemeError(
+                f"shard_gen must advance ({shard_gen} <="
+                f" {desc.shard_gen})")
+        desc = dataclasses.replace(
+            desc, n_shards=n_shards, shard_gen=shard_gen)
+        d = desc.to_json()
+        pub = {}
+
+        def fn(txc):
+            row = dict(txc.get("paths", (path,)))
+            row["version"] = row.get("version", 1) + 1
+            txc.put("paths", (path,), row)
+            txc.put("tables", (path,), d)
+            pub["v"] = self._journal(txc, "reshard_table", path, {
+                "n_shards": n_shards, "shard_gen": shard_gen,
+            })
+
+        self._run(fn)
+        self._publish(path, d, pub["v"])
+        return desc
+
 
 class SchemeShardTablet(TabletActor):
     """Actor wrapper: DDL over tablet pipes; replies ("ok", result_json)

@@ -59,6 +59,10 @@ class Catalog:
     primary_keys: dict[str, tuple[str, ...]] = dataclasses.field(
         default_factory=dict)
     dicts: DictionarySet | None = None
+    # table -> estimated row count (statistics service feed,
+    # obs/sysview.table_stats): drives CBO-lite join ordering — among
+    # connectable candidates, smaller estimated sides join first
+    row_counts: dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 class PlanError(Exception):
@@ -971,26 +975,48 @@ class _SelectPlanner:
 
         def connects(i: int, joined: list[str]) -> bool:
             alias = scopes[i].alias
-            for la, lc, ra, rc in on_conds.get(i, []):
-                if (ra == alias and la in joined) or (
-                        la == alias and ra in joined):
-                    return True
+            on = on_conds.get(i, [])
+            if on:
+                # an explicit ON clause must be placeable WHOLE: every
+                # conjunct's other side already joined (a partial pick
+                # would raise 'ON condition does not connect' later)
+                return all(
+                    (la in joined) if ra == alias else
+                    (ra in joined) if la == alias else False
+                    for la, lc, ra, rc in on
+                )
             for la, lc, ra, rc in pending:
                 if (ra == alias and la in joined) or (
                         la == alias and ra in joined):
                     return True
             return False
 
+        def est_rows(i: int) -> float:
+            t = scopes[i].table
+            if t is not None and t in self.catalog.row_counts:
+                return float(self.catalog.row_counts[t])
+            return float("inf")
+
+        # CBO-lite: with table statistics available (and no LEFT JOINs,
+        # which do not commute freely), prefer the SMALLEST connectable
+        # side next — dimension tables join before fact expansions
+        # (ydb/library/yql/core/cbo greedy ordering shape)
+        use_stats = bool(self.catalog.row_counts) and not any(
+            kind == "left" for _, _, kind in join_specs)
+
         join_order: list[int] = []
         while remaining:
-            pick = next(
-                (i for i in remaining if connects(i, joined_aliases
-                                                  + [scopes[j].alias
-                                                     for j in join_order])),
-                None,
-            )
-            if pick is None:
+            joined_now = joined_aliases + [
+                scopes[j].alias for j in join_order
+            ]
+            connectable = [i for i in remaining
+                           if connects(i, joined_now)]
+            if not connectable:
                 pick = remaining[0]  # will raise "no equi-join" below
+            elif use_stats:
+                pick = min(connectable, key=est_rows)
+            else:
+                pick = connectable[0]
             join_order.append(pick)
             remaining.remove(pick)
 
@@ -1498,6 +1524,10 @@ def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, having):
                     o.expr.parts[-1] in out_names:
                 keys.append(o.expr.parts[-1])
             else:
+                if isinstance(o.expr, ast.Literal):
+                    raise PlanError(
+                        "ORDER BY must reference output columns/aliases"
+                        " or aggregate expressions")
                 rw = rewrite(o.expr)
                 if len(agg_specs) != n_aggs_final:
                     # the GroupByStep (and post scope) snapshotted the

@@ -55,37 +55,45 @@ class ShardedTable:
         dicts: DictionarySet | None = None,
         boot: bool = False,
         upsert: bool = False,
+        gen: int = 0,
     ):
         self.name = name
         self.schema = schema
+        self.store = store
         self.coordinator = coordinator
         self.pk_column = pk_column or schema.names[0]
+        self.ttl_column = ttl_column
+        self.config = config
         # upsert: PK rewrite shadows the old row. Rows route by PK hash,
         # so one key always lands on one shard and per-shard newest-wins
         # dedup (engine.reader) is globally correct.
         self.upsert = upsert
+        # shard generation: RESHARD builds generation g+1 under
+        # <name>/g<g+1>/<i> and cuts over atomically (scheme descriptor)
+        self.gen = gen
         self.dicts = dicts if dicts is not None else DictionarySet()
+        ids = [self._shard_id(gen, i) for i in range(n_shards)]
         if boot:
             # reboot from the blob store (snapshot + WAL per shard); the
             # shared dict set must already be recovered by the caller
             self.shards = [
                 ColumnShard.boot(
-                    f"{name}/{i}", schema, store,
+                    sid, schema, store,
                     pk_column=self.pk_column, ttl_column=ttl_column,
                     config=config, dicts=self.dicts,
                 )
-                for i in range(n_shards)
+                for sid in ids
             ]
             for s in self.shards:
                 s.upsert = upsert
         else:
             self.shards = [
                 ColumnShard(
-                    f"{name}/{i}", schema, store,
+                    sid, schema, store,
                     pk_column=self.pk_column, ttl_column=ttl_column,
                     config=config, dicts=self.dicts, upsert=upsert,
                 )
-                for i in range(n_shards)
+                for sid in ids
             ]
         for s in self.shards:
             s.snap_source = coordinator.background_plan
@@ -94,10 +102,94 @@ class ShardedTable:
         # state ever references a dict id that is not itself durable
         self.pre_commit = None
 
+    def _shard_id(self, gen: int, i: int) -> str:
+        return (f"{self.name}/g{gen}/{i}" if gen else f"{self.name}/{i}")
+
     def storage_prefixes(self) -> list[str]:
         """Blob-store prefixes owning this table's durable state (DROP
         TABLE deletes them so a same-name CREATE starts empty)."""
         return [f"{s.shard_id}/" for s in self.shards]
+
+    # ---------------- split / merge (resharding) ----------------
+
+    def reshard(self, n_new: int, batch_rows: int = 1 << 18) -> int:
+        """SPLIT/MERGE: rebuild the table as generation gen+1 with
+        ``n_new`` shards — stream every row (at one snapshot, deduped)
+        out of the old shards and hash-route it into the new ones, then
+        swap. Returns the new generation; the CALLER must durably record
+        (n_new, gen) in the scheme (Cluster.reshard_table does) — until
+        then a reboot sees the old generation, and the new one's blobs
+        are swept as orphans. The datashard split/merge analog
+        (schemeshard__operation_split_merge.cpp) collapsed to an offline
+        copy: hash sharding moves most keys on a count change, so a
+        range-style incremental split does not apply."""
+        from ydb_tpu.engine.reader import PortionStreamSource
+
+        if n_new < 1:
+            raise ValueError("reshard needs n_new >= 1")
+        new_gen = self.gen + 1
+        old_shards = self.shards
+        snap = self.coordinator.read_snapshot()
+        new_shards = [
+            ColumnShard(
+                self._shard_id(new_gen, i), self.schema, self.store,
+                pk_column=self.pk_column, ttl_column=self.ttl_column,
+                config=self.config, dicts=self.dicts, upsert=self.upsert,
+            )
+            for i in range(n_new)
+        ]
+        for s in new_shards:
+            s.schema_version = old_shards[0].schema_version
+            s.column_added = dict(old_shards[0].column_added)
+        names = self.schema.names
+        for old in old_shards:
+            src = PortionStreamSource(old, old.visible_portions(snap))
+            from ydb_tpu.engine.reader import plan_clusters, rechunk
+
+            payloads = src.payload_stream(
+                plan_clusters(src.metas, src.dedup), names)
+            for cols, valid in rechunk(payloads, names, batch_rows):
+                route = _fnv_route(
+                    np.asarray(cols[self.pk_column], dtype=np.int64),
+                    n_new)
+                for i in range(n_new):
+                    mask = route == i
+                    if not mask.any():
+                        continue
+                    wid = new_shards[i].write(
+                        {k: v[mask] for k, v in cols.items()},
+                        {k: v[mask] for k, v in valid.items()},
+                    )
+                    # commit at a coordinator background step: local
+                    # snaps could run AHEAD of the plan clock, making
+                    # copied rows invisible at the read barrier
+                    new_shards[i].commit_at(
+                        [wid], self.coordinator.background_plan())
+        # cutover: swap in-memory; scheme records the new generation
+        self.shards = new_shards
+        self.gen = new_gen
+        for s in new_shards:
+            s.snap_source = self.coordinator.background_plan
+        return new_gen
+
+    def drop_generation_storage(self, gen: int, n_shards: int) -> None:
+        """Delete a superseded generation's blobs (post-cutover GC)."""
+        for i in range(n_shards):
+            prefix = f"{self._shard_id(gen, i)}/"
+            for bid in self.store.list(prefix):
+                self.store.delete(bid)
+
+    def sweep_stale_generations(self) -> int:
+        """Boot-time sweep: delete blobs of any generation other than
+        the current one (a crash mid-reshard leaves either the unborn
+        new generation or the superseded old one as orphans)."""
+        keep = tuple(f"{s.shard_id}/" for s in self.shards)
+        swept = 0
+        for bid in self.store.list(f"{self.name}/"):
+            if not bid.startswith(keep):
+                self.store.delete(bid)
+                swept += 1
+        return swept
 
     def alter_schema(
         self,
