@@ -1,0 +1,76 @@
+"""Global secondary indexes on the row store: online backfill + atomic
+maintenance in the same 2PC as data writes (SURVEY §2.6 index-build
+row; reference datashard build_index.cpp + indeximpl tables)."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.datashard.table import RowTable
+from ydb_tpu.tx.coordinator import Coordinator
+
+SCHEMA = dtypes.schema(
+    ("id", dtypes.INT64, False),
+    ("city", dtypes.STRING),
+    ("v", dtypes.INT64),
+)
+
+
+def _table():
+    t = RowTable("users", SCHEMA, MemBlobStore(),
+                 Coordinator(MemBlobStore()), n_shards=3,
+                 pk_columns=("id",))
+    t.insert({"id": np.arange(6, dtype=np.int64),
+              "city": [b"ams", b"ber", b"ams", b"cdg", b"ber", b"ams"],
+              "v": np.arange(6, dtype=np.int64) * 10})
+    return t
+
+
+def test_backfill_and_lookup():
+    t = _table()
+    t.add_index("by_city", "city")
+    assert sorted(t.lookup_index("by_city", b"ams")) == [(0,), (2,), (5,)]
+    assert sorted(t.lookup_index("by_city", b"ber")) == [(1,), (4,)]
+    assert t.lookup_index("by_city", b"nope") == []
+
+
+def test_index_maintained_by_writes():
+    t = _table()
+    t.add_index("by_city", "city")
+    # new row
+    t.insert({"id": np.array([9], dtype=np.int64), "city": [b"cdg"],
+              "v": np.array([90], dtype=np.int64)})
+    assert sorted(t.lookup_index("by_city", b"cdg")) == [(3,), (9,)]
+    # value change moves the entry
+    t.insert({"id": np.array([0], dtype=np.int64), "city": [b"cdg"],
+              "v": np.array([0], dtype=np.int64)})
+    assert sorted(t.lookup_index("by_city", b"ams")) == [(2,), (5,)]
+    assert sorted(t.lookup_index("by_city", b"cdg")) == [(0,), (3,), (9,)]
+    # delete removes the entry
+    t.delete_keys([(9,)])
+    assert sorted(t.lookup_index("by_city", b"cdg")) == [(0,), (3,)]
+
+
+def test_same_key_twice_in_one_batch_keeps_index_consistent():
+    t = _table()
+    t.add_index("by_city", "city")
+    # one batch writes id=0 twice: last value wins, no stale entry
+    t.upsert_rows([
+        {"id": 0, "city": t.dicts.for_column("city").add(b"ber"),
+         "v": 1},
+        {"id": 0, "city": t.dicts.for_column("city").add(b"cdg"),
+         "v": 2},
+    ])
+    assert (0,) not in t.lookup_index("by_city", b"ams")
+    assert (0,) not in t.lookup_index("by_city", b"ber")
+    assert (0,) in t.lookup_index("by_city", b"cdg")
+
+
+def test_index_guards():
+    t = _table()
+    with pytest.raises(ValueError):
+        t.add_index("bad", "id")  # already the PK
+    t.add_index("by_city", "city")
+    with pytest.raises(ValueError):
+        t.add_index("by_city", "v")  # duplicate name

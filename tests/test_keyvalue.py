@@ -1,0 +1,77 @@
+"""KeyValue tablet (SURVEY §2.3 keyvalue row; reference
+ydb/core/keyvalue): durable KV commands over the tablet executor with
+spilled-blob lifecycle and crash recovery."""
+
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.tablet.keyvalue import INLINE_LIMIT, KeyValueTablet
+
+
+def test_write_read_range_rename_delete():
+    store = MemBlobStore()
+    kv = KeyValueTablet("kv1", store)
+    kv.write("a", b"1")
+    kv.write("b", b"2")
+    kv.write("c", b"3")
+    assert kv.read("b") == b"2"
+    assert kv.read("nope") is None
+    assert kv.read_range("a", "c") == [("a", b"1"), ("b", b"2")]
+    assert kv.rename("b", "bb")
+    assert kv.read("b") is None and kv.read("bb") == b"2"
+    assert not kv.rename("ghost", "x")
+    assert kv.delete_range("a", "c") == 2  # a, bb
+    assert kv.read("a") is None
+    assert kv.read("c") == b"3"
+
+
+def test_large_values_spill_and_gc():
+    store = MemBlobStore()
+    kv = KeyValueTablet("kv1", store)
+    big = bytes(range(256)) * ((INLINE_LIMIT // 256) + 4)
+    kv.write("big", big)
+    assert len(store.list("kv1/kvblob/")) == 1
+    assert kv.read("big") == big
+    # overwrite drops the old blob AFTER commit
+    kv.write("big", b"small now")
+    assert store.list("kv1/kvblob/") == []
+    assert kv.read("big") == b"small now"
+    # copy duplicates spilled blobs (single-owner refs)
+    kv.write("big", big)
+    kv.copy_range("big", "bih", prefix_to="copy/")
+    assert len(store.list("kv1/kvblob/")) == 2
+    assert kv.read("copy/big") == big
+    kv.delete_range("big", "bih")
+    assert len(store.list("kv1/kvblob/")) == 1  # copy's blob survives
+    assert kv.read("copy/big") == big
+
+
+def test_self_rename_and_copy_overwrite_blob_lifecycle():
+    store = MemBlobStore()
+    kv = KeyValueTablet("kv1", store)
+    big = b"z" * (INLINE_LIMIT + 1)
+    kv.write("a", big)
+    assert kv.rename("a", "a")  # no-op must NOT free the blob
+    assert kv.read("a") == big
+    assert len(store.list("kv1/kvblob/")) == 1
+    # copy over an existing spilled destination releases its old blob
+    kv.write("c/a", b"q" * (INLINE_LIMIT + 1))
+    kv.copy_range("a", "b", prefix_to="c/")
+    assert kv.read("c/a") == big
+    assert len(store.list("kv1/kvblob/")) == 2  # a's + c/a's fresh copy
+
+
+def test_reboot_recovers_state_and_blob_seq():
+    store = MemBlobStore()
+    kv = KeyValueTablet("kv1", store)
+    big = b"x" * (INLINE_LIMIT + 1)
+    kv.write("k", b"inline")
+    kv.write("big", big)
+    kv.rename("k", "k2")
+
+    kv2 = KeyValueTablet.boot("kv1", store)
+    assert kv2.read("k2") == b"inline"
+    assert kv2.read("k") is None
+    assert kv2.read("big") == big
+    # new generation's spilled blobs cannot collide with old ones
+    kv2.write("big2", big)
+    assert len(store.list("kv1/kvblob/")) == 2
+    assert kv2.read("big2") == big

@@ -1,0 +1,72 @@
+"""Executor pools: parallel pool threads, FIFO per mailbox, cross-pool
+location transparency (SURVEY §2.2 executor-pools row)."""
+
+import threading
+import time
+
+from ydb_tpu.runtime.actors import Actor
+from ydb_tpu.runtime.pools import ThreadedPools
+
+
+class Collector(Actor):
+    def __init__(self):
+        super().__init__()
+        self.got = []
+        self.threads = set()
+
+    def receive(self, message, sender):
+        self.threads.add(threading.get_ident())
+        self.got.append(message)
+        if isinstance(message, tuple) and message[0] == "ping":
+            self.send(sender, ("pong", message[1]))
+
+
+class Pinger(Actor):
+    def __init__(self, peer, n):
+        super().__init__()
+        self.peer = peer
+        self.n = n
+        self.pongs = []
+
+    def on_start(self):
+        for i in range(self.n):
+            self.send(self.peer, ("ping", i))
+
+    def receive(self, message, sender):
+        self.pongs.append(message[1])
+
+
+def test_cross_pool_ping_pong_preserves_order():
+    pools = ThreadedPools(n_pools=3)
+    col = Collector()
+    col_id = pools.register(col, pool=2)
+    ping = Pinger(col_id, 50)
+    pools.register(ping, pool=0)
+    pools.start()
+    try:
+        deadline = time.monotonic() + 15
+        while len(ping.pongs) < 50 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ping.pongs == list(range(50))  # FIFO both directions
+        assert [m[1] for m in col.got] == list(range(50))
+    finally:
+        pools.stop()
+
+
+def test_pools_run_on_distinct_threads():
+    pools = ThreadedPools(n_pools=2)
+    a, b = Collector(), Collector()
+    ida = pools.register(a, pool=0)
+    idb = pools.register(b, pool=1)
+    pools.start()
+    try:
+        for i in range(20):
+            pools.send(ida, i)
+            pools.send(idb, i)
+        pools.drain()
+        assert len(a.got) == len(b.got) == 20
+        assert a.threads and b.threads and a.threads != b.threads
+        stats = pools.stats()
+        assert sum(s["delivered"] for s in stats) >= 40
+    finally:
+        pools.stop()

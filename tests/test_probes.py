@@ -1,0 +1,68 @@
+"""lwtrace-analog probes + memory observability (SURVEY §2.1 lwtrace
+row, §2.14 memory-profiling row)."""
+
+import numpy as np
+
+from ydb_tpu.obs.probes import TraceSession, list_probes, memory_stats, probe
+
+
+def test_probe_sessions_collect_and_detach():
+    p = probe("test.alpha")
+    q = probe("test.beta")
+    assert not p  # nothing attached: fire is near-free
+    p.fire(x=1)  # no-op
+    with TraceSession("test.*") as sess:
+        assert p and q
+        p.fire(x=1)
+        p.fire(x=2)
+        q.fire(y=9)
+    assert not p  # detached
+    p.fire(x=3)   # not recorded
+    assert sess.counts["test.alpha"] == 2
+    assert sess.counts["test.beta"] == 1
+    assert [e for e in sess.events] == [
+        ("test.alpha", {"x": 1}), ("test.alpha", {"x": 2}),
+        ("test.beta", {"y": 9})]
+    assert "test.alpha" in list_probes()
+
+
+def test_probe_predicate_filters():
+    p = probe("test.gamma")
+    with TraceSession("test.gamma",
+                      predicate=lambda n, kw: kw["x"] > 5) as sess:
+        p.fire(x=1)
+        p.fire(x=10)
+    assert sess.counts["test.gamma"] == 1
+
+
+def test_engine_probes_fire_during_scan_and_commit():
+    from ydb_tpu import dtypes
+    from ydb_tpu.engine.blobs import MemBlobStore
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+    from ydb_tpu.ssa.ops import Agg
+    from ydb_tpu.ssa.program import AggSpec, GroupByStep, Program
+
+    schema = dtypes.schema(("id", dtypes.INT64, False),
+                           ("v", dtypes.INT64))
+    shard = ColumnShard("probe_s", schema, MemBlobStore(),
+                        pk_column="id", upsert=True,
+                        config=ShardConfig(
+                            compact_portion_threshold=10 ** 9))
+    prog = Program((GroupByStep(keys=(), aggs=(
+        AggSpec(Agg.COUNT_ALL, None, "n"),)),))
+    with TraceSession("columnshard.*") as sess:
+        wid = shard.write({"id": np.arange(10, dtype=np.int64),
+                           "v": np.ones(10, dtype=np.int64)})
+        shard.commit([wid])
+        shard.scan(prog)
+    assert sess.counts["columnshard.commit"] == 1
+    assert sess.counts["columnshard.scan"] == 1
+    name, params = [e for e in sess.events
+                    if e[0] == "columnshard.scan"][0]
+    assert params["portions"] == 1
+
+
+def test_memory_stats_reports_rss():
+    st = memory_stats()
+    assert st["vmrss_mb"] > 0
+    assert st["vmhwm_mb"] >= st["vmrss_mb"] * 0.5

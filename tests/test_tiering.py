@@ -1,0 +1,64 @@
+"""Hot/cold blob tiering (SURVEY §2.7 tiering row; reference
+ydb/core/tx/tiering + S3 external storage)."""
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.blobs import MemBlobStore, TieredBlobStore
+from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+from ydb_tpu.ssa.ops import Agg
+from ydb_tpu.ssa.program import AggSpec, GroupByStep, Program
+
+COUNT = Program((GroupByStep(keys=(), aggs=(
+    AggSpec(Agg.COUNT_ALL, None, "n"),
+    AggSpec(Agg.SUM, "v", "s"),
+)),))
+
+
+def test_tier_basics():
+    hot, cold = MemBlobStore(), MemBlobStore()
+    t = TieredBlobStore(hot, cold)
+    t.put("a", b"1")
+    assert t.tier_of("a") == "hot"
+    assert t.evict(lambda bid: True) == 1
+    assert t.tier_of("a") == "cold"
+    assert t.get("a") == b"1"          # transparent read-through
+    assert t.exists("a") and "a" in t.list("")
+    assert t.promote("a")
+    assert t.tier_of("a") == "hot"
+    # rewrite supersedes a cold copy
+    t.evict(lambda bid: True)
+    t.put("a", b"2")
+    assert t.tier_of("a") == "hot" and t.get("a") == b"2"
+    assert not cold.exists("a")
+    t.delete("a")
+    assert t.tier_of("a") is None
+
+
+def test_shard_cold_eviction_keeps_scans_correct():
+    hot, cold = MemBlobStore(), MemBlobStore()
+    store = TieredBlobStore(hot, cold)
+    schema = dtypes.schema(("id", dtypes.INT64, False),
+                           ("v", dtypes.INT64))
+    shard = ColumnShard("t", schema, store, pk_column="id", upsert=True,
+                        config=ShardConfig(
+                            compact_portion_threshold=10 ** 9))
+    for i in range(3):
+        wid = shard.write({
+            "id": np.arange(i * 100, i * 100 + 100, dtype=np.int64),
+            "v": np.full(100, i, dtype=np.int64)})
+        shard.commit([wid])
+    old_snap = shard.snap
+    wid = shard.write({"id": np.arange(300, 400, dtype=np.int64),
+                       "v": np.full(100, 9, dtype=np.int64)})
+    shard.commit([wid])
+
+    moved = shard.evict_to_cold(old_snap)
+    assert moved == 3  # the three old portions' blobs
+    tiers = {m.blob_id: store.tier_of(m.blob_id)
+             for m in shard.visible_portions()}
+    assert sorted(tiers.values()) == ["cold", "cold", "cold", "hot"]
+
+    res = shard.scan(COUNT)
+    assert int(res.cols["n"][0][0]) == 400
+    assert int(res.cols["s"][0][0]) == 100 * (0 + 1 + 2 + 9)

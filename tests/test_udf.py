@@ -1,0 +1,49 @@
+"""Scalar UDFs (SURVEY §2.9 UDF-ABI row): registered host functions
+usable in SQL expressions, lowered through jax.pure_callback on the
+device path and called directly by the oracle."""
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.kqp.session import Cluster
+
+
+def _cluster():
+    c = Cluster()
+    s = c.session()
+    s.execute("create table kv (k bigint not null, v bigint, "
+              "primary key (k))")
+    s.execute("insert into kv (k, v) values (1, 10), (2, 20), (3, 33)")
+    c.register_udf(
+        "mix", lambda a, b: (a * 1000003 + b) % 97, dtypes.INT64)
+    c.register_udf(
+        "halve", lambda a: a.astype(np.float64) / 2.0, dtypes.DOUBLE)
+    return c, s
+
+
+def test_udf_in_select_and_where():
+    c, s = _cluster()
+    r = s.execute("select k, mix(k, v) as m from kv order by k")
+    want = [(k * 1000003 + v) % 97 for k, v in ((1, 10), (2, 20), (3, 33))]
+    assert [int(x) for x in r.column("m")] == want
+
+    r = s.execute("select k from kv where halve(v) > 9.0 order by k")
+    assert [int(x) for x in r.column("k")] == [2, 3]
+
+
+def test_udf_inside_aggregate():
+    c, s = _cluster()
+    r = s.execute("select sum(mix(k, v)) as t from kv")
+    want = sum((k * 1000003 + v) % 97 for k, v in
+               ((1, 10), (2, 20), (3, 33)))
+    assert int(r.column("t")[0]) == want
+
+
+def test_unknown_udf_still_errors():
+    c, s = _cluster()
+    import pytest
+
+    from ydb_tpu.sql.planner import PlanError
+
+    with pytest.raises(PlanError, match="unknown function"):
+        s.execute("select nosuch(k) from kv")

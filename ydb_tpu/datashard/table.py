@@ -56,6 +56,12 @@ class RowTable:
         self.schema_version = 1
         self.column_added: dict[str, int] = {}
         self.pre_commit = None
+        # secondary indexes: name -> (column, [index DataShards keyed
+        # (value, *pk)]); maintained ATOMICALLY with data writes — index
+        # shards join the same 2PC (the reference maintains indeximpl
+        # tables in the same distributed tx, datashard build_index /
+        # change exchange for indexes)
+        self.indexes: dict[str, tuple[str, list]] = {}
 
     def post_boot_sweep(self) -> None:
         """Crash-safe DROP COLUMN: if a prior strip (alter_schema) died
@@ -66,9 +72,14 @@ class RowTable:
         self._strip_columns(keep=set(self.schema.names))
 
     def storage_prefixes(self) -> list[str]:
-        """Blob-store prefixes owning this table's durable state (DROP
-        TABLE deletes them so a same-name CREATE starts empty)."""
-        return [f"tablet/{s.executor.tablet_id}/" for s in self.shards]
+        """Blob-store prefixes owning this table's durable state —
+        INDEX shards included (DROP TABLE deletes them so a same-name
+        CREATE + same-name index starts empty, no resurrection)."""
+        out = [f"tablet/{s.executor.tablet_id}/" for s in self.shards]
+        for _, idx_shards in self.indexes.values():
+            out += [f"tablet/{s.executor.tablet_id}/"
+                    for s in idx_shards]
+        return out
 
     # ---- encode helpers (shared dict ids, scaled decimals) ----
 
@@ -121,7 +132,101 @@ class RowTable:
                 ops, lock_id=lock_ids.get(i) if lock_ids else None)
             participants.append(shard)
             prepare_args.append([wid])
+        if self.indexes and per_row_ops:
+            # ONE old-row read serves every index
+            old_rows = self.read_rows([op.key for op in per_row_ops])
+            for col, idx_shards in self.indexes.values():
+                idx_ops = self._index_ops(col, per_row_ops, old_rows)
+                for shard, wid in _route_propose(idx_shards, idx_ops):
+                    participants.append(shard)
+                    prepare_args.append([wid])
         return self.coordinator.commit(participants, prepare_args)
+
+    # ---- secondary indexes ----
+
+    def _index_ops(self, col: str, per_row_ops, old_rows) -> list[RowOp]:
+        """Index maintenance ops mirroring ``per_row_ops``: erase the
+        old (value, pk) entry when the value changes or the row dies;
+        put the new one. NULL values are not indexed. The same key
+        appearing twice in one batch chains (last write wins, exactly
+        like the data shard's apply order)."""
+        idx_pk = (col,) + tuple(self.pk_columns)
+        cur: dict[tuple, object] = {}  # key -> value as the batch runs
+        idx_ops: list[RowOp] = []
+        for op in per_row_ops:
+            if op.key in cur:
+                old_v = cur[op.key]
+            else:
+                old = old_rows.get(op.key)
+                old_v = old.get(col) if old else None
+            new_v = op.row.get(col) if op.row is not None else None
+            cur[op.key] = new_v
+            if old_v is not None and old_v != new_v:
+                idx_ops.append(RowOp((old_v,) + op.key, None))
+            if new_v is not None and new_v != old_v:
+                idx_ops.append(
+                    RowOp((new_v,) + op.key,
+                          dict(zip(idx_pk, (new_v,) + op.key))))
+        return idx_ops
+
+    def add_index(self, name: str, column: str) -> None:
+        """Create a global secondary index on ``column`` and backfill it
+        online: the index registers FIRST (new writes maintain it), then
+        existing rows backfill at a snapshot — the online index-build
+        shape (datashard build_index.cpp)."""
+        if column in self.pk_columns:
+            raise ValueError("column is already the primary key")
+        if name in self.indexes:
+            raise ValueError(f"index {name} already exists")
+        fields = [self.schema.field(column)] + [
+            self.schema.field(c) for c in self.pk_columns
+        ]
+        idx_schema = dtypes.Schema(tuple(fields))
+        idx_pk = (column,) + tuple(self.pk_columns)
+        idx_shards = [
+            DataShard(f"{self.name}/idx_{name}/{i}", idx_schema,
+                      self.shards[0].executor.store, idx_pk)
+            for i in range(len(self.shards))
+        ]
+        self.indexes[name] = (column, idx_shards)
+        # online backfill at a snapshot; rows written after registration
+        # are maintained by the normal write path (idempotent upserts)
+        snap = self.coordinator.read_snapshot()
+        backfill: list[RowOp] = []
+        for shard in self.shards:
+            for page in shard.read(snap):
+                for key, row in page:
+                    v = row.get(column)
+                    if v is None:
+                        continue
+                    backfill.append(RowOp(
+                        (v,) + key, dict(zip(idx_pk, (v,) + key))))
+        proposed = _route_propose(idx_shards, backfill)
+        if proposed:
+            self.coordinator.commit(
+                [s for s, _ in proposed], [[w] for _, w in proposed])
+
+    def lookup_index(self, name: str, value) -> list[tuple]:
+        """Primary keys of rows where the indexed column == value."""
+        col, idx_shards = self.indexes[name]
+        f = self.schema.field(col)
+        if f.type.is_string and not isinstance(value, int):
+            v = self.dicts.for_column(col).get(_as_bytes(value))
+            if v is None:
+                return []
+        else:
+            v = _py(np.asarray(value)) if not isinstance(value, int) \
+                else value
+        snap = self.coordinator.read_snapshot()
+        shard = idx_shards[int(_fnv_route(
+            np.asarray([v], dtype=np.int64), len(idx_shards))[0])]
+        out = []
+        for page in shard.read(snap, lo=(v,)):
+            for key, _row in page:
+                if key[0] != v:
+                    return out
+                out.append(tuple(key[1:]))
+        return out
 
     def insert(self, columns: dict, validity=None) -> TxResult:
         """Upsert semantics (same surface as ShardedTable.insert)."""
@@ -296,6 +401,22 @@ class RowTable:
         for shard in self.shards:
             shard.compact(keep_after=horizon)
         return {"compacted": len(self.shards), "evicted": evicted}
+
+
+def _route_propose(shards: list, ops: list[RowOp]) -> list[tuple]:
+    """fnv-route ops by first key component and propose per shard;
+    returns [(shard, write_id)] (shared by the commit path, index
+    maintenance and index backfill)."""
+    if not ops:
+        return []
+    first = np.asarray([op.key[0] for op in ops], dtype=np.int64)
+    route = _fnv_route(first, len(shards))
+    out = []
+    for i, shard in enumerate(shards):
+        mine = [op for op, r in zip(ops, route) if r == i]
+        if mine:
+            out.append((shard, shard.propose(mine)))
+    return out
 
 
 def _as_bytes(v) -> bytes:

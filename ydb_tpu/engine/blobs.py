@@ -252,3 +252,77 @@ class CachedBlobStore(BlobStore):
         with self._lock:
             return {"bytes": self._bytes, "entries": len(self._lru),
                     "hits": self.hits, "misses": self.misses}
+
+
+class TieredBlobStore(BlobStore):
+    """Hot/cold tiering behind the flat BlobStore surface (SURVEY §2.7
+    blob-abstraction-and-tiering row; reference ydb/core/tx/tiering +
+    S3 external storage): writes land in the hot tier; ``evict``
+    migrates blobs matching a predicate to the cold tier (an object
+    store in a real deployment — any BlobStore here); reads fall
+    through hot -> cold transparently, so portion metadata never
+    changes when data changes temperature. ``promote`` moves a hot-read
+    candidate back.
+    """
+
+    def __init__(self, hot: BlobStore, cold: BlobStore):
+        self.hot = hot
+        self.cold = cold
+
+    def put(self, blob_id, data):
+        self.hot.put(blob_id, data)
+        # a rewrite supersedes any cold copy (stale tier shadowing)
+        if self.cold.exists(blob_id):
+            self.cold.delete(blob_id)
+
+    def get(self, blob_id):
+        if self.hot.exists(blob_id):
+            return self.hot.get(blob_id)
+        return self.cold.get(blob_id)
+
+    def get_range(self, blob_id, off, length):
+        if self.hot.exists(blob_id):
+            return self.hot.get_range(blob_id, off, length)
+        return self.cold.get_range(blob_id, off, length)
+
+    def delete(self, blob_id):
+        self.hot.delete(blob_id)
+        self.cold.delete(blob_id)
+
+    def exists(self, blob_id):
+        return self.hot.exists(blob_id) or self.cold.exists(blob_id)
+
+    def list(self, prefix=""):
+        merged = set(self.hot.list(prefix)) | set(self.cold.list(prefix))
+        return sorted(merged)
+
+    # -- tier management --
+
+    def evict(self, predicate) -> int:
+        """Move hot blobs with predicate(blob_id)=True to the cold tier
+        (the TTL-driven tier eviction shape, tx/tiering). Copy-then-
+        delete: a crash in between leaves a harmless duplicate (reads
+        prefer hot; the next evict pass re-deletes)."""
+        moved = 0
+        for bid in self.hot.list(""):
+            if not predicate(bid):
+                continue
+            self.cold.put(bid, self.hot.get(bid))
+            self.hot.delete(bid)
+            moved += 1
+        return moved
+
+    def promote(self, blob_id) -> bool:
+        """Bring a cold blob back to the hot tier (read-heat feedback)."""
+        if self.hot.exists(blob_id) or not self.cold.exists(blob_id):
+            return False
+        self.hot.put(blob_id, self.cold.get(blob_id))
+        self.cold.delete(blob_id)
+        return True
+
+    def tier_of(self, blob_id) -> str | None:
+        if self.hot.exists(blob_id):
+            return "hot"
+        if self.cold.exists(blob_id):
+            return "cold"
+        return None

@@ -132,12 +132,20 @@ def _const_array(c: Const, n: int) -> ColT:
 
 
 def _eval(expr, cols, types, dicts, n) -> ColT:
-    from ydb_tpu.ssa.program import DictMap
+    from ydb_tpu.ssa.program import DictMap, UdfCall
 
     if isinstance(expr, Col):
         return cols[expr.name]
     if isinstance(expr, Const):
         return _const_array(expr, n)
+    if isinstance(expr, UdfCall):
+        args = [_eval(a, cols, types, dicts, n) for a in expr.args]
+        valid = args[0][1].copy()
+        for _, ok in args[1:]:
+            valid &= ok
+        out = np.asarray(expr.fn(*[v for v, _ in args]),
+                         dtype=expr.out_type.physical)
+        return out, valid
     if isinstance(expr, DictMap):
         from ydb_tpu.ssa.compiler import dict_map_table
 

@@ -101,7 +101,7 @@ def required_columns(program: Program, schema: dtypes.Schema) -> tuple[str, ...]
     """Input columns the program actually reads (scan projection pushdown)."""
     from ydb_tpu.ssa.program import (
         AssignStep, Call, Col, DictMap, DictPredicate, FilterStep,
-        GroupByStep, ProjectStep, SortStep,
+        GroupByStep, ProjectStep, SortStep, UdfCall,
     )
 
     used: set[str] = set()
@@ -111,7 +111,7 @@ def required_columns(program: Program, schema: dtypes.Schema) -> tuple[str, ...]
         if isinstance(e, Col):
             if e.name not in assigned:
                 used.add(e.name)
-        elif isinstance(e, Call):
+        elif isinstance(e, (Call, UdfCall)):
             for a in e.args:
                 walk(a)
         elif isinstance(e, (DictPredicate, DictMap)):

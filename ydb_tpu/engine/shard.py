@@ -51,7 +51,12 @@ from ydb_tpu.engine.portion import (
     write_portion_blob,
 )
 from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
+from ydb_tpu.obs.probes import probe
 from ydb_tpu.ssa.program import Program
+
+_P_COMMIT = probe("columnshard.commit")
+_P_SCAN = probe("columnshard.scan")
+_P_COMPACT = probe("columnshard.compact")
 
 
 @dataclasses.dataclass
@@ -206,6 +211,9 @@ class ColumnShard:
 
     def _commit(self, write_ids: list[int], snap: int) -> int:
         batches = [self._insert_buffer.pop(w) for w in write_ids]
+        if _P_COMMIT:
+            _P_COMMIT.fire(shard=self.shard_id, snap=snap,
+                           writes=len(write_ids))
         self.snap = snap
         if not batches:
             self._log({"op": "noop", "snap": snap})
@@ -380,9 +388,15 @@ class ColumnShard:
                 program, src, self.config.scan_block_rows, key_spaces
             ).detach()
             self._scan_cache[key] = (ex, sizes)
-        return OracleTable.from_block(ex.run_stream(
+        out = OracleTable.from_block(ex.run_stream(
             src.blocks(self.config.scan_block_rows, ex.read_cols)
         ))
+        if _P_SCAN:
+            _P_SCAN.fire(shard=self.shard_id,
+                         portions=len(src.metas),
+                         chunks_read=src.chunks_read,
+                         compiled_fresh=hit is None)
+        return out
 
     # ---------------- background: compaction / TTL ----------------
 
@@ -452,6 +466,10 @@ class ColumnShard:
 
         self._in_compaction = True
         snap = self._advance_snap()
+        if _P_COMPACT:
+            _P_COMPACT.fire(shard=self.shard_id, snap=snap,
+                            clusters=len(clusters),
+                            portions=len(metas))
         # output portions are WAL-staged and only activate at the
         # cluster's compact_commit record, which also carries the removal
         # tombstones: a crash anywhere mid-stream replays to the exact
@@ -531,6 +549,26 @@ class ColumnShard:
                     self._log({"op": "remove_portion", "snap": snap,
                                "portion_id": meta.portion_id})
         return evicted
+
+    def evict_to_cold(self, max_snap: int) -> int:
+        """Move blobs of portions committed at/before ``max_snap`` to the
+        cold tier (the TTL/age-driven tier eviction of tx/tiering).
+        Requires a TieredBlobStore; scans keep working transparently
+        (reads fall through hot -> cold). Returns blobs moved."""
+        from ydb_tpu.engine.blobs import TieredBlobStore
+
+        store = self.store
+        # unwrap a page cache if one fronts the tiers
+        base = getattr(store, "base", None)
+        tiered = store if isinstance(store, TieredBlobStore) else (
+            base if isinstance(base, TieredBlobStore) else None)
+        if tiered is None:
+            return 0
+        ids = {
+            m.blob_id for m in self.visible_portions()
+            if m.commit_snap <= max_snap
+        }
+        return tiered.evict(lambda bid: bid in ids)
 
     def gc_blobs(self, keep_snap: int) -> int:
         """Delete blobs of portions invisible at and after keep_snap

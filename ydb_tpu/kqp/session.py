@@ -37,8 +37,11 @@ from ydb_tpu.sql.planner import (
     plan_select,
     plan_select_full,
 )
+from ydb_tpu.obs.probes import probe as _probe
 from ydb_tpu.tx import Coordinator, ShardedTable
 from ydb_tpu.tx.coordinator import TxResult
+
+_P_PLAN_CACHE = _probe("kqp.plan_cache")
 
 _TYPE_MAP = {
     "int8": dtypes.INT8, "int16": dtypes.INT16, "int32": dtypes.INT32,
@@ -105,6 +108,8 @@ class Cluster:
         # optional request-unit quoter (rate-limiter / kesus analog):
         # when set, every statement consumes 1 unit from "kqp/requests"
         self.quoter = None
+        # registered scalar UDFs: name -> (vectorized fn, result type)
+        self.udfs: dict[str, tuple] = {}
         # live-tunable knobs (immediate control board)
         self.icb = ControlBoard()
         self.icb.register("rmw_retries", 5, 1, 100)
@@ -586,7 +591,14 @@ class Cluster:
             if st["rows"] is not None
         }
         return Catalog(schemas=schemas, primary_keys=pks,
-                       dicts=self.dicts, row_counts=counts)
+                       dicts=self.dicts, row_counts=counts,
+                       udfs=dict(self.udfs))
+
+    def register_udf(self, name: str, fn, out_type) -> None:
+        """Register a scalar UDF: ``fn`` takes numpy arrays (one per SQL
+        argument) and returns an array; usable in any expression."""
+        self.udfs[name.lower()] = (fn, out_type)
+        self._plan_cache.clear()
 
     def snapshot_db(self, snap: int | None = None,
                     include_sys: bool = False) -> Database:
@@ -606,8 +618,12 @@ class Cluster:
     def plan(self, sql: str):
         hit = self._plan_cache.get(sql)
         if hit is not None:
+            if _P_PLAN_CACHE:
+                _P_PLAN_CACHE.fire(hit=True)
             self._plan_cache.move_to_end(sql)
             return hit
+        if _P_PLAN_CACHE:
+            _P_PLAN_CACHE.fire(hit=False)
         stmt = parse(sql)
         if not isinstance(stmt, ast.Select):
             return stmt

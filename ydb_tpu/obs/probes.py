@@ -1,0 +1,121 @@
+"""lwtrace-analog probe points: near-zero-cost named events with
+dynamically attached trace sessions.
+
+Reference: the lwtrace library (ydb/library/lwtrace; SURVEY §2.1 row
+'lwtrace probes') — probes compiled into hot paths fire only while a
+trace session is attached, collecting events into per-session ring
+buffers with filters. Same contract here: ``probe(name)`` returns a
+module-level Probe whose ``fire(**params)`` is a single attribute check
+when nothing is attached; sessions attach by glob pattern and keep a
+bounded ring of (name, params) events plus per-probe hit counts.
+"""
+
+from __future__ import annotations
+
+import collections
+import fnmatch
+import threading
+
+_registry: dict[str, "Probe"] = {}
+_lock = threading.Lock()
+
+
+class Probe:
+    __slots__ = ("name", "_sessions")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._sessions: tuple = ()
+
+    def fire(self, **params) -> None:
+        sessions = self._sessions  # snapshot; () when idle (the fast path)
+        for s in sessions:
+            s._record(self.name, params)
+
+    def __bool__(self) -> bool:
+        """Truthy while any session listens: guards costly param
+        computation (``if PROBE: PROBE.fire(expensive=...)``)."""
+        return bool(self._sessions)
+
+
+def probe(name: str) -> Probe:
+    """Get-or-create the module-level probe point."""
+    with _lock:
+        p = _registry.get(name)
+        if p is None:
+            p = _registry[name] = Probe(name)
+        return p
+
+
+def list_probes() -> list[str]:
+    with _lock:
+        return sorted(_registry)
+
+
+class TraceSession:
+    """One attached collector (lwtrace session analog)."""
+
+    def __init__(self, pattern: str = "*", capacity: int = 4096,
+                 predicate=None):
+        self.pattern = pattern
+        self.predicate = predicate
+        self.events: collections.deque = collections.deque(
+            maxlen=capacity)
+        self.counts: collections.Counter = collections.Counter()
+        self._elock = threading.Lock()
+        self._attached: list[Probe] = []
+
+    def _record(self, name: str, params: dict) -> None:
+        if self.predicate is not None and not self.predicate(name, params):
+            return
+        with self._elock:
+            self.counts[name] += 1
+            self.events.append((name, params))
+
+    def attach(self) -> "TraceSession":
+        with _lock:
+            for name, p in _registry.items():
+                if fnmatch.fnmatchcase(name, self.pattern):
+                    p._sessions = p._sessions + (self,)
+                    self._attached.append(p)
+        return self
+
+    def detach(self) -> None:
+        with _lock:
+            for p in self._attached:
+                p._sessions = tuple(
+                    s for s in p._sessions if s is not self)
+            self._attached = []
+
+    def __enter__(self) -> "TraceSession":
+        return self.attach()
+
+    def __exit__(self, *exc) -> None:
+        self.detach()
+
+
+def memory_stats() -> dict:
+    """Process + device memory observability (SURVEY §2.14 row
+    'memory profiling'): VmRSS/VmHWM from /proc plus per-device live
+    buffer stats when the backend exposes them."""
+    out: dict = {}
+    try:
+        for line in open("/proc/self/status"):
+            if line.startswith(("VmRSS", "VmHWM")):
+                k, v = line.split(":", 1)
+                out[k.lower() + "_mb"] = round(
+                    float(v.split()[0]) / 1024.0, 1)
+    except OSError:
+        pass
+    try:
+        import jax
+
+        for i, d in enumerate(jax.local_devices()):
+            st = getattr(d, "memory_stats", lambda: None)()
+            if st:
+                out[f"device{i}_bytes_in_use"] = st.get("bytes_in_use")
+                out[f"device{i}_peak_bytes"] = st.get(
+                    "peak_bytes_in_use")
+    except Exception:
+        pass
+    return out

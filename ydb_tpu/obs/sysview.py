@@ -38,6 +38,9 @@ SYS_SCHEMAS = {
     "sys_audit": dtypes.schema(
         ("kind", dtypes.STRING), ("sql", dtypes.STRING),
         ("status", dtypes.STRING), ("duration_us", dtypes.INT64)),
+    # memory observability (memory profiling row): process + device
+    "sys_memory": dtypes.schema(
+        ("metric", dtypes.STRING), ("value", dtypes.DOUBLE)),
 }
 
 
@@ -148,12 +151,21 @@ def _audit_rows(cluster):
             [a["duration_us"] for a in log]]
 
 
+def _memory_rows(cluster):
+    from ydb_tpu.obs.probes import memory_stats
+
+    st = memory_stats()
+    keys = sorted(k for k, v in st.items() if v is not None)
+    return [keys, [float(st[k]) for k in keys]]
+
+
 _BUILDERS = {
     "sys_partition_stats": _partition_stats_rows,
     "sys_query_stats": _query_stats_rows,
     "sys_scheme_paths": _scheme_paths_rows,
     "sys_table_stats": _table_stats_rows,
     "sys_audit": _audit_rows,
+    "sys_memory": _memory_rows,
 }
 
 

@@ -63,6 +63,8 @@ class Catalog:
     # obs/sysview.table_stats): drives CBO-lite join ordering — among
     # connectable candidates, smaller estimated sides join first
     row_counts: dict[str, int] = dataclasses.field(default_factory=dict)
+    # registered scalar UDFs: name -> (vectorized fn, result LogicalType)
+    udfs: dict[str, tuple] = dataclasses.field(default_factory=dict)
 
 
 class PlanError(Exception):
@@ -297,12 +299,13 @@ class _Lower:
     def __init__(self, types: dict[str, dtypes.LogicalType],
                  dicts: DictionarySet | None,
                  dict_src: dict[str, str] | None = None,
-                 resolve=None, emit=None):
+                 resolve=None, emit=None, udfs=None):
         self.types = types
         self.dicts = dicts
         self.dict_src = dict_src if dict_src is not None else {}
         self._resolve = resolve
         self._emit = emit
+        self.udfs = udfs or {}
 
     def name_of(self, e: ast.Name) -> str:
         if self._resolve is not None:
@@ -512,6 +515,25 @@ class _Lower:
                   "round": Op.ROUND, "coalesce": Op.COALESCE}
         if e.name in simple:
             return Call(simple[e.name], *[self.lower(a) for a in e.args])
+        if e.name in self.udfs:
+            from ydb_tpu.ssa.program import UdfCall
+
+            fn, out_type = self.udfs[e.name]
+            if not e.args:
+                raise PlanError(
+                    f"UDF {e.name} needs at least one argument")
+            if out_type.is_string:
+                raise PlanError(
+                    "UDFs cannot return strings (dictionary ids are"
+                    " plan-time state)")
+            lowered = tuple(self.lower(a) for a in e.args)
+            for a in lowered:
+                t = infer_type(a, None, self.types)
+                if t.is_string:
+                    raise PlanError(
+                        f"UDF {e.name}: string-column arguments are not"
+                        " supported (the UDF would see dictionary ids)")
+            return UdfCall(e.name, lowered, out_type, fn)
         raise PlanError(f"unknown function {e.name}")
 
 
@@ -934,7 +956,7 @@ class _SelectPlanner:
             dict_src = dict(scope.dict_src)
             steps: list = []
             low = _Lower(types, self.catalog.dicts, dict_src,
-                         emit=steps.append)
+                         emit=steps.append, udfs=self.catalog.udfs)
             for c in pushdown[scope.alias]:
                 steps.append(FilterStep(low.lower(c)))
             cols = tuple(
@@ -1150,7 +1172,8 @@ class _SelectPlanner:
 
         steps: list = []
         low = _Lower(types, self.catalog.dicts, dict_src,
-                     resolve=resolve_out, emit=steps.append)
+                     resolve=resolve_out, emit=steps.append,
+                     udfs=self.catalog.udfs)
         for c in residual:
             steps.append(FilterStep(low.lower(c)))
 
@@ -1493,7 +1516,8 @@ def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, having):
     post_dict_src = dict(low.dict_src)
     for spec in agg_specs:
         post_types[spec.out_name] = agg_result_type(spec, None, low.types)
-    post_low = _Lower(post_types, low.dicts, post_dict_src)
+    post_low = _Lower(post_types, low.dicts, post_dict_src,
+                      udfs=low.udfs)
     for spec in agg_specs:
         # MIN/MAX/SOME over a string column: the output carries the
         # source column's dictionary

@@ -40,6 +40,7 @@ from ydb_tpu.ssa.program import (
     ProjectStep,
     Program,
     SortStep,
+    UdfCall,
     agg_result_type,
     infer_type,
 )
@@ -158,6 +159,28 @@ def compile_program(
             return _resolve_dict_predicate(ctx, expr, cur_types)
         if isinstance(expr, DictMap):
             return _resolve_dict_map(ctx, expr, cur_types)
+        if isinstance(expr, UdfCall):
+            arg_fns = [resolve_expr(a)[0] for a in expr.args]
+            out_dtype = expr.out_type.physical
+            user_fn = expr.fn
+
+            def call_host(*arrs, _fn=user_fn, _dt=out_dtype):
+                return np.asarray(_fn(*arrs), dtype=_dt)
+
+            def lower_udf(env, aux, _fns=tuple(arg_fns),
+                          _dt=out_dtype, _call=call_host):
+                cols = [f(env, aux) for f in _fns]
+                valid = cols[0].validity
+                for c in cols[1:]:
+                    valid = valid & c.validity
+                out = jax.pure_callback(
+                    _call,
+                    jax.ShapeDtypeStruct(cols[0].data.shape, _dt),
+                    *[c.data for c in cols],
+                )
+                return Column(out, valid)
+
+            return lower_udf, expr.out_type
         assert isinstance(expr, Call)
         return _resolve_call(ctx, expr, cur_types, resolve_expr)
 

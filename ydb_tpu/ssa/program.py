@@ -61,6 +61,23 @@ class DictPredicate:
 
 
 @dataclasses.dataclass(frozen=True)
+class UdfCall:
+    """A registered scalar UDF applied elementwise (the UDF ABI analog,
+    ydb/library/yql/public/udf; SURVEY §2.9 UDF row). ``fn`` is the
+    host-side vectorized implementation (numpy arrays in/out), resolved
+    from the registry at plan time and carried in the node; the JAX
+    lowering runs it through ``jax.pure_callback`` (host roundtrip — the
+    price of arbitrary user code, exactly like the reference marshalling
+    rows through the UDF ABI), the oracle calls it directly. NULLs:
+    output row is NULL iff any argument is NULL."""
+
+    name: str
+    args: tuple["Expr", ...]
+    out_type: dtypes.LogicalType
+    fn: object  # Callable[[np.ndarray, ...], np.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
 class DictMap:
     """A string->string transform resolved against the column dictionary
     at compile time (substring etc.): builds the OUTPUT dictionary for
@@ -74,7 +91,7 @@ class DictMap:
     out_column: str
 
 
-Expr = Union[Col, Const, Call, DictPredicate, DictMap]
+Expr = Union[Col, Const, Call, DictPredicate, DictMap, UdfCall]
 
 
 def lit(value, typ: dtypes.LogicalType | None = None) -> Const:
@@ -191,6 +208,8 @@ def infer_type(
         return dtypes.BOOL
     if isinstance(expr, DictMap):
         return dtypes.STRING
+    if isinstance(expr, UdfCall):
+        return expr.out_type
     assert isinstance(expr, Call)
     op = expr.op
     if op in _CMP or op in _LOGIC or op in _PRED:
