@@ -23,14 +23,13 @@ host; BASELINE.md requires the CPU number be measured, not copied).
 
 Env knobs: YDB_TPU_BENCH_SF (default 10), YDB_TPU_BENCH_ITERS (default
 5), YDB_TPU_BENCH_BLOCK_ROWS (default 2^21), YDB_TPU_BENCH_SKIP_ENGINE=1
-(kernel-only quick mode), YDB_TPU_BENCH_PALLAS_COMPARE=1 (adds a
-subprocess A/B of the Pallas one-hot group-by vs the XLA scatter path).
+(kernel-only quick mode), YDB_TPU_BENCH_PALLAS_COMPARE=1 (force the
+in-process A/B of the Pallas one-hot group-by vs the XLA scatter path;
+default on for TPU backends).
 """
 
 import json
 import os
-import subprocess
-import sys
 import tempfile
 import time
 
@@ -104,51 +103,37 @@ def timed_cold_warm(fn, iters):
     return cold, warm, out
 
 
-def pallas_ab(sf, block_rows):
-    """Subprocess A/B: q1 kernel steady-state with the Pallas one-hot
-    group-by forced ON vs OFF (jit caches key on the traced path, so an
-    in-process flip would not retrace)."""
-    out = {}
-    for label, flag in (("pallas", "1"), ("scatter", "0")):
-        env = dict(os.environ, YDB_TPU_PALLAS=flag,
-                   YDB_TPU_BENCH_MODE="q1_kernel",
-                   YDB_TPU_BENCH_SF=str(sf),
-                   YDB_TPU_BENCH_BLOCK_ROWS=str(block_rows))
-        p = subprocess.run([sys.executable, __file__], env=env,
-                           capture_output=True, text=True, timeout=1800)
-        if p.returncode == 0:
-            out[f"{label}_q1_rows_per_sec"] = json.loads(
-                p.stdout.strip().splitlines()[-1])["value"]
-        else:
-            out[f"{label}_error"] = (p.stderr or "")[-300:]
-    return out
-
-
-def q1_kernel_mode(sf, iters, block_rows):
-    """Internal mode: print q1 kernel-steady rows/s as one JSON line."""
+def pallas_ab(src, blocks, n_rows, block_rows, iters):
+    """In-process A/B: q1 with the Pallas one-hot group-by forced ON vs
+    OFF. Fresh executors per side — enabled() is consulted at trace
+    time, and separate function objects trace separately. (No
+    subprocesses: a child python would try to claim the TPU the parent
+    already holds and hang on the tunnel.)"""
     import jax
 
-    from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
+    from ydb_tpu.engine.scan import ScanExecutor
+    from ydb_tpu.ssa import pallas_kernels
     from ydb_tpu.workload import tpch
 
-    data = tpch.TpchData(sf=sf, seed=42)
-    li = data.tables["lineitem"]
-    n_rows = len(li["l_orderkey"])
-    src = ColumnSource(li, tpch.LINEITEM_SCHEMA, data.dicts)
-    ex1 = ScanExecutor(tpch.q1_program(), src, block_rows=block_rows)
-    blocks = [jax.device_put(b)
-              for b in src.blocks(block_rows, ex1.read_cols)]
-    jax.block_until_ready(blocks)
+    out = {}
+    for label, force in (("pallas", True), ("scatter", False)):
+        pallas_kernels.FORCE = force
+        try:
+            ex = ScanExecutor(tpch.q1_program(), src,
+                              block_rows=block_rows)
 
-    def run():
-        out = ex1.finalize([ex1.run_block(b) for b in blocks])
-        jax.block_until_ready(out)
-        return out
+            def go():
+                r = ex.finalize([ex.run_block(b) for b in blocks])
+                jax.block_until_ready(r)
+                return r
 
-    _, warm, _ = timed_cold_warm(run, iters)
-    print(json.dumps({"metric": "q1_kernel_rows_per_sec",
-                      "value": round(n_rows / warm), "unit": "rows/s",
-                      "vs_baseline": 0}))
+            _, warm, _ = timed_cold_warm(go, iters)
+            out[f"{label}_q1_rows_per_sec"] = round(n_rows / warm)
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            out[f"{label}_error"] = repr(e)[-300:]
+        finally:
+            pallas_kernels.FORCE = None
+    return out
 
 
 def main():
@@ -156,9 +141,6 @@ def main():
     iters = int(os.environ.get("YDB_TPU_BENCH_ITERS", "5"))
     block_rows = int(os.environ.get("YDB_TPU_BENCH_BLOCK_ROWS",
                                     str(1 << 21)))
-    if os.environ.get("YDB_TPU_BENCH_MODE") == "q1_kernel":
-        q1_kernel_mode(sf, iters, block_rows)
-        return
 
     import jax
 
@@ -227,10 +209,21 @@ def main():
                    for b in blocks for nm, c in b.columns.items()
                    if nm in ex1.read_cols)
     extra["kernel_hbm_gb_per_sec"] = round(q1_bytes / warm1 / 1e9, 1)
+
+    # Pallas one-hot group-by vs XLA scatter A/B (VERDICT r4 item 9):
+    # by default on the real chip; force with YDB_TPU_BENCH_PALLAS_COMPARE
+    flag = os.environ.get("YDB_TPU_BENCH_PALLAS_COMPARE")
+    ab_enabled = (jax.default_backend() == "tpu" if flag is None
+                  else flag not in ("0", "", "off"))
+    if ab_enabled:
+        extra.update(pallas_ab(src, blocks, n_rows, block_rows,
+                               max(2, iters // 2)))
     del blocks
 
     engine_warm_rps = extra["kernel_q1_warm_rows_per_sec"]
-    if not os.environ.get("YDB_TPU_BENCH_SKIP_ENGINE"):
+    db_iters = min(iters, 2)  # storage tiers stream the table per run
+    try:
+      if not os.environ.get("YDB_TPU_BENCH_SKIP_ENGINE"):
         # ---- engine tier: ColumnShard on DirBlobStore ----
         with tempfile.TemporaryDirectory(prefix="ydbtpu_bench_") as root:
             store = DirBlobStore(root)
@@ -263,9 +256,9 @@ def main():
                 return go
 
             ecold1, ewarm1, eout1 = timed_cold_warm(
-                run_engine(tpch.q1_program()), iters)
+                run_engine(tpch.q1_program()), db_iters)
             ecold6, ewarm6, eout6 = timed_cold_warm(
-                run_engine(tpch.q6_program()), iters)
+                run_engine(tpch.q6_program()), db_iters)
             # verify engine results against the baseline
             eres = {n: np.asarray(v[0]) for n, v in eout1.cols.items()}
             eng_gid = (eres["l_returnflag"].astype(np.int64) * nls
@@ -308,24 +301,19 @@ def main():
                 return go
 
             scold1, swarm1, sout1 = timed_cold_warm(
-                run_sql(TPCH["q1"]), iters)
+                run_sql(TPCH["q1"]), db_iters)
             assert np.allclose(
                 np.sort(np.asarray(sout1.cols["count_order"][0])),
                 np.sort(base1["count"]))
             scold6, swarm6, sout6 = timed_cold_warm(
-                run_sql(TPCH["q6"]), iters)
+                run_sql(TPCH["q6"]), db_iters)
             assert int(np.asarray(sout6.cols["revenue"][0])[0]) == base6
             extra["sql_q1_cold_rows_per_sec"] = round(n_rows / scold1)
             extra["sql_q1_warm_rows_per_sec"] = round(n_rows / swarm1)
             extra["sql_q6_warm_rows_per_sec"] = round(n_rows / swarm6)
-
-    # Pallas one-hot group-by vs XLA scatter A/B: runs by default on the
-    # real chip (VERDICT r4 item 9); force with YDB_TPU_BENCH_PALLAS_COMPARE
-    flag = os.environ.get("YDB_TPU_BENCH_PALLAS_COMPARE")
-    enabled = (jax.default_backend() == "tpu" if flag is None
-               else flag not in ("0", "", "off"))
-    if enabled:
-        extra.update(pallas_ab(sf, block_rows))
+    except Exception as e:  # noqa: BLE001 - storage tiers fail soft:
+        # the kernel-tier numbers (already verified) still report
+        extra["engine_tier_error"] = repr(e)[-400:]
 
     extra["baseline"] = ("vectorized numpy single-pass (mask+bincount), "
                          f"same host, mean of {n_base} runs")

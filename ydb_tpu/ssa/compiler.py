@@ -758,9 +758,14 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
             gid = jnp.where(live, 0, 1).astype(jnp.int32)
             ng = 1
 
+        # counts accumulate in int32 per block (a block holds < 2^31
+        # rows) and widen after: int32 is what the Pallas one-hot
+        # reduction supports, so COUNT/AVG-count ride the MXU-friendly
+        # path on TPU instead of the serialized scatter
         live_count = kernels.scatter_sum(
-            jnp.ones_like(gid, dtype=jnp.int64), live, gid, ng
-        )
+            jnp.ones_like(gid, dtype=jnp.int32), live, gid, ng,
+            dtype=jnp.int32,
+        ).astype(jnp.int64)
         group_live = live_count > 0
 
         new_env: dict[str, Column] = {}
@@ -780,8 +785,9 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
                 c = env[spec.column]
                 vrow = live & c.validity
                 nn = kernels.scatter_sum(
-                    jnp.ones_like(gid, dtype=jnp.int64), vrow, gid, ng
-                )
+                    jnp.ones_like(gid, dtype=jnp.int32), vrow, gid, ng,
+                    dtype=jnp.int32,
+                ).astype(jnp.int64)
                 if spec.func is Agg.COUNT:
                     data = nn
                     valid = (

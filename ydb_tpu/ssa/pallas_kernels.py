@@ -31,7 +31,14 @@ ROW_TILE = 1024
 MAX_GROUPS = 2048
 
 
+#: test/bench override: True/False forces the decision regardless of
+#: env/backend (consulted at TRACE time — rebuild executors to switch)
+FORCE: bool | None = None
+
+
 def enabled() -> bool:
+    if FORCE is not None:
+        return FORCE
     v = os.environ.get("YDB_TPU_PALLAS")
     if v is not None:
         return v not in ("0", "", "off")
