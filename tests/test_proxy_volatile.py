@@ -1,0 +1,62 @@
+"""KQP session pool (SURVEY §2.8 KQP-proxy row) and the volatile
+single-shard commit fast path (VERDICT missing #9 scope)."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu.kqp.proxy import ProxyBusyError, SessionPool
+from ydb_tpu.kqp.session import Cluster
+from ydb_tpu.tx.coordinator import Coordinator
+
+
+def test_session_pool_reuses_and_caps():
+    c = Cluster()
+    pool = SessionPool(c, max_sessions=2)
+    pool.execute("create table kv (k bigint not null, v bigint, "
+                 "primary key (k))")
+    pool.execute("insert into kv (k, v) values (1, 10)")
+    r = pool.execute("select count(*) as n from kv")
+    assert int(r.column("n")[0]) == 1
+    assert pool.live == 1 and pool.idle == 1  # reuse, not churn
+    assert pool.stats["reused"] >= 2
+
+    # ceiling: two sessions held -> third acquire rejects
+    s1, s2 = pool.acquire(), pool.acquire()
+    with pytest.raises(ProxyBusyError):
+        pool.acquire()
+    pool.release(s1)
+    pool.release(s2)
+    assert pool.execute("select count(*) as n from kv") is not None
+
+
+class _Shard:
+    def __init__(self, fail_prepare=False):
+        self.fail_prepare = fail_prepare
+        self.committed_at = None
+        self.aborted = False
+
+    def prepare(self, args):
+        if self.fail_prepare:
+            raise RuntimeError("nope")
+        return args
+
+    def commit_at(self, token, step):
+        self.committed_at = step
+
+    def abort(self, args):
+        self.aborted = True
+
+
+def test_volatile_single_shard_commit():
+    coord = Coordinator()
+    s = _Shard()
+    res = coord.commit([s], [["w1"]])
+    assert res.committed and s.committed_at == res.step
+    assert coord.read_snapshot() == res.step  # barrier advanced
+
+    bad = _Shard(fail_prepare=True)
+    res = coord.commit([bad], [["w2"]])
+    assert not res.committed and bad.aborted
+    # a failed volatile commit must not advance the read barrier past
+    # anything unapplied
+    assert coord.read_snapshot() < res.step

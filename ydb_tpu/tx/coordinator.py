@@ -118,7 +118,28 @@ class Coordinator:
         errors (textbook 2PC — post-decision failures need repair/retry,
         not rollback), and any such error surfaces as RuntimeError after
         all attempts.
+
+        Single-participant commits take the VOLATILE fast path
+        (datashard volatile_tx.h analog): no cross-shard atomicity is at
+        stake, so the decision collapses to one prepare+apply and the
+        read barrier advances immediately — the common single-shard
+        write skips the 2PC decision bookkeeping.
         """
+        if len(participants) == 1:
+            with self._commit_lock:
+                txid, step = self.plan()
+                p, args = participants[0], prepare_args[0]
+                try:
+                    token = p.prepare(args)
+                except Exception as e:
+                    try:
+                        p.abort(args)
+                    except Exception:
+                        pass
+                    return TxResult(txid, step, False, f"prepare: {e}")
+                p.commit_at(token, step)
+                self._mark_completed(step)
+                return TxResult(txid, step, True)
         with self._commit_lock:
             txid, step = self.plan()
             tokens = []
