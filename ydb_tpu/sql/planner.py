@@ -720,6 +720,10 @@ class _SelectPlanner:
         for name, sub in sel.ctes:
             self.ctes[name] = self._sub(sub)
 
+        mixed = _rewrite_mixed_distinct(sel, self)
+        if mixed is not None:
+            sel = mixed
+
         binding, join_specs = self._bind(sel)
         scopes = binding.scopes
 
@@ -826,6 +830,39 @@ class _SelectPlanner:
         having = sel.having
         if having is not None and _contains_subquery(having):
             having = rewrite_scalars(having)
+
+        # scalar subqueries may appear in SELECT items too (the
+        # mixed-COUNT(DISTINCT) rewrite produces them); their synthetic
+        # result columns are functions of the correlation keys, so under
+        # aggregation they ride along as extra GROUP BY keys
+        if any(_contains_subquery(i.expr) for i in sel.items
+               if not isinstance(i.expr, ast.Star)):
+            new_items = tuple(
+                dataclasses.replace(i, expr=rewrite_scalars(i.expr))
+                if _contains_subquery(i.expr) else i
+                for i in sel.items
+            )
+            sel = dataclasses.replace(sel, items=new_items)
+        if synthetic and (sel.group_by or any(
+                _contains_agg(i.expr) for i in sel.items)):
+            used = {
+                n.parts[0]
+                for i in sel.items
+                for n in _walk_names(i.expr)
+                if len(n.parts) == 1 and n.parts[0] in synthetic
+            }
+            if having is not None:
+                used |= {
+                    n.parts[0] for n in _walk_names(having)
+                    if len(n.parts) == 1 and n.parts[0] in synthetic
+                }
+            extra = tuple(
+                ast.Name((n,)) for n in sorted(used)
+                if ast.Name((n,)) not in sel.group_by
+            )
+            if extra:
+                sel = dataclasses.replace(
+                    sel, group_by=tuple(sel.group_by) + extra)
 
         # --- classify WHERE conjuncts ---
         pushdown: dict[str, list[ast.Expr]] = {s.alias: [] for s in scopes}
@@ -1377,6 +1414,183 @@ class _SelectPlanner:
         return out
 
 
+def _collect_aggs(e, out: list) -> None:
+    if isinstance(e, ast.FuncCall):
+        if e.name in _AGG_FUNCS or (e.name == "count" and e.star):
+            out.append(e)
+            return
+        for a in e.args:
+            _collect_aggs(a, out)
+    elif isinstance(e, ast.BinOp):
+        _collect_aggs(e.left, out)
+        _collect_aggs(e.right, out)
+    elif isinstance(e, ast.UnOp):
+        _collect_aggs(e.operand, out)
+    elif isinstance(e, ast.Case):
+        for c, v in e.whens:
+            _collect_aggs(c, out)
+            _collect_aggs(v, out)
+        if e.else_ is not None:
+            _collect_aggs(e.else_, out)
+
+
+def _remap_alias_names(e, mapping: dict):
+    """Rewrite qualified Names whose alias is in ``mapping``."""
+    if isinstance(e, ast.Name):
+        if len(e.parts) == 2 and e.parts[0] in mapping:
+            return ast.Name((mapping[e.parts[0]], e.parts[1]))
+        return e
+    if isinstance(e, ast.BinOp):
+        return ast.BinOp(e.op, _remap_alias_names(e.left, mapping),
+                         _remap_alias_names(e.right, mapping))
+    if isinstance(e, ast.UnOp):
+        return ast.UnOp(e.op, _remap_alias_names(e.operand, mapping))
+    if isinstance(e, ast.FuncCall):
+        return ast.FuncCall(
+            e.name,
+            tuple(_remap_alias_names(a, mapping) for a in e.args),
+            e.star, e.distinct)
+    if isinstance(e, ast.Between):
+        return ast.Between(_remap_alias_names(e.expr, mapping),
+                           _remap_alias_names(e.low, mapping),
+                           _remap_alias_names(e.high, mapping), e.negated)
+    if isinstance(e, ast.InList):
+        return ast.InList(_remap_alias_names(e.expr, mapping),
+                          tuple(_remap_alias_names(i, mapping)
+                                for i in e.items), e.negated)
+    if isinstance(e, (ast.Like, ast.IsNull)):
+        return dataclasses.replace(
+            e, expr=_remap_alias_names(e.expr, mapping))
+    if isinstance(e, ast.Case):
+        return ast.Case(
+            tuple((_remap_alias_names(c, mapping),
+                   _remap_alias_names(v, mapping)) for c, v in e.whens),
+            _remap_alias_names(e.else_, mapping)
+            if e.else_ is not None else None)
+    return e
+
+
+def _rename_from(f, pre: str, mapping: dict):
+    if isinstance(f, ast.TableRef):
+        alias = f.alias or f.name
+        mapping[alias] = pre + alias
+        return ast.TableRef(f.name, pre + alias)
+    if isinstance(f, ast.SubquerySource):
+        mapping[f.alias] = pre + f.alias
+        return ast.SubquerySource(f.select, pre + f.alias)
+    left = _rename_from(f.left, pre, mapping)
+    right = _rename_from(f.right, pre, mapping)
+    on = _remap_alias_names(f.on, mapping) if f.on is not None else None
+    return ast.Join(left, right, on, f.kind)
+
+
+def _rewrite_mixed_distinct(sel: ast.Select, planner):
+    """COUNT(DISTINCT x) mixed with other aggregates (ClickBench Q9
+    shape): each distinct aggregate becomes a correlated scalar subquery
+    over a renamed copy of the FROM, correlated on the GROUP BY keys —
+    the existing decorrelation machinery then turns it into a
+    dedup-aggregate join. Returns the rewritten Select or None when the
+    query is not the mixed shape (the single-distinct fast path and the
+    'cannot mix' error stay as they were for unsupported forms)."""
+    aggs: list[ast.FuncCall] = []
+    for i in sel.items:
+        if not isinstance(i.expr, ast.Star):
+            _collect_aggs(i.expr, aggs)
+    if sel.having is not None:
+        _collect_aggs(sel.having, aggs)
+    distinct = [a for a in aggs if a.distinct]
+    plain = [a for a in aggs if not a.distinct]
+    d_cols = {a.args[0].column for a in distinct
+              if a.args and isinstance(a.args[0], ast.Name)}
+    if not distinct or not (plain or len(d_cols) > 1):
+        return None
+    if any(a.name != "count" or not a.args
+           or not isinstance(a.args[0], ast.Name) for a in distinct):
+        return None
+    if not all(isinstance(g, ast.Name) for g in sel.group_by):
+        return None
+    if sel.from_ is None:
+        return None
+    if any(_contains_subquery(c) for c in _conjuncts(sel.where)):
+        # the WHERE would be copied into the dedup subqueries, and
+        # nested-subquery scopes do not survive the alias renaming
+        return None
+    try:
+        binding, _ = planner._bind(sel)
+    except PlanError:
+        return None
+
+    counter = [0]
+
+    def subquery_for(fc: ast.FuncCall) -> ast.ScalarSubquery:
+        pre = f"__dd{counter[0]}_"
+        counter[0] += 1
+        mapping: dict = {}
+        inner_from = _rename_from(sel.from_, pre, mapping)
+        conjs = [
+            _remap_alias_names(c, mapping)
+            for c in _conjuncts(sel.where)
+        ]
+        # correlate on every group key: outer side stays qualified with
+        # the OUTER alias (unresolvable inside -> correlation), inner
+        # side uses the renamed alias
+        for g in sel.group_by:
+            alias, col = binding.resolve(g)
+            conjs.append(ast.BinOp(
+                "eq", ast.Name((alias, col)),
+                ast.Name((mapping[alias], col))))
+        where = None
+        for c in conjs:
+            where = c if where is None else ast.BinOp("and", where, c)
+        inner = ast.Select(
+            items=(ast.SelectItem(
+                ast.FuncCall(
+                    "count",
+                    tuple(_remap_alias_names(a, mapping)
+                          for a in fc.args),
+                    distinct=True), None),),
+            from_=inner_from, where=where, group_by=(), having=None,
+            order_by=(), limit=None,
+        )
+        return ast.ScalarSubquery(inner)
+
+    # one distinct aggregate stays INLINE (the single-distinct fast
+    # path handles it) so the outer query remains an aggregation and
+    # emits its mandatory row even over empty input; the rest become
+    # scalar subqueries
+    inline_key = repr(distinct[0]) if not plain else None
+    replaced: dict = {}
+
+    def rw(e):
+        if isinstance(e, ast.FuncCall) and e.distinct:
+            key = repr(e)
+            if key == inline_key:
+                return e
+            if key not in replaced:
+                replaced[key] = subquery_for(e)
+            return replaced[key]
+        if isinstance(e, ast.FuncCall):
+            return ast.FuncCall(e.name, tuple(rw(a) for a in e.args),
+                                e.star, e.distinct)
+        if isinstance(e, ast.BinOp):
+            return ast.BinOp(e.op, rw(e.left), rw(e.right))
+        if isinstance(e, ast.UnOp):
+            return ast.UnOp(e.op, rw(e.operand))
+        if isinstance(e, ast.Case):
+            return ast.Case(
+                tuple((rw(c), rw(v)) for c, v in e.whens),
+                rw(e.else_) if e.else_ is not None else None)
+        return e
+
+    new_items = tuple(
+        i if isinstance(i.expr, ast.Star)
+        else dataclasses.replace(i, expr=rw(i.expr))
+        for i in sel.items
+    )
+    new_having = rw(sel.having) if sel.having is not None else None
+    return dataclasses.replace(sel, items=new_items, having=new_having)
+
+
 def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, having):
     """Lower GROUP BY + aggregates + HAVING into SSA steps.
 
@@ -1504,6 +1718,13 @@ def _plan_aggregate(sel: ast.Select, low: _Lower, steps: list, having):
                for s in agg_specs):
             raise PlanError(
                 "COUNT(DISTINCT) cannot mix with other aggregates yet")
+        if len(set(distinct_cols)) > 1:
+            # one dedup pass over (keys + ALL distinct cols) would count
+            # PAIRS, silently wrong per column; the mixed-distinct
+            # rewrite handles the supported shapes before reaching here
+            raise PlanError(
+                "multiple COUNT(DISTINCT ...) columns need plain column"
+                " arguments (unsupported distinct-aggregate shape)")
         # dedup pass: group by (keys + distinct cols) with no aggregates,
         # then COUNT over the deduplicated rows
         steps.append(GroupByStep(

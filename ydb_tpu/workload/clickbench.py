@@ -7,13 +7,11 @@ The hits schema here is the subset of ClickBench's 105 columns that the
 implemented queries touch; distributions are synthetic-but-skewed
 (zipf-ish region/phrase popularity, mostly-empty search phrases) so the
 queries exercise the same shapes: wide scans, high-cardinality group-by,
-COUNT(DISTINCT), top-N by aggregate. Canonical answers come from
+COUNT(DISTINCT) — including Q9's mix of distinct and plain aggregates —
+and top-N by aggregate. Canonical answers come from
 ``reference_answers`` — an independent numpy implementation the engine
-results must match exactly (the canondata pattern).
-
-Q9 (COUNT(DISTINCT) mixed with other aggregates in one GROUP BY) is the
-one query shape not yet plannable; the dict below covers Q0-Q8 and
-Q10-Q13.
+results must match exactly (the canondata pattern). The dict below
+covers Q0-Q13.
 """
 
 from __future__ import annotations
@@ -117,6 +115,10 @@ QUERIES = {
            "order by count(*) desc, AdvEngineID"),
     "q8": ("select RegionID, count(distinct UserID) as u from hits "
            "group by RegionID order by u desc, RegionID limit 10"),
+    "q9": ("select RegionID, sum(AdvEngineID) as s, count(*) as c, "
+           "avg(ResolutionWidth) as w, count(distinct UserID) as u "
+           "from hits group by RegionID order by c desc, RegionID "
+           "limit 10"),
     "q10": ("select MobilePhoneModel, count(distinct UserID) as u "
             "from hits where MobilePhoneModel <> '' "
             "group by MobilePhoneModel "
@@ -162,6 +164,20 @@ def reference_answers(data: ClickBenchData) -> dict[str, object]:
         u8[r].add(u)
     out["q8"] = sorted(((k, len(v)) for k, v in u8.items()),
                        key=lambda kv: (-kv[1], kv[0]))[:10]
+    g9: dict = {}
+    for r, a, w, u in zip(h["RegionID"].tolist(), adv.tolist(),
+                          h["ResolutionWidth"].tolist(),
+                          h["UserID"].tolist()):
+        st = g9.setdefault(r, [0, 0, 0, set()])
+        st[0] += a
+        st[1] += 1
+        st[2] += w
+        st[3].add(u)
+    out["q9"] = [
+        (r, st[0], st[1], st[2] / st[1], len(st[3]))
+        for r, st in sorted(g9.items(),
+                            key=lambda kv: (-kv[1][1], kv[0]))[:10]
+    ]
     u10: dict = collections.defaultdict(set)
     u11: dict = collections.defaultdict(set)
     for m, ph, u in zip(models, h["MobilePhone"].tolist(),
@@ -246,6 +262,14 @@ def _verify(name: str, out, want, data) -> None:
     elif name == "q8":
         got = list(zip(ints("RegionID"), ints("u")))
         assert got == want, (name, got[:5], want[:5])
+    elif name == "q9":
+        got = list(zip(ints("RegionID"), ints("s"), ints("c"),
+                       [float(v) for v in np.asarray(out.cols["w"][0])],
+                       ints("u")))
+        assert len(got) == len(want)
+        for (gr, gs, gc, gw, gu), (wr, ws, wc, ww, wu) in zip(got, want):
+            assert (gr, gs, gc, gu) == (wr, ws, wc, wu)
+            assert abs(gw - ww) < 1e-9
     elif name == "q10":
         got = list(zip(strs("MobilePhoneModel"), ints("u")))
         assert got == want
