@@ -167,6 +167,31 @@ def test_spiller_quota_and_roundtrip():
         sp.get(s2)
 
 
+def test_spiller_peek_does_not_consume():
+    sp = Spiller(mem_quota_bytes=0, prefix="s")  # everything spills
+    sid = sp.put({"a": np.arange(8, dtype=np.int64)})
+    np.testing.assert_array_equal(sp.peek(sid)["a"], np.arange(8))
+    np.testing.assert_array_equal(sp.peek(sid)["a"], np.arange(8))
+    np.testing.assert_array_equal(sp.get(sid)["a"], np.arange(8))
+    with pytest.raises(KeyError):
+        sp.peek(sid)
+
+
+def test_aggregate_accumulation_spills_beyond_quota():
+    """Operator spilling (SURVEY §2.9 spilling-interface row): an agg
+    stage's accumulated partial states live in the spiller, so a zero
+    quota forces them to blobs while results stay exact."""
+    sch, parts, merged = _make_sources(n_parts=3, rows=900)
+    rt = SimRuntime(n_nodes=1)
+    handle_res = _run_two_stage(rt, sch, parts, window=4, quota=0)
+    ora = run_oracle(AGG, OracleTable(
+        {k: (v, np.ones(len(v), dtype=bool)) for k, v in merged.items()},
+        sch,
+    ))
+    np.testing.assert_array_equal(handle_res.cols["total"][0],
+                                  ora.cols["total"][0])
+
+
 def test_filter_map_stage_without_agg():
     sch, parts, merged = _make_sources(n_parts=2, rows=400)
     prog = Program((

@@ -225,7 +225,10 @@ class ComputeActor(Actor):
         self.spiller = spiller or Spiller()
 
         self._in_finished: set[int] = set()
-        self._acc: list[TableBlock] = []  # agg stages accumulate
+        # agg stages accumulate partial states THROUGH the spiller
+        # (operator spilling: beyond the memory quota the partials live
+        # in blobs, not RAM — dq_spilling + combiner spill analog)
+        self._acc_ids: list[int] = []
         # join stages accumulate their hash bucket per side (payloads
         # stay host-side until the single device-local bucket join)
         self._join_acc: dict[int, list] = {0: [], 1: []}
@@ -261,9 +264,8 @@ class ComputeActor(Actor):
             state = checkpoint_storage.load_task(
                 restore_checkpoint, task.task_id)
             if state is not None:
-                self._acc = [
-                    payload_to_block(p, self.compiled.mid_schema)
-                    for p in state["acc"]
+                self._acc_ids = [
+                    self.spiller.put(p) for p in state["acc"]
                 ]
                 self._join_acc = {
                     int(k): list(v)
@@ -349,7 +351,8 @@ class ComputeActor(Actor):
         if self.checkpoint_storage is not None:
             self.checkpoint_storage.save_task(checkpoint_id,
                                               self.task.task_id, {
-                "acc": [block_to_payload(b) for b in self._acc],
+                "acc": [self.spiller.peek(sid)
+                        for sid in self._acc_ids],
                 # join stages: both sides' accumulated bucket payloads
                 "join_acc": {k: list(v)
                              for k, v in self._join_acc.items()},
@@ -421,8 +424,10 @@ class ComputeActor(Actor):
     def _ingest(self, block: TableBlock):
         spec = self.task.stage_spec
         if spec.final_program is not None:
-            # aggregate stage: per-block partial, accumulate for the merge
-            self._acc.append(self.compiled.run_block(block))
+            # aggregate stage: per-block partial, accumulated via the
+            # spiller (blocks beyond the quota go to blobs)
+            self._acc_ids.append(self.spiller.put(
+                block_to_payload(self.compiled.run_block(block))))
         else:
             out = self.compiled.run_block(block)
             self._emit(out)
@@ -439,13 +444,18 @@ class ComputeActor(Actor):
             self._finish_output()
             return
         if spec.final_program is not None:
-            if self._acc:
-                self._emit(self.compiled.run_final(self._acc))
+            if self._acc_ids:
+                blocks = [
+                    payload_to_block(self.spiller.get(sid),
+                                     self.compiled.mid_schema)
+                    for sid in self._acc_ids
+                ]
+                self._emit(self.compiled.run_final(blocks))
             else:
                 # empty input still finalizes (COUNT over nothing etc.)
                 empty = _empty_block(self.compiled.mid_schema)
                 self._emit(self.compiled.run_final([empty]))
-            self._acc = []
+            self._acc_ids = []
         self._finish_output()
 
     # ---- output side ----

@@ -60,6 +60,15 @@ class Spiller:
             self._mem_bytes += size
         return sid
 
+    def peek(self, sid: int) -> dict[str, np.ndarray]:
+        """Read WITHOUT consuming (checkpoint snapshots of accumulated
+        state read the same ids again at finalize)."""
+        if sid in self._mem:
+            return self._mem[sid]
+        if sid in self._spilled:
+            return _decode(self.store.get(f"{self.prefix}/{sid}"))
+        raise KeyError(sid)
+
     def get(self, sid: int) -> dict[str, np.ndarray]:
         if sid in self._mem:
             payload = self._mem.pop(sid)
