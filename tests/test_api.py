@@ -146,3 +146,39 @@ def test_session_lifecycle_and_commit_validation(served):
         tc.commit("t_changefeed", "c", -1, 0)
     with pytest.raises(ApiError):
         tc.commit("t_changefeed", "c", 99, 0)
+
+
+def test_topic_streaming_sessions(served):
+    """Streaming write + read sessions (SURVEY §2.13 gRPC topic-session
+    row; persqueue_v1 stream sessions)."""
+    cluster, driver = served
+    q = driver.query_client()
+    q.execute("CREATE TABLE st (id int64, PRIMARY KEY (id)) "
+              "WITH (store = row, changefeed = on)")
+    tc = driver.topic_client()
+
+    # streaming writes: one ack per item, producer seqno dedup holds
+    acks = tc.stream_write(
+        "st_changefeed",
+        [(f"m{i}".encode(), "", "prod-1", i + 1) for i in range(5)],
+    )
+    assert len(acks) == 5
+    tc.stream_write("st_changefeed", [(b"m0", "", "prod-1", 1)])
+    # the duplicate seqno was swallowed: still exactly five messages
+
+    # streaming read with auto-commit: exactly the five messages, then
+    # the idle timeout ends the stream
+    got = list(tc.stream_read("st_changefeed", "sapp",
+                              idle_timeout_ms=300))
+    assert sorted(d for _, _, d in got) == [
+        f"m{i}".encode() for i in range(5)]
+    # offsets were committed: a new session sees nothing
+    assert list(tc.stream_read("st_changefeed", "sapp",
+                               idle_timeout_ms=200)) == []
+    # without auto-commit nothing advances durably
+    got2 = list(tc.stream_read("st_changefeed", "s2",
+                               auto_commit=False, idle_timeout_ms=200))
+    assert len(got2) == 5
+    got3 = list(tc.stream_read("st_changefeed", "s2",
+                               auto_commit=False, idle_timeout_ms=200))
+    assert len(got3) == 5

@@ -130,6 +130,57 @@ class TopicClient:
             raise ApiError(resp.error)
         return [(m.partition, m.offset, m.data) for m in resp.messages]
 
+    def stream_read(self, topic: str, consumer: str,
+                    max_batch: int = 100, auto_commit: bool = True,
+                    idle_timeout_ms: int = 0):
+        """Streaming read session: yields (partition, offset, data)
+        until the server ends the stream (idle timeout) or the caller
+        breaks out (cancelling the RPC)."""
+        rpc = self.driver.channel.unary_stream(
+            "/ydb_tpu.Topic/StreamRead",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.TopicReadResponse.FromString,
+        )
+        stream = rpc(pb.StreamReadRequest(
+            topic=topic, consumer=consumer, max_batch=max_batch,
+            auto_commit=auto_commit, idle_timeout_ms=idle_timeout_ms,
+        ), metadata=self.driver.metadata)
+        try:
+            for resp in stream:
+                if resp.error:
+                    raise ApiError(resp.error)
+                for m in resp.messages:
+                    yield m.partition, m.offset, m.data
+        finally:
+            stream.cancel()
+
+    def stream_write(self, topic: str, items):
+        """Streaming write session: ``items`` yields (data, key,
+        producer, seqno) tuples (or bare bytes); returns the acks."""
+        def gen():
+            for it in items:
+                if isinstance(it, (bytes, str)):
+                    data, key, producer, seqno = it, "", "", 0
+                else:
+                    data, key, producer, seqno = it
+                if isinstance(data, str):
+                    data = data.encode()
+                yield pb.StreamWriteItem(
+                    topic=topic, key=key, data=data,
+                    producer=producer, seqno=seqno)
+
+        rpc = self.driver.channel.stream_stream(
+            "/ydb_tpu.Topic/StreamWrite",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.StreamWriteAck.FromString,
+        )
+        acks = []
+        for ack in rpc(gen(), metadata=self.driver.metadata):
+            if ack.error:
+                raise ApiError(ack.error)
+            acks.append((ack.partition, ack.offset))
+        return acks
+
     def commit(self, topic: str, consumer: str, partition: int,
                offset: int):
         resp = self.driver._call(

@@ -166,6 +166,80 @@ class RequestProxy:
             for m in msgs
         ])
 
+    def topic_stream_read(self, request, context):
+        """Server-streaming read session (the persqueue_v1 read-session
+        analog): batches stream as data arrives; session-local read
+        positions start at the committed offsets, so two sessions of one
+        consumer do not double-deliver within themselves; auto_commit
+        durably advances the consumer."""
+        import time as _t
+
+        self.check_auth(context)
+        pos: dict[int, int] = {}
+        idle_ms = request.idle_timeout_ms
+        max_batch = request.max_batch or 100
+        last_data = _t.monotonic()
+        while context.is_active():
+            batch = []
+            with self.lock:
+                topic = self._topic(request.topic)
+                if topic is None:
+                    yield pb.TopicReadResponse(
+                        error=f"no topic {request.topic}")
+                    return
+                for pi, part in enumerate(topic.partitions):
+                    start = pos.get(
+                        pi, part.committed(request.consumer))
+                    for m in part.read(start, max_batch):
+                        batch.append(dict(m, partition=pi))
+                        start = m["offset"] + 1
+                    pos[pi] = start
+                if batch and request.auto_commit:
+                    tops: dict[int, int] = {}
+                    for m in batch:
+                        tops[m["partition"]] = max(
+                            tops.get(m["partition"], -1), m["offset"])
+                    for pi, off in tops.items():
+                        topic.partitions[pi].commit(
+                            request.consumer, off + 1)
+            if batch:
+                last_data = _t.monotonic()
+                yield pb.TopicReadResponse(messages=[
+                    pb.TopicMessage(
+                        partition=m["partition"], offset=m["offset"],
+                        data=m["data"].encode("utf-8",
+                                              "surrogateescape"))
+                    for m in batch
+                ])
+            else:
+                if idle_ms and (_t.monotonic() - last_data) * 1000 > \
+                        idle_ms:
+                    return
+                _t.sleep(0.02)
+
+    def topic_stream_write(self, request_iterator, context):
+        """Bidirectional write session: one ack per item, producer
+        seqno dedup exactly as unary writes."""
+        self.check_auth(context)
+        for item in request_iterator:
+            with self.lock:
+                topic = self._topic(item.topic)
+                if topic is None:
+                    yield pb.StreamWriteAck(
+                        error=f"no topic {item.topic}")
+                    continue
+                try:
+                    p, off = topic.write(
+                        item.data.decode("utf-8", "surrogateescape"),
+                        key=item.key or None,
+                        producer=item.producer or None,
+                        seqno=item.seqno if item.producer else None,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    yield pb.StreamWriteAck(error=str(e))
+                    continue
+            yield pb.StreamWriteAck(partition=p, offset=off)
+
     def topic_commit(self, request, context):
         self.check_auth(context)
         topic = self._topic(request.topic)
@@ -211,6 +285,10 @@ _SERVICES = {
         "Read": ("topic_read", pb.TopicReadRequest, pb.TopicReadResponse),
         "Commit": ("topic_commit", pb.TopicCommitRequest,
                    pb.TopicCommitResponse),
+        "StreamRead": ("topic_stream_read", pb.StreamReadRequest,
+                       pb.TopicReadResponse, "unary_stream"),
+        "StreamWrite": ("topic_stream_write", pb.StreamWriteItem,
+                        pb.StreamWriteAck, "stream_stream"),
     },
     "ydb_tpu.Discovery": {
         "ListEndpoints": ("list_endpoints", pb.ListEndpointsRequest,
@@ -231,8 +309,16 @@ def make_server(cluster: Cluster, port: int = 0,
 
     for service, methods in _SERVICES.items():
         handlers = {}
-        for rpc_name, (attr, req_cls, resp_cls) in methods.items():
-            handlers[rpc_name] = grpc.unary_unary_rpc_method_handler(
+        for rpc_name, spec in methods.items():
+            attr, req_cls, resp_cls = spec[:3]
+            kind = spec[3] if len(spec) > 3 else "unary_unary"
+            ctor = {
+                "unary_unary": grpc.unary_unary_rpc_method_handler,
+                "unary_stream": grpc.unary_stream_rpc_method_handler,
+                "stream_unary": grpc.stream_unary_rpc_method_handler,
+                "stream_stream": grpc.stream_stream_rpc_method_handler,
+            }[kind]
+            handlers[rpc_name] = ctor(
                 getattr(proxy, attr),
                 request_deserializer=req_cls.FromString,
                 response_serializer=resp_cls.SerializeToString,
