@@ -1,0 +1,61 @@
+"""NodeWarden + BSController automated self-heal (SURVEY §2.3
+NodeWarden/BSC row; reference mind/bscontroller/self_heal.cpp)."""
+
+import numpy as np
+
+from ydb_tpu.blobstorage.controller import BSController, NodeWarden
+from ydb_tpu.blobstorage.group import DSProxy, GroupInfo, VDisk
+
+
+def _group(gid):
+    group = GroupInfo(gid, "block42")
+    proxy = DSProxy(group)
+    rng = np.random.default_rng(gid)
+    blobs = {f"b{gid}/{i}": rng.bytes(300 + i) for i in range(5)}
+    for bid, data in blobs.items():
+        proxy.put(bid, data)
+    return proxy, blobs
+
+
+def test_controller_heals_degraded_groups_from_spares():
+    ctl = BSController()
+    p1, blobs1 = _group(1)
+    p2, blobs2 = _group(2)
+    ctl.register_group(p1)
+    ctl.register_group(p2)
+    w = NodeWarden(1)
+    for i in range(3):
+        w.register_spare(VDisk(f"spare-{i}"))
+    ctl.register_warden(w)
+
+    assert ctl.check_and_heal() == []  # healthy: no-op
+
+    p1.group.disks[0].down = True
+    p2.group.disks[3].down = True
+    p2.group.disks[5].down = True
+    # worst-degraded group (2 down) heals first
+    healed = ctl.check_and_heal()
+    assert [h.group_id for h in healed] == [2, 2, 1]
+    assert w.spare_count == 0
+    assert ctl.degraded_groups() == []
+    for proxy, blobs in ((p1, blobs1), (p2, blobs2)):
+        for bid, data in blobs.items():
+            assert proxy.get(bid) == data
+
+
+def test_controller_stops_when_out_of_spares():
+    ctl = BSController()
+    p1, blobs1 = _group(7)
+    ctl.register_group(p1)
+    w = NodeWarden(1)
+    w.register_spare(VDisk("only-spare"))
+    ctl.register_warden(w)
+
+    p1.group.disks[0].down = True
+    p1.group.disks[1].down = True
+    healed = ctl.check_and_heal()
+    assert len(healed) == 1
+    assert len(ctl.degraded_groups()) == 1  # one slot still down
+    # block-4-2 tolerates the single remaining dead disk
+    for bid, data in blobs1.items():
+        assert p1.get(bid) == data

@@ -1,0 +1,67 @@
+"""Mediator time cast: per-node time caches follow the coordinator
+barrier without coordinator round trips (SURVEY §2.5 mediator row)."""
+
+import threading
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.ssa.ops import Agg
+from ydb_tpu.ssa.program import AggSpec, GroupByStep, Program
+from ydb_tpu.tx.coordinator import Coordinator
+from ydb_tpu.tx.mediator import Mediator, NodeTimeCache
+from ydb_tpu.tx.sharded import ShardedTable
+
+SCHEMA = dtypes.schema(("id", dtypes.INT64, False), ("v", dtypes.INT64))
+COUNT = Program((GroupByStep(keys=(), aggs=(
+    AggSpec(Agg.COUNT_ALL, None, "n"),)),))
+
+
+def test_time_caches_follow_commits_and_reads_are_consistent():
+    coord = Coordinator(MemBlobStore())
+    med = Mediator(coord)
+    cache_a, cache_b = med.register(), med.register()
+    t = ShardedTable("t", SCHEMA, MemBlobStore(), coord, n_shards=2,
+                     pk_column="id", upsert=True)
+    assert cache_a.read_snapshot() == coord.read_snapshot()
+
+    t.insert({"id": np.arange(10, dtype=np.int64),
+              "v": np.ones(10, dtype=np.int64)})
+    step1 = coord.read_snapshot()
+    # both caches learned the barrier WITHOUT asking the coordinator
+    assert cache_a.read_snapshot() == step1
+    assert cache_b.read_snapshot() == step1
+    # a scan at the cached snapshot sees the commit
+    res = t.scan(COUNT, snap=cache_a.read_snapshot())
+    assert int(res.cols["n"][0][0]) == 10
+
+    # late joiner starts at the current barrier
+    late = med.register()
+    assert late.read_snapshot() == step1
+
+
+def test_wait_for_blocks_until_barrier_passes():
+    coord = Coordinator()
+    med = Mediator(coord)
+    cache = med.register()
+    target = coord.read_snapshot() + 1
+    got = []
+
+    def waiter():
+        got.append(cache.wait_for(target, timeout=10))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    # a background (volatile) step advances the barrier
+    step = coord.background_plan()
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert got and got[0] >= target and step >= target
+
+    empty = NodeTimeCache()
+    try:
+        empty.wait_for(5, timeout=0.1)
+        raise AssertionError("expected TimeoutError")
+    except TimeoutError:
+        pass

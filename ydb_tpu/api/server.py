@@ -179,29 +179,37 @@ class RequestProxy:
         idle_ms = request.idle_timeout_ms
         max_batch = request.max_batch or 100
         last_data = _t.monotonic()
+        pending_commit: list[dict] = []
         while context.is_active():
             batch = []
+            error = None
             with self.lock:
                 topic = self._topic(request.topic)
                 if topic is None:
-                    yield pb.TopicReadResponse(
-                        error=f"no topic {request.topic}")
-                    return
-                for pi, part in enumerate(topic.partitions):
-                    start = pos.get(
-                        pi, part.committed(request.consumer))
-                    for m in part.read(start, max_batch):
-                        batch.append(dict(m, partition=pi))
-                        start = m["offset"] + 1
-                    pos[pi] = start
-                if batch and request.auto_commit:
-                    tops: dict[int, int] = {}
-                    for m in batch:
-                        tops[m["partition"]] = max(
-                            tops.get(m["partition"], -1), m["offset"])
-                    for pi, off in tops.items():
-                        topic.partitions[pi].commit(
-                            request.consumer, off + 1)
+                    error = f"no topic {request.topic}"
+                else:
+                    if pending_commit and request.auto_commit:
+                        # commit the PREVIOUS batch only now that its
+                        # yield completed: a disconnect mid-transfer
+                        # must not lose committed-but-undelivered rows
+                        topic.reader(request.consumer).commit_batch(
+                            pending_commit)
+                        pending_commit = []
+                    for pi, part in enumerate(topic.partitions):
+                        start = pos.get(
+                            pi, part.committed(request.consumer))
+                        if part.head_offset <= start:
+                            pos[pi] = start  # idle partition: no scan
+                            continue
+                        for m in part.read(start, max_batch):
+                            batch.append(dict(m, partition=pi))
+                            start = m["offset"] + 1
+                        pos[pi] = start
+            # NEVER yield while holding the lock: a slow client's flow
+            # control would wedge every RPC on the node
+            if error is not None:
+                yield pb.TopicReadResponse(error=error)
+                return
             if batch:
                 last_data = _t.monotonic()
                 yield pb.TopicReadResponse(messages=[
@@ -211,34 +219,44 @@ class RequestProxy:
                                               "surrogateescape"))
                     for m in batch
                 ])
+                pending_commit = batch
             else:
                 if idle_ms and (_t.monotonic() - last_data) * 1000 > \
                         idle_ms:
-                    return
+                    break
                 _t.sleep(0.02)
+        # graceful end: the final delivered batch commits too
+        if pending_commit and request.auto_commit:
+            with self.lock:
+                topic = self._topic(request.topic)
+                if topic is not None:
+                    topic.reader(request.consumer).commit_batch(
+                        pending_commit)
 
     def topic_stream_write(self, request_iterator, context):
         """Bidirectional write session: one ack per item, producer
         seqno dedup exactly as unary writes."""
         self.check_auth(context)
         for item in request_iterator:
+            ack = None
             with self.lock:
                 topic = self._topic(item.topic)
                 if topic is None:
-                    yield pb.StreamWriteAck(
+                    ack = pb.StreamWriteAck(
                         error=f"no topic {item.topic}")
-                    continue
-                try:
-                    p, off = topic.write(
-                        item.data.decode("utf-8", "surrogateescape"),
-                        key=item.key or None,
-                        producer=item.producer or None,
-                        seqno=item.seqno if item.producer else None,
-                    )
-                except Exception as e:  # noqa: BLE001
-                    yield pb.StreamWriteAck(error=str(e))
-                    continue
-            yield pb.StreamWriteAck(partition=p, offset=off)
+                else:
+                    try:
+                        p, off = topic.write(
+                            item.data.decode("utf-8", "surrogateescape"),
+                            key=item.key or None,
+                            producer=item.producer or None,
+                            seqno=item.seqno if item.producer else None,
+                        )
+                        ack = pb.StreamWriteAck(partition=p, offset=off)
+                    except Exception as e:  # noqa: BLE001
+                        ack = pb.StreamWriteAck(error=str(e))
+            # yield outside the lock (slow-client flow control)
+            yield ack
 
     def topic_commit(self, request, context):
         self.check_auth(context)

@@ -69,6 +69,9 @@ class Coordinator:
         # it leaves plan(), so recovery never re-assigns a used step
         self._reserved = start_step
         self._next_txid = start_step + 1
+        # mediator fan-out: callbacks invoked (outside locks) whenever
+        # the completed-step barrier advances (tx/mediator.py)
+        self._on_complete: list = []
 
     @property
     def last_step(self) -> int:
@@ -91,9 +94,19 @@ class Coordinator:
             self._next_txid += 1
             return txid, self._step
 
+    def subscribe_completed(self, fn) -> None:
+        """Register a mediator callback: fn(step) fires on every barrier
+        advance (after the step is fully applied)."""
+        self._on_complete.append(fn)
+
     def _mark_completed(self, step: int) -> None:
         with self._lock:
+            advanced = step > self._completed
             self._completed = max(self._completed, step)
+            completed = self._completed
+        if advanced:
+            for fn in self._on_complete:
+                fn(completed)
 
     def background_plan(self) -> int:
         """Plan step for a single-shard background op (compaction/TTL).
