@@ -39,6 +39,7 @@ class RowTable:
         dicts: DictionarySet | None = None,
         boot: bool = False,  # DataShard.boot is implicit (executor boot)
         ttl_column: str | None = None,
+        gen: int = 0,
     ):
         self.name = name
         self.schema = schema
@@ -48,9 +49,12 @@ class RowTable:
             (pk_column or schema.names[0],))
         self.pk_column = self.pk_columns[0]
         self.ttl_column = ttl_column
+        self.store = store
+        self.gen = gen
         self.dicts = dicts if dicts is not None else DictionarySet()
         self.shards = [
-            DataShard(f"{name}/{i}", schema, store, self.pk_columns)
+            DataShard(self._shard_id(gen, i), schema, store,
+                      self.pk_columns)
             for i in range(n_shards)
         ]
         self.schema_version = 1
@@ -71,6 +75,9 @@ class RowTable:
         table (and once the real coordinator clock is installed)."""
         self._strip_columns(keep=set(self.schema.names))
 
+    def _shard_id(self, gen: int, i: int) -> str:
+        return (f"{self.name}/g{gen}/{i}" if gen else f"{self.name}/{i}")
+
     def storage_prefixes(self) -> list[str]:
         """Blob-store prefixes owning this table's durable state —
         INDEX shards included (DROP TABLE deletes them so a same-name
@@ -80,6 +87,76 @@ class RowTable:
             out += [f"tablet/{s.executor.tablet_id}/"
                     for s in idx_shards]
         return out
+
+    # ---- split / merge (resharding) ----
+
+    def reshard(self, n_new: int) -> int:
+        """SPLIT/MERGE for the row store: stream every row at one
+        snapshot out of the old shards into ``n_new`` new DataShards
+        (generation gen+1), then swap. The CALLER records (n_new, gen)
+        durably in the scheme (Cluster.reshard_table); until then a
+        reboot serves the old generation and sweeps the new one.
+        Secondary indexes rebuild by re-registration after the swap
+        (the backfill is index-build, already online)."""
+        if n_new < 1:
+            raise ValueError("reshard needs n_new >= 1")
+        if self.indexes:
+            raise ValueError(
+                "drop secondary indexes before resharding (re-add to"
+                " rebuild against the new shards)")
+        new_gen = self.gen + 1
+        snap = self.coordinator.read_snapshot()
+        new_shards = [
+            DataShard(self._shard_id(new_gen, i), self.schema,
+                      self.store, self.pk_columns)
+            for i in range(n_new)
+        ]
+        ops: list[RowOp] = []
+
+        def flush():
+            proposed = _route_propose(new_shards, ops)
+            if proposed:
+                self.coordinator.commit(
+                    [s for s, _ in proposed], [[w] for _, w in proposed])
+            ops.clear()
+
+        for shard in self.shards:
+            for page in shard.read(snap):
+                for key, row in page:
+                    ops.append(RowOp(tuple(key), dict(row)))
+                if len(ops) >= 4096:
+                    flush()
+        flush()
+        self.shards = new_shards
+        self.gen = new_gen
+        return new_gen
+
+    def drop_generation_storage(self, gen: int, n_shards: int) -> None:
+        """Delete a superseded generation's tablet state."""
+        for i in range(n_shards):
+            prefix = f"tablet/ds/{self._shard_id(gen, i)}/"
+            for bid in self.store.list(prefix):
+                self.store.delete(bid)
+
+    def sweep_stale_generations(self) -> int:
+        """Boot-time sweep of shard generations other than the current
+        one (crash mid-reshard orphans)."""
+        keep = tuple(f"tablet/{s.executor.tablet_id}/"
+                     for s in self.shards)
+        for _, idx_shards in self.indexes.values():
+            keep += tuple(f"tablet/{s.executor.tablet_id}/"
+                          for s in idx_shards)
+        swept = 0
+        for bid in self.store.list(f"tablet/ds/{self.name}/"):
+            if "/idx_" in bid:
+                # index storage is managed by add_index/DROP TABLE, and
+                # index registrations are not (yet) scheme-durable — a
+                # reboot must not garbage-collect them
+                continue
+            if not bid.startswith(keep):
+                self.store.delete(bid)
+                swept += 1
+        return swept
 
     # ---- encode helpers (shared dict ids, scaled decimals) ----
 

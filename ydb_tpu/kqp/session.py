@@ -208,6 +208,7 @@ class Cluster:
                 n_shards=desc.n_shards,
                 pk_columns=tuple(desc.primary_key),
                 ttl_column=desc.ttl_column, dicts=self.dicts, boot=boot,
+                gen=desc.shard_gen,
             )
         else:
             t = ShardedTable(
@@ -549,17 +550,13 @@ class Cluster:
         return res
 
     def reshard_table(self, name: str, n_shards: int) -> int:
-        """Split/merge a column table to ``n_shards`` shards: stream-copy
-        into a new shard generation, journal the cutover in the scheme
-        (the durable commit point), then GC the old generation. Returns
-        the new generation."""
-        from ydb_tpu.datashard.table import RowTable
-
+        """Split/merge a table (column OR row store) to ``n_shards``
+        shards: stream-copy into a new shard generation, journal the
+        cutover in the scheme (the durable commit point), then GC the
+        old generation. Returns the new generation."""
         t = self.tables.get(name)
         if t is None:
             raise PlanError(f"unknown table {name}")
-        if isinstance(t, RowTable):
-            raise PlanError("resharding row tables is not supported yet")
         if n_shards < 1:
             # validate BEFORE the destructive copy/swap, not after
             raise PlanError("n_shards must be >= 1")
