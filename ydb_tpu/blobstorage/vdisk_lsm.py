@@ -15,7 +15,6 @@ a blob group runs its part store on real chunked storage.
 
 from __future__ import annotations
 
-import json
 import struct
 import zlib
 

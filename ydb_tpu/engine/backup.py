@@ -23,8 +23,6 @@ from __future__ import annotations
 
 import json
 
-import numpy as np
-
 from ydb_tpu import dtypes
 from ydb_tpu.engine.blobs import BlobStore
 from ydb_tpu.engine.portion import read_portion_blob, write_portion_blob
