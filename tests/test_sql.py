@@ -328,3 +328,22 @@ def test_left_join_residual_on_colliding_name_raises():
     with pytest.raises(PlanError, match="not carried through the join"):
         s.execute("""select a.k from a left join b on a.k = b.k
                      where a.ya = b.k""")
+
+
+def test_explain_renders_the_physical_plan(data, db, catalog):
+    from ydb_tpu.kqp.session import Cluster
+
+    c = Cluster()
+    s = c.session()
+    s.execute("create table kv (k bigint not null, v bigint, "
+              "primary key (k))")
+    s.execute("insert into kv (k, v) values (1, 2), (3, 4)")
+    text = s.execute("explain select k, sum(v) as t from kv "
+                     "where k > 0 group by k order by t desc limit 5")
+    assert "Transform" in text and "TableScan kv" in text
+    assert "group_by[keys=['k']" in text
+    assert "limit=5" in text
+    # joins show probe/build structure
+    text2 = s.execute(
+        "explain select a.k from kv a, kv b where a.k = b.k")
+    assert "Join" in text2

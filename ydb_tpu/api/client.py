@@ -77,6 +77,8 @@ class QueryClient:
             pb.ExecuteQueryResponse)
         if resp.status != pb.ExecuteQueryResponse.SUCCESS:
             raise ApiError(resp.error)
+        if resp.plan_text:
+            return resp.plan_text  # EXPLAIN
         if resp.arrow_ipc:
             return ipc_to_table(resp.arrow_ipc)
         return (resp.tx_step, resp.committed)

@@ -87,6 +87,8 @@ class RequestProxy:
             status=pb.ExecuteQueryResponse.SUCCESS)
         if out is None:  # DDL: no result set, no tx step
             resp.committed = True
+        elif isinstance(out, str):  # EXPLAIN: the rendered plan
+            resp.plan_text = out
         elif isinstance(out, OracleTable):
             # out.dicts is the per-result view the session bound (alias
             # -> source dictionary), not the raw cluster set

@@ -591,6 +591,15 @@ class Cluster:
                        dicts=self.dicts, row_counts=counts,
                        udfs=dict(self.udfs))
 
+    def _explain_scalar_exec(self, plan_node, t):
+        """EXPLAIN still precomputes uncorrelated scalar subqueries (the
+        plan shape depends on their values being constants)."""
+        out = to_host(execute_plan(plan_node, self.snapshot_db()))
+        col = out.schema.names[0]
+        v, ok = out.cols[col]
+        return v[0].item() if len(v) else None, bool(
+            ok[0]) if len(v) else False
+
     def register_udf(self, name: str, fn, out_type) -> None:
         """Register a scalar UDF: ``fn`` takes numpy arrays (one per SQL
         argument) and returns an array; usable in any expression."""
@@ -622,6 +631,10 @@ class Cluster:
         if _P_PLAN_CACHE:
             _P_PLAN_CACHE.fire(hit=False)
         stmt = parse(sql)
+        if isinstance(stmt, ast.Explain):
+            pq = plan_select_full(stmt.select, self.catalog(),
+                                  self._explain_scalar_exec)
+            return ("explain", pq.plan)
         if not isinstance(stmt, ast.Select):
             return stmt
 
@@ -804,6 +817,10 @@ class Session:
             return self.cluster.update(planned)
         if isinstance(planned, ast.Delete):
             return self.cluster.delete(planned)
+        if planned[0] == "explain":
+            from ydb_tpu.plan.nodes import format_plan
+
+            return format_plan(planned[1])
         p, alias_map, plan_db = planned
         # reuse the plan-time snapshot when scalar subqueries precomputed
         # against it (statement-level read consistency)

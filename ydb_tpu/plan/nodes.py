@@ -64,3 +64,67 @@ class Transform:
 
 
 PlanNode = Union[TableScan, LookupJoin, ExpandJoin, Transform]
+
+
+def format_plan(plan: PlanNode, indent: int = 0) -> str:
+    """Human-readable physical plan (EXPLAIN output; the reference
+    renders its plans via kqp query plan JSON — this is the compact
+    text form)."""
+    pad = "  " * indent
+
+    def prog_summary(program) -> str:
+        if program is None:
+            return ""
+        from ydb_tpu.ssa.program import (
+            AssignStep, FilterStep, GroupByStep, ProjectStep, SortStep,
+        )
+
+        bits = []
+        n_filters = sum(
+            1 for s in program.steps if isinstance(s, FilterStep))
+        n_assigns = sum(
+            1 for s in program.steps if isinstance(s, AssignStep))
+        if n_filters:
+            bits.append(f"filters={n_filters}")
+        if n_assigns:
+            bits.append(f"assigns={n_assigns}")
+        for s in program.steps:
+            if isinstance(s, GroupByStep):
+                bits.append(
+                    f"group_by[keys={list(s.keys)}, "
+                    f"aggs={len(s.aggs)}]")
+            elif isinstance(s, SortStep) and (s.keys or s.limit):
+                lim = f", limit={s.limit}" if s.limit is not None else ""
+                bits.append(f"sort[{list(s.keys)}{lim}]")
+            elif isinstance(s, ProjectStep):
+                bits.append(f"project={list(s.names)}")
+        return ", ".join(bits)
+
+    if isinstance(plan, TableScan):
+        return (f"{pad}TableScan {plan.table}"
+                + (f" ({prog_summary(plan.program)})"
+                   if plan.program is not None else ""))
+    if isinstance(plan, LookupJoin):
+        head = (f"{pad}LookupJoin[{plan.kind}] "
+                f"{list(plan.probe_keys)} = {list(plan.build_keys)}"
+                + (f" payload={list(plan.payload)}" if plan.payload
+                   else ""))
+        return "\n".join([
+            head,
+            format_plan(plan.probe, indent + 1),
+            format_plan(plan.build, indent + 1),
+        ])
+    if isinstance(plan, ExpandJoin):
+        head = (f"{pad}ExpandJoin[{plan.kind}] "
+                f"{list(plan.probe_keys)} = {list(plan.build_keys)}")
+        return "\n".join([
+            head,
+            format_plan(plan.probe, indent + 1),
+            format_plan(plan.build, indent + 1),
+        ])
+    if isinstance(plan, Transform):
+        return "\n".join([
+            f"{pad}Transform ({prog_summary(plan.program)})",
+            format_plan(plan.input, indent + 1),
+        ])
+    return f"{pad}{plan!r}"

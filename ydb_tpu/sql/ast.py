@@ -207,5 +207,12 @@ class AlterTable:
     drop_columns: tuple[str, ...] = ()
 
 
+@dataclasses.dataclass(frozen=True)
+class Explain:
+    """EXPLAIN <select>: return the physical plan, not the rows."""
+
+    select: Select
+
+
 Statement = Union[Select, Insert, CreateTable, DropTable, AlterTable,
-                  Update, Delete]
+                  Update, Delete, Explain]

@@ -34,7 +34,7 @@ _KEYWORDS = {
     "values", "create", "table", "primary", "key", "case", "when", "then",
     "else", "end", "date", "interval", "true", "false", "distinct",
     "outer", "exists", "cast", "drop", "alter", "add", "column", "with",
-    "update", "set", "delete", "extract", "substring", "for",
+    "update", "set", "delete", "extract", "substring", "for", "explain",
 }
 
 
@@ -113,7 +113,10 @@ class Parser:
     # -- statements --
 
     def parse_statement(self) -> ast.Statement:
-        if self.peek().value in ("select", "with"):
+        if self.peek().value == "explain":
+            self.next()
+            stmt = ast.Explain(self.parse_select())
+        elif self.peek().value in ("select", "with"):
             stmt = self.parse_select()
         elif self.peek().value == "insert":
             stmt = self.parse_insert()
