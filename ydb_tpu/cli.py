@@ -71,7 +71,9 @@ def cmd_sql(args):
     dt = time.monotonic() - t0
     import pyarrow as pa
 
-    if isinstance(out, pa.Table):
+    if isinstance(out, str):  # EXPLAIN: the rendered plan
+        print(out)
+    elif isinstance(out, pa.Table):
         print(out.to_pandas().to_string(index=False))
         print(f"-- {out.num_rows} rows in {dt:.3f}s", file=sys.stderr)
     else:

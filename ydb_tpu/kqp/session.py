@@ -591,14 +591,23 @@ class Cluster:
                        dicts=self.dicts, row_counts=counts,
                        udfs=dict(self.udfs))
 
-    def _explain_scalar_exec(self, plan_node, t):
-        """EXPLAIN still precomputes uncorrelated scalar subqueries (the
-        plan shape depends on their values being constants)."""
-        out = to_host(execute_plan(plan_node, self.snapshot_db()))
-        col = out.schema.names[0]
-        v, ok = out.cols[col]
-        return v[0].item() if len(v) else None, bool(
-            ok[0]) if len(v) else False
+    def _stmt_scalar_exec(self, stmt_db: list):
+        """Scalar-subquery executor bound to ONE statement snapshot
+        (lazily created into ``stmt_db[0]``): the KQP precompute-phase
+        analog, shared by SELECT planning and EXPLAIN."""
+        def scalar_exec(plan_node, t):
+            if stmt_db[0] is None:
+                stmt_db[0] = self.snapshot_db(
+                    include_sys=self.flags.enable_sys_views)
+            out = to_host(execute_plan(plan_node, stmt_db[0]))
+            col = out.schema.names[0]
+            v, ok = out.cols[col]
+            if len(v) != 1:
+                raise PlanError(
+                    f"scalar subquery returned {len(v)} rows")
+            return v[0].item(), bool(ok[0])
+
+        return scalar_exec
 
     def register_udf(self, name: str, fn, out_type) -> None:
         """Register a scalar UDF: ``fn`` takes numpy arrays (one per SQL
@@ -632,8 +641,11 @@ class Cluster:
             _P_PLAN_CACHE.fire(hit=False)
         stmt = parse(sql)
         if isinstance(stmt, ast.Explain):
+            # EXPLAIN precomputes scalar subqueries exactly like
+            # execution would (same guards, same single snapshot), so
+            # the rendered plan is the plan the engine would run
             pq = plan_select_full(stmt.select, self.catalog(),
-                                  self._explain_scalar_exec)
+                                  self._stmt_scalar_exec([None]))
             return ("explain", pq.plan)
         if not isinstance(stmt, ast.Select):
             return stmt
@@ -642,22 +654,8 @@ class Cluster:
         # precompute and (if any ran) the outer execution read the same
         # state, preserving statement-level read consistency
         stmt_db: list = [None]
-
-        def scalar_exec(plan_node, t):
-            # uncorrelated scalar subqueries run eagerly at plan time
-            # (KQP precompute-phase analog)
-            if stmt_db[0] is None:
-                stmt_db[0] = self.snapshot_db(
-                    include_sys=self.flags.enable_sys_views)
-            out = to_host(execute_plan(plan_node, stmt_db[0]))
-            col = out.schema.names[0]
-            v, ok = out.cols[col]
-            if len(v) != 1:
-                raise PlanError(
-                    f"scalar subquery returned {len(v)} rows")
-            return v[0].item(), bool(ok[0])
-
-        pq = plan_select_full(stmt, self.catalog(), scalar_exec)
+        pq = plan_select_full(stmt, self.catalog(),
+                              self._stmt_scalar_exec(stmt_db))
         entry = (pq.plan, dict(pq.dict_aliases), stmt_db[0])
         if not pq.used_scalar_exec:
             # plans with baked-in subquery results are snapshot-bound:
@@ -779,8 +777,12 @@ class Session:
         with c.tracer.trace("query", trace_id) as span:
             with span.child("plan") as plan_span:
                 planned = c.plan(sql)
-                kind = (type(planned).__name__.lower()
-                        if not isinstance(planned, tuple) else "select")
+                if not isinstance(planned, tuple):
+                    kind = type(planned).__name__.lower()
+                elif planned[0] == "explain":
+                    kind = "explain"
+                else:
+                    kind = "select"
                 plan_span.set(kind=kind)
             span.set(kind=kind)
             with span.child("execute"):
