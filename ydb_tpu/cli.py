@@ -119,31 +119,34 @@ def cmd_topic(args):
     driver.close()
 
 
-def cmd_workload(args):
+def _run_workload(args, run, **kwargs):
     import jax
 
     jax.config.update("jax_platforms", args.platform)
-    from ydb_tpu.workload.runner import run_tpch
-
     queries = args.queries.split(",") if args.queries else None
-    results = run_tpch(sf=args.sf, queries=queries,
-                       iterations=args.iterations)
+    results = run(queries=queries, iterations=args.iterations, **kwargs)
     for name, seconds, rows in results:
         print(f"{name:6} {seconds * 1000:9.1f} ms   {rows} rows")
+
+
+def cmd_workload(args):
+    from ydb_tpu.workload.runner import run_tpch
+
+    _run_workload(args, run_tpch, sf=args.sf)
 
 
 def cmd_clickbench(args):
-    import jax
-
-    jax.config.update("jax_platforms", args.platform)
     from ydb_tpu.workload.clickbench import run_clickbench
 
-    queries = args.queries.split(",") if args.queries else None
-    results = run_clickbench(rows=args.rows, queries=queries,
-                             iterations=args.iterations,
-                             verify=not args.no_verify)
-    for name, seconds, rows in results:
-        print(f"{name:6} {seconds * 1000:9.1f} ms   {rows} rows")
+    _run_workload(args, run_clickbench, rows=args.rows,
+                  verify=not args.no_verify)
+
+
+def cmd_tpcds(args):
+    from ydb_tpu.workload.tpcds import run_tpcds
+
+    _run_workload(args, run_tpcds, sf=args.sf,
+                  verify=not args.no_verify)
 
 
 def main(argv=None):
@@ -210,6 +213,13 @@ def main(argv=None):
     wc.add_argument("--platform", default="cpu")
     wc.add_argument("--no-verify", action="store_true")
     wc.set_defaults(fn=cmd_clickbench)
+    wd = wsub.add_parser("tpcds")
+    wd.add_argument("--sf", type=float, default=0.002)
+    wd.add_argument("--queries", default=None)
+    wd.add_argument("--iterations", type=int, default=1)
+    wd.add_argument("--platform", default="cpu")
+    wd.add_argument("--no-verify", action="store_true")
+    wd.set_defaults(fn=cmd_tpcds)
 
     args = ap.parse_args(argv)
     args.fn(args)

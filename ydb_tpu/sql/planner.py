@@ -350,6 +350,34 @@ class _Lower:
             return lowered.name
         raise PlanError(f"{what} needs a string column operand")
 
+    def _is_string_operand(self, e) -> bool:
+        if isinstance(e, ast.Name):
+            try:
+                col = self.name_of(e)
+            except PlanError:
+                return False
+            return self.types.get(col, dtypes.INT64).is_string
+        return isinstance(e, ast.FuncCall) and e.name == "substring"
+
+    def _xrank(self, e, peer) -> Col:
+        """Hidden int column: e's dictionary ids translated to ranks in
+        the union of e's and peer's dictionaries (see "xrank" in
+        ssa/compiler.dict_map_table)."""
+        col = self._as_string_col(e, "string comparison")
+        peer_col = self._as_string_col(peer, "string comparison")
+        p_src = self.dict_src.get(peer_col, peer_col)
+        if self.dictionary_of(col) is None \
+                or self.dictionary_of(peer_col) is None:
+            raise PlanError(
+                "string column comparison needs dictionaries")
+        # keyed on the operand COLUMNS (not dictionary sources): a
+        # self-join compares two columns that share one dictionary
+        hidden = f"__xrank_{col}_{peer_col}"
+        if hidden not in self.types:
+            self.emit_assign(
+                hidden, DictMap(col, "xrank", (), p_src), dtypes.INT32)
+        return Col(hidden)
+
     def lower(self, e: ast.Expr):
         if isinstance(e, ast.Name):
             return Col(self.name_of(e))
@@ -441,7 +469,15 @@ class _Lower:
                 val = lit_side.value.encode() if isinstance(
                     lit_side.value, str) else lit_side.value
                 return DictPredicate(col, "custom", ("ord", op, val))
-            # string column vs string column (q21-style) unsupported here
+            # string column vs string column: translate both sides into
+            # the rank space of their dictionaries' union (plan-time
+            # "xrank" DictMap), then integer-compare — correct across
+            # different per-column dictionaries (TPC-DS q19 zip compare)
+            if self._is_string_operand(e.left) \
+                    and self._is_string_operand(e.right):
+                return Call(_CMP[e.op],
+                            self._xrank(e.left, e.right),
+                            self._xrank(e.right, e.left))
             return Call(_CMP[e.op], self.lower(e.left), self.lower(e.right))
         if e.op in _ARITH:
             folded = _try_const_date(e)
@@ -491,7 +527,6 @@ class _Lower:
                     and isinstance(e.args[2], ast.Literal)):
                 raise PlanError("substring bounds must be literals")
             start, length = int(e.args[1].value), int(e.args[2].value)
-            src_dict = self.dict_src.get(col, col)
             hidden = f"__substr_{col}_{start}_{length}"
             if hidden not in self.types:
                 self.emit_assign(
@@ -499,8 +534,12 @@ class _Lower:
                     DictMap(col, "substr", (start, length), hidden),
                     dtypes.STRING,
                 )
-                # DictMap registers the output dictionary under `hidden`
+                # DictMap populates the output dictionary under `hidden`
+                # at compile time; register it now so downstream plan
+                # steps (e.g. xrank comparisons) see it exists
                 self.dict_src[hidden] = hidden
+                if self.dicts is not None:
+                    self.dicts.for_column(hidden)
             return Col(hidden)
         if e.name.startswith("cast_"):
             target = e.name[5:]

@@ -350,6 +350,15 @@ def dict_map_table(d, out_d, kind: str, args: tuple) -> np.ndarray:
         start, length = args  # SQL 1-based start
         lo = start - 1
         out = [out_d.add(v[lo:lo + length]) for v in d.values]
+    elif kind == "xrank":
+        # cross-dictionary compare: rank each value within the sorted
+        # union of this column's and the peer column's dictionaries
+        # (out_d here is the PEER dictionary, not an output dict); both
+        # sides of the comparison derive identical ranks from the same
+        # union, so ==/!=/</<= on the ranks match byte-string compare.
+        ranks = {v: i for i, v in enumerate(
+            sorted(set(d.values) | set(out_d.values)))}
+        out = [ranks[v] for v in d.values]
     else:
         raise NotImplementedError(f"dict map kind {kind}")
     return np.asarray(out or [0], dtype=np.int32)
@@ -364,6 +373,8 @@ def _resolve_dict_map(ctx: _Lowering, m: DictMap, cur_types):
         raise ValueError(f"no dictionary for column {m.column}")
     if ctx.dicts is None:
         raise ValueError("dict map needs a shared DictionarySet")
+    # for "xrank" out_column names the PEER dictionary (already
+    # registered) and the result is an int rank, not a string
     out_d = ctx.dicts.for_column(m.out_column)
     table = dict_map_table(d, out_d, m.kind, m.args)
     key = ctx.add_aux(f"map.{m.column}.{m.kind}", table)
@@ -372,7 +383,7 @@ def _resolve_dict_map(ctx: _Lowering, m: DictMap, cur_types):
     def lower(env, aux, _key=key, _col=col):
         return kernels.dict_gather(aux[_key], env[_col])
 
-    return lower, dtypes.STRING
+    return lower, (dtypes.INT32 if m.kind == "xrank" else dtypes.STRING)
 
 
 def _custom_dict_mask(d, pattern) -> np.ndarray:
