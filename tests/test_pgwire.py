@@ -1,0 +1,233 @@
+"""PostgreSQL wire-protocol frontend tests: a from-the-spec minimal
+client (independent of the server code) drives the full handshake,
+simple-query results, errors, auth, and extended-protocol resync
+(reference: ydb/core/local_pgwire)."""
+
+import socket
+import struct
+
+import pytest
+
+from ydb_tpu.api.pgwire import PgWireServer
+from ydb_tpu.kqp.session import Cluster
+
+
+class MiniPgClient:
+    """Just enough of the frontend side of PostgreSQL protocol 3.0."""
+
+    def __init__(self, port, user="tester", password=None,
+                 try_ssl=False):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=10)
+        if try_ssl:
+            self.sock.sendall(struct.pack("!II", 8, 80877103))
+            assert self._recv_exact(1) == b"N"
+        params = (b"user\x00" + user.encode() + b"\x00"
+                  + b"database\x00postgres\x00\x00")
+        self.sock.sendall(
+            struct.pack("!II", len(params) + 8, 196608) + params)
+        self.params = {}
+        self.backend_key = None
+        self._password = password
+        self.ready = False
+        self._pump_until_ready()
+
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            c = self.sock.recv(n - len(buf))
+            assert c, "server closed"
+            buf += c
+        return buf
+
+    def read_message(self):
+        t = self._recv_exact(1)
+        (ln,) = struct.unpack("!I", self._recv_exact(4))
+        return t, self._recv_exact(ln - 4)
+
+    def _pump_until_ready(self):
+        msgs = []
+        while True:
+            t, body = self.read_message()
+            msgs.append((t, body))
+            if t == b"R":
+                (code,) = struct.unpack("!I", body[:4])
+                if code == 3:  # cleartext password requested
+                    assert self._password is not None, "auth required"
+                    pw = self._password.encode() + b"\x00"
+                    self.sock.sendall(
+                        b"p" + struct.pack("!I", len(pw) + 4) + pw)
+                else:
+                    assert code == 0
+            elif t == b"S":
+                k, v = body.split(b"\x00")[:2]
+                self.params[k.decode()] = v.decode()
+            elif t == b"K":
+                self.backend_key = struct.unpack("!II", body)
+            elif t == b"Z":
+                self.ready = True
+                return msgs
+            elif t == b"E":
+                raise RuntimeError(self._error_text(body))
+
+    @staticmethod
+    def _error_text(body):
+        fields = {}
+        for part in body.split(b"\x00"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode()
+        return fields.get("M", "unknown error")
+
+    def query(self, sql):
+        """Returns (rows, columns, tags, errors): rows as lists of
+        str|None, columns as [(name, oid)]."""
+        q = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(q) + 4) + q)
+        rows, cols, tags, errors = [], [], [], []
+        while True:
+            t, body = self.read_message()
+            if t == b"T":
+                (n,) = struct.unpack("!H", body[:2])
+                off = 2
+                cols = []
+                for _ in range(n):
+                    end = body.index(b"\x00", off)
+                    name = body[off:end].decode()
+                    (oid,) = struct.unpack(
+                        "!I", body[end + 7:end + 11])
+                    cols.append((name, oid))
+                    off = end + 19
+            elif t == b"D":
+                (n,) = struct.unpack("!H", body[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", body[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(body[off:off + ln].decode())
+                        off += ln
+                rows.append(row)
+            elif t == b"C":
+                tags.append(body.rstrip(b"\x00").decode())
+            elif t == b"E":
+                errors.append(self._error_text(body))
+            elif t == b"I":
+                tags.append("")
+            elif t == b"Z":
+                return rows, cols, tags, errors
+
+    def send_raw(self, type_byte, payload=b""):
+        self.sock.sendall(
+            type_byte + struct.pack("!I", len(payload) + 4) + payload)
+
+    def close(self):
+        self.send_raw(b"X")
+        self.sock.close()
+
+
+@pytest.fixture
+def server():
+    cluster = Cluster()
+    srv = PgWireServer(cluster).start()
+    yield srv
+    srv.stop()
+
+
+def test_handshake_and_query_roundtrip(server):
+    c = MiniPgClient(server.port, try_ssl=True)
+    assert c.params["server_encoding"] == "UTF8"
+    assert c.backend_key is not None
+
+    _, _, tags, errors = c.query(
+        "CREATE TABLE t (id int64, name string, amount decimal(10,2), "
+        "d date, PRIMARY KEY (id))")
+    assert not errors and tags == ["CREATE"]
+    _, _, tags, errors = c.query(
+        "INSERT INTO t VALUES (1, 'ann', 12.50, date '2026-01-05'), "
+        "(2, 'bob', 0.75, date '2026-02-06'), (3, NULL, NULL, NULL)")
+    assert not errors and tags == ["INSERT 0 0"]
+
+    rows, cols, tags, errors = c.query(
+        "SELECT id, name, amount, d FROM t ORDER BY id")
+    assert not errors and tags == ["SELECT 3"]
+    assert [(n, o) for n, o in cols] == [
+        ("id", 20), ("name", 25), ("amount", 1700), ("d", 1082)]
+    assert rows[0] == ["1", "ann", "12.50", "2026-01-05"]
+    assert rows[1] == ["2", "bob", "0.75", "2026-02-06"]
+    assert rows[2] == ["3", None, None, None]
+    c.close()
+
+
+def test_multi_statement_and_error_recovery(server):
+    c = MiniPgClient(server.port)
+    _, _, tags, errors = c.query(
+        "CREATE TABLE kv (k int64, v int64, PRIMARY KEY (k)); "
+        "INSERT INTO kv VALUES (1, 10); INSERT INTO kv VALUES (2, 20)")
+    assert not errors and len(tags) == 3
+
+    # error aborts the rest of the string but not the connection
+    _, _, tags, errors = c.query("SELECT nope FROM kv; SELECT k FROM kv")
+    assert errors and not tags
+    rows, _, tags, errors = c.query("SELECT k, v FROM kv ORDER BY k")
+    assert not errors and rows == [["1", "10"], ["2", "20"]]
+    c.close()
+
+
+def test_auth_required(server):
+    server.auth_tokens = {"sesame"}
+    with pytest.raises((RuntimeError, AssertionError)):
+        MiniPgClient(server.port, password="wrong")
+    c = MiniPgClient(server.port, password="sesame")
+    _, _, tags, errors = c.query(
+        "CREATE TABLE a (k int64, PRIMARY KEY (k))")
+    assert not errors
+    c.close()
+    server.auth_tokens = None
+
+
+def test_failed_dml_aborts_rest_of_query_string():
+    """A DML that returns TxResult(committed=False) must send an error
+    AND abort the remaining statements (pg simple-query semantics)."""
+    from ydb_tpu.tx.coordinator import TxResult
+
+    executed = []
+
+    class StubSession:
+        def execute(self, sql):
+            executed.append(sql)
+            if "fail" in sql:
+                return TxResult(1, 1, False, "lock conflict")
+            return None
+
+    class StubCluster:
+        def session(self):
+            return StubSession()
+
+    srv = PgWireServer(StubCluster()).start()
+    try:
+        c = MiniPgClient(srv.port)
+        _, _, tags, errors = c.query(
+            "UPSERT fail; CREATE TABLE never_runs (k int64)")
+        assert errors == ["lock conflict"] and not tags
+        assert executed == ["UPSERT fail"]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_extended_protocol_resync(server):
+    c = MiniPgClient(server.port)
+    # Parse message -> error; stream must resync on Sync
+    c.send_raw(b"P", b"\x00SELECT 1\x00\x00\x00")
+    t, body = c.read_message()
+    assert t == b"E" and b"extended" in body
+    c.send_raw(b"S")
+    t, _ = c.read_message()
+    assert t == b"Z"
+    c.query("CREATE TABLE e (k int64, PRIMARY KEY (k))")
+    _, _, tags, errors = c.query("EXPLAIN SELECT k FROM e")
+    assert not errors and tags == ["EXPLAIN"]
+    c.close()

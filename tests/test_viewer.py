@@ -1,0 +1,103 @@
+"""Monitoring HTTP endpoint tests: viewer JSON APIs, whiteboard,
+counters pages (reference: core/viewer/viewer.cpp, core/mon/mon.cpp,
+tablet/node_whiteboard.cpp)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.kqp.session import Cluster
+from ydb_tpu.obs.viewer import Viewer
+from ydb_tpu.topic.topic import Topic
+
+
+@pytest.fixture
+def served():
+    cluster = Cluster()
+    s = cluster.session()
+    s.execute("CREATE TABLE t (id int64, name string, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 'a'), (2, 'b')")
+    s.execute("SELECT id FROM t ORDER BY id")
+    cluster.topics["ev"] = Topic("ev", MemBlobStore(), n_partitions=1)
+    cluster.topics["ev"].write("m1")
+    v = Viewer(cluster).start()
+    yield cluster, v
+    v.stop()
+
+
+def get(v, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{v.port}{path}", timeout=10) as r:
+        ctype = r.headers["Content-Type"]
+        return r.status, ctype, r.read()
+
+
+def test_cluster_scheme_tables_topics(served):
+    _cluster, v = served
+    st, ctype, body = get(v, "/viewer/json/cluster")
+    assert st == 200 and ctype.startswith("application/json")
+    info = json.loads(body)
+    assert info["tables"] == ["t"] and info["topics"] == ["ev"]
+    assert info["uptime_seconds"] >= 0
+
+    scheme = json.loads(get(v, "/viewer/json/scheme")[2])
+    assert {"path": "/t", "type": "table"} in scheme
+
+    tables = json.loads(get(v, "/viewer/json/tables")[2])
+    assert sum(r["rows"] for r in tables
+               if r["table_name"] == "t") == 2
+
+    topics = json.loads(get(v, "/viewer/json/topics")[2])
+    assert topics == [{"topic": "ev", "partition": 0,
+                       "start_offset": 0, "end_offset": 1}]
+
+
+def test_health_whiteboard_counters(served):
+    _cluster, v = served
+    health = json.loads(get(v, "/viewer/json/healthcheck")[2])
+    assert health["status"] in ("GOOD", "DEGRADED", "EMERGENCY")
+
+    wb = json.loads(get(v, "/viewer/json/whiteboard")[2])
+    assert wb["tables"] == 1 and wb["topics"] == 1
+    assert any(q["kind"] == "select" or "SELECT" in q["sql"].upper()
+               for q in wb["recent_queries"])
+    assert wb["memory"], "memory stats empty"
+
+    counters = json.loads(get(v, "/counters")[2])
+    assert counters, "counters snapshot empty"
+    st, ctype, prom = get(v, "/counters/prometheus")
+    assert st == 200 and b"# TYPE" in prom or prom != b""
+
+
+def test_sysview_listing_and_rows(served):
+    _cluster, v = served
+    names = json.loads(get(v, "/viewer/json/sysview")[2])
+    assert "sys_query_stats" in names
+    rows = json.loads(
+        get(v, "/viewer/json/sysview?name=sys_query_stats")[2])
+    assert any("SELECT" in r["query_text"].upper() for r in rows)
+
+
+def test_bearer_auth():
+    cluster = Cluster()
+    v = Viewer(cluster, auth_tokens={"tok"}).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(v, "/viewer/json/cluster")
+        assert ei.value.code == 401
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{v.port}/viewer/json/cluster",
+            headers={"Authorization": "Bearer tok"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+    finally:
+        v.stop()
+
+
+def test_unknown_endpoint_404(served):
+    _cluster, v = served
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get(v, "/viewer/json/nope")
+    assert ei.value.code == 404

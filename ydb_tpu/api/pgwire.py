@@ -1,0 +1,317 @@
+"""PostgreSQL wire-protocol frontend (protocol 3.0, simple query flow).
+
+Mirror of the reference's pgwire compatibility layer
+(ydb/core/local_pgwire/local_pgwire_connection.cpp, ydb/core/pgproxy):
+a TCP listener that speaks the PostgreSQL v3 message protocol and
+routes SQL text into the same in-process session layer the gRPC Query
+service uses, so any stock PostgreSQL client (psql, psycopg, JDBC in
+simple-query mode) can talk to the cluster.
+
+Supported flow:
+  * SSL/GSS negotiation requests (politely refused with 'N'),
+  * StartupMessage with optional cleartext-password auth checked
+    against the same token set as the gRPC request proxy,
+  * ParameterStatus + BackendKeyData + ReadyForQuery handshake,
+  * simple Query ('Q') with multi-statement strings, text-format
+    results (RowDescription/DataRow/CommandComplete),
+  * CancelRequest (connection-level no-op), Terminate ('X'),
+  * extended-protocol messages are answered with a clear error and
+    the stream resynchronizes on Sync — simple-query clients are the
+    compatibility target, exactly like the reference's initial pgwire.
+
+Every connection owns one session; cluster state is single-writer, so
+statement execution serializes on the shared lock (the same contract as
+api/server.RequestProxy.lock).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.tx.coordinator import TxResult
+
+_PROTO_V3 = 196608        # 3.0
+_SSL_REQUEST = 80877103
+_GSSENC_REQUEST = 80877104
+_CANCEL_REQUEST = 80877102
+
+# (type oid, typlen) per logical kind; values always travel in text
+# format, the oid is what drives client-side parsing
+_PG_OID = {
+    dtypes.Kind.BOOL: (16, 1),
+    dtypes.Kind.INT8: (21, 2),
+    dtypes.Kind.INT16: (21, 2),
+    dtypes.Kind.INT32: (23, 4),
+    dtypes.Kind.INT64: (20, 8),
+    dtypes.Kind.UINT8: (21, 2),
+    dtypes.Kind.UINT16: (23, 4),
+    dtypes.Kind.UINT32: (20, 8),
+    dtypes.Kind.UINT64: (20, 8),
+    dtypes.Kind.FLOAT: (700, 4),
+    dtypes.Kind.DOUBLE: (701, 8),
+    dtypes.Kind.DATE: (1082, 4),
+    dtypes.Kind.TIMESTAMP: (1114, 8),
+    dtypes.Kind.DECIMAL: (1700, -1),
+    dtypes.Kind.STRING: (25, -1),
+}
+
+
+def _msg(type_byte: bytes, payload: bytes) -> bytes:
+    return type_byte + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode("utf-8", "surrogateescape") + b"\x00"
+
+
+def _error(message: str, code: str = "XX000") -> bytes:
+    fields = (b"S" + _cstr("ERROR") + b"V" + _cstr("ERROR")
+              + b"C" + _cstr(code) + b"M" + _cstr(message) + b"\x00")
+    return _msg(b"E", fields)
+
+
+def _format_value(kind: dtypes.Kind, scale: int, v) -> bytes:
+    if kind == dtypes.Kind.BOOL:
+        return b"t" if v else b"f"
+    if kind == dtypes.Kind.DATE:
+        return str(np.datetime64(int(v), "D")).encode()
+    if kind == dtypes.Kind.TIMESTAMP:
+        return str(np.datetime64(int(v), "us")).encode().replace(
+            b"T", b" ")
+    if kind == dtypes.Kind.DECIMAL:
+        import decimal as pydec
+
+        return str(pydec.Decimal(int(v)).scaleb(-scale)).encode()
+    if kind in (dtypes.Kind.FLOAT, dtypes.Kind.DOUBLE):
+        return f"{float(v):.17g}".encode()
+    return str(int(v)).encode()
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # noqa: C901 - one protocol, one state machine
+        srv: PgWireServer = self.server.pg  # type: ignore[attr-defined]
+        sock = self.request
+        sock.settimeout(srv.idle_timeout)
+        try:
+            if not self._startup(srv, sock):
+                return
+            self._session_loop(srv, sock)
+        except (ConnectionError, socket.timeout, OSError):
+            pass
+
+    # -- startup / auth --
+
+    def _read_exact(self, sock, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client closed")
+            buf += chunk
+        return buf
+
+    def _startup(self, srv, sock) -> bool:
+        while True:
+            (length,) = struct.unpack("!I", self._read_exact(sock, 4))
+            payload = self._read_exact(sock, length - 4)
+            (code,) = struct.unpack("!I", payload[:4])
+            if code in (_SSL_REQUEST, _GSSENC_REQUEST):
+                sock.sendall(b"N")  # not supported, retry in clear
+                continue
+            if code == _CANCEL_REQUEST:
+                return False  # per protocol: no response, just close
+            if code != _PROTO_V3:
+                sock.sendall(_error(
+                    f"unsupported protocol {code >> 16}.{code & 0xffff}",
+                    "0A000"))
+                return False
+            params = payload[4:].split(b"\x00")
+            kv = dict(zip(params[0::2], params[1::2]))
+            self.user = kv.get(b"user", b"").decode()
+            break
+        if srv.auth_tokens is not None:
+            sock.sendall(_msg(b"R", struct.pack("!I", 3)))  # cleartext
+            t, body = self._read_message(sock)
+            if t != b"p" or body[:-1].decode() not in srv.auth_tokens:
+                sock.sendall(_error("password authentication failed",
+                                    "28P01"))
+                return False
+        sock.sendall(_msg(b"R", struct.pack("!I", 0)))  # AuthenticationOk
+        for k, v in (("server_version", "15.0 ydb-tpu"),
+                     ("server_encoding", "UTF8"),
+                     ("client_encoding", "UTF8"),
+                     ("DateStyle", "ISO, YMD"),
+                     ("integer_datetimes", "on")):
+            sock.sendall(_msg(b"S", _cstr(k) + _cstr(v)))
+        backend_id = next(srv._backend_ids)
+        sock.sendall(_msg(b"K", struct.pack("!II", backend_id, 0)))
+        self._ready(sock)
+        return True
+
+    def _read_message(self, sock):
+        t = self._read_exact(sock, 1)
+        (length,) = struct.unpack("!I", self._read_exact(sock, 4))
+        return t, self._read_exact(sock, length - 4)
+
+    def _ready(self, sock):
+        sock.sendall(_msg(b"Z", b"I"))
+
+    # -- query loop --
+
+    def _session_loop(self, srv, sock):
+        session = srv.cluster.session()
+        skip_to_sync = False
+        while True:
+            t, body = self._read_message(sock)
+            if t == b"X":
+                return
+            if skip_to_sync:
+                if t == b"S":
+                    skip_to_sync = False
+                    self._ready(sock)
+                continue
+            if t == b"Q":
+                self._simple_query(srv, sock, session,
+                                   body.rstrip(b"\x00").decode(
+                                       "utf-8", "surrogateescape"))
+                self._ready(sock)
+            elif t in (b"P", b"B", b"D", b"E", b"C", b"F", b"H"):
+                sock.sendall(_error(
+                    "extended query protocol not supported; use "
+                    "simple query", "0A000"))
+                skip_to_sync = True
+            elif t == b"S":
+                self._ready(sock)
+            # anything else (e.g. stray password): ignore
+
+    def _simple_query(self, srv, sock, session, text: str):
+        statements = [s.strip() for s in text.split(";")]
+        statements = [s for s in statements if s]
+        if not statements:
+            sock.sendall(_msg(b"I", b""))  # EmptyQueryResponse
+            return
+        for stmt in statements:
+            try:
+                with srv.lock:
+                    out = session.execute(stmt)
+            except Exception as e:  # noqa: BLE001 - wire it to client
+                sock.sendall(_error(str(e), "42601"))
+                return  # abort rest of the query string (pg semantics)
+            if not self._send_result(sock, stmt, out):
+                return  # failed DML also aborts the rest
+
+    def _send_result(self, sock, stmt: str, out) -> bool:
+        """Sends the per-statement response; False = error sent (the
+        caller must abort the rest of the query string, pg semantics)."""
+        verb = (stmt.split(None, 1)[0] if stmt.split() else "").upper()
+        if out is None:  # DDL
+            sock.sendall(_msg(b"C", _cstr(verb or "OK")))
+        elif isinstance(out, str):  # EXPLAIN text
+            self._send_rowdesc(
+                sock, [("QUERY PLAN", dtypes.Kind.STRING, 0)])
+            for line in out.splitlines():
+                v = line.encode()
+                sock.sendall(_msg(
+                    b"D", struct.pack("!H", 1)
+                    + struct.pack("!I", len(v)) + v))
+            sock.sendall(_msg(b"C", _cstr("EXPLAIN")))
+        elif isinstance(out, OracleTable):
+            self._send_table(sock, out)
+        elif isinstance(out, TxResult):
+            if not out.committed:
+                sock.sendall(_error(out.error or "not committed",
+                                    "40001"))
+                return False
+            tag = ("INSERT 0 0" if verb in ("INSERT", "UPSERT")
+                   else verb or "OK")
+            sock.sendall(_msg(b"C", _cstr(tag)))
+        else:
+            sock.sendall(_msg(b"C", _cstr(verb or "OK")))
+        return True
+
+    def _send_rowdesc(self, sock, cols):
+        parts = [struct.pack("!H", len(cols))]
+        for name, kind, _scale in cols:
+            oid, typlen = _PG_OID[kind]
+            parts.append(
+                _cstr(name)
+                + struct.pack("!IhIhih", 0, 0, oid, typlen, -1, 0))
+        sock.sendall(_msg(b"T", b"".join(parts)))
+
+    def _send_table(self, sock, out: OracleTable):
+        fields = list(out.schema.fields)
+        self._send_rowdesc(
+            sock, [(f.name, f.type.kind, getattr(f.type, "scale", 0))
+                   for f in fields])
+        n = out.num_rows
+        text_cols = []
+        for f in fields:
+            vals, valid = out.cols[f.name]
+            valid = np.asarray(valid, dtype=bool)
+            if f.type.is_string:
+                decoded = out.strings(f.name)
+                col = [None if not valid[i] else
+                       decoded[i] for i in range(n)]
+            else:
+                scale = getattr(f.type, "scale", 0)
+                col = [None if not valid[i] else
+                       _format_value(f.type.kind, scale, vals[i])
+                       for i in range(n)]
+            text_cols.append(col)
+        for i in range(n):
+            parts = [struct.pack("!H", len(fields))]
+            for col in text_cols:
+                v = col[i]
+                if v is None:
+                    parts.append(struct.pack("!i", -1))
+                else:
+                    parts.append(struct.pack("!I", len(v)) + v)
+            sock.sendall(_msg(b"D", b"".join(parts)))
+        sock.sendall(_msg(b"C", _cstr(f"SELECT {n}")))
+
+
+class PgWireServer:
+    """Threaded PostgreSQL-wire listener over a Cluster.
+
+    ``lock`` serializes statement execution against other front doors;
+    pass RequestProxy.lock to co-host with the gRPC server."""
+
+    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0,
+                 auth_tokens: set[str] | None = None,
+                 lock: threading.Lock | None = None,
+                 idle_timeout: float = 300.0):
+        self.cluster = cluster
+        self.auth_tokens = auth_tokens
+        self.lock = lock if lock is not None else threading.Lock()
+        self.idle_timeout = idle_timeout
+        self._backend_ids = itertools.count(1)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._server.pg = self  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "PgWireServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="pgwire")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

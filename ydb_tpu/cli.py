@@ -6,6 +6,7 @@ topic read/write, and workload benchmark runners.
 
 Commands:
   serve     --data-dir D [--port P] [--auth-token T]   run a node
+            [--pg-port P] [--kafka-port P]             wire-compat fronts
   sql       -e ENDPOINT "SELECT ..."                   run a query
   scheme ls -e ENDPOINT [PATH]                         list a directory
   scheme describe -e ENDPOINT PATH                     table metadata
@@ -48,6 +49,30 @@ def cmd_serve(args):
         tokens = (tokens or set()) | {args.auth_token}
     server, port = make_server(cluster, port=port, auth_tokens=tokens)
     server.start()
+    extra_fronts = []
+    if args.pg_port is not None:
+        from ydb_tpu.api.pgwire import PgWireServer
+
+        pg = PgWireServer(cluster, port=args.pg_port,
+                          auth_tokens=tokens,
+                          lock=server.request_proxy.lock).start()
+        extra_fronts.append(pg)
+        print(f"pgwire listening on 127.0.0.1:{pg.port}", flush=True)
+    if args.kafka_port is not None:
+        from ydb_tpu.api.kafka import KafkaServer
+
+        kf = KafkaServer(cluster, port=args.kafka_port,
+                         auth_tokens=tokens,
+                         lock=server.request_proxy.lock).start()
+        extra_fronts.append(kf)
+        print(f"kafka listening on 127.0.0.1:{kf.port}", flush=True)
+    if args.mon_port is not None:
+        from ydb_tpu.obs.viewer import Viewer
+
+        mon = Viewer(cluster, port=args.mon_port, auth_tokens=tokens,
+                     lock=server.request_proxy.lock).start()
+        extra_fronts.append(mon)
+        print(f"monitoring on http://127.0.0.1:{mon.port}", flush=True)
     print(f"ydb_tpu serving on 127.0.0.1:{port}", flush=True)
     period = (args.background_period
               if args.background_period is not None
@@ -60,6 +85,8 @@ def cmd_serve(args):
             with server.request_proxy.lock:
                 cluster.run_background()
     except KeyboardInterrupt:
+        for front in extra_fronts:
+            front.stop()
         server.stop(1)
 
 
@@ -164,6 +191,12 @@ def main(argv=None):
     p.add_argument("--platform", default="cpu")
     p.add_argument("--background-period", type=float, default=None)
     p.add_argument("--yaml-config", default=None)
+    p.add_argument("--pg-port", type=int, default=None,
+                   help="also listen for PostgreSQL clients (0=auto)")
+    p.add_argument("--kafka-port", type=int, default=None,
+                   help="also listen for Kafka clients (0=auto)")
+    p.add_argument("--mon-port", type=int, default=None,
+                   help="monitoring HTTP endpoint (0=auto)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("sql")

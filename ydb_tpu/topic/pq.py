@@ -42,6 +42,7 @@ class _WriteTx(Transaction):
                 continue
             txc.put("msgs", (offset,), {
                 "data": msg["data"],
+                "key": msg.get("key"),
                 "ts": msg.get("ts", self.p.now()),
                 "seqno": seqno,
                 "producer": self.producer,
@@ -57,14 +58,17 @@ class _WriteTx(Transaction):
 
 
 class _CommitTx(Transaction):
-    def __init__(self, consumer: str, offset: int):
+    def __init__(self, consumer: str, offset: int,
+                 allow_rewind: bool = False):
         self.consumer = consumer
         self.offset = offset
+        self.allow_rewind = allow_rewind
 
     def execute(self, txc, tablet):
         cur = txc.get("consumers", (self.consumer,))
-        if cur is not None and cur["offset"] >= self.offset:
-            return
+        if cur is not None and cur["offset"] >= self.offset \
+                and not self.allow_rewind:
+            return  # stale/out-of-order ack: keep the monotonic offset
         txc.put("consumers", (self.consumer,), {"offset": self.offset})
 
 
@@ -119,8 +123,12 @@ class Partition:
 
     # ---- consumers ----
 
-    def commit(self, consumer: str, offset: int) -> None:
-        self.executor.execute(_CommitTx(consumer, offset))
+    def commit(self, consumer: str, offset: int,
+               allow_rewind: bool = False) -> None:
+        """Set the consumer's committed (next-to-read) offset. Stale
+        acks are ignored unless ``allow_rewind`` (an explicit seek-back,
+        e.g. a Kafka consumer reprocessing)."""
+        self.executor.execute(_CommitTx(consumer, offset, allow_rewind))
 
     def committed(self, consumer: str) -> int:
         row = self.executor.db.table("consumers").get((consumer,))
