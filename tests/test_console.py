@@ -1,0 +1,128 @@
+"""Console dynamic config + CMS maintenance tests (reference:
+ydb/core/cms/console selector configs + ConfigsDispatcher,
+ydb/core/cms availability-budget permissions)."""
+
+import pytest
+
+from ydb_tpu.config import ConfigError
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.runtime.console import (
+    Cms,
+    Console,
+    ConfigsDispatcher,
+    VersionMismatch,
+)
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_versioned_config_cas_and_validation():
+    c = Console(MemBlobStore())
+    assert c.set_config("n_shards: 8") == 1
+    text, v = c.get_config()
+    assert "n_shards: 8" in text and v == 1
+    # CAS: stale expected version rejected
+    with pytest.raises(VersionMismatch):
+        c.set_config("n_shards: 2", expected_version=0)
+    assert c.set_config("n_shards: 2", expected_version=1) == 2
+    # invalid config rejected BEFORE commit; version unchanged
+    with pytest.raises(ConfigError):
+        c.set_config("nope_key: 1")
+    assert c.version == 2
+
+
+def test_selector_overrides_merge_in_order():
+    c = Console(MemBlobStore())
+    c.set_config("n_shards: 4\nplan_cache_size: 64")
+    c.add_override({"tenant": "/Root/a"}, "n_shards: 16")
+    c.add_override({"node_kind": "storage"}, "plan_cache_size: 8")
+
+    base = c.resolve({})
+    assert base.n_shards == 4 and base.plan_cache_size == 64
+    a = c.resolve({"tenant": "/Root/a"})
+    assert a.n_shards == 16 and a.plan_cache_size == 64
+    both = c.resolve({"tenant": "/Root/a", "node_kind": "storage"})
+    assert both.n_shards == 16 and both.plan_cache_size == 8
+
+
+def test_dispatcher_receives_pushes():
+    c = Console(MemBlobStore())
+    c.set_config("n_shards: 4")
+    d = ConfigsDispatcher({"tenant": "/Root/x"})
+    seen = []
+    c.subscribe(d)
+    d.on_change(lambda cfg: seen.append(cfg.n_shards))
+    assert seen == [4]  # immediate delivery on subscribe
+    c.add_override({"tenant": "/Root/x"}, "n_shards: 32")
+    assert seen[-1] == 32
+    c.set_config("n_shards: 6")  # override still applies on top
+    assert seen[-1] == 32 and d.version == c.version
+
+
+def test_console_reboot_keeps_versions_and_overrides():
+    store = MemBlobStore()
+    c = Console(store)
+    c.set_config("n_shards: 8")
+    c.add_override({"tenant": "/t"}, "n_shards: 2")
+    c2 = Console(store)
+    assert c2.version == 2
+    assert c2.resolve({"tenant": "/t"}).n_shards == 2
+
+
+def test_cms_availability_budget():
+    clock = Clock()
+    cms = Cms(MemBlobStore(), max_unavailable=1, now=clock)
+    assert cms.request(1, duration_s=100)
+    assert cms.permitted(1)
+    assert not cms.request(2)          # budget spent -> queued
+    assert cms.request(1)              # idempotent re-request
+    granted = cms.done(1)              # returning grants the queue head
+    assert granted == [2] and cms.permitted(2) and not cms.permitted(1)
+
+
+def test_cms_expired_permission_frees_budget():
+    clock = Clock()
+    cms = Cms(MemBlobStore(), max_unavailable=1, now=clock)
+    assert cms.request(1, duration_s=50)
+    clock.t += 60  # lapsed
+    assert not cms.permitted(1)
+    assert cms.request(2)  # expired permission no longer counts
+
+
+def test_cms_expiry_grants_queue_fifo_no_jumping():
+    """A fresh request must not jump nodes already queued when an
+    expired permission frees budget (code-review regression)."""
+    clock = Clock()
+    cms = Cms(MemBlobStore(), max_unavailable=1, now=clock)
+    assert cms.request(1, duration_s=50)
+    assert not cms.request(2)          # queued behind 1
+    clock.t += 60                      # 1's permission expires silently
+    assert not cms.request(3)          # 2 is first in line, 3 queues
+    assert cms.permitted(2) and not cms.permitted(3)
+    assert cms.done(2) == [3]          # then 3 gets its turn
+
+
+def test_cms_tick_grants_after_expiry():
+    clock = Clock()
+    cms = Cms(MemBlobStore(), max_unavailable=1, now=clock)
+    cms.request(1, duration_s=50)
+    assert not cms.request(2)
+    clock.t += 60
+    assert cms.tick() == [2]
+    assert cms.permitted(2)
+
+
+def test_cms_survives_reboot():
+    store = MemBlobStore()
+    clock = Clock()
+    cms = Cms(store, max_unavailable=1, now=clock)
+    cms.request(7, duration_s=500)
+    cms2 = Cms(store, max_unavailable=1, now=clock)
+    assert cms2.permitted(7)
+    assert not cms2.request(8)

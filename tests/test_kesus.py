@@ -1,0 +1,181 @@
+"""Kesus coordination tablet + SequenceShard tests: semaphore
+contention/waiter promotion, ephemeral locks, session expiry recovery,
+reboot survival, durable sequence ranges (reference:
+ydb/core/kesus/tablet, ydb/core/tx/sequenceshard)."""
+
+import pytest
+
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.tablet.kesus import KesusTablet, SequenceShard
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_semaphore_acquire_release_and_waiters():
+    k = KesusTablet("k1", MemBlobStore())
+    s1 = k.attach_session()
+    s2 = k.attach_session()
+    s3 = k.attach_session()
+    k.create_semaphore("res", limit=2)
+    assert k.acquire(s1, "res")
+    assert k.acquire(s2, "res")
+    # full: immediate reject without timeout, queue with timeout
+    assert not k.acquire(s3, "res", timeout_s=0)
+    assert not k.acquire(s3, "res", timeout_s=60)
+    d = k.describe("res")
+    assert set(d["owners"]) == {s1, s2} and d["waiters"] == [s3]
+    # release -> FIFO promotion
+    assert k.release(s1, "res") == [s3]
+    d = k.describe("res")
+    assert set(d["owners"]) == {s2, s3} and d["waiters"] == []
+
+
+def test_counting_semaphore_respects_counts():
+    k = KesusTablet("k2", MemBlobStore())
+    s1, s2 = k.attach_session(), k.attach_session()
+    k.create_semaphore("slots", limit=10)
+    assert k.acquire(s1, "slots", count=7)
+    assert not k.acquire(s2, "slots", count=4)  # 7+4 > 10
+    assert k.release(s1, "slots") == []
+    assert k.acquire(s2, "slots", count=4)
+
+
+def test_ephemeral_lock_lifecycle():
+    k = KesusTablet("k3", MemBlobStore())
+    s1, s2 = k.attach_session(), k.attach_session()
+    # first acquire creates the lock; second contends
+    assert k.acquire(s1, "mylock", ephemeral=True)
+    assert not k.acquire(s2, "mylock", ephemeral=True)
+    k.release(s1, "mylock")
+    # fully released ephemeral semaphore vanishes
+    with pytest.raises(KeyError):
+        k.describe("mylock")
+    assert k.acquire(s2, "mylock", ephemeral=True)
+
+
+def test_session_expiry_releases_holds():
+    clock = Clock()
+    k = KesusTablet("k4", MemBlobStore(), now=clock)
+    s1 = k.attach_session(timeout_s=10)
+    s2 = k.attach_session(timeout_s=1000)
+    k.create_semaphore("res", limit=1)
+    assert k.acquire(s1, "res")
+    assert not k.acquire(s2, "res", timeout_s=60)
+    clock.t += 50  # s1 deadline passes
+    dead = k.tick()
+    assert dead == [s1]
+    # s2 promoted when the dead session's hold was dropped
+    assert k.describe("res")["owners"] == {s2: 1}
+
+
+def test_ping_extends_session():
+    clock = Clock()
+    k = KesusTablet("k5", MemBlobStore(), now=clock)
+    s1 = k.attach_session(timeout_s=10)
+    clock.t += 8
+    assert k.ping_session(s1)
+    clock.t += 8  # past the original deadline, inside the new one
+    assert k.tick() == []
+    clock.t += 5
+    assert k.tick() == [s1]
+
+
+def test_kesus_reboots_with_state():
+    store = MemBlobStore()
+    k = KesusTablet("k6", store)
+    s1 = k.attach_session(timeout_s=1000)
+    k.create_semaphore("res", limit=3)
+    assert k.acquire(s1, "res", count=2)
+
+    k2 = KesusTablet("k6", store)  # reboot from the same storage
+    d = k2.describe("res")
+    assert d["owners"] == {s1: 2} and d["limit"] == 3
+    # the rebooted tablet keeps serving: release + new acquire work
+    k2.release(s1, "res")
+    s2 = k2.attach_session()
+    assert s2 > s1
+    assert k2.acquire(s2, "res", count=3)
+
+
+def test_tick_never_promotes_a_co_dying_session():
+    """Two sessions dying in one tick: the waiter among them must NOT
+    end up owning the semaphore (code-review regression)."""
+    clock = Clock()
+    k = KesusTablet("kr1", MemBlobStore(), now=clock)
+    s1 = k.attach_session(timeout_s=10)
+    s2 = k.attach_session(timeout_s=10)
+    k.create_semaphore("sem", limit=1)
+    assert k.acquire(s1, "sem")
+    assert not k.acquire(s2, "sem", timeout_s=1000)
+    clock.t += 50  # both sessions lapse together
+    assert k.tick() == sorted([s1, s2])
+    d = k.describe("sem")
+    assert d["owners"] == {} and d["waiters"] == []
+
+
+def test_lapsed_waiter_is_never_promoted():
+    clock = Clock()
+    k = KesusTablet("kr2", MemBlobStore(), now=clock)
+    s1 = k.attach_session(timeout_s=10_000)
+    s2 = k.attach_session(timeout_s=10_000)
+    k.create_semaphore("sem", limit=1)
+    assert k.acquire(s1, "sem")
+    assert not k.acquire(s2, "sem", timeout_s=5)  # waiter deadline +5
+    clock.t += 50  # waiter lapsed (sessions still alive)
+    assert k.release(s1, "sem") == []  # no stale promotion
+    assert k.describe("sem")["owners"] == {}
+    # the semaphore is free again: a fresh acquire succeeds instantly
+    assert k.acquire(s1, "sem", timeout_s=5)
+    assert k.describe("sem")["owners"] == {s1: 1}
+    # and tick sweeps any lapsed waiters out of the queue
+    assert not k.acquire(s2, "sem", timeout_s=5)
+    clock.t += 50
+    k.tick()
+    assert k.describe("sem")["waiters"] == []
+
+
+def test_delete_semaphore_clears_stale_waiters():
+    k = KesusTablet("kr3", MemBlobStore())
+    s1, s2 = k.attach_session(), k.attach_session()
+    k.create_semaphore("x", limit=0)
+    assert not k.acquire(s1, "x", timeout_s=10_000)  # queued forever
+    k.delete_semaphore("x")
+    k.create_semaphore("x", limit=5)
+    assert k.acquire(s2, "x")
+    assert k.release(s2, "x") == []  # stale waiter must not reappear
+    assert k.describe("x")["owners"] == {}
+
+
+def test_sequence_descending():
+    seq = SequenceShard("sd", MemBlobStore())
+    seq.create_sequence("down", start=100, increment=-1, cache=10)
+    got = [seq.next_val("down") for _ in range(12)]
+    assert got == list(range(100, 88, -1))  # no skips inside ranges
+
+
+def test_sequence_durable_ranges():
+    store = MemBlobStore()
+    seq = SequenceShard("s1", store)
+    seq.create_sequence("ids", start=1, cache=5)
+    got = [seq.next_val("ids") for _ in range(7)]
+    assert got == [1, 2, 3, 4, 5, 6, 7]
+
+    # reboot: cached-but-unused values are skipped, never repeated
+    seq2 = SequenceShard("s1", store)
+    nxt = seq2.next_val("ids")
+    assert nxt == 11  # second range [6, 11) was burned by the crash
+    assert seq2.next_val("ids") == 12
+
+
+def test_sequence_increment_and_missing():
+    seq = SequenceShard("s2", MemBlobStore())
+    seq.create_sequence("even", start=0, increment=2, cache=3)
+    assert [seq.next_val("even") for _ in range(4)] == [0, 2, 4, 6]
+    with pytest.raises(KeyError):
+        seq.next_val("nope")

@@ -1,0 +1,78 @@
+"""Load-test service + metering tests (reference: ydb/core/load_test,
+ydb/core/metering)."""
+
+import io
+import json
+
+import pytest
+
+from ydb_tpu.kqp.session import Cluster
+from ydb_tpu.obs.loadtest import LoadService
+from ydb_tpu.obs.metering import Metering, request_units
+
+
+def test_kv_upsert_and_select_load():
+    cluster = Cluster()
+    svc = LoadService(cluster)
+    r = svc.run("kv_upsert", requests=20, key_space=10)
+    assert r["kind"] == "kv_upsert" and r["requests"] == 20 and r["errors"] == 0
+    assert r["rps"] > 0 and r["p99_ms"] >= r["p50_ms"] > 0
+
+    r2 = svc.run("select", requests=10, key_space=10)
+    assert r2["errors"] == 0 and r2["requests"] == 10
+    assert len(svc.history) == 2
+
+    # the load actually landed: table has some of the 10 keys
+    out = cluster.session().execute("SELECT count(*) AS c FROM load_kv")
+    n = int(out.column("c")[0])
+    assert 1 <= n <= 10
+
+
+def test_storage_put_load_and_unknown_kind():
+    cluster = Cluster()
+    svc = LoadService(cluster)
+    r = svc.run("storage_put", requests=5, blob_bytes=128)
+    assert r["errors"] == 0 and r["requests"] == 5
+    with pytest.raises(KeyError):
+        svc.run("nope")
+
+
+def test_request_units_schedule():
+    assert request_units("select", 0) == 1
+    assert request_units("select", 128) == 1
+    assert request_units("select", 129) == 2
+    assert request_units("upsert", 10_000) == 1
+
+
+def test_metering_records_and_aggregates():
+    sink = io.StringIO()
+    clock = [1000.0]
+    m = Metering(tenant="/Root/a", sink=sink, now=lambda: clock[0])
+    m.record("kqp.select", 2)
+    clock[0] += 10
+    m.record("kqp.upsert", 1)
+    clock[0] += 3600
+    m.record("kqp.select", 3)
+    agg = m.aggregate(interval_s=3600)
+    assert agg == [
+        {"tenant": "/Root/a", "resource": "kqp.select",
+         "interval_start": 0.0, "units": 2},
+        {"tenant": "/Root/a", "resource": "kqp.upsert",
+         "interval_start": 0.0, "units": 1},
+        {"tenant": "/Root/a", "resource": "kqp.select",
+         "interval_start": 3600.0, "units": 3},
+    ]
+    lines = [json.loads(x) for x in sink.getvalue().splitlines()]
+    assert len(lines) == 3 and lines[0]["units"] == 2
+    assert m.total_units() == 6 and m.total_units("kqp.select") == 5
+
+
+def test_session_books_request_units():
+    cluster = Cluster()
+    s = cluster.session()
+    s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1), (2)")
+    s.execute("SELECT id FROM t")
+    res = {r["resource"] for r in cluster.metering.records}
+    assert {"kqp.createtable", "kqp.insert", "kqp.select"} <= res
+    assert cluster.metering.total_units() >= 3

@@ -1,0 +1,114 @@
+"""NodeBroker dynamic registration + TenantPool tests, including
+dynamic interconnect peer discovery between two live actor systems
+(reference: ydb/core/mind/node_broker.cpp, tenant_pool.cpp)."""
+
+import time
+
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.runtime.actors import Actor, ActorId, ActorSystem
+from ydb_tpu.runtime.interconnect import Interconnect
+from ydb_tpu.runtime.nodebroker import NodeBroker, TenantPool
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_register_renew_expire():
+    clock = Clock()
+    nb = NodeBroker(MemBlobStore(), lease_s=30, now=clock)
+    a = nb.register("10.0.0.1", 19001)
+    b = nb.register("10.0.0.2", 19001)
+    assert a.node_id == 1024 and b.node_id == 1025
+    assert nb.resolve(1025) == ("10.0.0.2", 19001)
+
+    # same endpoint re-registers -> same id (restart inside lease)
+    a2 = nb.register("10.0.0.1", 19001)
+    assert a2.node_id == a.node_id
+
+    clock.t += 20
+    nb.extend(a.node_id)
+    clock.t += 15  # b's lease (30s) lapsed; a extended at +20
+    assert nb.tick() == [b.node_id]
+    assert [n.node_id for n in nb.nodes()] == [a.node_id]
+    # epoch bumped on expiry (stale resolution fencing)
+    assert nb.nodes()[0].epoch == 2
+    # freed id is reused
+    c = nb.register("10.0.0.3", 19001)
+    assert c.node_id == 1025
+
+
+def test_broker_reboot_keeps_registrations():
+    store = MemBlobStore()
+    clock = Clock()
+    nb = NodeBroker(store, lease_s=300, now=clock)
+    a = nb.register("h1", 1)
+    nb2 = NodeBroker(store, lease_s=300, now=clock)
+    assert nb2.resolve(a.node_id) == ("h1", 1)
+    assert nb2.register("h2", 2).node_id == a.node_id + 1
+
+
+class Echo(Actor):
+    def receive(self, message, sender):
+        if message[0] == "ping":
+            self.send(sender, ("pong", message[1]))
+
+
+class Collector(Actor):
+    def __init__(self):
+        super().__init__()
+        self.got = []
+
+    def receive(self, message, sender):
+        self.got.append(message)
+
+
+def test_dynamic_peer_discovery_end_to_end():
+    """Two actor systems find each other through the broker alone."""
+    nb = NodeBroker(MemBlobStore(), lease_s=300)
+
+    sys_a = ActorSystem(node=0)
+    sys_b = ActorSystem(node=0)
+    ic_a = Interconnect(sys_a, listen_port=0)
+    ic_b = Interconnect(sys_b, listen_port=0)
+    try:
+        a = nb.register("127.0.0.1", ic_a.port)
+        b = nb.register("127.0.0.1", ic_b.port)
+        sys_a.node = a.node_id
+        sys_b.node = b.node_id
+
+        echo = Echo()
+        sys_b.register(echo)  # ActorId(b, 1)
+        coll = Collector()
+        sys_a.register(coll)  # ActorId(a, 1)
+
+        nb.connect_peers(ic_a)
+        nb.connect_peers(ic_b)
+
+        sys_a.send(ActorId(b.node_id, 1), ("ping", 7),
+                   sender=ActorId(a.node_id, 1))
+        deadline = time.time() + 10
+        while not coll.got and time.time() < deadline:
+            ic_b.pump(0.05)
+            ic_a.pump(0.05)
+        assert coll.got == [("pong", 7)]
+    finally:
+        ic_a.close()
+        ic_b.close()
+
+
+def test_tenant_pool_slots():
+    tp = TenantPool(slots=4)
+    assert tp.claim("/Root/a", 3)
+    assert not tp.claim("/Root/b", 2)
+    assert tp.claim("/Root/b", 1)
+    assert tp.free_slots() == 0
+    tp.release("/Root/a", 2)
+    assert tp.free_slots() == 2 and tp.tenants() == {
+        "/Root/a": 1, "/Root/b": 1}
+    tp.release("/Root/a")
+    assert "/Root/a" not in tp.tenants()

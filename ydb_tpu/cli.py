@@ -176,6 +176,19 @@ def cmd_tpcds(args):
                   verify=not args.no_verify)
 
 
+def cmd_loadtest(args):
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+    from ydb_tpu.kqp.session import Cluster
+    from ydb_tpu.obs.loadtest import LoadService
+
+    svc = LoadService(Cluster())
+    r = svc.run(args.kind, requests=args.requests)
+    print(f"{r['kind']:12} {r['requests']} reqs  {r['errors']} errors  "
+          f"{r['rps']} rps  p50={r['p50_ms']}ms p99={r['p99_ms']}ms")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="ydb_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -253,6 +266,12 @@ def main(argv=None):
     wd.add_argument("--platform", default="cpu")
     wd.add_argument("--no-verify", action="store_true")
     wd.set_defaults(fn=cmd_tpcds)
+    wl = wsub.add_parser("load")
+    wl.add_argument("--kind", default="kv_upsert",
+                    choices=["kv_upsert", "select", "storage_put"])
+    wl.add_argument("--requests", type=int, default=100)
+    wl.add_argument("--platform", default="cpu")
+    wl.set_defaults(fn=cmd_loadtest)
 
     args = ap.parse_args(argv)
     args.fn(args)

@@ -108,6 +108,11 @@ class Cluster:
         # optional request-unit quoter (rate-limiter / kesus analog):
         # when set, every statement consumes 1 unit from "kqp/requests"
         self.quoter = None
+        # usage metering (ydb/core/metering analog): request units
+        # booked per statement, aggregatable per tenant/interval
+        from ydb_tpu.obs.metering import Metering
+
+        self.metering = Metering()
         # registered scalar UDFs: name -> (vectorized fn, result type)
         self.udfs: dict[str, tuple] = {}
         # live-tunable knobs (immediate control board)
@@ -801,6 +806,11 @@ class Session:
         g = c.counters.group(kind=kind)
         g.counter("queries").inc()
         g.histogram("latency_seconds").observe(seconds)
+        if c.metering is not None:
+            from ydb_tpu.obs.metering import request_units
+
+            c.metering.record(f"kqp.{kind}",
+                              request_units(kind, rows))
         return out
 
     def _dispatch(self, planned):

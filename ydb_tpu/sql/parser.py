@@ -30,7 +30,8 @@ _TOKEN_RE = re.compile(
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "as", "and", "or", "not", "between", "in", "like", "is", "null",
-    "asc", "desc", "join", "inner", "left", "on", "insert", "into",
+    "asc", "desc", "join", "inner", "left", "on", "insert", "upsert",
+    "into",
     "values", "create", "table", "primary", "key", "case", "when", "then",
     "else", "end", "date", "interval", "true", "false", "distinct",
     "outer", "exists", "cast", "drop", "alter", "add", "column", "with",
@@ -118,7 +119,7 @@ class Parser:
             stmt = ast.Explain(self.parse_select())
         elif self.peek().value in ("select", "with"):
             stmt = self.parse_select()
-        elif self.peek().value == "insert":
+        elif self.peek().value in ("insert", "upsert"):
             stmt = self.parse_insert()
         elif self.peek().value == "create":
             stmt = self.parse_create()
@@ -239,7 +240,10 @@ class Parser:
         return ast.OrderItem(e, desc)
 
     def parse_insert(self) -> ast.Insert:
-        self.expect("kw", "insert")
+        # UPSERT INTO parses to the same node: the row stores' write
+        # path is newest-wins (blind upsert), matching YQL UPSERT
+        if not self.accept("kw", "upsert"):
+            self.expect("kw", "insert")
         self.expect("kw", "into")
         table = self.expect("name").value
         cols = []

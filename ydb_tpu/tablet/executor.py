@@ -63,6 +63,18 @@ class Transaction:
         pass
 
 
+class _ApplyTx(Transaction):
+    """Single-closure transaction (the lambda-ITransaction idiom used
+    by the small coordination tablets: kesus, console, nodebroker)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.result = None
+
+    def execute(self, txc, tablet):
+        self.result = self.fn(txc)
+
+
 class FencedError(Exception):
     """A higher generation has taken over this tablet; the caller is a
     zombie leader and must stop (blob-barrier analog)."""
@@ -86,6 +98,12 @@ class TabletExecutor:
 
     def _prefix(self) -> str:
         return f"tablet/{self.tablet_id}/"
+
+    def run(self, fn):
+        """Execute a single-closure transaction; returns fn's result."""
+        tx = _ApplyTx(fn)
+        self.execute(tx)
+        return tx.result
 
     def execute(self, tx: Transaction):
         txc = TxContext(self.db, self.version + 1)
