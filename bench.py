@@ -54,6 +54,10 @@ def _budget_left(budget: float) -> float:
     return budget - (time.perf_counter() - _T0)
 
 
+class _SqlProbeTooSlow(Exception):
+    """SQL tier probe exceeded its cap; skip that tier, keep the rest."""
+
+
 def cpu_q1(li, cutoff):
     """Vectorized single-pass numpy Q1 (the CPU columnar baseline)."""
     m = li["l_shipdate"] <= cutoff
@@ -330,13 +334,47 @@ def main():
                 raise TimeoutError(
                     f"bench budget spent before SQL tier "
                     f"({budget:g}s)")
-            _log("sql tier")
             from ydb_tpu.engine.reader import MultiShardStreamSource
             from ydb_tpu.plan import Database, execute_plan, to_host
             from ydb_tpu.sql.parser import parse
             from ydb_tpu.sql.planner import Catalog, plan_select_full
             from ydb_tpu.workload.queries import TPCH
 
+            # probe the SQL path at a tiny scale first: it has the same
+            # compile + per-block dispatch structure as the full run,
+            # so a pathologically slow backend (e.g. a high-latency
+            # device tunnel) is detected in seconds, not tens of
+            # minutes — the tier is then skipped with an explicit
+            # marker instead of eating the whole budget
+            _log("sql tier: probe")
+            pdata = tpch.TpchData(sf=0.02, seed=43)
+            pshard = ColumnShard(
+                "probe", tpch.LINEITEM_SCHEMA, store,
+                dicts=pdata.dicts,
+                config=ShardConfig(
+                    compact_portion_threshold=10 ** 9,
+                    scan_block_rows=block_rows,
+                    portion_chunk_rows=1 << 18))
+            pshard.commit([pshard.write(
+                dict(pdata.tables["lineitem"]))])
+            pcat = Catalog(schemas={"lineitem": tpch.LINEITEM_SCHEMA},
+                           primary_keys={}, dicts=pdata.dicts)
+            pdb = Database(
+                sources={"lineitem": MultiShardStreamSource(
+                    [pshard], tpch.LINEITEM_SCHEMA, pdata.dicts)},
+                dicts=pdata.dicts)
+            pplan = plan_select_full(parse(TPCH["q1"]), pcat).plan
+            t0 = time.perf_counter()
+            to_host(execute_plan(pplan, pdb))
+            probe_s = time.perf_counter() - t0
+            extra["sql_probe_cold_s"] = round(probe_s, 1)
+            probe_cap = min(300.0, _budget_left(budget) / 4)
+            if probe_s > probe_cap:
+                raise _SqlProbeTooSlow(
+                    f"sql probe took {probe_s:.0f}s (cap "
+                    f"{probe_cap:.0f}s)")
+
+            _log("sql tier")
             catalog = Catalog(
                 schemas={"lineitem": tpch.LINEITEM_SCHEMA},
                 primary_keys={}, dicts=edicts)
@@ -366,6 +404,9 @@ def main():
             extra["sql_q1_cold_rows_per_sec"] = round(e_rows / scold1)
             extra["sql_q1_warm_rows_per_sec"] = round(e_rows / swarm1)
             extra["sql_q6_warm_rows_per_sec"] = round(e_rows / swarm6)
+    except _SqlProbeTooSlow as e:
+        # the engine tier SUCCEEDED; only the SQL tier is skipped
+        skipped.append(f"sql_tier:{e}")
     except Exception as e:  # noqa: BLE001 - storage tiers fail soft:
         # the kernel-tier numbers (already verified) still report
         extra["engine_tier_error"] = repr(e)[-400:]

@@ -27,3 +27,13 @@ jax.config.update("jax_enable_x64", True)
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running out-of-core / subprocess tests")
+
+
+class Clock:
+    """Injectable manual clock shared by coordination-plane tests."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t

@@ -4,6 +4,8 @@ ydb/core/cms availability-budget permissions)."""
 
 import pytest
 
+from conftest import Clock
+
 from ydb_tpu.config import ConfigError
 from ydb_tpu.engine.blobs import MemBlobStore
 from ydb_tpu.runtime.console import (
@@ -13,13 +15,6 @@ from ydb_tpu.runtime.console import (
     VersionMismatch,
 )
 
-
-class Clock:
-    def __init__(self, t=0.0):
-        self.t = t
-
-    def __call__(self):
-        return self.t
 
 
 def test_versioned_config_cas_and_validation():
@@ -116,6 +111,27 @@ def test_cms_tick_grants_after_expiry():
     clock.t += 60
     assert cms.tick() == [2]
     assert cms.permitted(2)
+
+
+def test_invalid_override_rejected_before_commit():
+    c = Console(MemBlobStore())
+    c.set_config("n_shards: 4")
+    with pytest.raises(ConfigError):
+        c.add_override({"tenant": "/t"}, "nope_key: 1")
+    assert c.version == 1  # nothing committed
+    assert c.resolve({"tenant": "/t"}).n_shards == 4  # not poisoned
+
+
+def test_cms_repeat_request_keeps_queue_position():
+    clock = Clock()
+    cms = Cms(MemBlobStore(), max_unavailable=1, now=clock)
+    assert cms.request(1, duration_s=500)
+    assert not cms.request(2)
+    assert not cms.request(2)  # retry: same position, no duplicate
+    assert not cms.request(3)
+    assert cms.done(1) == [2]
+    # node 2's duplicate must not consume the next free slot
+    assert cms.done(2) == [3]
 
 
 def test_cms_survives_reboot():

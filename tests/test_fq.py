@@ -1,0 +1,140 @@
+"""Streaming (federated) query tests: continuous SQL over topics,
+incremental group state, crash/replay exactly-once via sink seqno
+dedup (reference: ydb/core/fq/libs checkpoint coordinator + row
+dispatcher)."""
+
+import json
+
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.fq import FederatedQueryService, StreamingQuery
+from ydb_tpu.topic.topic import Topic
+
+EVENTS = dtypes.schema(
+    ("region", dtypes.STRING, False),
+    ("amount", dtypes.INT64, False),
+)
+
+SQL = ("select region, count(*) as n, sum(amount) as total "
+       "from stream group by region")
+
+
+def send(topic, **row):
+    topic.write(json.dumps(row))
+
+
+@pytest.fixture
+def env():
+    store = MemBlobStore()
+    source = Topic("events", store, n_partitions=2)
+    sink = Topic("results", store, n_partitions=1)
+    svc = FederatedQueryService(store)
+    return store, source, sink, svc
+
+
+def sink_records(sink):
+    out = []
+    for m in sink.partitions[0].read(0, limit=1000):
+        out.append(json.loads(m["data"]))
+    return out
+
+
+def test_incremental_group_aggregation(env):
+    _store, source, sink, svc = env
+    q = svc.create_query("agg", SQL, EVENTS, source, sink)
+    send(source, region="eu", amount=10)
+    send(source, region="us", amount=5)
+    assert q.poll() == 2
+    assert q.results() == [
+        {"region": "eu", "n": 1, "total": 10},
+        {"region": "us", "n": 1, "total": 5},
+    ]
+    # second batch folds into the same groups
+    send(source, region="eu", amount=7)
+    assert q.poll() == 1
+    assert q.results()[0] == {"region": "eu", "n": 2, "total": 17}
+    # only the changed group was re-emitted in the second batch
+    recs = sink_records(sink)
+    assert recs[-1] == {"region": "eu", "n": 2, "total": 17}
+    assert q.poll() == 0  # no new data
+
+
+def test_filter_and_min_max(env):
+    _store, source, _sink, svc = env
+    q = svc.create_query(
+        "mm",
+        "select region, min(amount) as lo, max(amount) as hi "
+        "from stream where amount > 0 group by region",
+        EVENTS, source)
+    send(source, region="eu", amount=3)
+    send(source, region="eu", amount=-99)  # filtered out
+    send(source, region="eu", amount=8)
+    q.poll()
+    send(source, region="eu", amount=1)
+    q.poll()
+    assert q.results() == [{"region": "eu", "lo": 1, "hi": 8}]
+
+
+def test_crash_replay_is_exactly_once(env):
+    """Simulate a crash BETWEEN sink emission and checkpoint: the
+    replayed batch's emission must be deduplicated by seqno."""
+    store, source, sink, svc = env
+    q = svc.create_query("eo", SQL, EVENTS, source, sink)
+    send(source, region="eu", amount=10)
+    assert q.poll() == 1
+    assert len(sink_records(sink)) == 1
+
+    # crash after emit, before checkpoint: rebuild the query from
+    # storage with the checkpoint rolled back one step by replaying
+    # the same batch — emulate by constructing a fresh query whose
+    # tablet state we reset to the pre-poll cursor
+    send(source, region="eu", amount=5)
+    # poison the checkpoint path: run the batch manually
+    offsets, state, seq = q._state()
+    rows = [{"region": "eu", "amount": 5}]
+    out = q._run_batch(rows)
+    changed = q._fold(state, out)
+    q.sink.partitions[0].write(
+        [{"data": json.dumps(dict(zip(("region",),
+                                      json.loads(k))) | state[k])}
+         for k in changed],
+        producer="fq/eo", first_seqno=seq + 1)
+    # CRASH here: checkpoint never happens. Recover:
+    q2 = StreamingQuery("eo", SQL, EVENTS, source, sink, store)
+    assert q2.poll() == 1  # replays the un-checkpointed message
+    recs = sink_records(sink)
+    # the replayed emission was dropped by producer-seqno dedup
+    assert len(recs) == 2
+    assert recs[-1] == {"region": "eu", "n": 2, "total": 15}
+    assert q2.results() == [{"region": "eu", "n": 2, "total": 15}]
+
+
+def test_state_survives_reboot(env):
+    store, source, sink, svc = env
+    q = svc.create_query("rb", SQL, EVENTS, source, sink)
+    send(source, region="eu", amount=4)
+    q.poll()
+    q2 = StreamingQuery("rb", SQL, EVENTS, source, sink, store)
+    assert q2.results() == [{"region": "eu", "n": 1, "total": 4}]
+    send(source, region="eu", amount=6)
+    assert q2.poll() == 1
+    assert q2.results() == [{"region": "eu", "n": 2, "total": 10}]
+
+
+def test_poison_messages_skipped(env):
+    _store, source, _sink, svc = env
+    q = svc.create_query("ps", SQL, EVENTS, source, sink=None)
+    source.write("not json at all")
+    send(source, region="eu", amount=2)
+    assert q.poll() == 1
+    assert q.results() == [{"region": "eu", "n": 1, "total": 2}]
+
+
+def test_rejects_non_foldable_aggregates(env):
+    store, source, _sink, _svc = env
+    with pytest.raises(ValueError):
+        StreamingQuery(
+            "bad", "select region, avg(amount) as a from stream "
+            "group by region", EVENTS, source, None, store)

@@ -5,16 +5,11 @@ ydb/core/kesus/tablet, ydb/core/tx/sequenceshard)."""
 
 import pytest
 
+from conftest import Clock
+
 from ydb_tpu.engine.blobs import MemBlobStore
 from ydb_tpu.tablet.kesus import KesusTablet, SequenceShard
 
-
-class Clock:
-    def __init__(self, t=100.0):
-        self.t = t
-
-    def __call__(self):
-        return self.t
 
 
 def test_semaphore_acquire_release_and_waiters():
@@ -60,7 +55,7 @@ def test_ephemeral_lock_lifecycle():
 
 
 def test_session_expiry_releases_holds():
-    clock = Clock()
+    clock = Clock(100.0)
     k = KesusTablet("k4", MemBlobStore(), now=clock)
     s1 = k.attach_session(timeout_s=10)
     s2 = k.attach_session(timeout_s=1000)
@@ -75,7 +70,7 @@ def test_session_expiry_releases_holds():
 
 
 def test_ping_extends_session():
-    clock = Clock()
+    clock = Clock(100.0)
     k = KesusTablet("k5", MemBlobStore(), now=clock)
     s1 = k.attach_session(timeout_s=10)
     clock.t += 8
@@ -106,7 +101,7 @@ def test_kesus_reboots_with_state():
 def test_tick_never_promotes_a_co_dying_session():
     """Two sessions dying in one tick: the waiter among them must NOT
     end up owning the semaphore (code-review regression)."""
-    clock = Clock()
+    clock = Clock(100.0)
     k = KesusTablet("kr1", MemBlobStore(), now=clock)
     s1 = k.attach_session(timeout_s=10)
     s2 = k.attach_session(timeout_s=10)
@@ -120,7 +115,7 @@ def test_tick_never_promotes_a_co_dying_session():
 
 
 def test_lapsed_waiter_is_never_promoted():
-    clock = Clock()
+    clock = Clock(100.0)
     k = KesusTablet("kr2", MemBlobStore(), now=clock)
     s1 = k.attach_session(timeout_s=10_000)
     s2 = k.attach_session(timeout_s=10_000)
@@ -150,6 +145,32 @@ def test_delete_semaphore_clears_stale_waiters():
     assert k.acquire(s2, "x")
     assert k.release(s2, "x") == []  # stale waiter must not reappear
     assert k.describe("x")["owners"] == {}
+
+
+def test_retried_acquire_does_not_duplicate_waiter():
+    k = KesusTablet("kr4", MemBlobStore())
+    s1, s2, s3 = (k.attach_session() for _ in range(3))
+    k.create_semaphore("sem", limit=1)
+    assert k.acquire(s1, "sem")
+    assert not k.acquire(s2, "sem", timeout_s=60)
+    assert not k.acquire(s2, "sem", timeout_s=60)  # client retry
+    assert not k.acquire(s3, "sem", timeout_s=60)
+    assert k.describe("sem")["waiters"] == [s2, s3]
+    assert k.release(s1, "sem") == [s2]
+    # s2's promotion must not double-count: s3 fits after s2 releases
+    assert k.release(s2, "sem") == [s3]
+
+
+def test_ephemeral_erase_clears_unpromotable_waiters():
+    k = KesusTablet("kr5", MemBlobStore())
+    s1, s2, s3 = (k.attach_session() for _ in range(3))
+    assert k.acquire(s1, "L", ephemeral=True)  # limit=1
+    # a count-2 waiter can never fit a limit-1 ephemeral lock
+    assert not k.acquire(s2, "L", count=2, timeout_s=1000)
+    assert k.release(s1, "L") == []  # lock vanishes, waiter must too
+    assert k.acquire(s3, "L", ephemeral=True)
+    assert k.describe("L")["waiters"] == []
+    assert k.release(s3, "L") == []  # stale s2 never resurrects
 
 
 def test_sequence_descending():

@@ -4,22 +4,17 @@ dynamic interconnect peer discovery between two live actor systems
 
 import time
 
+from conftest import Clock
+
 from ydb_tpu.engine.blobs import MemBlobStore
 from ydb_tpu.runtime.actors import Actor, ActorId, ActorSystem
 from ydb_tpu.runtime.interconnect import Interconnect
 from ydb_tpu.runtime.nodebroker import NodeBroker, TenantPool
 
 
-class Clock:
-    def __init__(self, t=1000.0):
-        self.t = t
-
-    def __call__(self):
-        return self.t
-
 
 def test_register_renew_expire():
-    clock = Clock()
+    clock = Clock(1000.0)
     nb = NodeBroker(MemBlobStore(), lease_s=30, now=clock)
     a = nb.register("10.0.0.1", 19001)
     b = nb.register("10.0.0.2", 19001)
@@ -44,7 +39,7 @@ def test_register_renew_expire():
 
 def test_broker_reboot_keeps_registrations():
     store = MemBlobStore()
-    clock = Clock()
+    clock = Clock(1000.0)
     nb = NodeBroker(store, lease_s=300, now=clock)
     a = nb.register("h1", 1)
     nb2 = NodeBroker(store, lease_s=300, now=clock)

@@ -73,6 +73,13 @@ def cmd_serve(args):
                      lock=server.request_proxy.lock).start()
         extra_fronts.append(mon)
         print(f"monitoring on http://127.0.0.1:{mon.port}", flush=True)
+    if args.sqs_port is not None:
+        from ydb_tpu.api.sqs import SqsHttpServer
+
+        sqs = SqsHttpServer(cluster.store, port=args.sqs_port,
+                            lock=server.request_proxy.lock).start()
+        extra_fronts.append(sqs)
+        print(f"sqs on http://127.0.0.1:{sqs.port}", flush=True)
     print(f"ydb_tpu serving on 127.0.0.1:{port}", flush=True)
     period = (args.background_period
               if args.background_period is not None
@@ -210,6 +217,8 @@ def main(argv=None):
                    help="also listen for Kafka clients (0=auto)")
     p.add_argument("--mon-port", type=int, default=None,
                    help="monitoring HTTP endpoint (0=auto)")
+    p.add_argument("--sqs-port", type=int, default=None,
+                   help="SQS-compatible queue HTTP endpoint (0=auto)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("sql")

@@ -1,0 +1,3 @@
+from ydb_tpu.fq.service import FederatedQueryService, StreamingQuery
+
+__all__ = ["FederatedQueryService", "StreamingQuery"]

@@ -1,0 +1,240 @@
+"""Federated/streaming query service: continuous SQL over topics.
+
+Mirror of the reference's FQ platform (ydb/core/fq/libs: control
+plane storing query definitions, row dispatcher reading shared topic
+partitions, checkpoint coordinator persisting operator state —
+checkpoint_coordinator.h:25, checkpoint_storage/; SURVEY.md §2.13 row
+"FQ / streaming platform"), built on this framework's own planes:
+
+  * source/sink are PersQueue topics; rows travel as JSON objects;
+  * each poll() processes one micro-batch through the REAL SQL path
+    (parse -> plan -> device execution on a batch ColumnSource) and
+    folds the batch aggregates into durable running state — the
+    incremental shape of the reference's task graph with a
+    WideCombiner state, expressed as batch-fold;
+  * exactly-once effects: the tablet checkpoint (source offset, agg
+    state, emit seqno) commits AFTER the sink write, and sink writes
+    carry producer seqnos — a crash between sink write and checkpoint
+    replays the batch, and the PQ producer-dedup drops the duplicate
+    emission (topic/pq.py _WriteTx seqno guard). The checkpointing
+    contract of dq/checkpoint.py at the service level.
+
+Query shape supported: SELECT <keys and aggregates> FROM stream
+[WHERE ...] [GROUP BY ...] with count/sum/min/max (aggregates must be
+fold-combinable; avg rewrites to sum+count pairs at the edge are the
+caller's concern, matching the two-phase-agg restriction).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.blobs import BlobStore
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.plan import Database, execute_plan, to_host
+from ydb_tpu.sql import ast
+from ydb_tpu.sql.parser import parse
+from ydb_tpu.sql.planner import Catalog, plan_select_full
+from ydb_tpu.tablet.executor import TabletExecutor
+
+_FOLD = {
+    "count": lambda a, b: a + b,
+    "sum": lambda a, b: a + b,
+    "min": min,
+    "max": max,
+}
+
+
+class StreamingQuery:
+    """One continuous query: source topic -> SQL -> sink topic."""
+
+    def __init__(self, name: str, sql: str, schema: dtypes.Schema,
+                 source, sink, store: BlobStore,
+                 batch_limit: int = 1024):
+        self.name = name
+        self.sql = sql
+        self.schema = schema
+        self.source = source          # Topic
+        self.sink = sink              # Topic | None
+        self.batch_limit = batch_limit
+        self.executor = TabletExecutor.boot(f"fq/{name}", store)
+        stmt = parse(sql)
+        if not isinstance(stmt, ast.Select):
+            raise ValueError("streaming query must be a SELECT")
+        self._select = stmt
+        self._key_cols, self._agg_cols = self._classify(stmt)
+
+    @staticmethod
+    def _classify(stmt: ast.Select):
+        from ydb_tpu.sql.planner import _AGG_FUNCS
+
+        keys, aggs = [], []
+        for item in stmt.items:
+            name = item.alias or getattr(item.expr, "column", None)
+            if isinstance(item.expr, ast.FuncCall) and (
+                    item.expr.name in _AGG_FUNCS or item.expr.star):
+                kind = "count" if item.expr.star else item.expr.name
+                if kind not in _FOLD:
+                    raise ValueError(
+                        f"aggregate {kind} is not fold-combinable; "
+                        "rewrite (e.g. avg -> sum + count) upstream")
+                aggs.append((name, kind))
+            else:
+                keys.append(name)
+        return keys, aggs
+
+    # -- durable state --
+
+    def _state(self) -> tuple[int, dict, int]:
+        db = self.executor.db
+        meta = db.table("meta").get(("cursor",)) or {
+            "offsets": {}, "emit_seqno": 0}
+        state = {}
+        for (key_json,), row in db.table("state").range():
+            state[key_json] = row["aggs"]
+        return meta["offsets"], state, meta["emit_seqno"]
+
+    # -- one micro-batch --
+
+    def poll(self) -> int:
+        """Process available source messages; returns rows consumed.
+        Emits changed groups to the sink, then checkpoints atomically."""
+        offsets, state, emit_seqno = self._state()
+        rows, new_offsets = [], dict(offsets)
+        for pi, part in enumerate(self.source.partitions):
+            start = offsets.get(str(pi), 0)
+            msgs = part.read(start, limit=self.batch_limit)
+            for m in msgs:
+                try:
+                    rows.append(json.loads(m["data"]))
+                except json.JSONDecodeError:
+                    continue  # poison messages are skipped, not fatal
+            if msgs:
+                new_offsets[str(pi)] = msgs[-1]["offset"] + 1
+        if not rows:
+            return 0
+
+        batch_out = self._run_batch(rows)
+        changed = self._fold(state, batch_out)
+
+        # 1. emit (idempotent via producer seqno) ...
+        if self.sink is not None and changed:
+            payloads = []
+            for key_json in changed:
+                rec = dict(zip(self._key_cols, json.loads(key_json)))
+                rec.update(state[key_json])
+                payloads.append({"data": json.dumps(rec)})
+            self.sink.partitions[0].write(
+                payloads, producer=f"fq/{self.name}",
+                first_seqno=emit_seqno + 1)
+            emit_seqno += len(payloads)
+
+        # 2. ... THEN checkpoint; a crash in between replays the batch
+        # and the seqno guard swallows the duplicate emission
+        def fn(txc):
+            txc.put("meta", ("cursor",), {
+                "offsets": new_offsets, "emit_seqno": emit_seqno})
+            for key_json in changed:
+                txc.put("state", (key_json,),
+                        {"aggs": state[key_json]})
+        self.executor.run(fn)
+        return len(rows)
+
+    def _run_batch(self, rows: list[dict]) -> list[dict]:
+        """Run the SQL over one batch through the normal query path."""
+        dicts = DictionarySet()
+        arrays: dict[str, np.ndarray] = {}
+        validity: dict[str, np.ndarray] = {}
+        for f in self.schema.fields:
+            vals = [r.get(f.name) for r in rows]
+            ok = np.array([v is not None for v in vals], dtype=bool)
+            if f.type.is_string:
+                d = dicts.for_column(f.name)
+                arrays[f.name] = np.array(
+                    [d.add(v or "") for v in vals], dtype=np.int32)
+            else:
+                arrays[f.name] = np.array(
+                    [v if v is not None else 0 for v in vals],
+                    dtype=f.type.physical)
+            validity[f.name] = ok
+        src = ColumnSource(arrays, self.schema, dicts,
+                           validity=validity)
+        catalog = Catalog(schemas={"stream": self.schema},
+                          primary_keys={}, dicts=dicts)
+        pq = plan_select_full(parse(self.sql), catalog)
+        out = to_host(execute_plan(
+            pq.plan, Database(sources={"stream": src}, dicts=dicts)))
+        result = []
+        n = out.num_rows
+        cols = {}
+        for f in out.schema.fields:
+            v, _ok = out.cols[f.name]
+            if f.type.is_string:
+                src_d = pq.dict_aliases.get(f.name, f.name)
+                cols[f.name] = [x.decode("utf-8", "surrogateescape")
+                                for x in dicts[src_d].decode(
+                                    np.asarray(v))]
+            elif f.type.is_decimal:
+                cols[f.name] = [int(x) for x in np.asarray(v)]
+            else:
+                cols[f.name] = [x.item() for x in np.asarray(v)]
+        for i in range(n):
+            result.append({k: cols[k][i] for k in cols})
+        return result
+
+    def _fold(self, state: dict, batch_out: list[dict]) -> set:
+        """Merge batch aggregates into running state; returns the set
+        of changed group keys (JSON-encoded key tuples)."""
+        changed = set()
+        for row in batch_out:
+            key_json = json.dumps(
+                [row[k] for k in self._key_cols], sort_keys=True)
+            cur = state.get(key_json)
+            if cur is None:
+                state[key_json] = {name: row[name]
+                                   for name, _kind in self._agg_cols}
+            else:
+                for name, kind in self._agg_cols:
+                    cur[name] = _FOLD[kind](cur[name], row[name])
+            changed.add(key_json)
+        return changed
+
+    def results(self) -> list[dict]:
+        """Current materialized view (keys + running aggregates)."""
+        _offsets, state, _seq = self._state()
+        out = []
+        for key_json, aggs in sorted(state.items()):
+            rec = dict(zip(self._key_cols, json.loads(key_json)))
+            rec.update(aggs)
+            out.append(rec)
+        return out
+
+
+class FederatedQueryService:
+    """Control plane: named streaming queries over cluster topics
+    (the fq control-plane/row-dispatcher analog, scoped to this
+    framework's in-process cluster)."""
+
+    def __init__(self, store: BlobStore):
+        self.store = store
+        self.queries: dict[str, StreamingQuery] = {}
+
+    def create_query(self, name: str, sql: str, schema: dtypes.Schema,
+                     source, sink=None,
+                     batch_limit: int = 1024) -> StreamingQuery:
+        if name in self.queries:
+            raise ValueError(f"query {name} exists")
+        q = StreamingQuery(name, sql, schema, source, sink,
+                           self.store, batch_limit)
+        self.queries[name] = q
+        return q
+
+    def delete_query(self, name: str) -> None:
+        self.queries.pop(name, None)
+
+    def poll_all(self) -> dict[str, int]:
+        return {name: q.poll() for name, q in self.queries.items()}

@@ -30,7 +30,7 @@ import time
 
 import yaml
 
-from ydb_tpu.config import AppConfig
+from ydb_tpu.config import AppConfig, ConfigError
 from ydb_tpu.engine.blobs import BlobStore
 from ydb_tpu.tablet.executor import TabletExecutor
 
@@ -82,7 +82,16 @@ class Console:
         return (row["yaml"] if row else "", self.version)
 
     def add_override(self, selector: dict, yaml_fragment: str) -> int:
-        yaml.safe_load(yaml_fragment)  # must at least be valid YAML
+        # validate the EFFECTIVE config before commit, like set_config:
+        # a fragment with unknown keys/bad types must not durably
+        # poison resolve() for matching nodes
+        frag = yaml.safe_load(yaml_fragment) or {}
+        if not isinstance(frag, dict):
+            raise ConfigError("override fragment must be a mapping")
+        main_row = self.executor.db.table("config").get(("main",))
+        base = yaml.safe_load(main_row["yaml"]) if main_row else {}
+        AppConfig.from_yaml(yaml.safe_dump(
+            deep_merge(base or {}, frag)))
 
         def fn(txc):
             n = sum(1 for _ in
@@ -200,6 +209,10 @@ class Cms:
             granted, active_n = self._grant_queued(txc, now)
             if node_id in granted:
                 return True
+            # already queued: keep the original position, no duplicate
+            for (_qn,), row in self.executor.db.table("queue").range():
+                if row["node"] == node_id:
+                    return False
             q_committed = sum(
                 1 for _ in self.executor.db.table("queue").range())
             still_queued = q_committed - len(granted)

@@ -152,6 +152,10 @@ class KesusTablet:
             cur = txc.get("holds", (name, sid))
             if cur is not None:
                 return True  # re-acquire is idempotent
+            for (n, _pos), row in \
+                    self.executor.db.table("waiters").range():
+                if n == name and row["session"] == sid:
+                    return False  # already queued: no duplicate waiter
             if self._held(name) + count <= sem["limit"]:
                 txc.put("holds", (name, sid), {"count": count})
                 return True
@@ -198,6 +202,13 @@ class KesusTablet:
                 promoted.append(row["session"])
         if sem is not None and sem["ephemeral"] and held == 0 \
                 and not promoted:
+            # fully-released ephemeral lock vanishes; any never-
+            # promotable waiters must go with it, or they would
+            # resurrect under an unrelated recreation of the name
+            for (n, pos), _row in list(
+                    self.executor.db.table("waiters").range()):
+                if n == name:
+                    txc.erase("waiters", (n, pos))
             txc.erase("semaphores", (name,))
         return promoted
 
