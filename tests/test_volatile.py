@@ -1,0 +1,232 @@
+"""Volatile distributed transactions: optimistic apply, readset
+exchange, reader fencing, abort-on-restart semantics, barrier
+monotonicity (reference: ydb/core/tx/datashard/volatile_tx.h:91,
+datashard_outreadset.h; VERDICT r3 missing #9 / weak #7)."""
+
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.datashard.shard import (
+    DataShard,
+    RowOp,
+    VolatileUndecided,
+)
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.tx.coordinator import Coordinator
+
+SCHEMA = dtypes.schema(("id", dtypes.INT64, False),
+                       ("v", dtypes.INT64, True))
+
+
+def make_shards(n=2):
+    store = MemBlobStore()
+    return store, [DataShard(f"s{i}", SCHEMA, store, ("id",))
+                   for i in range(n)]
+
+
+def propose(shard, key, v):
+    return shard.propose([RowOp((key,), {"id": key, "v": v})])
+
+
+def test_volatile_commit_across_shards():
+    _store, (a, b) = make_shards()
+    coord = Coordinator()
+    wa, wb = propose(a, 1, 10), propose(b, 2, 20)
+    res = coord.commit_volatile([a, b], [[wa], [wb]])
+    assert res.committed
+    snap = coord.read_snapshot()
+    assert snap >= res.step
+    rows_a = [r for page in a.read(snap) for r in page]
+    rows_b = [r for page in b.read(snap) for r in page]
+    assert rows_a[0][1]["v"] == 10 and rows_b[0][1]["v"] == 20
+
+
+def test_volatile_abort_rolls_back_all_participants():
+    _store, (a, b) = make_shards()
+    coord = Coordinator()
+    wa = propose(a, 1, 10)
+    # b's write id is bogus -> b's local validation fails
+    res = coord.commit_volatile([a, b], [[wa], [9999]])
+    assert not res.committed and "volatile abort" in res.error
+    snap = coord.read_snapshot()
+    assert [r for page in a.read(snap) for r in page] == []
+    # staged entry on a was aborted, not left dangling
+    assert a.executor.db.table("pending").get((wa,)) is None
+
+
+def test_undecided_volatile_fences_readers():
+    _store, (a, b) = make_shards()
+    wa = propose(a, 1, 10)
+    assert a.apply_volatile([wa], txid=7, step=5, expected_peers=[1])
+    # the decision never arrived: snapshot readers at step >= 5 block
+    with pytest.raises(VolatileUndecided):
+        a.read(5, keys=[(1,)])
+    with pytest.raises(VolatileUndecided):
+        list(a.read(6))
+    # readers BELOW the volatile step pass (it is ordered after them)
+    assert [r for page in a.read(4) for r in page] == []
+    # non-intersecting point reads pass too
+    assert [r for page in a.read(6, keys=[(42,)]) for r in page] == []
+    # decision arrives -> effects durable and readable
+    assert b is not a
+    assert a.deliver_readset(7, 1, True) is True
+    rows = [r for page in a.read(5) for r in page]
+    assert rows[0][1]["v"] == 10
+
+
+def test_negative_readset_rolls_back():
+    _store, (a, _b) = make_shards()
+    wa = propose(a, 1, 10)
+    assert a.apply_volatile([wa], txid=9, step=3, expected_peers=[1])
+    assert a.deliver_readset(9, 1, False) is False
+    assert [r for page in a.read(3) for r in page] == []
+    assert a.executor.db.table("pending").get((wa,)) is None
+
+
+def test_restart_forgets_undecided_volatile():
+    """Volatile effects are not durable before the decision: a reboot
+    auto-aborts them (the reference's volatile contract)."""
+    store, (a, _b) = make_shards()
+    wa = propose(a, 1, 10)
+    assert a.apply_volatile([wa], txid=11, step=4, expected_peers=[1])
+    a2 = DataShard("s0", SCHEMA, store, ("id",))  # reboot
+    # no fence, no data: the undecided tx evaporated ...
+    assert [r for page in a2.read(10) for r in page] == []
+    # ... but the durably staged pending entry survives for repair
+    assert a2.executor.db.table("pending").get((wa,)) is not None
+
+
+def test_barrier_never_passes_undecided_step():
+    """A later classic commit must not advance the read barrier past an
+    undecided volatile step (snapshot monotonicity)."""
+    _store, (a, b) = make_shards()
+    coord = Coordinator()
+
+    class SlowShard:
+        """Participant that accepts but never hears back (peer lost)."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.calls = []
+
+        def apply_volatile(self, args, txid, step, peers):
+            self.calls.append(("apply", step))
+            return self.inner.apply_volatile(args, txid, step, peers)
+
+        def deliver_readset(self, txid, frm, ok):
+            self.calls.append(("rs", txid))
+            return None  # swallow: decision never settles
+
+    wa, wb = propose(a, 1, 10), propose(b, 2, 20)
+    slow_a = SlowShard(a)
+    import threading
+
+    started = threading.Event()
+    release = threading.Event()
+
+    real_apply = slow_a.apply_volatile
+
+    def blocking_apply(args, txid, step, peers):
+        ok = real_apply(args, txid, step, peers)
+        started.set()
+        release.wait(timeout=10)
+        return ok
+
+    slow_a.apply_volatile = blocking_apply
+    t = threading.Thread(
+        target=lambda: coord.commit_volatile(
+            [slow_a, b], [[wa], [wb]]),
+        daemon=True)
+    t.start()
+    assert started.wait(timeout=10)
+    vol_step = a._volatile and next(
+        iter(a._volatile.values())).step
+    # while the volatile tx is outstanding, a background plan at a
+    # LATER step cannot drag the barrier past the undecided step
+    later = coord.background_plan()
+    assert later > vol_step
+    assert coord.read_snapshot() < vol_step
+    release.set()
+    t.join(timeout=10)
+    assert coord.read_snapshot() >= later
+
+
+def test_prepare_rejects_key_with_undecided_volatile():
+    """expect-preconditions (and blind writes) must not validate
+    against committed data while an undecided volatile write owns the
+    key (code-review regression)."""
+    _store, (a, _b) = make_shards()
+    wa = propose(a, 1, 10)
+    assert a.apply_volatile([wa], txid=21, step=5, expected_peers=[1])
+    # fail-if-exists INSERT for the same key: committed data says the
+    # key is free, but the volatile write at step 5 owns it
+    w2 = a.propose([RowOp((1,), {"id": 1, "v": 99})],
+                   expect={(1,): None})
+    import pytest as _pytest
+
+    from ydb_tpu.datashard.shard import TxRejected
+
+    with _pytest.raises(TxRejected, match="undecided volatile"):
+        a.prepare([w2])
+    # decision lands -> the key is committed -> precondition now
+    # fails for the RIGHT reason (key exists)
+    a.deliver_readset(21, 1, True)
+    with _pytest.raises(TxRejected, match="precondition"):
+        a.prepare([w2])
+
+
+def test_volatile_never_overtakes_classic_commit_mid_apply():
+    """A volatile commit finishing while a classic commit is still
+    applying must not advance the barrier past the classic step
+    (code-review regression: torn cross-shard read)."""
+    import threading
+
+    _store, shards = make_shards(4)
+    a, b, c, d = shards
+    coord = Coordinator()
+
+    applied_first = threading.Event()
+    release = threading.Event()
+    real_commit_at = b.commit_at
+
+    def slow_commit_at(write_ids, step):
+        applied_first.set()
+        release.wait(timeout=10)
+        return real_commit_at(write_ids, step)
+
+    b.commit_at = slow_commit_at
+    wa, wb = propose(a, 1, 10), propose(b, 2, 20)
+    classic = {}
+    t = threading.Thread(
+        target=lambda: classic.update(
+            res=coord.commit([a, b], [[wa], [wb]])), daemon=True)
+    t.start()
+    assert applied_first.wait(timeout=10)
+    classic_step = coord.last_step
+    # volatile commit on OTHER shards completes while classic mid-apply
+    wc, wd = propose(c, 3, 30), propose(d, 4, 40)
+    vres = coord.commit_volatile([c, d], [[wc], [wd]])
+    assert vres.committed and vres.step > classic_step
+    # barrier must still be short of the classic step: shard a has the
+    # write, shard b does not yet
+    assert coord.read_snapshot() < classic_step
+    release.set()
+    t.join(timeout=10)
+    assert classic["res"].committed
+    assert coord.read_snapshot() >= vres.step
+
+
+def test_sql_multi_shard_upsert_goes_volatile():
+    """The row-table SQL path commits multi-shard writes through the
+    volatile protocol end to end."""
+    from ydb_tpu.kqp.session import Cluster
+
+    cluster = Cluster()
+    s = cluster.session()
+    s.execute("CREATE TABLE t (id int64, v int64, PRIMARY KEY (id)) "
+              "WITH (store = row, shards = 4)")
+    s.execute("INSERT INTO t VALUES " + ", ".join(
+        f"({i}, {i * 10})" for i in range(16)))
+    out = s.execute("SELECT count(*) AS c, sum(v) AS s FROM t")
+    assert int(out.column("c")[0]) == 16
+    assert int(out.column("s")[0]) == sum(i * 10 for i in range(16))

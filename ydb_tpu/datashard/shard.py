@@ -38,6 +38,13 @@ from ydb_tpu.engine.blobs import BlobStore
 from ydb_tpu.tablet.executor import TabletExecutor, Transaction
 
 
+class VolatileUndecided(Exception):
+    """A read hit the key range of a volatile tx whose cross-shard
+    decision is still outstanding; the reader must wait for the
+    readset exchange to settle (the reference blocks the read iterator
+    on TVolatileTxManager, datashard__read_iterator.cpp)."""
+
+
 class TxRejected(Exception):
     pass
 
@@ -136,6 +143,22 @@ class _AbortTx(Transaction):
             txc.erase("pending", (wid,))
 
 
+@dataclasses.dataclass
+class _VolatileTx:
+    """An optimistically-applied distributed tx awaiting peer readsets
+    (TVolatileTxManager analog, volatile_tx.h:91). Effects live only
+    in this in-memory record until the decision — a shard restart
+    forgets undecided volatile txs, which is exactly the reference's
+    contract (volatile = not yet persistent)."""
+
+    txid: int
+    step: int
+    write_ids: list
+    keys: set
+    expected: set   # peer participant ids whose readsets are awaited
+    received: dict  # peer id -> bool
+
+
 class DataShard:
     def __init__(self, shard_id: str, schema: dtypes.Schema,
                  store: BlobStore, pk_columns: tuple[str, ...]):
@@ -148,6 +171,7 @@ class DataShard:
         self._locks: dict[int, _Lock] = {}
         self._next_lock = itertools.count(1)
         self.cdc_enabled = False
+        self._volatile: dict[int, _VolatileTx] = {}
 
     # ---- MVCC state ----
 
@@ -191,6 +215,19 @@ class DataShard:
                 lock = self._locks.get(lock_id)
                 if lock is None or lock.broken:
                     raise LockBroken(f"lock {lock_id} is broken")
+            # an undecided volatile write to any of this tx's keys is
+            # ordered BEFORE it but not yet in the data table: both
+            # expect-preconditions and blind writes must wait for (or
+            # conservatively reject on) the outstanding decision, like
+            # the read-path fence — otherwise fail-if-exists could pass
+            # against a key a decided-later volatile insert owns
+            for key_list, _row in pend["ops"]:
+                key = tuple(key_list)
+                for vt in self._volatile.values():
+                    if key in vt.keys:
+                        raise TxRejected(
+                            f"key {key} has an undecided volatile "
+                            f"write (tx {vt.txid})")
             for key_list, want in pend.get("expect") or []:
                 key = tuple(key_list)
                 have = self.executor.db.table("data").get(key)
@@ -205,6 +242,80 @@ class DataShard:
 
     def abort(self, write_ids: list[int]) -> None:
         self.executor.execute(_AbortTx(write_ids))
+
+    # ---- volatile distributed commit (volatile_tx.h:91 analog) ----
+
+    def apply_volatile(self, write_ids: list[int], txid: int,
+                       step: int, expected_peers) -> bool:
+        """Validate + optimistically accept a planned volatile tx
+        WITHOUT waiting for peers' outcomes (no prepare round-trip):
+        on success the tx is recorded undecided and its keys are
+        fenced from snapshot readers until the readset exchange
+        settles. Local failure aborts the staged writes immediately
+        and returns False (the readset this shard sends its peers)."""
+        try:
+            self.prepare(write_ids)
+        except TxRejected:
+            self.abort(write_ids)
+            return False
+        keys = set()
+        for wid in write_ids:
+            pend = self.executor.db.table("pending").get((wid,))
+            for key_list, _row in pend["ops"]:
+                keys.add(tuple(key_list))
+        self._volatile[txid] = _VolatileTx(
+            txid, step, list(write_ids), keys,
+            set(expected_peers), {})
+        # conflicting optimistic readers must learn NOW, not at the
+        # decision: the write is already ordered at `step`
+        for key in keys:
+            self._break_locks(key)
+        return True
+
+    def deliver_readset(self, txid: int, from_peer,
+                        ok: bool) -> bool | None:
+        """Record a peer's outcome (TEvReadSet analog). Returns the
+        decision once it settles: True committed, False rolled back,
+        None still undecided / unknown tx."""
+        vt = self._volatile.get(txid)
+        if vt is None:
+            return None
+        if not ok:
+            self.executor.execute(_AbortTx(vt.write_ids))
+            del self._volatile[txid]
+            return False
+        vt.received[from_peer] = True
+        if set(vt.received) >= vt.expected:
+            # decision: effects become durable at the planned step
+            self.executor.execute(
+                _CommitTx(self, vt.write_ids, vt.step))
+            del self._volatile[txid]
+            return True
+        return None
+
+    def abort_volatile(self, txid: int) -> None:
+        """Locally roll back an undecided volatile tx (restart/timeout
+        path: volatile effects are never durable before the decision)."""
+        vt = self._volatile.pop(txid, None)
+        if vt is not None:
+            self.executor.execute(_AbortTx(vt.write_ids))
+
+    def _volatile_fence(self, snapshot: int, lo, hi, keys) -> None:
+        """Raise VolatileUndecided when the request intersects an
+        undecided volatile tx ordered at or before the snapshot."""
+        for vt in self._volatile.values():
+            if vt.step > snapshot:
+                continue
+            if keys is not None:
+                if vt.keys.intersection(tuple(k) for k in keys):
+                    raise VolatileUndecided(
+                        f"tx {vt.txid} at step {vt.step} undecided")
+            else:
+                for k in vt.keys:
+                    if (lo is None or k >= lo) and \
+                            (hi is None or k < hi):
+                        raise VolatileUndecided(
+                            f"tx {vt.txid} at step {vt.step} undecided")
 
     # ---- read path (read iterator) ----
 
@@ -233,6 +344,7 @@ class DataShard:
                 lock.points.update(tuple(k) for k in keys)
             else:
                 lock.ranges.append((lo, hi))
+        self._volatile_fence(snapshot, lo, hi, keys)
         return self._read_pages(snapshot, lo, hi, keys, columns,
                                 page_rows)
 

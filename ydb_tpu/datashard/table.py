@@ -217,7 +217,11 @@ class RowTable:
                 for shard, wid in _route_propose(idx_shards, idx_ops):
                     participants.append(shard)
                     prepare_args.append([wid])
-        return self.coordinator.commit(participants, prepare_args)
+        # multi-shard row commits take the volatile path: no prepare
+        # round-trip under the coordinator's commit lock, outcomes
+        # exchanged as readsets (volatile_tx.h; VERDICT missing #9)
+        return self.coordinator.commit_volatile(participants,
+                                                prepare_args)
 
     # ---- secondary indexes ----
 

@@ -20,6 +20,7 @@ stale leader's appends after a takeover are ignored by the next boot
 from __future__ import annotations
 
 import json
+import threading
 
 from ydb_tpu.engine.blobs import BlobStore
 from ydb_tpu.tablet.localdb import LocalDb
@@ -93,6 +94,10 @@ class TabletExecutor:
         self.version = version  # last committed version
         self.log_index = log_index  # next redo record index
         self._since_snap = 0
+        # one tablet = one writer: commit paths that bypass a global
+        # commit lock (volatile readset exchange) still serialize
+        # per-tablet here, so version/log_index never collide
+        self._exec_lock = threading.Lock()
 
     # ---- commit path ----
 
@@ -106,28 +111,30 @@ class TabletExecutor:
         return tx.result
 
     def execute(self, tx: Transaction):
-        txc = TxContext(self.db, self.version + 1)
-        tx.execute(txc, self)
-        if txc.changes:
-            record = {
-                "gen": self.generation,
-                "version": txc.version,
-                "changes": [
-                    [ch[0], list(ch[1])] + list(ch[2:])
-                    for ch in txc.changes
-                ],
-            }
-            blob_id = (f"{self._prefix()}log/"
-                       f"{self.generation:08d}.{self.log_index:010d}")
-            self.store.put(blob_id, json.dumps(record).encode())
-            self.log_index += 1
-            self.db.apply(txc.changes, txc.version)
-            self.version = txc.version
-            self._since_snap += 1
-            if self._since_snap >= self.SNAP_EVERY:
-                self.checkpoint()
-        tx.complete(self)
-        return tx
+        with self._exec_lock:
+            txc = TxContext(self.db, self.version + 1)
+            tx.execute(txc, self)
+            if txc.changes:
+                record = {
+                    "gen": self.generation,
+                    "version": txc.version,
+                    "changes": [
+                        [ch[0], list(ch[1])] + list(ch[2:])
+                        for ch in txc.changes
+                    ],
+                }
+                blob_id = (f"{self._prefix()}log/"
+                           f"{self.generation:08d}."
+                           f"{self.log_index:010d}")
+                self.store.put(blob_id, json.dumps(record).encode())
+                self.log_index += 1
+                self.db.apply(txc.changes, txc.version)
+                self.version = txc.version
+                self._since_snap += 1
+                if self._since_snap >= self.SNAP_EVERY:
+                    self.checkpoint()
+            tx.complete(self)
+            return tx
 
     def _superseded(self) -> bool:
         """True when the store shows a higher generation has booted —
