@@ -1,0 +1,137 @@
+"""BlobDepot tests: dedup refcounting, crash-safe GC, reboot,
+decommission, and running a real tablet over the depot adapter
+(reference: ydb/core/blob_depot)."""
+
+import pytest
+
+from ydb_tpu.blobstorage.blob_depot import BlobDepot, DepotBlobStore
+from ydb_tpu.engine.blobs import MemBlobStore
+
+
+def test_dedup_and_refcounted_delete():
+    be = MemBlobStore()
+    d = BlobDepot("d1", be)
+    payload = b"x" * 1000
+    d.put("a", payload)
+    d.put("b", payload)       # same content: stored once
+    d.put("c", b"different")
+    st = d.stats()
+    assert st["names"] == 3 and st["payloads"] == 2
+    assert st["logical_bytes"] == 2009
+    assert st["physical_bytes"] == 1009
+
+    d.delete("a")             # refcount 2 -> 1: payload stays
+    assert d.get("b") == payload
+    d.delete("b")             # 1 -> 0: payload physically gone
+    assert not any(k.startswith("depot/d1/data/")
+                   and b"x" * 10 in be.get(k)
+                   for k in be.list("depot/d1/data/"))
+    with pytest.raises(KeyError):
+        d.get("a")
+    assert d.get("c") == b"different"
+
+
+def test_overwrite_moves_reference_and_sweeps():
+    be = MemBlobStore()
+    d = BlobDepot("d2", be)
+    d.put("k", b"v1")
+    phys_before = set(be.list("depot/d2/data/"))
+    d.put("k", b"v2")
+    assert d.get("k") == b"v2"
+    st = d.stats()
+    assert st["names"] == 1 and st["payloads"] == 1
+    # the displaced payload was physically collected, not just
+    # unreferenced (overwrite-only workloads must not leak)
+    phys_after = set(be.list("depot/d2/data/"))
+    assert len(phys_after) == 1 and phys_after != phys_before
+
+
+def test_gc_resurrection_safe():
+    """A digest re-referenced between trash-mark and sweep must not be
+    deleted."""
+    be = MemBlobStore()
+    d = BlobDepot("d3", be)
+    d.put("a", b"payload")
+    # mark trash without sweeping (delete() normally sweeps; emulate a
+    # crash between the index commit and the sweep)
+    def fn(txc):
+        row = txc.get("names", ("a",))
+        txc.erase("names", ("a",))
+        d._dec_locked(txc, row["digest"])
+    d.executor.run(fn)
+    d.put("b", b"payload")  # resurrects the digest
+    assert d.collect_garbage() == 0  # unmarked, not deleted
+    assert d.get("b") == b"payload"
+
+
+def test_depot_reboot():
+    be = MemBlobStore()
+    d = BlobDepot("d4", be)
+    d.put("a", b"one")
+    d.put("b", b"two")
+    d2 = BlobDepot("d4", be)  # reboot over the same backend
+    assert d2.get("a") == b"one" and d2.get("b") == b"two"
+    assert d2.stats()["names"] == 2
+    d2.delete("a")
+    with pytest.raises(KeyError):
+        d2.get("a")
+
+
+def test_boot_sweeps_crash_trash():
+    """Trash left by a crash between index commit and physical delete
+    is reclaimed on the next boot."""
+    be = MemBlobStore()
+    d = BlobDepot("d7", be)
+    d.put("a", b"doomed")
+
+    # emulate the crash: index drops the name and trash-marks, but the
+    # physical delete never runs
+    def fn(txc):
+        row = txc.get("names", ("a",))
+        txc.erase("names", ("a",))
+        d._dec_locked(txc, row["digest"])
+    d.executor.run(fn)
+    assert be.list("depot/d7/data/")  # garbage present
+
+    d2 = BlobDepot("d7", be)  # boot sweeps
+    assert be.list("depot/d7/data/") == []
+    assert d2.stats()["payloads"] == 0
+
+
+def test_decommit_never_touches_sibling_depots():
+    be = MemBlobStore()
+    d_a = BlobDepot("da", be)
+    d_a.put("x", b"payload-a")
+    d_b = BlobDepot("db", be)
+    assert d_b.decommit("") == 0  # nothing outside depot/tablet space
+    assert d_a.get("x") == b"payload-a"  # sibling untouched
+
+
+def test_decommit_absorbs_direct_blobs():
+    be = MemBlobStore()
+    be.put("legacy/1", b"aaa")
+    be.put("legacy/2", b"bbb")
+    be.put("legacy/3", b"aaa")  # dup content
+    d = BlobDepot("d5", be)
+    assert d.decommit("legacy/") == 3
+    assert be.list("legacy/") == []  # originals drained
+    assert d.get("legacy/1") == b"aaa" and d.get("legacy/2") == b"bbb"
+    assert d.stats()["payloads"] == 2  # deduped during absorption
+
+
+def test_tablet_runs_over_depot_adapter():
+    """A real tablet executor (PQ partition) works unchanged over the
+    depot's virtual store."""
+    from ydb_tpu.topic.pq import Partition
+
+    be = MemBlobStore()
+    depot = BlobDepot("vg", be)
+    store = DepotBlobStore(depot)
+    p = Partition("pq0", store)
+    offs = p.write([{"data": f"m{i}"} for i in range(5)])
+    assert offs == list(range(5))
+    # reboot the partition over the same depot: WAL replays through
+    # the indirection
+    p2 = Partition("pq0", store)
+    msgs = p2.read(0, limit=10)
+    assert [m["data"] for m in msgs] == [f"m{i}" for i in range(5)]
