@@ -234,16 +234,17 @@ def _apply_op(op, expr, args, ts, cols, types, dicts, n) -> ColT:
     # decimal MUL multiplies unscaled values (scales add); only additive and
     # comparison ops align operand scales
     if op in (Op.ADD, Op.SUB, Op.MUL, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT,
-              Op.GE, Op.DIV):
+              Op.GE, Op.DIV, Op.GREATEST, Op.LEAST):
         args, ts = _descale_mixed_np(args, ts)
     if op in (Op.ADD, Op.SUB, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT,
-              Op.GE, Op.MOD):
+              Op.GE, Op.MOD, Op.GREATEST, Op.LEAST):
         args = _align_dec(op, args, ts)
     simple = {
         Op.EQ: np.equal, Op.NE: np.not_equal, Op.LT: np.less,
         Op.LE: np.less_equal, Op.GT: np.greater, Op.GE: np.greater_equal,
         Op.ADD: np.add, Op.SUB: np.subtract, Op.MUL: np.multiply,
         Op.XOR: np.bitwise_xor,
+        Op.GREATEST: np.maximum, Op.LEAST: np.minimum,
     }
     if op in simple:
         (a, va), (b, vb) = args
@@ -313,18 +314,23 @@ def _apply_op(op, expr, args, ts, cols, types, dicts, n) -> ColT:
                 return (a.astype(np.float64) / 10 ** ta.scale).astype(target), va
             return (a // 10 ** ta.scale).astype(target), va
         return a.astype(target), va
-    if op in (Op.YEAR, Op.MONTH):
+    if op in (Op.YEAR, Op.MONTH, Op.DAY):
         a, va = args[0]
         ta = ts[0]
         days = a // 86_400_000_000 if ta.kind == dtypes.Kind.TIMESTAMP else a
         dt = days.astype("datetime64[D]")
         if op is Op.YEAR:
             return dt.astype("datetime64[Y]").astype(int) + 1970, va
-        m = (dt.astype("datetime64[M]").astype(int) % 12) + 1
-        return m.astype(np.int32), va
-    if op in (Op.SQRT, Op.EXP, Op.LN, Op.FLOOR, Op.CEIL, Op.ROUND):
+        if op is Op.MONTH:
+            m = (dt.astype("datetime64[M]").astype(int) % 12) + 1
+            return m.astype(np.int32), va
+        dom = (dt - dt.astype("datetime64[M]")).astype(int) + 1
+        return dom.astype(np.int32), va
+    if op in (Op.SQRT, Op.EXP, Op.LN, Op.LOG10, Op.FLOOR, Op.CEIL,
+              Op.ROUND, Op.SIGN):
         f = {Op.SQRT: np.sqrt, Op.EXP: np.exp, Op.LN: np.log,
-             Op.FLOOR: np.floor, Op.CEIL: np.ceil, Op.ROUND: np.round}[op]
+             Op.LOG10: np.log10, Op.FLOOR: np.floor, Op.CEIL: np.ceil,
+             Op.ROUND: np.round, Op.SIGN: np.sign}[op]
         a, va = args[0]
         return f(a), va
     if op is Op.POW:

@@ -744,6 +744,10 @@ def _literal_value(e: ast.Expr, t: dtypes.LogicalType):
                 return e.value.encode(), True
             raise PlanError(f"string literal for {t}")
         if e.kind == "decimal":
+            if t.is_floating:
+                # fractional literal into a float/double column: the
+                # decimal-scaling path would round 0.5 to integral 0
+                return float(e.value), True
             import decimal as pydec
 
             return int(

@@ -23,6 +23,7 @@ import numpy as np
 
 from ydb_tpu import dtypes
 from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.blocks.dictionary import _as_bytes as _as_b
 from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, Transform
 from ydb_tpu.sql import ast
 from ydb_tpu.ssa.ops import Agg, Op
@@ -336,15 +337,22 @@ class _Lower:
 
     # -- string-column helpers --
 
+    # FuncCalls producing a (dictionary-encoded) string column
+    _STRING_FUNCS = frozenset({
+        "substring", "upper", "lower", "trim", "ltrim", "rtrim",
+        "replace", "concat",
+    })
+
     def _as_string_col(self, e, what: str) -> str:
-        """Column name of a string-valued operand; lowers substring()
-        to a hidden DictMap column on the fly."""
+        """Column name of a string-valued operand; lowers string
+        transforms (substring/upper/...) to hidden DictMap columns on
+        the fly."""
         if isinstance(e, ast.Name):
             col = self.name_of(e)
             if not self.types.get(col, dtypes.INT64).is_string:
                 raise PlanError(f"{what} needs a string column operand")
             return col
-        if isinstance(e, ast.FuncCall) and e.name == "substring":
+        if isinstance(e, ast.FuncCall) and e.name in self._STRING_FUNCS:
             lowered = self.lower(e)  # DictMap assign via emit
             assert isinstance(lowered, Col)
             return lowered.name
@@ -357,7 +365,38 @@ class _Lower:
             except PlanError:
                 return False
             return self.types.get(col, dtypes.INT64).is_string
-        return isinstance(e, ast.FuncCall) and e.name == "substring"
+        return isinstance(e, ast.FuncCall) and \
+            e.name in self._STRING_FUNCS
+
+    def _dict_map(self, col: str, kind: str, args: tuple,
+                  out_type=dtypes.STRING) -> Col:
+        """Hidden column holding a plan-time dictionary transform of
+        ``col`` (substr/upper/replace/strlen/... — every string op is
+        an id-indexed table built once over the dictionary)."""
+        if args:
+            # collision-free tag: short args stay readable, anything
+            # long/exotic goes through a stable digest
+            import hashlib
+
+            rep = repr(args)
+            tag = (rep if len(rep) <= 32 else
+                   hashlib.blake2b(rep.encode(),
+                                   digest_size=6).hexdigest())
+            tag = "".join(c if c.isalnum() else "_" for c in tag)
+            hidden = f"__{kind}_{col}_{tag}"
+        else:
+            hidden = f"__{kind}_{col}"
+        if hidden not in self.types:
+            self.emit_assign(
+                hidden, DictMap(col, kind, args, hidden), out_type)
+            if out_type.is_string:
+                # the output dictionary populates at compile time;
+                # register it now so downstream plan steps (xrank
+                # comparisons, nested transforms) see it exists
+                self.dict_src[hidden] = hidden
+                if self.dicts is not None:
+                    self.dicts.for_column(hidden)
+        return Col(hidden)
 
     def _xrank(self, e, peer) -> Col:
         """Hidden int column: e's dictionary ids translated to ranks in
@@ -518,29 +557,68 @@ class _Lower:
                     f"interval unit {unit} only folds against constant"
                     " dates")
             return Const(n * days, dtypes.INT32)
-        if e.name in ("year", "month"):
-            op = Op.YEAR if e.name == "year" else Op.MONTH
+        if e.name in ("year", "month", "day"):
+            op = {"year": Op.YEAR, "month": Op.MONTH,
+                  "day": Op.DAY}[e.name]
             return Call(op, self.lower(e.args[0]))
+        if e.name in ("greatest", "least"):
+            if any(self._is_string_operand(a) for a in e.args):
+                # dictionary ids carry no order; a string greatest
+                # would need a union-dict gather-back, not an int max
+                raise PlanError(
+                    f"{e.name} on string columns is not supported")
+            op = Op.GREATEST if e.name == "greatest" else Op.LEAST
+            out = self.lower(e.args[0])
+            for arg in e.args[1:]:  # n-ary folds into binary chains
+                out = Call(op, out, self.lower(arg))
+            return out
         if e.name == "substring":
             col = self._as_string_col(e.args[0], "substring")
             if not (isinstance(e.args[1], ast.Literal)
                     and isinstance(e.args[2], ast.Literal)):
                 raise PlanError("substring bounds must be literals")
             start, length = int(e.args[1].value), int(e.args[2].value)
-            hidden = f"__substr_{col}_{start}_{length}"
-            if hidden not in self.types:
-                self.emit_assign(
-                    hidden,
-                    DictMap(col, "substr", (start, length), hidden),
-                    dtypes.STRING,
-                )
-                # DictMap populates the output dictionary under `hidden`
-                # at compile time; register it now so downstream plan
-                # steps (e.g. xrank comparisons) see it exists
-                self.dict_src[hidden] = hidden
-                if self.dicts is not None:
-                    self.dicts.for_column(hidden)
-            return Col(hidden)
+            return self._dict_map(col, "substr", (start, length))
+        if e.name in ("upper", "lower", "trim", "ltrim", "rtrim"):
+            col = self._as_string_col(e.args[0], e.name)
+            return self._dict_map(col, e.name, ())
+        if e.name == "replace":
+            col = self._as_string_col(e.args[0], "replace")
+            old, new = e.args[1], e.args[2]
+            if not (isinstance(old, ast.Literal)
+                    and isinstance(new, ast.Literal)):
+                raise PlanError("replace patterns must be literals")
+            return self._dict_map(
+                col, "replace",
+                (_as_b(old.value), _as_b(new.value)))
+        if e.name == "concat":
+            # string column ++ literal (either order): a plan-time
+            # dictionary transform, like every string op here
+            a, b = e.args[0], e.args[1]
+            if isinstance(b, ast.Literal) and b.kind == "string":
+                col = self._as_string_col(a, "concat")
+                return self._dict_map(col, "concat_suffix",
+                                      (_as_b(b.value),))
+            if isinstance(a, ast.Literal) and a.kind == "string":
+                col = self._as_string_col(b, "concat")
+                return self._dict_map(col, "concat_prefix",
+                                      (_as_b(a.value),))
+            raise PlanError("concat needs one string literal operand")
+        if e.name in ("length", "strlen"):  # byte length (String type)
+            col = self._as_string_col(e.args[0], "length")
+            hidden = self._dict_map(col, "strlen", (),
+                                    out_type=dtypes.INT32)
+            return hidden
+        if e.name in ("starts_with", "ends_with"):
+            col = self._as_string_col(e.args[0], e.name)
+            lit = e.args[1]
+            if not (isinstance(lit, ast.Literal)
+                    and lit.kind == "string"):
+                raise PlanError(f"{e.name} needs a string literal")
+            if e.name == "starts_with":
+                return DictPredicate(col, "prefix", lit.value)
+            return DictPredicate(col, "custom",
+                                 ("suffix", _as_b(lit.value)))
         if e.name.startswith("cast_"):
             target = e.name[5:]
             op = {"int32": Op.CAST_INT32, "int64": Op.CAST_INT64,
@@ -550,8 +628,10 @@ class _Lower:
                 raise PlanError(f"cast to {target}")
             return Call(op, self.lower(e.args[0]))
         simple = {"abs": Op.ABS, "sqrt": Op.SQRT, "exp": Op.EXP,
-                  "ln": Op.LN, "floor": Op.FLOOR, "ceil": Op.CEIL,
-                  "round": Op.ROUND, "coalesce": Op.COALESCE}
+                  "ln": Op.LN, "log10": Op.LOG10, "floor": Op.FLOOR,
+                  "ceil": Op.CEIL, "round": Op.ROUND,
+                  "sign": Op.SIGN, "power": Op.POW, "pow": Op.POW,
+                  "coalesce": Op.COALESCE}
         if e.name in simple:
             return Call(simple[e.name], *[self.lower(a) for a in e.args])
         if e.name in self.udfs:

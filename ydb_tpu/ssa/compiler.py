@@ -350,6 +350,28 @@ def dict_map_table(d, out_d, kind: str, args: tuple) -> np.ndarray:
         start, length = args  # SQL 1-based start
         lo = start - 1
         out = [out_d.add(v[lo:lo + length]) for v in d.values]
+    elif kind == "upper":
+        out = [out_d.add(v.upper()) for v in d.values]
+    elif kind == "lower":
+        out = [out_d.add(v.lower()) for v in d.values]
+    elif kind == "trim":
+        out = [out_d.add(v.strip()) for v in d.values]
+    elif kind == "ltrim":
+        out = [out_d.add(v.lstrip()) for v in d.values]
+    elif kind == "rtrim":
+        out = [out_d.add(v.rstrip()) for v in d.values]
+    elif kind == "replace":
+        old, new = args
+        out = [out_d.add(v.replace(old, new)) for v in d.values]
+    elif kind == "concat_suffix":
+        (lit,) = args
+        out = [out_d.add(v + lit) for v in d.values]
+    elif kind == "concat_prefix":
+        (lit,) = args
+        out = [out_d.add(lit + v) for v in d.values]
+    elif kind == "strlen":
+        # int output: byte length per dictionary value (no out dict)
+        out = [len(v) for v in d.values]
     elif kind == "xrank":
         # cross-dictionary compare: rank each value within the sorted
         # union of this column's and the peer column's dictionaries
@@ -383,16 +405,19 @@ def _resolve_dict_map(ctx: _Lowering, m: DictMap, cur_types):
     def lower(env, aux, _key=key, _col=col):
         return kernels.dict_gather(aux[_key], env[_col])
 
-    return lower, (dtypes.INT32 if m.kind == "xrank" else dtypes.STRING)
+    return lower, (dtypes.INT32 if m.kind in ("xrank", "strlen")
+                   else dtypes.STRING)
 
 
 def _custom_dict_mask(d, pattern) -> np.ndarray:
     """Plan-time masks beyond the fixed kinds. ("ord", op, val) = ordered
     byte-string comparison evaluated over the dictionary values."""
+    from ydb_tpu.blocks.dictionary import _as_bytes
+
     tag = pattern[0]
     if tag == "ord":
         _, op, val = pattern
-        val = val if isinstance(val, bytes) else str(val).encode()
+        val = _as_bytes(val)
         cmp = {
             "lt": lambda v: v < val,
             "le": lambda v: v <= val,
@@ -400,6 +425,10 @@ def _custom_dict_mask(d, pattern) -> np.ndarray:
             "ge": lambda v: v >= val,
         }[op]
         return d.match_mask(cmp)
+    if tag == "suffix":
+        _, val = pattern
+        val = _as_bytes(val)
+        return d.match_mask(lambda v: v.endswith(val))
     raise NotImplementedError(f"custom dict predicate {tag}")
 
 
@@ -414,6 +443,8 @@ _SIMPLE_BINOPS = {
     Op.SUB: lambda a, b: a - b,
     Op.MUL: lambda a, b: a * b,
     Op.XOR: lambda a, b: a ^ b,
+    Op.GREATEST: jnp.maximum,
+    Op.LEAST: jnp.minimum,
 }
 
 _SIMPLE_UNOPS = {
@@ -423,9 +454,11 @@ _SIMPLE_UNOPS = {
     Op.SQRT: jnp.sqrt,
     Op.EXP: jnp.exp,
     Op.LN: jnp.log,
+    Op.LOG10: lambda a: jnp.log(a) / jnp.log(10.0),
     Op.FLOOR: jnp.floor,
     Op.CEIL: jnp.ceil,
     Op.ROUND: jnp.round,
+    Op.SIGN: jnp.sign,
 }
 
 
@@ -440,11 +473,11 @@ def _resolve_call(ctx: _Lowering, call: Call, cur_types, resolve_expr):
     # comparison/arithmetic then runs in double — exactness is already
     # lost the moment a float entered)
     if op in (Op.ADD, Op.SUB, Op.MUL, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT,
-              Op.GE, Op.DIV):
+              Op.GE, Op.DIV, Op.GREATEST, Op.LEAST):
         fns, ts = _descale_mixed(fns, ts)
     # rescale decimal operands to a common scale for add/sub/compare
     if op in (Op.ADD, Op.SUB, Op.EQ, Op.NE, Op.LT, Op.LE, Op.GT, Op.GE,
-              Op.MOD):
+              Op.MOD, Op.GREATEST, Op.LEAST):
         fns, ts = _align_decimals(op, call, fns, ts)
 
     if op in _SIMPLE_BINOPS and len(fns) == 2:
@@ -575,11 +608,11 @@ def _resolve_call(ctx: _Lowering, call: Call, cur_types, resolve_expr):
             return Column(d.astype(_tp), a.validity)
 
         return lower, out_t
-    if op in (Op.YEAR, Op.MONTH):
+    if op in (Op.YEAR, Op.MONTH, Op.DAY):
         fa = fns[0]
         ta = ts[0]
         is_ts = ta.kind == dtypes.Kind.TIMESTAMP
-        part = 0 if op is Op.YEAR else 1
+        part = {Op.YEAR: 0, Op.MONTH: 1, Op.DAY: 2}[op]
 
         def lower(env, aux, _fa=fa, _ts=is_ts, _p=part):
             a = _fa(env, aux)

@@ -38,10 +38,14 @@ class Op(enum.Enum):
     SQRT = "sqrt"
     EXP = "exp"
     LN = "ln"
+    LOG10 = "log10"
     FLOOR = "floor"
     CEIL = "ceil"
     ROUND = "round"
     POW = "pow"
+    SIGN = "sign"
+    GREATEST = "greatest"
+    LEAST = "least"
     # null handling
     IS_NULL = "is_null"
     IS_NOT_NULL = "is_not_null"
@@ -55,6 +59,7 @@ class Op(enum.Enum):
     # date parts (DATE=int32 days / TIMESTAMP=int64 us)
     YEAR = "year"
     MONTH = "month"
+    DAY = "day"
     # string ops on dictionary ids (plan-time resolved masks)
     DICT_GATHER = "dict_gather"   # aux table lookup by id (masks, ranks)
     IN_SET = "in_set"

@@ -220,11 +220,17 @@ def infer_type(
         return dtypes.INT64
     if op in (Op.CAST_FLOAT,):
         return dtypes.FLOAT
-    if op in (Op.CAST_DOUBLE, Op.SQRT, Op.EXP, Op.LN, Op.POW):
+    if op in (Op.CAST_DOUBLE, Op.SQRT, Op.EXP, Op.LN, Op.LOG10,
+              Op.POW):
         return dtypes.DOUBLE
-    if op in (Op.YEAR, Op.MONTH):
+    if op in (Op.YEAR, Op.MONTH, Op.DAY):
         return dtypes.INT32
     arg_ts = [infer_type(a, schema, assigned) for a in expr.args]
+    if op is Op.SIGN:
+        # sign's output (-1/0/1) is NOT in a decimal arg's scaled
+        # domain; type it as plain int (physical stays int64)
+        return (dtypes.INT64 if arg_ts[0].is_decimal
+                else arg_ts[0])
     if op in (Op.NEG, Op.ABS, Op.FLOOR, Op.CEIL, Op.ROUND):
         return arg_ts[0]
     if op in (Op.COALESCE,):
@@ -233,6 +239,8 @@ def infer_type(
         return arg_ts[1]
     if op in (Op.ADD, Op.SUB, Op.MUL, Op.DIV, Op.MOD):
         return _numeric_result(op, arg_ts)
+    if op in (Op.GREATEST, Op.LEAST):
+        return _numeric_result(Op.ADD, arg_ts)
     if op is Op.DICT_GATHER:
         raise TypeError("DICT_GATHER is lowered internally, not user-facing")
     raise NotImplementedError(f"type inference for {op}")
