@@ -9,7 +9,9 @@ def test_clickbench_queries_match_reference():
     results = run_clickbench(rows=20_000, seed=3, verify=True)
     assert len(results) == len(QUERIES)
     for name, seconds, rows in results:
-        assert rows >= 1
+        # q18 filters on a fixed spec UserID constant that synthetic
+        # data never contains: a verified-empty result is correct
+        assert rows >= 1 or name == "q18"
 
 
 def test_clickbench_cli_verb(capsys):

@@ -326,6 +326,15 @@ def _apply_op(op, expr, args, ts, cols, types, dicts, n) -> ColT:
             return m.astype(np.int32), va
         dom = (dt - dt.astype("datetime64[M]")).astype(int) + 1
         return dom.astype(np.int32), va
+    if op in (Op.HOUR, Op.MINUTE):
+        a, va = args[0]
+        if ts[0].kind != dtypes.Kind.TIMESTAMP:
+            # identical semantics to the JAX lowering: sub-day parts
+            # of a DATE are an error, not silent zeros
+            raise TypeError(f"{op} needs a timestamp operand")
+        div = 3_600_000_000 if op is Op.HOUR else 60_000_000
+        mod = 24 if op is Op.HOUR else 60
+        return ((a // div) % mod).astype(np.int32), va
     if op in (Op.SQRT, Op.EXP, Op.LN, Op.LOG10, Op.FLOOR, Op.CEIL,
               Op.ROUND, Op.SIGN):
         f = {Op.SQRT: np.sqrt, Op.EXP: np.exp, Op.LN: np.log,

@@ -557,9 +557,9 @@ class _Lower:
                     f"interval unit {unit} only folds against constant"
                     " dates")
             return Const(n * days, dtypes.INT32)
-        if e.name in ("year", "month", "day"):
-            op = {"year": Op.YEAR, "month": Op.MONTH,
-                  "day": Op.DAY}[e.name]
+        if e.name in ("year", "month", "day", "hour", "minute"):
+            op = {"year": Op.YEAR, "month": Op.MONTH, "day": Op.DAY,
+                  "hour": Op.HOUR, "minute": Op.MINUTE}[e.name]
             return Call(op, self.lower(e.args[0]))
         if e.name in ("greatest", "least"):
             if any(self._is_string_operand(a) for a in e.args):

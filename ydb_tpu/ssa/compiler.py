@@ -621,6 +621,19 @@ def _resolve_call(ctx: _Lowering, call: Call, cur_types, resolve_expr):
             return Column(parts[_p], a.validity)
 
         return lower, out_t
+    if op in (Op.HOUR, Op.MINUTE):
+        fa = fns[0]
+        if ts[0].kind != dtypes.Kind.TIMESTAMP:
+            raise TypeError(f"{op} needs a timestamp operand")
+        div = 3_600_000_000 if op is Op.HOUR else 60_000_000
+        mod = 24 if op is Op.HOUR else 60
+
+        def lower(env, aux, _fa=fa, _d=div, _m=mod):
+            a = _fa(env, aux)
+            return Column(
+                ((a.data // _d) % _m).astype(jnp.int32), a.validity)
+
+        return lower, out_t
     if op is Op.IN_SET:
         # IN over numeric literals: OR of equalities
         fa = fns[0]

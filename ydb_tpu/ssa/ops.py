@@ -60,6 +60,8 @@ class Op(enum.Enum):
     YEAR = "year"
     MONTH = "month"
     DAY = "day"
+    HOUR = "hour"
+    MINUTE = "minute"
     # string ops on dictionary ids (plan-time resolved masks)
     DICT_GATHER = "dict_gather"   # aux table lookup by id (masks, ranks)
     IN_SET = "in_set"

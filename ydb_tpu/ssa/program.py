@@ -223,7 +223,7 @@ def infer_type(
     if op in (Op.CAST_DOUBLE, Op.SQRT, Op.EXP, Op.LN, Op.LOG10,
               Op.POW):
         return dtypes.DOUBLE
-    if op in (Op.YEAR, Op.MONTH, Op.DAY):
+    if op in (Op.YEAR, Op.MONTH, Op.DAY, Op.HOUR, Op.MINUTE):
         return dtypes.INT32
     arg_ts = [infer_type(a, schema, assigned) for a in expr.args]
     if op is Op.SIGN:

@@ -27,13 +27,17 @@ HITS_SCHEMA = dtypes.schema(
     ("WatchID", dtypes.INT64, False),
     ("UserID", dtypes.INT64, False),
     ("EventDate", dtypes.DATE, False),
+    ("EventTime", dtypes.TIMESTAMP, False),
     ("CounterID", dtypes.INT32, False),
     ("RegionID", dtypes.INT32, False),
     ("AdvEngineID", dtypes.INT32, False),
+    ("SearchEngineID", dtypes.INT32, False),
     ("ResolutionWidth", dtypes.INT32, False),
     ("MobilePhone", dtypes.INT32, False),
     ("MobilePhoneModel", dtypes.STRING, False),
     ("SearchPhrase", dtypes.STRING, False),
+    ("URL", dtypes.STRING, False),
+    ("Title", dtypes.STRING, False),
 )
 
 _PHONE_MODELS = [b"", b"iPhone 2", b"iPhone 4", b"Nokia 3310",
@@ -78,21 +82,53 @@ class ClickBenchData:
             rng.random(n) < 0.9, 0,
             1 + _zipf_choice(rng, len(_PHONE_MODELS) - 1, n))
 
+        # URLs: a skewed pool of synthetic paths; 2 of 7 hosts are
+        # google.* so ~29% of rows match the LIKE '%google%' queries
+        hosts = [b"example.com", b"news.site", b"google.com",
+                 b"shop.io", b"google.de", b"docs.org", b"blog.net"]
+        url_pool = [
+            b"http://%s/%s/%d" % (rng.choice(hosts),
+                                  rng.choice(_PHRASE_WORDS),
+                                  rng.integers(0, 100))
+            for _ in range(2000)
+        ]
+        url_d = self.dicts.for_column("URL")
+        url_ids = np.array([url_d.add(u) for u in url_pool],
+                           dtype=np.int32)
+        title_pool = [b"" ] + [
+            b"%s - page %d" % (rng.choice(_PHRASE_WORDS),
+                               rng.integers(0, 50))
+            for _ in range(499)
+        ]
+        title_d = self.dicts.for_column("Title")
+        title_ids = np.array([title_d.add(t) for t in title_pool],
+                             dtype=np.int32)
+
+        dates = (d0 + rng.integers(0, 31, n)).astype(np.int32)
         self.hits: dict[str, np.ndarray] = {
             "WatchID": rng.integers(1, 1 << 62, n, dtype=np.int64),
             "UserID": (_zipf_choice(rng, n_users, n) + 1),
-            "EventDate": (d0 + rng.integers(0, 31, n)).astype(np.int32),
+            "EventDate": dates,
+            "EventTime": (dates.astype(np.int64) * 86_400_000_000
+                          + rng.integers(0, 86_400, n) * 1_000_000),
             "CounterID": rng.integers(1, 10_000, n, dtype=np.int32),
             "RegionID": _zipf_choice(rng, 5000, n).astype(np.int32),
             "AdvEngineID": np.where(
                 rng.random(n) < 0.95, 0,
                 rng.integers(1, 20, n)).astype(np.int32),
+            "SearchEngineID": np.where(
+                rng.random(n) < 0.7, 0,
+                rng.integers(1, 8, n)).astype(np.int32),
             "ResolutionWidth": rng.choice(
                 np.array([1024, 1280, 1366, 1440, 1536, 1600, 1920],
                          dtype=np.int32), size=n),
             "MobilePhone": rng.integers(0, 8, n, dtype=np.int32),
             "MobilePhoneModel": model_ids[model_pick],
             "SearchPhrase": phrase_ids[phrase_pick],
+            "URL": url_ids[_zipf_choice(rng, len(url_pool), n)],
+            "Title": title_ids[np.where(
+                rng.random(n) < 0.3, 0,
+                1 + _zipf_choice(rng, len(title_pool) - 1, n))],
         }
 
     def schema(self, table: str = "hits") -> dtypes.Schema:
@@ -134,6 +170,30 @@ QUERIES = {
     "q13": ("select SearchPhrase, count(distinct UserID) as u from hits "
             "where SearchPhrase <> '' group by SearchPhrase "
             "order by u desc, SearchPhrase limit 10"),
+    "q14": ("select SearchEngineID, SearchPhrase, count(*) as c "
+            "from hits where SearchPhrase <> '' "
+            "group by SearchEngineID, SearchPhrase "
+            "order by c desc, SearchEngineID, SearchPhrase limit 10"),
+    "q15": ("select UserID, count(*) as c from hits group by UserID "
+            "order by c desc, UserID limit 10"),
+    "q16": ("select UserID, SearchPhrase, count(*) as c from hits "
+            "group by UserID, SearchPhrase "
+            "order by c desc, UserID, SearchPhrase limit 10"),
+    "q17": ("select UserID, extract(minute from EventTime) as m, "
+            "SearchPhrase, count(*) as c from hits "
+            "group by UserID, extract(minute from EventTime), "
+            "SearchPhrase order by c desc, UserID, m, SearchPhrase "
+            "limit 10"),
+    "q18": "select UserID from hits where UserID = 43509093289964",
+    "q19": ("select count(*) as c from hits "
+            "where URL like '%google%'"),
+    "q20": ("select SearchPhrase, min(URL) as u, count(*) as c "
+            "from hits where URL like '%google%' "
+            "and SearchPhrase <> '' group by SearchPhrase "
+            "order by c desc, SearchPhrase limit 10"),
+    "q21": ("select Title, count(*) as c from hits "
+            "where Title <> '' and URL like '%google%' "
+            "group by Title order by c desc, Title limit 10"),
 }
 
 
@@ -197,6 +257,45 @@ def reference_answers(data: ClickBenchData) -> dict[str, object]:
             u13[p].add(u)
     out["q13"] = sorted(((k, len(v)) for k, v in u13.items()),
                         key=lambda kv: (-kv[1], kv[0]))[:10]
+
+    urls = np.array(data.dicts["URL"].values + [b""],
+                    dtype=object)[h["URL"]]
+    titles = np.array(data.dicts["Title"].values + [b""],
+                      dtype=object)[h["Title"]]
+    c14 = collections.Counter(
+        (e, p) for e, p in zip(h["SearchEngineID"].tolist(), phrases)
+        if p != b"")
+    out["q14"] = sorted(
+        ((k, v) for k, v in c14.items()),
+        key=lambda kv: (-kv[1], kv[0][0], kv[0][1]))[:10]
+    c15 = collections.Counter(h["UserID"].tolist())
+    out["q15"] = sorted(c15.items(),
+                        key=lambda kv: (-kv[1], kv[0]))[:10]
+    c16 = collections.Counter(zip(h["UserID"].tolist(), phrases))
+    out["q16"] = sorted(c16.items(),
+                        key=lambda kv: (-kv[1], kv[0][0], kv[0][1]))[:10]
+    minutes = ((h["EventTime"] // 60_000_000) % 60).tolist()
+    c17 = collections.Counter(
+        zip(h["UserID"].tolist(), minutes, phrases))
+    out["q17"] = sorted(
+        c17.items(),
+        key=lambda kv: (-kv[1], kv[0][0], kv[0][1], kv[0][2]))[:10]
+    out["q18"] = [u for u in h["UserID"].tolist()
+                  if u == 43509093289964]
+    googley = np.array([b"google" in u for u in urls])
+    out["q19"] = int(googley.sum())
+    g20: dict = {}
+    for u, p, g in zip(urls, phrases, googley):
+        if g and p != b"":
+            st = g20.setdefault(p, [u, 0])
+            st[0] = min(st[0], u)
+            st[1] += 1
+    out["q20"] = sorted(((k, v[0], v[1]) for k, v in g20.items()),
+                        key=lambda kv: (-kv[2], kv[0]))[:10]
+    c21 = collections.Counter(
+        t for t, g in zip(titles, googley) if g and t != b"")
+    out["q21"] = sorted(c21.items(),
+                        key=lambda kv: (-kv[1], kv[0]))[:10]
     return out
 
 
@@ -223,10 +322,11 @@ def run_clickbench(rows: int = 100_000, queries=None, iterations: int = 1,
     names = queries or sorted(QUERIES, key=lambda q: int(q[1:]))
     results = []
     for name in names:
-        plan = plan_select_full(parse(QUERIES[name]), catalog).plan
+        pq = plan_select_full(parse(QUERIES[name]), catalog)
+        plan = pq.plan
         out = to_host(execute_plan(plan, db))  # warmup/compile
         if verify:
-            _verify(name, out, want[name], data)
+            _verify(name, out, want[name], data, pq)
         best = float("inf")
         for _ in range(max(1, iterations)):
             t0 = time.monotonic()
@@ -236,12 +336,13 @@ def run_clickbench(rows: int = 100_000, queries=None, iterations: int = 1,
     return results
 
 
-def _verify(name: str, out, want, data) -> None:
+def _verify(name: str, out, want, data, pq=None) -> None:
     def ints(col):
         return [int(v) for v in np.asarray(out.cols[col][0])]
 
     def strs(col):
-        return data.dicts[col].decode(np.asarray(out.cols[col][0]))
+        src = pq.dict_aliases.get(col, col) if pq is not None else col
+        return data.dicts[src].decode(np.asarray(out.cols[col][0]))
 
     if name in ("q0", "q1"):
         assert ints("c")[0] == want, (name, ints("c"), want)
@@ -282,6 +383,32 @@ def _verify(name: str, out, want, data) -> None:
     elif name in ("q12", "q13"):
         col = "c" if name == "q12" else "u"
         got = list(zip(strs("SearchPhrase"), ints(col)))
+        assert got == want, (name, got[:3], want[:3])
+    elif name == "q14":
+        got = [((e, p), c) for e, p, c in zip(
+            ints("SearchEngineID"), strs("SearchPhrase"), ints("c"))]
+        assert got == want, (name, got[:3], want[:3])
+    elif name == "q15":
+        got = list(zip(ints("UserID"), ints("c")))
+        assert got == want, (name, got[:3], want[:3])
+    elif name == "q16":
+        got = [((u, p), c) for u, p, c in zip(
+            ints("UserID"), strs("SearchPhrase"), ints("c"))]
+        assert got == want, (name, got[:3], want[:3])
+    elif name == "q17":
+        got = [((u, m, p), c) for u, m, p, c in zip(
+            ints("UserID"), ints("m"), strs("SearchPhrase"),
+            ints("c"))]
+        assert got == want, (name, got[:3], want[:3])
+    elif name == "q18":
+        assert ints("UserID") == want if out.num_rows else want == []
+    elif name == "q19":
+        assert ints("c")[0] == want, (name, ints("c"), want)
+    elif name == "q20":
+        got = list(zip(strs("SearchPhrase"), strs("u"), ints("c")))
+        assert got == want, (name, got[:3], want[:3])
+    elif name == "q21":
+        got = list(zip(strs("Title"), ints("c")))
         assert got == want, (name, got[:3], want[:3])
     else:
         raise KeyError(name)
