@@ -74,6 +74,27 @@ def test_math_functions(session):
     assert col(out, "le") == [1, -7, 0]
 
 
+def test_hour_minute_on_timestamps():
+    from ydb_tpu.engine.oracle import OracleTable  # noqa: F401
+    from ydb_tpu.kqp.session import Cluster
+
+    s = Cluster().session()
+    s.execute("CREATE TABLE ev (id int64, ts timestamp, "
+              "PRIMARY KEY (id))")
+    # 2024-03-07 13:45:07 UTC in microseconds
+    us = (19789 * 86_400 + 13 * 3600 + 45 * 60 + 7) * 1_000_000
+    s.execute(f"INSERT INTO ev VALUES (1, {us})")
+    out = s.execute("SELECT extract(hour from ts) AS h, "
+                    "extract(minute from ts) AS m FROM ev")
+    assert int(out.column("h")[0]) == 13
+    assert int(out.column("m")[0]) == 45
+    # DATE operands are rejected identically on both engines
+    s.execute("CREATE TABLE dd (id int64, d date, PRIMARY KEY (id))")
+    s.execute("INSERT INTO dd VALUES (1, date '2024-01-01')")
+    with pytest.raises(Exception, match="timestamp"):
+        s.execute("SELECT extract(hour from d) AS h FROM dd")
+
+
 def test_date_parts(session):
     out = session.execute(
         "SELECT id, extract(year from d) AS y, "

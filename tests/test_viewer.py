@@ -71,6 +71,24 @@ def test_health_whiteboard_counters(served):
     assert st == 200 and b"# TYPE" in prom or prom != b""
 
 
+def test_tablet_counters_aggregation(served):
+    cluster, v = served
+    data = json.loads(get(v, "/viewer/json/tablets")[2])
+    rows = data["tablets"]
+    assert rows, "no tablets collected"
+    assert all(r["tx_committed"] <= r["tx_executed"] for r in rows)
+    # the scheme tablet and the topic partition both show up
+    types = {r["type"] for r in rows}
+    assert "pq" in types
+    agg = data["aggregates"]
+    for t, a in agg.items():
+        mine = [r for r in rows if r["type"] == t]
+        assert a["tablets"] == len(mine)
+        assert a["redo_bytes"] == sum(r["redo_bytes"] for r in mine)
+    # durable writes happened, so redo bytes are nonzero somewhere
+    assert sum(a["redo_bytes"] for a in agg.values()) > 0
+
+
 def test_sysview_listing_and_rows(served):
     _cluster, v = served
     names = json.loads(get(v, "/viewer/json/sysview")[2])

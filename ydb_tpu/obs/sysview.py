@@ -41,6 +41,12 @@ SYS_SCHEMAS = {
     # memory observability (memory profiling row): process + device
     "sys_memory": dtypes.schema(
         ("metric", dtypes.STRING), ("value", dtypes.DOUBLE)),
+    # per-tablet executor counters (tablet_counters_aggregator feed)
+    "sys_tablet_counters": dtypes.schema(
+        ("tablet_id", dtypes.STRING), ("type", dtypes.STRING),
+        ("generation", dtypes.INT32), ("tx_executed", dtypes.INT64),
+        ("tx_committed", dtypes.INT64), ("redo_bytes", dtypes.INT64),
+        ("checkpoints", dtypes.INT64)),
 }
 
 
@@ -159,6 +165,19 @@ def _memory_rows(cluster):
     return [keys, [float(st[k]) for k in keys]]
 
 
+def _tablet_counters_rows(cluster):
+    from ydb_tpu.obs.tablet_counters import collect
+
+    rows = collect(cluster)
+    return [[r["tablet_id"] for r in rows],
+            [r["type"] for r in rows],
+            [r["generation"] for r in rows],
+            [r["tx_executed"] for r in rows],
+            [r["tx_committed"] for r in rows],
+            [r["redo_bytes"] for r in rows],
+            [r["checkpoints"] for r in rows]]
+
+
 _BUILDERS = {
     "sys_partition_stats": _partition_stats_rows,
     "sys_query_stats": _query_stats_rows,
@@ -166,6 +185,7 @@ _BUILDERS = {
     "sys_table_stats": _table_stats_rows,
     "sys_audit": _audit_rows,
     "sys_memory": _memory_rows,
+    "sys_tablet_counters": _tablet_counters_rows,
 }
 
 

@@ -133,6 +133,7 @@ class Viewer:
             "/viewer/json/healthcheck": self._health,
             "/viewer/json/whiteboard": self._whiteboard,
             "/viewer/json/sysview": self._sysview,
+            "/viewer/json/tablets": self._tablets,
             "/counters": self._counters,
         }
         h = handlers.get(path)
@@ -211,6 +212,18 @@ class Viewer:
         if not names:
             return sorted(sysview.SYS_SCHEMAS)
         return sysview.sys_source(self.cluster, names[0])
+
+    def _tablets(self, query) -> dict:
+        """Per-tablet counters + per-type aggregates (the counters-
+        aggregator merge, tablet_counters_aggregator.cpp)."""
+        from ydb_tpu.obs import tablet_counters
+
+        rows = tablet_counters.collect(self.cluster)
+        return {
+            "tablets": rows,
+            "aggregates": tablet_counters.aggregate(
+                self.cluster, rows),
+        }
 
     def _counters(self, query) -> dict:
         return self.cluster.counters.snapshot()

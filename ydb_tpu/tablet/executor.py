@@ -98,6 +98,12 @@ class TabletExecutor:
         # commit lock (volatile readset exchange) still serialize
         # per-tablet here, so version/log_index never collide
         self._exec_lock = threading.Lock()
+        # per-tablet counters (tablet_counters*.cpp analog), merged
+        # cluster-wide by obs.tablet_counters.aggregate
+        self.counters = {
+            "tx_executed": 0, "tx_committed": 0, "redo_bytes": 0,
+            "checkpoints": 0,
+        }
 
     # ---- commit path ----
 
@@ -114,6 +120,7 @@ class TabletExecutor:
         with self._exec_lock:
             txc = TxContext(self.db, self.version + 1)
             tx.execute(txc, self)
+            self.counters["tx_executed"] += 1
             if txc.changes:
                 record = {
                     "gen": self.generation,
@@ -126,7 +133,10 @@ class TabletExecutor:
                 blob_id = (f"{self._prefix()}log/"
                            f"{self.generation:08d}."
                            f"{self.log_index:010d}")
-                self.store.put(blob_id, json.dumps(record).encode())
+                payload = json.dumps(record).encode()
+                self.store.put(blob_id, payload)
+                self.counters["tx_committed"] += 1
+                self.counters["redo_bytes"] += len(payload)
                 self.log_index += 1
                 self.db.apply(txc.changes, txc.version)
                 self.version = txc.version
@@ -179,6 +189,7 @@ class TabletExecutor:
             if (int(gen), int(ver)) < (self.generation, self.version):
                 self.store.delete(blob_id)
         self._since_snap = 0
+        self.counters["checkpoints"] += 1
 
     # ---- boot path ----
 
