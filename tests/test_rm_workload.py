@@ -1,0 +1,119 @@
+"""Resource manager + workload-service admission tests (reference:
+ydb/core/kqp/rm_service/kqp_rm_service.h:82,
+kqp_workload_service.cpp:37)."""
+
+import threading
+
+import pytest
+
+from ydb_tpu.kqp.rm import (
+    PoolOverloaded,
+    ResourceExhausted,
+    ResourceManager,
+    WorkloadService,
+)
+
+
+def test_rm_budgets_and_release():
+    rm = ResourceManager(memory_bytes=1000, compute_slots=2)
+    rm.acquire("q1", memory=600, slots=1)
+    rm.acquire("q2", memory=300, slots=1)
+    with pytest.raises(ResourceExhausted, match="memory"):
+        rm.acquire("q3", memory=200, slots=0)
+    with pytest.raises(ResourceExhausted, match="slots"):
+        rm.acquire("q4", memory=0, slots=1)
+    snap = rm.snapshot()
+    assert snap["memory_used"] == 900 and snap["slots_used"] == 2
+    rm.release("q1")
+    rm.acquire("q3", memory=200, slots=1)
+    # re-acquire for the same query replaces, not adds
+    rm.acquire("q3", memory=700, slots=1)
+    assert rm.snapshot()["memory_used"] == 1000
+
+
+def test_workload_admission_queue_fifo():
+    ws = WorkloadService()
+    ws.configure("etl", concurrent_limit=1, queue_size=2)
+    assert ws.admit("a", "etl")
+    assert not ws.admit("b", "etl")
+    assert not ws.admit("c", "etl")
+    with pytest.raises(PoolOverloaded):
+        ws.admit("d", "etl")
+    assert not ws.poll("c", "etl")  # b is ahead
+    ws.finish("a", "etl")
+    assert not ws.poll("c", "etl")  # still b's turn
+    assert ws.poll("b", "etl")
+    ws.finish("b", "etl")
+    assert ws.poll("c", "etl")
+    st = ws.stats("etl")
+    assert st["admitted"] == 3 and st["rejected"] == 1
+
+
+def test_workload_cancel_while_queued():
+    ws = WorkloadService()
+    ws.configure("p", concurrent_limit=1, queue_size=4)
+    ws.admit("a", "p")
+    ws.admit("b", "p")
+    ws.admit("c", "p")
+    ws.finish("b", "p")  # cancel in queue
+    ws.finish("a", "p")
+    assert ws.poll("c", "p")  # c skips the cancelled b
+
+
+def test_rm_exhaustion_waits_instead_of_failing():
+    """Pool-admitted queries wait for a compute slot rather than
+    surfacing ResourceExhausted (code-review regression)."""
+    import time
+
+    from ydb_tpu.kqp.session import Cluster
+
+    cluster = Cluster()
+    cluster.rm = ResourceManager(compute_slots=1)
+    s = cluster.session()
+    s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id))")
+    cluster.rm.acquire("hog", slots=1)  # external holder
+
+    def free_later():
+        time.sleep(0.2)
+        cluster.rm.release("hog")
+
+    t = threading.Thread(target=free_later)
+    t.start()
+    out = s.execute("SELECT count(*) AS c FROM t")  # waits ~200ms
+    t.join()
+    assert int(out.column("c")[0]) == 0
+    assert cluster.rm.snapshot()["slots_used"] == 0
+
+
+def test_session_admission_end_to_end():
+    from ydb_tpu.kqp.session import Cluster
+
+    cluster = Cluster()
+    cluster.workload = WorkloadService()
+    cluster.workload.configure("default", concurrent_limit=1,
+                               queue_size=8)
+    cluster.rm = ResourceManager(compute_slots=4)
+    s = cluster.session()
+    s.execute("CREATE TABLE t (id int64, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1), (2)")
+    out = s.execute("SELECT count(*) AS c FROM t")
+    assert int(out.column("c")[0]) == 2
+    # all grants returned after each statement
+    assert cluster.rm.snapshot()["slots_used"] == 0
+    assert cluster.workload.stats()["running"] == 0
+    assert cluster.workload.stats()["admitted"] >= 3
+
+    # two threads through a 1-wide pool: both finish (queue turn-taking)
+    results = []
+
+    def run(i):
+        sess = cluster.session()
+        out = sess.execute("SELECT count(*) AS c FROM t")
+        results.append(int(out.column("c")[0]))
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert results == [2, 2]

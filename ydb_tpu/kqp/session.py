@@ -113,6 +113,15 @@ class Cluster:
         from ydb_tpu.obs.metering import Metering
 
         self.metering = Metering()
+        # optional admission planes (kqp rm_service/workload_service):
+        # when set, every statement passes pool admission and books a
+        # compute slot for its duration
+        self.workload = None
+        self.rm = None
+        self._query_seq = 0
+        import threading
+
+        self._qid_lock = threading.Lock()
         # registered scalar UDFs: name -> (vectorized fn, result type)
         self.udfs: dict[str, tuple] = {}
         # live-tunable knobs (immediate control board)
@@ -782,7 +791,53 @@ class Session:
 
             c.counters.group(kind="throttled").counter("queries").inc()
             raise ThrottledError("request rate limit exceeded")
-        t0 = _time.monotonic()
+        t0 = _time.monotonic()  # BEFORE admission: queue wait is part
+        # of the latency operators observe
+        qid = None
+        if c.workload is not None or c.rm is not None:
+            with c._qid_lock:
+                c._query_seq += 1
+                qid = f"q{c._query_seq}"
+        deadline = t0 + 30.0
+        if c.workload is not None:
+            # pool admission: run now or condition-wait our queued turn
+            if not c.workload.admit(qid) and not \
+                    c.workload.wait_admitted(
+                        qid, timeout=deadline - _time.monotonic()):
+                c.workload.finish(qid)
+                from ydb_tpu.kqp.rm import PoolOverloaded
+
+                raise PoolOverloaded("admission wait timed out")
+        if c.rm is not None:
+            # the two planes' limits are independent: a pool-admitted
+            # query still waits (not fails) for a compute slot
+            from ydb_tpu.kqp.rm import ResourceExhausted
+
+            while True:
+                try:
+                    c.rm.acquire(qid, slots=1)
+                    break
+                except ResourceExhausted:
+                    if _time.monotonic() > deadline:
+                        if c.workload is not None:
+                            c.workload.finish(qid)
+                        raise
+                    _time.sleep(0.002)
+        try:
+            return self._execute_admitted(sql, trace_id, t0)
+        finally:
+            if c.rm is not None:
+                c.rm.release(qid)
+            if c.workload is not None:
+                c.workload.finish(qid)
+
+    def _execute_admitted(self, sql: str, trace_id: int | None = None,
+                          t0: float | None = None):
+        import time as _time
+
+        c = self.cluster
+        if t0 is None:
+            t0 = _time.monotonic()
         with c.tracer.trace("query", trace_id) as span:
             with span.child("plan") as plan_span:
                 planned = c.plan(sql)
