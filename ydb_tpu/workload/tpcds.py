@@ -99,6 +99,8 @@ CUSTOMER_SCHEMA = dtypes.schema(
 CUSTOMER_ADDRESS_SCHEMA = dtypes.schema(
     ("ca_address_sk", dtypes.INT64, False),
     ("ca_zip", dtypes.STRING, False),
+    ("ca_state", dtypes.STRING, False),
+    ("ca_country", dtypes.STRING, False),
 )
 
 CUSTOMER_DEMOGRAPHICS_SCHEMA = dtypes.schema(
@@ -122,11 +124,14 @@ STORE_SALES_SCHEMA = dtypes.schema(
     ("ss_hdemo_sk", dtypes.INT64, False),
     ("ss_store_sk", dtypes.INT64, False),
     ("ss_promo_sk", dtypes.INT64, False),
+    ("ss_addr_sk", dtypes.INT64, False),
     ("ss_quantity", dtypes.INT32, False),
     ("ss_list_price", DEC2, False),
     ("ss_sales_price", DEC2, False),
     ("ss_ext_sales_price", DEC2, False),
+    ("ss_ext_wholesale_cost", DEC2, False),
     ("ss_coupon_amt", DEC2, False),
+    ("ss_net_profit", DEC2, False),
 )
 
 CATALOG_SALES_SCHEMA = dtypes.schema(
@@ -307,12 +312,22 @@ class TpcdsData:
             "hd_dep_count": (np.arange(n_hd) % 10).astype(np.int32),
         }
 
+    _STATES = [b"TX", b"OH", b"OR", b"NM", b"KY", b"VA", b"MS",
+               b"CA", b"NY", b"WA", b"GA", b"FL"]
+
     def _gen_customer(self, rng, n_cust: int, n_addr: int):
         zips = [b"%05d" % z for z in
                 rng.integers(10000, 99999, n_addr).tolist()]
+        state_pick = rng.integers(0, len(self._STATES), n_addr)
         self.tables["customer_address"] = {
             "ca_address_sk": np.arange(1, n_addr + 1, dtype=np.int64),
             "ca_zip": _enc(self.dicts, "ca_zip", zips),
+            "ca_state": _enc(self.dicts, "ca_state",
+                             [self._STATES[i] for i in state_pick]),
+            "ca_country": _enc(
+                self.dicts, "ca_country",
+                [b"United States" if us else b"Canada"
+                 for us in rng.random(n_addr) < 0.95]),
         }
         self.tables["customer"] = {
             "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
@@ -340,13 +355,19 @@ class TpcdsData:
                 rng, "household_demographics", "hd_demo_sk", n),
             "ss_store_sk": self._fk(rng, "store", "s_store_sk", n),
             "ss_promo_sk": self._fk(rng, "promotion", "p_promo_sk", n),
+            "ss_addr_sk": self._fk(
+                rng, "customer_address", "ca_address_sk", n),
             "ss_quantity": qty,
             "ss_list_price": list_price,
             "ss_sales_price": sales_price,
             "ss_ext_sales_price": sales_price * qty,
+            "ss_ext_wholesale_cost": (
+                list_price * rng.integers(40, 80, n) // 100
+                * qty).astype(np.int64),
             "ss_coupon_amt": np.where(
                 rng.random(n) < 0.2, _cents(rng, 0.0, 50.0, n),
                 0).astype(np.int64),
+            "ss_net_profit": _cents(rng, -100.0, 300.0, n),
         }
 
     def _gen_catalog_sales(self, rng, n: int):
@@ -422,6 +443,42 @@ where d_date_sk = ss_sold_date_sk
 group by i_brand_id, i_brand, i_manufact_id, i_manufact
 order by ext_price desc, i_brand, i_brand_id, i_manufact_id, i_manufact
 limit 100""",
+    # q13: store-sales averages under OR-combined demographic and
+    # address bands (join equalities hoisted out of the OR groups —
+    # (E and F1) or (E and F2) == E and (F1 or F2), exactly)
+    "q13": """
+select avg(ss_quantity) as avg_qty,
+       avg(ss_ext_sales_price) as avg_esp,
+       avg(ss_ext_wholesale_cost) as avg_ewc,
+       sum(ss_ext_wholesale_cost) as sum_ewc
+from store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk and d_year = 2001
+  and ss_hdemo_sk = hd_demo_sk
+  and cd_demo_sk = ss_cdemo_sk
+  and ss_addr_sk = ca_address_sk
+  and ((cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00
+        and hd_dep_count = 3)
+    or (cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 50.00 and 100.00
+        and hd_dep_count = 1)
+    or (cd_marital_status = 'W'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 150.00 and 200.00
+        and hd_dep_count = 1))
+  and ((ca_country = 'United States'
+        and ca_state in ('TX', 'OH', 'TX')
+        and ss_net_profit between 100 and 200)
+    or (ca_country = 'United States'
+        and ca_state in ('OR', 'NM', 'KY')
+        and ss_net_profit between 150 and 300)
+    or (ca_country = 'United States'
+        and ca_state in ('VA', 'TX', 'MS')
+        and ss_net_profit between 50 and 250))""",
     # q26: the catalog_sales twin of q7
     "q26": """
 select i_item_id,
@@ -684,6 +741,67 @@ class _Ref:
         rows.sort(key=lambda r: (-r[4], r[1], r[0], r[2], r[3]))
         return rows[:100]
 
+    def q13(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        dd = d.tables["date_dim"]
+        years = dict(zip(dd["d_date_sk"].tolist(),
+                         dd["d_year"].tolist()))
+        cd = d.tables["customer_demographics"]
+        m = _decode(d, "customer_demographics", "cd_marital_status")
+        e = _decode(d, "customer_demographics", "cd_education_status")
+        demo = {sk: (m[i], e[i]) for i, sk in
+                enumerate(cd["cd_demo_sk"].tolist())}
+        hd = dict(zip(
+            d.tables["household_demographics"]["hd_demo_sk"].tolist(),
+            d.tables["household_demographics"]["hd_dep_count"].tolist()))
+        ca = d.tables["customer_address"]
+        states = _decode(d, "customer_address", "ca_state")
+        countries = _decode(d, "customer_address", "ca_country")
+        addr = {sk: (states[i], countries[i]) for i, sk in
+                enumerate(ca["ca_address_sk"].tolist())}
+        qty_sum = esp_sum = ewc_sum = n_rows = 0
+        for dk, hk, ck, ak, q, sp, esp, ewc, npf in zip(
+                ss["ss_sold_date_sk"].tolist(),
+                ss["ss_hdemo_sk"].tolist(),
+                ss["ss_cdemo_sk"].tolist(),
+                ss["ss_addr_sk"].tolist(),
+                ss["ss_quantity"].tolist(),
+                ss["ss_sales_price"].tolist(),
+                ss["ss_ext_sales_price"].tolist(),
+                ss["ss_ext_wholesale_cost"].tolist(),
+                ss["ss_net_profit"].tolist()):
+            if years[dk] != 2001:
+                continue
+            ms, ed = demo[ck]
+            dep = hd[hk]
+            band1 = (
+                (ms == b"M" and ed == b"Advanced Degree"
+                 and 10000 <= sp <= 15000 and dep == 3)
+                or (ms == b"S" and ed == b"College"
+                    and 5000 <= sp <= 10000 and dep == 1)
+                or (ms == b"W" and ed == b"2 yr Degree"
+                    and 15000 <= sp <= 20000 and dep == 1))
+            if not band1:
+                continue
+            st, country = addr[ak]
+            band2 = country == b"United States" and (
+                (st in (b"TX", b"OH") and 10000 <= npf <= 20000)
+                or (st in (b"OR", b"NM", b"KY")
+                    and 15000 <= npf <= 30000)
+                or (st in (b"VA", b"TX", b"MS")
+                    and 5000 <= npf <= 25000))
+            if not band2:
+                continue
+            qty_sum += q
+            esp_sum += esp
+            ewc_sum += ewc
+            n_rows += 1
+        if n_rows == 0:
+            return [(None, None, None, None)]
+        return [(qty_sum / n_rows, esp_sum / n_rows / 100,
+                 ewc_sum / n_rows / 100, ewc_sum)]
+
     def q42(self):
         acc = self._brand_rollup(manager_id=1, moy=11, year=2000,
                                  key="category")
@@ -796,6 +914,8 @@ _VERIFY_COLS = {
            ("sum_agg", "dec")),
     "q7": (("i_item_id", "str"), ("agg1", "avg"), ("agg2", "avg"),
            ("agg3", "avg"), ("agg4", "avg")),
+    "q13": (("avg_qty", "avg"), ("avg_esp", "avg"),
+            ("avg_ewc", "avg"), ("sum_ewc", "dec")),
     "q19": (("i_brand_id", "int"), ("i_brand", "str"),
             ("i_manufact_id", "int"), ("i_manufact", "str"),
             ("ext_price", "dec")),
@@ -844,12 +964,18 @@ def verify_result(name, out, want, data, pq=None) -> None:
             got_cols.append([float(x) / scale for x in arr])
         else:
             got_cols.append([int(x) for x in arr])
+    ok_cols = [np.asarray(out.cols[col][1], dtype=bool)
+               for col, _k in spec]
     got = list(zip(*got_cols)) if got_cols else []
     assert len(got) == len(want), \
         (name, len(got), len(want), got[:3], want[:3])
-    for gi, wi in zip(got, want):
-        for (col, kind), g, w in zip(spec, gi, wi):
-            if kind == "avg":
+    for i, (gi, wi) in enumerate(zip(got, want)):
+        for j, ((col, kind), g, w) in enumerate(zip(spec, gi, wi)):
+            if w is None:
+                # zero-input aggregate: the engine must mark the
+                # value NULL (validity false), not fabricate one
+                assert not ok_cols[j][i], (name, col, g)
+            elif kind == "avg":
                 assert abs(g - w) < 1e-9, (name, col, g, w)
             elif kind == "dec":
                 ww = int(round(w)) if not isinstance(w, int) else w
