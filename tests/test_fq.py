@@ -92,7 +92,7 @@ def test_crash_replay_is_exactly_once(env):
     # tablet state we reset to the pre-poll cursor
     send(source, region="eu", amount=5)
     # poison the checkpoint path: run the batch manually
-    offsets, state, seq = q._state()
+    offsets, state, seq, _meta = q._state()
     rows = [{"region": "eu", "amount": 5}]
     out = q._run_batch(rows)
     changed = q._fold(state, out)
@@ -130,6 +130,98 @@ def test_poison_messages_skipped(env):
     send(source, region="eu", amount=2)
     assert q.poll() == 1
     assert q.results() == [{"region": "eu", "n": 1, "total": 2}]
+
+
+def test_tumbling_windows_with_watermark(env):
+    """Event-time tumbling windows: finalize on watermark pass, emit
+    once with bounds, drop too-late arrivals."""
+    store, source, sink, svc = env
+    EV = dtypes.schema(("region", dtypes.STRING, False),
+                       ("amount", dtypes.INT64, False),
+                       ("ts", dtypes.INT64, False))
+    q = svc.create_query(
+        "win", "select region, count(*) as n, sum(amount) as total "
+        "from stream group by region", EV, source, sink,
+        window=("ts", 100, 20))  # 100us windows, 20us lateness
+
+    send(source, region="eu", amount=1, ts=10)
+    send(source, region="eu", amount=2, ts=50)   # same window [0,100)
+    send(source, region="us", amount=5, ts=110)  # window [100,200)
+    q.poll()
+    # watermark = 110-20 = 90: nothing finalized yet
+    assert sink_records(sink) == []
+    open_w = q.results()
+    assert {w["window_start"] for w in open_w} == {0, 100}
+
+    send(source, region="eu", amount=4, ts=95)   # in-lateness arrival
+    send(source, region="eu", amount=9, ts=230)  # advances watermark
+    q.poll()
+    # watermark = 230-20 = 210: windows [0,100) (incl. the late ts=95
+    # row) AND [100,200) finalize in order
+    recs = sink_records(sink)
+    assert recs == [
+        {"window_start": 0, "window_end": 100,
+         "region": "eu", "n": 3, "total": 7},
+        {"window_start": 100, "window_end": 200,
+         "region": "us", "n": 1, "total": 5},
+    ]
+    # too-late arrival for a finalized window: dropped + counted
+    send(source, region="eu", amount=100, ts=5)
+    send(source, region="us", amount=1, ts=320)
+    q.poll()
+    assert q.watermark_info()["late_dropped"] == 1
+    recs = sink_records(sink)
+    # watermark 300 finalized [200,300) (the eu ts=230 row)
+    assert recs[-1] == {"window_start": 200, "window_end": 300,
+                        "region": "eu", "n": 1, "total": 9}
+    # finalized state dropped; only open windows remain
+    assert all(w["window_start"] >= 300 for w in q.results())
+
+
+def test_below_watermark_rows_fold_into_open_windows(env):
+    """A row below the watermark whose WINDOW is still open must fold
+    in, not count as late (code-review regression)."""
+    _store, source, sink, svc = env
+    EV = dtypes.schema(("region", dtypes.STRING, False),
+                       ("amount", dtypes.INT64, False),
+                       ("ts", dtypes.INT64, False))
+    q = svc.create_query(
+        "open", "select region, count(*) as n, sum(amount) as total "
+        "from stream group by region", EV, source, sink,
+        window=("ts", 100, 20))
+    send(source, region="eu", amount=1, ts=150)  # watermark -> 130
+    q.poll()
+    send(source, region="eu", amount=2, ts=120)  # < watermark, window
+    q.poll()                                     # [100,200) still open
+    assert q.watermark_info()["late_dropped"] == 0
+    send(source, region="eu", amount=0, ts=500)  # finalize [100,200)
+    q.poll()
+    recs = sink_records(sink)
+    assert recs[0] == {"window_start": 100, "window_end": 200,
+                       "region": "eu", "n": 2, "total": 3}
+
+
+def test_windowed_state_survives_reboot(env):
+    store, source, sink, svc = env
+    EV = dtypes.schema(("region", dtypes.STRING, False),
+                       ("amount", dtypes.INT64, False),
+                       ("ts", dtypes.INT64, False))
+    q = svc.create_query(
+        "winrb", "select region, count(*) as n, sum(amount) as total "
+        "from stream group by region", EV, source, sink,
+        window=("ts", 100, 0))
+    send(source, region="eu", amount=3, ts=10)
+    q.poll()
+    q2 = StreamingQuery(
+        "winrb", "select region, count(*) as n, sum(amount) as total "
+        "from stream group by region", EV, source, sink, store,
+        window=("ts", 100, 0))
+    send(source, region="eu", amount=4, ts=60)
+    send(source, region="eu", amount=1, ts=150)
+    q2.poll()
+    recs = sink_records(sink)
+    assert recs[-1] == {"window_start": 0, "window_end": 100,
+                        "region": "eu", "n": 2, "total": 7}
 
 
 def test_rejects_non_foldable_aggregates(env):

@@ -54,13 +54,23 @@ class StreamingQuery:
 
     def __init__(self, name: str, sql: str, schema: dtypes.Schema,
                  source, sink, store: BlobStore,
-                 batch_limit: int = 1024):
+                 batch_limit: int = 1024,
+                 window: tuple | None = None):
+        """``window``: optional (event_time_field, size_us,
+        lateness_us) — tumbling event-time windows with watermark
+        semantics (the compute-actor watermark plane,
+        dq_compute_actor_impl.h): the watermark is max event time seen
+        minus the allowed lateness; a window finalizes — its groups
+        emit ONCE with window bounds and its state drops — when the
+        watermark passes its end; events older than a finalized window
+        are dropped late arrivals (counted, not applied)."""
         self.name = name
         self.sql = sql
         self.schema = schema
         self.source = source          # Topic
         self.sink = sink              # Topic | None
         self.batch_limit = batch_limit
+        self.window = window
         self.executor = TabletExecutor.boot(f"fq/{name}", store)
         stmt = parse(sql)
         if not isinstance(stmt, ast.Select):
@@ -89,21 +99,22 @@ class StreamingQuery:
 
     # -- durable state --
 
-    def _state(self) -> tuple[int, dict, int]:
+    def _state(self) -> tuple[dict, dict, int, dict]:
         db = self.executor.db
         meta = db.table("meta").get(("cursor",)) or {
-            "offsets": {}, "emit_seqno": 0}
+            "offsets": {}, "emit_seqno": 0, "late_dropped": 0}
         state = {}
         for (key_json,), row in db.table("state").range():
             state[key_json] = row["aggs"]
-        return meta["offsets"], state, meta["emit_seqno"]
+        return meta["offsets"], state, meta["emit_seqno"], meta
 
     # -- one micro-batch --
 
     def poll(self) -> int:
         """Process available source messages; returns rows consumed.
-        Emits changed groups to the sink, then checkpoints atomically."""
-        offsets, state, emit_seqno = self._state()
+        Emits (changed groups, or watermark-finalized windows) to the
+        sink, then checkpoints atomically."""
+        offsets, state, emit_seqno, meta = self._state()
         rows, new_offsets = [], dict(offsets)
         for pi, part in enumerate(self.source.partitions):
             start = offsets.get(str(pi), 0)
@@ -118,31 +129,94 @@ class StreamingQuery:
         if not rows:
             return 0
 
-        batch_out = self._run_batch(rows)
-        changed = self._fold(state, batch_out)
-
-        # 1. emit (idempotent via producer seqno) ...
-        if self.sink is not None and changed:
+        if self.window is None:
+            changed = self._fold(state, self._run_batch(rows))
             payloads = []
-            for key_json in changed:
+            for key_json in sorted(changed):
                 rec = dict(zip(self._key_cols, json.loads(key_json)))
                 rec.update(state[key_json])
-                payloads.append({"data": json.dumps(rec)})
+                payloads.append(rec)
+            finalized: list = []
+            new_meta = {"offsets": new_offsets}
+        else:
+            payloads, changed, finalized, new_meta = \
+                self._poll_windowed(rows, state, meta)
+            new_meta["offsets"] = new_offsets
+
+        # 1. emit (idempotent via producer seqno) ...
+        if self.sink is not None and payloads:
             self.sink.partitions[0].write(
-                payloads, producer=f"fq/{self.name}",
+                [{"data": json.dumps(p)} for p in payloads],
+                producer=f"fq/{self.name}",
                 first_seqno=emit_seqno + 1)
             emit_seqno += len(payloads)
 
         # 2. ... THEN checkpoint; a crash in between replays the batch
         # and the seqno guard swallows the duplicate emission
+        new_meta["emit_seqno"] = emit_seqno
+
+        finalized_set = set(finalized)
+
         def fn(txc):
-            txc.put("meta", ("cursor",), {
-                "offsets": new_offsets, "emit_seqno": emit_seqno})
+            txc.put("meta", ("cursor",), new_meta)
             for key_json in changed:
-                txc.put("state", (key_json,),
-                        {"aggs": state[key_json]})
+                if key_json not in finalized_set:
+                    txc.put("state", (key_json,),
+                            {"aggs": state[key_json]})
+            for key_json in finalized:
+                txc.erase("state", (key_json,))
         self.executor.run(fn)
         return len(rows)
+
+    def _poll_windowed(self, rows, state, meta):
+        """Tumbling-window batch: bucket rows by event-time window,
+        fold per window, finalize windows the watermark passed."""
+        ts_field, size, lateness = self.window
+        finalized_before = meta.get("finalized_before")
+        max_ts = meta.get("max_ts")
+        late = meta.get("late_dropped", 0)
+        buckets: dict[int, list] = {}
+        for r in rows:
+            ts = r.get(ts_field)
+            if not isinstance(ts, (int, float)):
+                continue  # unstamped rows are poison for windowing
+            ts = int(ts)
+            w = (ts // size) * size
+            # late = the row's WINDOW is already finalized; rows below
+            # the watermark whose window is still open must fold in
+            if finalized_before is not None \
+                    and w + size <= finalized_before:
+                late += 1
+                continue
+            buckets.setdefault(w, []).append(r)
+            max_ts = ts if max_ts is None else max(max_ts, ts)
+        changed: set = set()
+        for w, rs in sorted(buckets.items()):
+            changed |= self._fold(state, self._run_batch(rs),
+                                  window=w)
+        # watermark = max event time - lateness; windows fully below
+        # it finalize: emit once with bounds, drop their state
+        payloads, finalized = [], []
+        cut = None if max_ts is None else max_ts - lateness
+        if cut is not None:
+            # numeric event-time order, not JSON-string order
+            for key_json in sorted(state,
+                                   key=lambda k: json.loads(k)):
+                w, keyvals = json.loads(key_json)
+                if w + size <= cut:
+                    rec = {"window_start": w, "window_end": w + size}
+                    rec.update(zip(self._key_cols, keyvals))
+                    rec.update(state[key_json])
+                    payloads.append(rec)
+                    finalized.append(key_json)
+        new_meta = {
+            "max_ts": max_ts,
+            "finalized_before": (max(cut, finalized_before)
+                                 if finalized_before is not None
+                                 else cut),
+            "late_dropped": late,
+        }
+        return payloads, changed, finalized, new_meta
 
     def _run_batch(self, rows: list[dict]) -> list[dict]:
         """Run the SQL over one batch through the normal query path."""
@@ -186,13 +260,17 @@ class StreamingQuery:
             result.append({k: cols[k][i] for k in cols})
         return result
 
-    def _fold(self, state: dict, batch_out: list[dict]) -> set:
+    def _fold(self, state: dict, batch_out: list[dict],
+              window: int | None = None) -> set:
         """Merge batch aggregates into running state; returns the set
-        of changed group keys (JSON-encoded key tuples)."""
+        of changed group keys (JSON-encoded; windowed keys carry
+        [window_start, [key values...]])."""
         changed = set()
         for row in batch_out:
+            keyvals = [row[k] for k in self._key_cols]
             key_json = json.dumps(
-                [row[k] for k in self._key_cols], sort_keys=True)
+                keyvals if window is None else [window, keyvals],
+                sort_keys=True)
             cur = state.get(key_json)
             if cur is None:
                 state[key_json] = {name: row[name]
@@ -204,14 +282,29 @@ class StreamingQuery:
         return changed
 
     def results(self) -> list[dict]:
-        """Current materialized view (keys + running aggregates)."""
-        _offsets, state, _seq = self._state()
+        """Current materialized view: running groups (non-windowed) or
+        the still-open windows (windowed)."""
+        _offsets, state, _seq, _meta = self._state()
         out = []
-        for key_json, aggs in sorted(state.items()):
-            rec = dict(zip(self._key_cols, json.loads(key_json)))
+        for key_json, aggs in sorted(
+                state.items(), key=lambda kv: json.loads(kv[0])):
+            decoded = json.loads(key_json)
+            if self.window is not None:
+                w, keyvals = decoded
+                rec = {"window_start": w,
+                       "window_end": w + self.window[1]}
+                rec.update(zip(self._key_cols, keyvals))
+            else:
+                rec = dict(zip(self._key_cols, decoded))
             rec.update(aggs)
             out.append(rec)
         return out
+
+    def watermark_info(self) -> dict:
+        _offsets, _state, _seq, meta = self._state()
+        return {"max_ts": meta.get("max_ts"),
+                "finalized_before": meta.get("finalized_before"),
+                "late_dropped": meta.get("late_dropped", 0)}
 
 
 class FederatedQueryService:
@@ -224,12 +317,12 @@ class FederatedQueryService:
         self.queries: dict[str, StreamingQuery] = {}
 
     def create_query(self, name: str, sql: str, schema: dtypes.Schema,
-                     source, sink=None,
-                     batch_limit: int = 1024) -> StreamingQuery:
+                     source, sink=None, batch_limit: int = 1024,
+                     window: tuple | None = None) -> StreamingQuery:
         if name in self.queries:
             raise ValueError(f"query {name} exists")
         q = StreamingQuery(name, sql, schema, source, sink,
-                           self.store, batch_limit)
+                           self.store, batch_limit, window=window)
         self.queries[name] = q
         return q
 
