@@ -13,8 +13,10 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow the official templates (q3, q7, q19, q26, q42, q43,
-q52, q55, q96) restated in the framework dialect; each is verified
+Queries follow the official templates (q3, q7, q13, q19, q26, q42,
+q43, q48, q52, q55, q96) restated in the framework dialect (q13/q48
+hoist the join equalities shared by every OR branch — an exact
+identity); each is verified
 against ``reference_answers`` — an independent numpy implementation
 computed straight off the generated tables (the canondata pattern,
 ydb/tests/functional/tpc).
@@ -499,6 +501,34 @@ where cs_sold_date_sk = d_date_sk
 group by i_item_id
 order by i_item_id
 limit 100""",
+    # q48: total quantity under OR-combined demographic/address bands
+    # (same hoisting identity as q13)
+    "q48": """
+select sum(ss_quantity) as total_qty
+from store_sales, store, customer_demographics, customer_address,
+     date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk and d_year = 2001
+  and cd_demo_sk = ss_cdemo_sk
+  and ss_addr_sk = ca_address_sk
+  and ((cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+    or (cd_marital_status = 'D'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 50.00 and 100.00)
+    or (cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 150.00 and 200.00))
+  and ((ca_country = 'United States'
+        and ca_state in ('CO', 'OH', 'TX')
+        and ss_net_profit between 0 and 2000)
+    or (ca_country = 'United States'
+        and ca_state in ('OR', 'MN', 'KY')
+        and ss_net_profit between 150 and 3000)
+    or (ca_country = 'United States'
+        and ca_state in ('VA', 'CA', 'MS')
+        and ss_net_profit between 50 and 25000))""",
     # q42: category revenue for one manager's items
     "q42": """
 select d_year, i_category_id, i_category,
@@ -741,9 +771,10 @@ class _Ref:
         rows.sort(key=lambda r: (-r[4], r[1], r[0], r[2], r[3]))
         return rows[:100]
 
-    def q13(self):
+    def _sales_dim_maps(self):
+        """Shared q13/q48 lookup maps: date_sk->year, cd_demo_sk->
+        (marital, education), ca_address_sk->(state, country)."""
         d = self.d
-        ss = d.tables["store_sales"]
         dd = d.tables["date_dim"]
         years = dict(zip(dd["d_date_sk"].tolist(),
                          dd["d_year"].tolist()))
@@ -752,14 +783,20 @@ class _Ref:
         e = _decode(d, "customer_demographics", "cd_education_status")
         demo = {sk: (m[i], e[i]) for i, sk in
                 enumerate(cd["cd_demo_sk"].tolist())}
-        hd = dict(zip(
-            d.tables["household_demographics"]["hd_demo_sk"].tolist(),
-            d.tables["household_demographics"]["hd_dep_count"].tolist()))
         ca = d.tables["customer_address"]
         states = _decode(d, "customer_address", "ca_state")
         countries = _decode(d, "customer_address", "ca_country")
         addr = {sk: (states[i], countries[i]) for i, sk in
                 enumerate(ca["ca_address_sk"].tolist())}
+        return years, demo, addr
+
+    def q13(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        years, demo, addr = self._sales_dim_maps()
+        hd = dict(zip(
+            d.tables["household_demographics"]["hd_demo_sk"].tolist(),
+            d.tables["household_demographics"]["hd_dep_count"].tolist()))
         qty_sum = esp_sum = ewc_sum = n_rows = 0
         for dk, hk, ck, ak, q, sp, esp, ewc, npf in zip(
                 ss["ss_sold_date_sk"].tolist(),
@@ -801,6 +838,42 @@ class _Ref:
             return [(None, None, None, None)]
         return [(qty_sum / n_rows, esp_sum / n_rows / 100,
                  ewc_sum / n_rows / 100, ewc_sum)]
+
+    def q48(self):
+        ss = self.d.tables["store_sales"]
+        years, demo, addr = self._sales_dim_maps()
+        total = 0
+        for dk, ck, ak, q, sp, npf in zip(
+                ss["ss_sold_date_sk"].tolist(),
+                ss["ss_cdemo_sk"].tolist(),
+                ss["ss_addr_sk"].tolist(),
+                ss["ss_quantity"].tolist(),
+                ss["ss_sales_price"].tolist(),
+                ss["ss_net_profit"].tolist()):
+            if years[dk] != 2001:
+                continue
+            ms, ed = demo[ck]
+            band1 = (
+                (ms == b"M" and ed == b"4 yr Degree"
+                 and 10000 <= sp <= 15000)
+                or (ms == b"D" and ed == b"2 yr Degree"
+                    and 5000 <= sp <= 10000)
+                or (ms == b"S" and ed == b"College"
+                    and 15000 <= sp <= 20000))
+            if not band1:
+                continue
+            st, country = addr[ak]
+            band2 = country == b"United States" and (
+                (st in (b"CO", b"OH", b"TX")
+                 and 0 <= npf <= 200000)
+                or (st in (b"OR", b"MN", b"KY")
+                    and 15000 <= npf <= 300000)
+                or (st in (b"VA", b"CA", b"MS")
+                    and 5000 <= npf <= 2500000))
+            if not band2:
+                continue
+            total += q
+        return [(total if total else None,)]
 
     def q42(self):
         acc = self._brand_rollup(manager_id=1, moy=11, year=2000,
@@ -916,6 +989,7 @@ _VERIFY_COLS = {
            ("agg3", "avg"), ("agg4", "avg")),
     "q13": (("avg_qty", "avg"), ("avg_esp", "avg"),
             ("avg_ewc", "avg"), ("sum_ewc", "dec")),
+    "q48": (("total_qty", "int"),),
     "q19": (("i_brand_id", "int"), ("i_brand", "str"),
             ("i_manufact_id", "int"), ("i_manufact", "str"),
             ("ext_price", "dec")),
