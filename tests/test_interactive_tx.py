@@ -1,0 +1,151 @@
+"""Interactive multi-statement transactions through SQL: deferred
+effects, atomic cross-table commit, optimistic conflict abort,
+repeatable reads, rollback (reference: session tx state in
+kqp_session_actor.cpp + datashard locks; SURVEY §2.8)."""
+
+import pytest
+
+from ydb_tpu.kqp.session import Cluster, PlanError
+from ydb_tpu.tx.coordinator import TxResult
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    s = c.session()
+    s.execute("CREATE TABLE acct (id int64, bal int64, "
+              "PRIMARY KEY (id)) WITH (store = row, shards = 2)")
+    s.execute("CREATE TABLE log (seq int64, note int64, "
+              "PRIMARY KEY (seq)) WITH (store = row)")
+    s.execute("INSERT INTO acct VALUES (1, 100), (2, 50)")
+    return c
+
+
+def val(s, sql, col):
+    out = s.execute(sql)
+    return [int(x) for x in out.column(col)]
+
+
+def test_commit_applies_atomically_across_tables(cluster):
+    s = cluster.session()
+    assert s.execute("BEGIN") is None
+    s.execute("UPDATE acct SET bal = bal - 30 WHERE id = 1")
+    s.execute("UPDATE acct SET bal = bal + 30 WHERE id = 2")
+    s.execute("INSERT INTO log VALUES (1, 30)")
+    # deferred effects: another session sees nothing yet
+    other = cluster.session()
+    assert val(other, "SELECT bal FROM acct ORDER BY id", "bal") == \
+        [100, 50]
+    assert val(other, "SELECT seq FROM log", "seq") == []
+    res = s.execute("COMMIT")
+    assert isinstance(res, TxResult) and res.committed
+    # all three effects land at ONE step
+    assert val(other, "SELECT bal FROM acct ORDER BY id", "bal") == \
+        [70, 80]
+    assert val(other, "SELECT note FROM log", "note") == [30]
+
+
+def test_rollback_discards_and_releases(cluster):
+    s = cluster.session()
+    s.execute("BEGIN")
+    s.execute("UPDATE acct SET bal = 0 WHERE id = 1")
+    assert s.execute("ROLLBACK") is None
+    assert val(s, "SELECT bal FROM acct WHERE id = 1", "bal") == [100]
+    # locks released: another session's write proceeds and commits
+    other = cluster.session()
+    other.execute("UPDATE acct SET bal = 7 WHERE id = 2")
+    assert val(s, "SELECT bal FROM acct WHERE id = 2", "bal") == [7]
+
+
+def test_conflicting_commit_aborts_transaction(cluster):
+    a = cluster.session()
+    a.execute("BEGIN")
+    a.execute("UPDATE acct SET bal = bal - 10 WHERE id = 1")
+
+    b = cluster.session()  # concurrent writer commits first
+    b.execute("UPDATE acct SET bal = 999 WHERE id = 1")
+
+    res = a.execute("COMMIT")
+    assert isinstance(res, TxResult) and not res.committed
+    # b's write survives; a's buffered effect never landed
+    assert val(b, "SELECT bal FROM acct WHERE id = 1", "bal") == [999]
+
+
+def test_repeatable_reads_at_begin_snapshot(cluster):
+    a = cluster.session()
+    a.execute("BEGIN")
+    assert val(a, "SELECT bal FROM acct WHERE id = 1", "bal") == [100]
+    b = cluster.session()
+    b.execute("UPDATE acct SET bal = 5 WHERE id = 1")
+    # a still reads the BEGIN snapshot
+    assert val(a, "SELECT bal FROM acct WHERE id = 1", "bal") == [100]
+    a.execute("ROLLBACK")
+    assert val(a, "SELECT bal FROM acct WHERE id = 1", "bal") == [5]
+
+
+def test_insert_then_read_own_write_not_visible_until_commit(cluster):
+    """Deferred-effect model: the transaction does NOT see its own
+    buffered writes (documented semantics)."""
+    s = cluster.session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO log VALUES (9, 1)")
+    assert val(s, "SELECT seq FROM log", "seq") == []
+    s.execute("COMMIT")
+    assert val(s, "SELECT seq FROM log", "seq") == [9]
+
+
+def test_tx_statement_errors(cluster):
+    s = cluster.session()
+    with pytest.raises(PlanError):
+        s.execute("COMMIT")  # no open tx
+    s.execute("BEGIN")
+    with pytest.raises(PlanError):
+        s.execute("BEGIN")  # nested
+    s.execute("ROLLBACK")
+    s.execute("BEGIN")
+    with pytest.raises(PlanError, match="DDL"):
+        s.execute("CREATE TABLE t2 (id int64, PRIMARY KEY (id))")
+    # the failed DDL aborted the tx; a new BEGIN works
+    s.execute("BEGIN")
+    s.execute("ROLLBACK")
+
+
+def test_no_lost_update_between_begin_and_first_touch(cluster):
+    """A commit landing between BEGIN and the tx's first touch of a
+    table must abort the tx, not be clobbered by stale full-row
+    writes (code-review regression, confirmed repro)."""
+    s = cluster.session()
+    s.execute("ALTER TABLE acct ADD COLUMN x int64")
+    s.execute("UPDATE acct SET x = 0 WHERE id = 1")
+    a = cluster.session()
+    a.execute("BEGIN")
+    b = cluster.session()
+    b.execute("UPDATE acct SET x = 777 WHERE id = 1")  # after BEGIN
+    with pytest.raises(PlanError, match="changed after BEGIN"):
+        a.execute("UPDATE acct SET bal = bal - 10 WHERE id = 1")
+    # b's committed write intact, a's tx gone
+    out = b.execute("SELECT x, bal FROM acct WHERE id = 1")
+    assert int(out.column("x")[0]) == 777
+    assert int(out.column("bal")[0]) == 100
+    assert a._tx is None
+
+
+def test_scalar_subquery_reads_tx_snapshot(cluster):
+    """Subqueries inside a tx must see the BEGIN snapshot, matching
+    the outer statement (code-review regression, confirmed repro)."""
+    a = cluster.session()
+    a.execute("BEGIN")
+    b = cluster.session()
+    b.execute("UPDATE acct SET bal = 999 WHERE id = 2")
+    out = a.execute(
+        "SELECT id FROM acct WHERE bal = (SELECT max(bal) FROM acct)")
+    # at the BEGIN snapshot max(bal)=100 on id 1, not b's 999
+    assert [int(x) for x in out.column("id")] == [1]
+    a.execute("ROLLBACK")
+
+
+def test_empty_commit_is_trivially_true(cluster):
+    s = cluster.session()
+    s.execute("BEGIN")
+    res = s.execute("COMMIT")
+    assert res.committed

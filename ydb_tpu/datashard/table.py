@@ -193,10 +193,13 @@ class RowTable:
 
     # ---- writes (2PC across shards) ----
 
-    def _commit_ops(self, per_row_ops: list[RowOp],
-                    lock_ids: dict[int, int] | None = None) -> TxResult:
-        """lock_ids: shard index -> optimistic lock the tx validated
-        under; prepare fails (aborting the 2PC) if it broke."""
+    def propose_ops(self, per_row_ops: list[RowOp],
+                    lock_ids: dict[int, int] | None = None
+                    ) -> tuple[list, list]:
+        """Durably stage ops (and index maintenance) on their shards;
+        returns (participants, prepare_args) for a coordinator commit.
+        Interactive transactions combine several tables' proposals
+        into ONE atomic commit this way."""
         if self.pre_commit is not None:
             self.pre_commit()
         route = self._route([op.key for op in per_row_ops])
@@ -217,6 +220,14 @@ class RowTable:
                 for shard, wid in _route_propose(idx_shards, idx_ops):
                     participants.append(shard)
                     prepare_args.append([wid])
+        return participants, prepare_args
+
+    def _commit_ops(self, per_row_ops: list[RowOp],
+                    lock_ids: dict[int, int] | None = None) -> TxResult:
+        """lock_ids: shard index -> optimistic lock the tx validated
+        under; prepare fails (aborting the 2PC) if it broke."""
+        participants, prepare_args = self.propose_ops(per_row_ops,
+                                                      lock_ids)
         # multi-shard row commits take the volatile path: no prepare
         # round-trip under the coordinator's commit lock, outcomes
         # exchanged as readsets (volatile_tx.h; VERDICT missing #9)
@@ -311,9 +322,13 @@ class RowTable:
 
     def insert(self, columns: dict, validity=None) -> TxResult:
         """Upsert semantics (same surface as ShardedTable.insert)."""
+        return self._commit_ops(self.insert_ops(columns, validity))
+
+    def insert_ops(self, columns: dict, validity=None) -> list[RowOp]:
+        """The insert's effects as RowOps, uncommitted (interactive-
+        transaction buffering seam)."""
         rows = self._encode_columns(columns, validity)
-        return self._commit_ops(
-            [RowOp(self._key_of(r), r) for r in rows])
+        return [RowOp(self._key_of(r), r) for r in rows]
 
     def upsert_rows(self, rows: list[dict]) -> TxResult:
         return self._commit_ops(

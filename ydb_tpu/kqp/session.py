@@ -442,6 +442,13 @@ class Cluster:
     def _update_once(self, t, stmt: ast.Update,
                      locks: dict[int, int]) -> TxResult:
         snap = self.coordinator.read_snapshot()
+        ops = self.update_ops(t, stmt, snap)
+        if not ops:
+            return TxResult(0, snap, True)
+        return t._commit_ops(ops, lock_ids=locks)
+
+    def _update_rows(self, t, stmt: ast.Update, snap: int):
+        """Rows with the SET effects applied, read at ``snap``."""
         # constant SET values evaluate directly (string literals cannot
         # ride the device plan — they'd be bare dict ids); computed
         # expressions run through the normal SELECT path
@@ -501,12 +508,21 @@ class Cluster:
                         col[r], out.schema.field(f"__set_{i}").type,
                         t.schema.field(name).type)
             rows.append(row)
-        if not rows:
-            return TxResult(0, snap, True)
+        return rows
+
+    def update_ops(self, t, stmt: ast.Update, snap: int):
+        """The UPDATE's row effects as RowOps, uncommitted (the
+        interactive-transaction buffering seam)."""
         from ydb_tpu.datashard.shard import RowOp
 
-        return t._commit_ops(
-            [RowOp(t._key_of(r), r) for r in rows], lock_ids=locks)
+        rows = self._update_rows(t, stmt, snap)
+        return [RowOp(t._key_of(r), r) for r in rows]
+
+    def delete_ops(self, t, stmt: ast.Delete, snap: int):
+        from ydb_tpu.datashard.shard import RowOp
+
+        _out, keys = self._select_rows(t, [], stmt.where, snap)
+        return [RowOp(tuple(k), None) for k in keys]
 
     def delete(self, stmt: ast.Delete) -> TxResult:
         t = self._row_table(stmt.table)
@@ -524,16 +540,31 @@ class Cluster:
 
     def _delete_once(self, t, stmt: ast.Delete,
                      locks: dict[int, int]) -> TxResult:
-        from ydb_tpu.datashard.shard import RowOp
-
         snap = self.coordinator.read_snapshot()
-        _out, keys = self._select_rows(t, [], stmt.where, snap)
-        if not keys:
+        ops = self.delete_ops(t, stmt, snap)
+        if not ops:
             return TxResult(0, snap, True)
-        return t._commit_ops(
-            [RowOp(tuple(k), None) for k in keys], lock_ids=locks)
+        return t._commit_ops(ops, lock_ids=locks)
 
     def insert(self, stmt: ast.Insert) -> TxResult:
+        t, arrays, val = self._insert_arrays(stmt)
+        res = t.insert(arrays, val)  # journals dict growth via pre_commit
+        # new dictionary entries may invalidate cached plan aux tables
+        self._plan_cache.clear()
+        return res
+
+    def insert_ops(self, stmt: ast.Insert):
+        """The INSERT's effects as (table, RowOps), uncommitted (the
+        interactive-transaction buffering seam; row tables only)."""
+        t, arrays, val = self._insert_arrays(stmt)
+        if not hasattr(t, "insert_ops"):
+            raise PlanError(
+                f"interactive transactions support row tables; "
+                f"{stmt.table} is a column table")
+        self._plan_cache.clear()
+        return t, t.insert_ops(arrays, val)
+
+    def _insert_arrays(self, stmt: ast.Insert):
         t = self.tables.get(stmt.table)
         if t is None:
             raise PlanError(f"unknown table {stmt.table}")
@@ -558,10 +589,7 @@ class Cluster:
             else:
                 arrays[n] = np.asarray(cols[n], dtype=f.type.physical)
         val = {n: np.asarray(v, dtype=bool) for n, v in validity.items()}
-        res = t.insert(arrays, val)  # journals dict growth via pre_commit
-        # new dictionary entries may invalidate cached plan aux tables
-        self._plan_cache.clear()
-        return res
+        return t, arrays, val
 
     def reshard_table(self, name: str, n_shards: int) -> int:
         """Split/merge a table (column OR row store) to ``n_shards``
@@ -605,14 +633,16 @@ class Cluster:
                        dicts=self.dicts, row_counts=counts,
                        udfs=dict(self.udfs))
 
-    def _stmt_scalar_exec(self, stmt_db: list):
+    def _stmt_scalar_exec(self, stmt_db: list, snap: int | None = None):
         """Scalar-subquery executor bound to ONE statement snapshot
         (lazily created into ``stmt_db[0]``): the KQP precompute-phase
-        analog, shared by SELECT planning and EXPLAIN."""
+        analog, shared by SELECT planning and EXPLAIN. ``snap`` pins
+        the snapshot (interactive transactions pass their BEGIN
+        snapshot so sub- and outer query read the same state)."""
         def scalar_exec(plan_node, t):
             if stmt_db[0] is None:
                 stmt_db[0] = self.snapshot_db(
-                    include_sys=self.flags.enable_sys_views)
+                    snap, include_sys=self.flags.enable_sys_views)
             out = to_host(execute_plan(plan_node, stmt_db[0]))
             col = out.schema.names[0]
             v, ok = out.cols[col]
@@ -644,22 +674,26 @@ class Cluster:
             sources = _SysLazySources(self, sources)
         return Database(sources=sources, dicts=self.dicts)
 
-    def plan(self, sql: str):
-        hit = self._plan_cache.get(sql)
-        if hit is not None:
+    def plan(self, sql: str, snap: int | None = None):
+        """``snap`` pins the statement snapshot (an interactive
+        transaction's BEGIN snapshot): scalar subqueries precompute
+        against it, and such plans never enter the cache."""
+        if snap is None:
+            hit = self._plan_cache.get(sql)
+            if hit is not None:
+                if _P_PLAN_CACHE:
+                    _P_PLAN_CACHE.fire(hit=True)
+                self._plan_cache.move_to_end(sql)
+                return hit
             if _P_PLAN_CACHE:
-                _P_PLAN_CACHE.fire(hit=True)
-            self._plan_cache.move_to_end(sql)
-            return hit
-        if _P_PLAN_CACHE:
-            _P_PLAN_CACHE.fire(hit=False)
+                _P_PLAN_CACHE.fire(hit=False)
         stmt = parse(sql)
         if isinstance(stmt, ast.Explain):
             # EXPLAIN precomputes scalar subqueries exactly like
             # execution would (same guards, same single snapshot), so
             # the rendered plan is the plan the engine would run
             pq = plan_select_full(stmt.select, self.catalog(),
-                                  self._stmt_scalar_exec([None]))
+                                  self._stmt_scalar_exec([None], snap))
             return ("explain", pq.plan)
         if not isinstance(stmt, ast.Select):
             return stmt
@@ -669,11 +703,11 @@ class Cluster:
         # state, preserving statement-level read consistency
         stmt_db: list = [None]
         pq = plan_select_full(stmt, self.catalog(),
-                              self._stmt_scalar_exec(stmt_db))
+                              self._stmt_scalar_exec(stmt_db, snap))
         entry = (pq.plan, dict(pq.dict_aliases), stmt_db[0])
-        if not pq.used_scalar_exec:
-            # plans with baked-in subquery results are snapshot-bound:
-            # never serve them from the cache
+        if not pq.used_scalar_exec and snap is None:
+            # plans with baked-in subquery results (or pinned to a tx
+            # snapshot) are snapshot-bound: never serve from the cache
             self._plan_cache[sql] = entry
             while len(self._plan_cache) > self._plan_cache_size:
                 self._plan_cache.popitem(last=False)
@@ -776,9 +810,19 @@ def _literal_value(e: ast.Expr, t: dtypes.LogicalType):
 
 @dataclasses.dataclass
 class Session:
-    """One client session (kqp_session_actor analog)."""
+    """One client session (kqp_session_actor analog).
+
+    Interactive transactions (BEGIN/COMMIT/ROLLBACK): effects buffer
+    on the session and apply in ONE atomic (cross-table) commit at
+    COMMIT; statements inside the transaction read the BEGIN snapshot
+    (the deferred-effect model — uncommitted effects are not visible,
+    including to the transaction itself). Conflict detection is
+    optimistic full-table locks taken at first touch of each written
+    table: any concurrent commit to a touched table after that point
+    breaks the lock and COMMIT aborts (the client retries)."""
 
     cluster: Cluster
+    _tx: dict | None = None
 
     def execute(self, sql: str, trace_id: int | None = None):
         """Returns OracleTable for SELECT, TxResult for INSERT, None DDL."""
@@ -840,7 +884,9 @@ class Session:
             t0 = _time.monotonic()
         with c.tracer.trace("query", trace_id) as span:
             with span.child("plan") as plan_span:
-                planned = c.plan(sql)
+                planned = c.plan(
+                    sql,
+                    snap=self._tx["snap"] if self._tx else None)
                 if not isinstance(planned, tuple):
                     kind = type(planned).__name__.lower()
                 elif planned[0] == "explain":
@@ -873,20 +919,55 @@ class Session:
         return out
 
     def _dispatch(self, planned):
+        if isinstance(planned, ast.Begin):
+            if self._tx is not None:
+                raise PlanError("a transaction is already open")
+            self._tx = {
+                "snap": self.cluster.coordinator.read_snapshot(),
+                "locks": {},   # table name -> {shard idx: lock id}
+                "ops": {},     # table name -> (table, [RowOp]) ordered
+            }
+            return None
+        if isinstance(planned, ast.Commit):
+            return self._tx_commit()
+        if isinstance(planned, ast.Rollback):
+            self._tx_release()
+            return None
         if isinstance(planned, ast.CreateTable):
+            self._no_tx("DDL")
             self.cluster.create_table(planned)
             return None
         if isinstance(planned, ast.DropTable):
+            self._no_tx("DDL")
             self.cluster.drop_table(planned)
             return None
         if isinstance(planned, ast.AlterTable):
+            self._no_tx("DDL")
             self.cluster.alter_table(planned)
             return None
         if isinstance(planned, ast.Insert):
+            if self._tx is not None:
+                t, ops = self.cluster.insert_ops(planned)
+                self._tx_buffer(planned.table, t, ops)
+                return None
             return self.cluster.insert(planned)
         if isinstance(planned, ast.Update):
+            if self._tx is not None:
+                t = self.cluster._row_table(planned.table)
+                self._tx_lock(planned.table, t)
+                ops = self.cluster.update_ops(t, planned,
+                                              self._tx["snap"])
+                self._tx_buffer(planned.table, t, ops)
+                return None
             return self.cluster.update(planned)
         if isinstance(planned, ast.Delete):
+            if self._tx is not None:
+                t = self.cluster._row_table(planned.table)
+                self._tx_lock(planned.table, t)
+                ops = self.cluster.delete_ops(t, planned,
+                                              self._tx["snap"])
+                self._tx_buffer(planned.table, t, ops)
+                return None
             return self.cluster.delete(planned)
         if planned[0] == "explain":
             from ydb_tpu.plan.nodes import format_plan
@@ -895,8 +976,87 @@ class Session:
         p, alias_map, plan_db = planned
         # reuse the plan-time snapshot when scalar subqueries precomputed
         # against it (statement-level read consistency)
-        db = plan_db if plan_db is not None else self.cluster.snapshot_db(
-            include_sys=self.cluster.flags.enable_sys_views)
+        if plan_db is not None:
+            # scalar subqueries precomputed against this db (pinned to
+            # the tx snapshot when one is open): reuse it
+            db = plan_db
+        elif self._tx is not None:
+            # repeatable read: every statement in the transaction sees
+            # the BEGIN snapshot
+            db = self.cluster.snapshot_db(
+                self._tx["snap"],
+                include_sys=self.cluster.flags.enable_sys_views)
+        else:
+            db = self.cluster.snapshot_db(
+                include_sys=self.cluster.flags.enable_sys_views)
         out = to_host(execute_plan(p, db))
         out.dicts = self.cluster.result_dicts(out.schema, alias_map)
         return out
+
+    # -- interactive transaction plumbing --
+
+    def _no_tx(self, what: str) -> None:
+        if self._tx is not None:
+            self._tx_release()
+            raise PlanError(
+                f"{what} inside a transaction aborts it (unsupported)")
+
+    def _tx_lock(self, name: str, t) -> None:
+        if name in self._tx["locks"]:
+            return
+        locks = t.lock_all_shards()
+        # the lock starts protecting NOW, but the tx reads the BEGIN
+        # snapshot: a commit that landed in between would be silently
+        # clobbered by full-row buffered writes (lost update). Close
+        # the window like the statement path's lock-before-read does:
+        # abort if the table moved past the snapshot before the lock.
+        if any(shard.last_step > self._tx["snap"]
+               for shard in t.shards):
+            t.release_locks(locks)
+            self._tx_release()
+            raise PlanError(
+                f"transaction aborted: {name} changed after BEGIN "
+                "(retry the transaction)")
+        self._tx["locks"][name] = locks
+
+    def _tx_buffer(self, name: str, t, ops) -> None:
+        self._tx_lock(name, t)
+        entry = self._tx["ops"].setdefault(name, (t, []))
+        entry[1].extend(ops)
+
+    def _tx_release(self) -> None:
+        tx, self._tx = self._tx, None
+        if tx is None:
+            return
+        for name, locks in tx["locks"].items():
+            table = self.cluster.tables.get(name)
+            if table is not None:
+                table.release_locks(locks)
+
+    def _tx_commit(self):
+        tx = self._tx
+        if tx is None:
+            raise PlanError("no open transaction")
+        try:
+            participants, prepare_args = [], []
+            try:
+                for name, (t, ops) in tx["ops"].items():
+                    p, a = t.propose_ops(ops,
+                                         lock_ids=tx["locks"][name])
+                    participants.extend(p)
+                    prepare_args.extend(a)
+            except Exception:
+                # a later table's propose failed: earlier tables'
+                # durably staged writes must not leak in pending
+                for p, a in zip(participants, prepare_args):
+                    try:
+                        p.abort(a)
+                    except Exception:
+                        pass
+                raise
+            if not participants:
+                return TxResult(0, tx["snap"], True)
+            return self.cluster.coordinator.commit_volatile(
+                participants, prepare_args)
+        finally:
+            self._tx_release()

@@ -214,5 +214,20 @@ class Explain:
     select: Select
 
 
+@dataclasses.dataclass(frozen=True)
+class Begin:
+    """BEGIN: open an interactive transaction on the session."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Commit:
+    """COMMIT: apply the transaction's buffered effects atomically."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Rollback:
+    """ROLLBACK: discard the transaction's buffered effects."""
+
+
 Statement = Union[Select, Insert, CreateTable, DropTable, AlterTable,
-                  Update, Delete, Explain]
+                  Update, Delete, Explain, Begin, Commit, Rollback]

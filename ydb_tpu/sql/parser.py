@@ -36,6 +36,7 @@ _KEYWORDS = {
     "else", "end", "date", "interval", "true", "false", "distinct",
     "outer", "exists", "cast", "drop", "alter", "add", "column", "with",
     "update", "set", "delete", "extract", "substring", "for", "explain",
+    "begin", "commit", "rollback", "transaction",
 }
 
 
@@ -121,6 +122,16 @@ class Parser:
             stmt = self.parse_select()
         elif self.peek().value in ("insert", "upsert"):
             stmt = self.parse_insert()
+        elif self.peek().value == "begin":
+            self.next()
+            self.accept("kw", "transaction")
+            stmt = ast.Begin()
+        elif self.peek().value == "commit":
+            self.next()
+            stmt = ast.Commit()
+        elif self.peek().value == "rollback":
+            self.next()
+            stmt = ast.Rollback()
         elif self.peek().value == "create":
             stmt = self.parse_create()
         elif self.peek().value == "drop":
