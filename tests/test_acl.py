@@ -1,0 +1,192 @@
+"""Path ACL tests: grants with subtree inheritance, enforcement at
+the session for reads/writes/DDL, bootstrap-friendly activation,
+durable ACEs (reference: library/aclib, schemeshard ACLs,
+ticket-parser principals)."""
+
+import pytest
+
+from ydb_tpu.kqp.session import Cluster, PlanError
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    s = c.session()
+    s.execute("CREATE TABLE t (id int64, v int64, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 10)")
+    return c
+
+
+def test_acl_disabled_until_first_ace(cluster):
+    s = cluster.session()
+    s.principal = "alice"
+    # no ACEs anywhere: authenticated sessions keep full access
+    assert int(s.execute("SELECT v FROM t").column("v")[0]) == 10
+    cluster.scheme.grant("/t", "bob", "read")
+    # enforcement now active: alice has no grant
+    with pytest.raises(PlanError, match="access denied"):
+        s.execute("SELECT v FROM t")
+
+
+def test_grants_enforce_per_permission(cluster):
+    sch = cluster.scheme
+    sch.grant("/t", "reader", "read")
+    sch.grant("/t", "writer", ["read", "write"])
+    sch.grant("/", "admin", "full")
+
+    r = cluster.session()
+    r.principal = "reader"
+    assert int(r.execute("SELECT v FROM t").column("v")[0]) == 10
+    with pytest.raises(PlanError, match="access denied"):
+        r.execute("INSERT INTO t VALUES (2, 20)")
+    with pytest.raises(PlanError, match="access denied"):
+        r.execute("DROP TABLE t")
+
+    w = cluster.session()
+    w.principal = "writer"
+    w.execute("INSERT INTO t VALUES (2, 20)")
+    with pytest.raises(PlanError, match="access denied"):
+        w.execute("CREATE TABLE t2 (id int64, PRIMARY KEY (id))")
+
+    a = cluster.session()
+    a.principal = "admin"  # root grant inherits down the tree
+    a.execute("CREATE TABLE t2 (id int64, PRIMARY KEY (id))")
+    a.execute("INSERT INTO t2 VALUES (1)")
+    assert int(a.execute("SELECT count(*) AS c FROM t2")
+               .column("c")[0]) == 1
+
+
+def test_revoke_and_access_list(cluster):
+    sch = cluster.scheme
+    sch.grant("/t", "u", ["read", "write"])
+    assert sch.access_list("/t") == {"u": ["read", "write"]}
+    sch.revoke("/t", "u", "write")
+    assert sch.access_list("/t") == {"u": ["read"]}
+    s = cluster.session()
+    s.principal = "u"
+    s.execute("SELECT v FROM t")
+    with pytest.raises(PlanError, match="access denied"):
+        s.execute("INSERT INTO t VALUES (3, 30)")
+    sch.revoke("/t", "u")
+    assert sch.access_list("/t") == {}
+
+
+def test_aces_survive_reboot(cluster):
+    cluster.scheme.grant("/t", "u", "read")
+    c2 = Cluster(store=cluster.store)
+    assert c2.scheme.access_list("/t") == {"u": ["read"]}
+    s = c2.session()
+    s.principal = "u"
+    assert s.execute("SELECT v FROM t").num_rows == 1
+    with pytest.raises(PlanError, match="access denied"):
+        s.execute("DROP TABLE t")
+
+
+def test_joins_check_every_scanned_table(cluster):
+    s0 = cluster.session()
+    s0.execute("CREATE TABLE u (id int64, w int64, PRIMARY KEY (id))")
+    s0.execute("INSERT INTO u VALUES (1, 7)")
+    cluster.scheme.grant("/t", "p", "read")  # NOT /u
+    s = cluster.session()
+    s.principal = "p"
+    with pytest.raises(PlanError, match="access denied.*'/u'|/u"):
+        s.execute("SELECT v, w FROM t, u WHERE t.id = u.id")
+
+
+def test_scalar_subquery_cannot_leak_forbidden_table(cluster):
+    """Plan-time subquery execution must pass the same read gate as
+    the outer query (code-review security regression)."""
+    s0 = cluster.session()
+    s0.execute("CREATE TABLE pub (id int64, PRIMARY KEY (id))")
+    s0.execute("INSERT INTO pub VALUES (1)")
+    cluster.scheme.grant("/pub", "eve", "read")
+    eve = cluster.session()
+    eve.principal = "eve"
+    with pytest.raises(PlanError, match="access denied"):
+        eve.execute("SELECT id FROM pub "
+                    "WHERE id <= (SELECT max(v) FROM t)")
+
+
+def test_explain_requires_read_access(cluster):
+    cluster.scheme.grant("/t", "other", "read")  # activate ACLs
+    eve = cluster.session()
+    eve.principal = "eve"
+    with pytest.raises(PlanError, match="access denied"):
+        eve.execute("EXPLAIN SELECT v FROM t")
+
+
+def test_sys_prefix_is_read_only_exemption(cluster):
+    cluster.scheme.grant("/t", "other", "read")  # activate ACLs
+    eve = cluster.session()
+    eve.principal = "eve"
+    # reads of sys views pass without grants ...
+    assert eve.execute(
+        "SELECT count(*) AS c FROM sys_scheme_paths").num_rows == 1
+    # ... but sys_ names grant no ddl/write escape hatch
+    with pytest.raises(PlanError):
+        eve.execute("CREATE TABLE sys_evil (id int64, "
+                    "PRIMARY KEY (id))")
+    root = cluster.session()  # even unauthenticated: prefix reserved
+    with pytest.raises(PlanError, match="reserved"):
+        root.execute("CREATE TABLE sys_evil (id int64, "
+                     "PRIMARY KEY (id))")
+
+
+def test_typo_revoke_fails_loud(cluster):
+    from ydb_tpu.scheme.shard import SchemeError
+
+    cluster.scheme.grant("/t", "u", "write")
+    with pytest.raises(SchemeError, match="unknown permission"):
+        cluster.scheme.revoke("/t", "u", "writes")
+    assert cluster.scheme.access_list("/t") == {"u": ["write"]}
+
+
+def test_session_cannot_be_hijacked_across_principals(cluster):
+    from ydb_tpu.api.client import ApiError, Driver
+    from ydb_tpu.api.server import make_server
+
+    cluster.scheme.grant("/t", "alice", "read")
+    server, port = make_server(cluster, port=0,
+                               auth_tokens={"alice", "bob"})
+    server.start()
+    try:
+        alice = Driver(f"127.0.0.1:{port}", auth_token="alice")
+        qa = alice.query_client()  # creates a server-side session
+        sid = qa.session_id
+        assert sid
+        import grpc
+
+        bob = Driver(f"127.0.0.1:{port}", auth_token="bob")
+        qb = bob.query_client()
+        qb.session_id = sid  # guessed/stolen session id
+        with pytest.raises(grpc.RpcError) as ei:
+            qb.execute("SELECT v FROM t")
+        assert ei.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        alice.close()
+        bob.close()
+    finally:
+        server.stop(0)
+
+
+def test_grpc_front_carries_principal(cluster):
+    from ydb_tpu.api.client import ApiError, Driver
+    from ydb_tpu.api.server import make_server
+
+    cluster.scheme.grant("/t", "sesame", "read")
+    server, port = make_server(cluster, port=0,
+                               auth_tokens={"sesame", "other"})
+    server.start()
+    try:
+        drv = Driver(f"127.0.0.1:{port}", auth_token="sesame")
+        q = drv.query_client()
+        out = q.execute("SELECT v FROM t")
+        assert out.column("v").to_pylist() == [10]
+        with pytest.raises(ApiError, match="access denied"):
+            q.execute("INSERT INTO t VALUES (9, 9)")
+        drv.close()
+        drv2 = Driver(f"127.0.0.1:{port}", auth_token="other")
+        with pytest.raises(ApiError, match="access denied"):
+            drv2.query_client().execute("SELECT v FROM t")
+        drv2.close()
+    finally:
+        server.stop(0)

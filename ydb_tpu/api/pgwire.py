@@ -137,6 +137,7 @@ class _Handler(socketserver.BaseRequestHandler):
             kv = dict(zip(params[0::2], params[1::2]))
             self.user = kv.get(b"user", b"").decode()
             break
+        self.principal = None
         if srv.auth_tokens is not None:
             sock.sendall(_msg(b"R", struct.pack("!I", 3)))  # cleartext
             t, body = self._read_message(sock)
@@ -144,6 +145,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 sock.sendall(_error("password authentication failed",
                                     "28P01"))
                 return False
+            self.principal = body[:-1].decode()  # the ACL subject
         sock.sendall(_msg(b"R", struct.pack("!I", 0)))  # AuthenticationOk
         for k, v in (("server_version", "15.0 ydb-tpu"),
                      ("server_encoding", "UTF8"),
@@ -168,6 +170,7 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _session_loop(self, srv, sock):
         session = srv.cluster.session()
+        session.principal = getattr(self, "principal", None)
         skip_to_sync = False
         while True:
             t, body = self._read_message(sock)

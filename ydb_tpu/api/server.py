@@ -46,37 +46,55 @@ class RequestProxy:
         self.lock = threading.Lock()
         self.endpoints: tuple = ()
 
-    def check_auth(self, context) -> bool:
+    def check_auth(self, context) -> str | None:
+        """Validates the ticket; returns it (the ACL principal) when
+        auth is on, None for open clusters."""
         if self.auth_tokens is None:
-            return True
+            return None
         md = dict(context.invocation_metadata())
-        if md.get("x-ydb-auth-ticket") in self.auth_tokens:
-            return True
+        ticket = md.get("x-ydb-auth-ticket")
+        if ticket in self.auth_tokens:
+            return ticket
         context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad ticket")
-        return False
+        return None
 
     # ---- Query ----
 
     def create_session(self, request, context):
-        self.check_auth(context)
+        principal = self.check_auth(context)
         with self.lock:
             sid = f"session-{next(self._next_session)}"
-            self.sessions[sid] = self.cluster.session()
+            session = self.cluster.session()
+            session.principal = principal
+            self.sessions[sid] = session
             while len(self.sessions) > self.max_sessions:
                 self.sessions.popitem(last=False)
         return pb.CreateSessionResponse(session_id=sid)
 
+    def _owned_session(self, session_id, principal, context):
+        """Session ids are guessable; a ticket may only drive sessions
+        it created (no cross-principal ACL identity borrowing)."""
+        session = self.sessions.get(session_id)
+        if session is not None and session.principal != principal:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED,
+                          "session belongs to another principal")
+        return session
+
     def delete_session(self, request, context):
-        self.check_auth(context)
+        principal = self.check_auth(context)
         with self.lock:
-            self.sessions.pop(request.session_id, None)
+            if self._owned_session(request.session_id, principal,
+                                   context) is not None:
+                self.sessions.pop(request.session_id, None)
         return pb.DeleteSessionResponse()
 
     def execute_query(self, request, context):
-        self.check_auth(context)
-        session = self.sessions.get(request.session_id)
+        principal = self.check_auth(context)
+        session = self._owned_session(request.session_id, principal,
+                                      context)
         if session is None:
             session = self.cluster.session()  # sessionless query
+            session.principal = principal
         try:
             with self.lock:
                 out = session.execute(request.sql)

@@ -253,6 +253,11 @@ class Cluster:
 
         if stmt.table in self.tables:
             raise PlanError(f"table {stmt.table} already exists")
+        if stmt.table.startswith("sys_"):
+            # reserved: user tables must not shadow system views (and
+            # the ACL read exemption for sys views must not become a
+            # writable escape hatch)
+            raise PlanError("the sys_ name prefix is reserved")
         fields = []
         for name, typ, not_null in stmt.columns:
             fields.append(dtypes.Field(name, _parse_type(typ), not not_null))
@@ -633,13 +638,17 @@ class Cluster:
                        dicts=self.dicts, row_counts=counts,
                        udfs=dict(self.udfs))
 
-    def _stmt_scalar_exec(self, stmt_db: list, snap: int | None = None):
+    def _stmt_scalar_exec(self, stmt_db: list, snap: int | None = None,
+                          access_check=None):
         """Scalar-subquery executor bound to ONE statement snapshot
         (lazily created into ``stmt_db[0]``): the KQP precompute-phase
         analog, shared by SELECT planning and EXPLAIN. ``snap`` pins
         the snapshot (interactive transactions pass their BEGIN
-        snapshot so sub- and outer query read the same state)."""
+        snapshot so sub- and outer query read the same state);
+        ``access_check`` gates each subquery plan before it reads."""
         def scalar_exec(plan_node, t):
+            if access_check is not None:
+                access_check(plan_node)
             if stmt_db[0] is None:
                 stmt_db[0] = self.snapshot_db(
                     snap, include_sys=self.flags.enable_sys_views)
@@ -674,11 +683,14 @@ class Cluster:
             sources = _SysLazySources(self, sources)
         return Database(sources=sources, dicts=self.dicts)
 
-    def plan(self, sql: str, snap: int | None = None):
+    def plan(self, sql: str, snap: int | None = None,
+             access_check=None):
         """``snap`` pins the statement snapshot (an interactive
         transaction's BEGIN snapshot): scalar subqueries precompute
-        against it, and such plans never enter the cache."""
-        if snap is None:
+        against it, and such plans never enter the cache.
+        ``access_check(plan_node)`` gates plan-time subquery execution
+        (ACL enforcement happens BEFORE any table is read)."""
+        if snap is None and access_check is None:
             hit = self._plan_cache.get(sql)
             if hit is not None:
                 if _P_PLAN_CACHE:
@@ -692,8 +704,9 @@ class Cluster:
             # EXPLAIN precomputes scalar subqueries exactly like
             # execution would (same guards, same single snapshot), so
             # the rendered plan is the plan the engine would run
-            pq = plan_select_full(stmt.select, self.catalog(),
-                                  self._stmt_scalar_exec([None], snap))
+            pq = plan_select_full(
+                stmt.select, self.catalog(),
+                self._stmt_scalar_exec([None], snap, access_check))
             return ("explain", pq.plan)
         if not isinstance(stmt, ast.Select):
             return stmt
@@ -702,10 +715,12 @@ class Cluster:
         # precompute and (if any ran) the outer execution read the same
         # state, preserving statement-level read consistency
         stmt_db: list = [None]
-        pq = plan_select_full(stmt, self.catalog(),
-                              self._stmt_scalar_exec(stmt_db, snap))
+        pq = plan_select_full(
+            stmt, self.catalog(),
+            self._stmt_scalar_exec(stmt_db, snap, access_check))
         entry = (pq.plan, dict(pq.dict_aliases), stmt_db[0])
-        if not pq.used_scalar_exec and snap is None:
+        if not pq.used_scalar_exec and snap is None \
+                and access_check is None:
             # plans with baked-in subquery results (or pinned to a tx
             # snapshot) are snapshot-bound: never serve from the cache
             self._plan_cache[sql] = entry
@@ -823,6 +838,9 @@ class Session:
 
     cluster: Cluster
     _tx: dict | None = None
+    # authenticated principal (the auth token); None = internal
+    # session, exempt from ACL checks
+    principal: str | None = None
 
     def execute(self, sql: str, trace_id: int | None = None):
         """Returns OracleTable for SELECT, TxResult for INSERT, None DDL."""
@@ -886,7 +904,10 @@ class Session:
             with span.child("plan") as plan_span:
                 planned = c.plan(
                     sql,
-                    snap=self._tx["snap"] if self._tx else None)
+                    snap=self._tx["snap"] if self._tx else None,
+                    access_check=(self._plan_access_check
+                                  if self.principal is not None
+                                  else None))
                 if not isinstance(planned, tuple):
                     kind = type(planned).__name__.lower()
                 elif planned[0] == "explain":
@@ -918,6 +939,45 @@ class Session:
                               request_units(kind, rows))
         return out
 
+    def _check_access(self, perm: str, *paths: str) -> None:
+        """ACL gate (scheme ACEs with subtree inheritance): enforced
+        for authenticated principals once any ACE exists; internal
+        (principal-less) sessions and ACL-less clusters pass."""
+        if self.principal is None:
+            return
+        scheme = self.cluster.scheme
+        if not scheme.acl_enabled():
+            return
+        for path in paths:
+            if perm == "read" and path.lstrip("/").startswith("sys_"):
+                continue  # sys VIEWS are readable; only reads exempt
+            if not scheme.check_access(self.principal, path, perm):
+                raise PlanError(
+                    f"access denied: {self.principal!r} lacks "
+                    f"{perm!r} on {path}")
+
+    def _plan_access_check(self, plan_node) -> None:
+        self._check_access(
+            "read", *("/" + t for t in self._plan_tables(plan_node)))
+
+    @staticmethod
+    def _plan_tables(node) -> set[str]:
+        """Table names referenced by a plan (TableScan leaves)."""
+        from ydb_tpu.plan.nodes import TableScan
+
+        out: set[str] = set()
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, TableScan):
+                out.add(n.table)
+                continue
+            for f in getattr(n, "__dataclass_fields__", {}):
+                v = getattr(n, f)
+                if hasattr(v, "__dataclass_fields__"):
+                    stack.append(v)
+        return out
+
     def _dispatch(self, planned):
         if isinstance(planned, ast.Begin):
             if self._tx is not None:
@@ -935,23 +995,28 @@ class Session:
             return None
         if isinstance(planned, ast.CreateTable):
             self._no_tx("DDL")
+            self._check_access("ddl", "/" + planned.table)
             self.cluster.create_table(planned)
             return None
         if isinstance(planned, ast.DropTable):
             self._no_tx("DDL")
+            self._check_access("ddl", "/" + planned.table)
             self.cluster.drop_table(planned)
             return None
         if isinstance(planned, ast.AlterTable):
             self._no_tx("DDL")
+            self._check_access("ddl", "/" + planned.table)
             self.cluster.alter_table(planned)
             return None
         if isinstance(planned, ast.Insert):
+            self._check_access("write", "/" + planned.table)
             if self._tx is not None:
                 t, ops = self.cluster.insert_ops(planned)
                 self._tx_buffer(planned.table, t, ops)
                 return None
             return self.cluster.insert(planned)
         if isinstance(planned, ast.Update):
+            self._check_access("write", "/" + planned.table)
             if self._tx is not None:
                 t = self.cluster._row_table(planned.table)
                 self._tx_lock(planned.table, t)
@@ -961,6 +1026,7 @@ class Session:
                 return None
             return self.cluster.update(planned)
         if isinstance(planned, ast.Delete):
+            self._check_access("write", "/" + planned.table)
             if self._tx is not None:
                 t = self.cluster._row_table(planned.table)
                 self._tx_lock(planned.table, t)
@@ -972,8 +1038,13 @@ class Session:
         if planned[0] == "explain":
             from ydb_tpu.plan.nodes import format_plan
 
+            # EXPLAIN reveals schema/plan shape: same read gate as
+            # executing the query would have
+            self._plan_access_check(planned[1])
             return format_plan(planned[1])
         p, alias_map, plan_db = planned
+        self._check_access(
+            "read", *("/" + t for t in self._plan_tables(p)))
         # reuse the plan-time snapshot when scalar subqueries precomputed
         # against it (statement-level read consistency)
         if plan_db is not None:

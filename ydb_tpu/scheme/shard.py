@@ -141,6 +141,83 @@ class SchemeShardCore:
         if k != "dir":
             raise SchemeError(f"parent {parent} is not a directory")
 
+    # ---- path ACLs (library/aclib + schemeshard ACL analog) ----
+
+    PERMS = frozenset({"read", "write", "ddl", "grant", "full"})
+
+    def grant(self, path: str, subject: str, perms) -> None:
+        """Grant permissions on ``path`` (inherited by the subtree) to
+        ``subject`` (an auth token / principal name)."""
+        path = _norm(path)
+        perms = {perms} if isinstance(perms, str) else set(perms)
+        bad = perms - self.PERMS
+        if bad:
+            raise SchemeError(f"unknown permission(s) {sorted(bad)}")
+        if path != "/" and not self.exists(path):
+            raise SchemeError(f"no path {path}")
+
+        def fn(txc):
+            cur = txc.get("acl", (path, subject))
+            have = set(cur["perms"]) if cur else set()
+            txc.put("acl", (path, subject),
+                    {"perms": sorted(have | perms)})
+            self._journal(txc, "grant", path)
+        self._run(fn)
+
+    def revoke(self, path: str, subject: str, perms=None) -> None:
+        """Revoke (some or all) permissions of ``subject`` on ``path``."""
+        path = _norm(path)
+        if perms is not None:
+            drop = {perms} if isinstance(perms, str) else set(perms)
+            bad = drop - self.PERMS
+            if bad:  # a typo'd revoke must not silently keep access
+                raise SchemeError(
+                    f"unknown permission(s) {sorted(bad)}")
+
+        def fn(txc):
+            cur = txc.get("acl", (path, subject))
+            if cur is None:
+                return
+            if perms is None:
+                txc.erase("acl", (path, subject))
+            else:
+                drop = {perms} if isinstance(perms, str) else set(perms)
+                left = sorted(set(cur["perms"]) - drop)
+                if left:
+                    txc.put("acl", (path, subject), {"perms": left})
+                else:
+                    txc.erase("acl", (path, subject))
+            self._journal(txc, "revoke", path)
+        self._run(fn)
+
+    def access_list(self, path: str) -> dict[str, list[str]]:
+        path = _norm(path)
+        return {subj: row["perms"] for (p, subj), row in
+                self.executor.db.table("acl").range()
+                if p == path}
+
+    def acl_enabled(self) -> bool:
+        """Enforcement is on once ANY ACE exists (bootstrap-friendly:
+        a cluster without configured ACLs keeps token-only auth)."""
+        for _k, _row in self.executor.db.table("acl").range():
+            return True
+        return False
+
+    def check_access(self, subject: str, path: str, perm: str) -> bool:
+        """True when an ACE on ``path`` or any ancestor grants
+        ``subject`` the permission (or "full")."""
+        path = _norm(path)
+        acl = self.executor.db.table("acl")
+        probe = path
+        while True:
+            row = acl.get((probe, subject))
+            if row is not None and (
+                    perm in row["perms"] or "full" in row["perms"]):
+                return True
+            if probe == "/":
+                return False
+            probe = _parent(probe)
+
     def create_table(self, desc: TableDescription) -> None:
         path = _norm(desc.path)
         if self.exists(path):
