@@ -218,16 +218,168 @@ def test_failed_dml_aborts_rest_of_query_string():
         srv.stop()
 
 
-def test_extended_protocol_resync(server):
+def _parse(c, name, query):
+    c.send_raw(b"P", name.encode() + b"\x00" + query.encode()
+               + b"\x00" + struct.pack("!H", 0))
+
+
+def _bind(c, portal, stmt, params):
+    body = portal.encode() + b"\x00" + stmt.encode() + b"\x00"
+    body += struct.pack("!H", 1) + struct.pack("!H", 0)  # all text
+    body += struct.pack("!H", len(params))
+    for p in params:
+        if p is None:
+            body += struct.pack("!i", -1)
+        else:
+            b = str(p).encode()
+            body += struct.pack("!i", len(b)) + b
+    body += struct.pack("!H", 0)  # result formats: default
+    c.send_raw(b"B", body)
+
+
+def _collect_until_ready(c):
+    msgs = []
+    while True:
+        t, body = c.read_message()
+        msgs.append((t, body))
+        if t == b"Z":
+            return msgs
+
+
+def test_extended_protocol_parameterized_flow(server):
     c = MiniPgClient(server.port)
-    # Parse message -> error; stream must resync on Sync
-    c.send_raw(b"P", b"\x00SELECT 1\x00\x00\x00")
+    c.query("CREATE TABLE e (k int64, name string, PRIMARY KEY (k))")
+
+    # Parse once, Bind/Execute twice with different parameters
+    _parse(c, "ins", "INSERT INTO e VALUES ($1, $2)")
+    for k, name in ((1, "ann"), (2, "bob's")):  # quote in the value
+        _bind(c, "", "ins", [k, name])
+        c.send_raw(b"E", b"\x00" + struct.pack("!i", 0))
+    c.send_raw(b"S")
+    types = [t for t, _ in _collect_until_ready(c)]
+    assert types.count(b"1") == 1 and types.count(b"2") == 2
+    assert types.count(b"C") == 2 and b"E" not in types
+
+    # select it back through Describe + Execute
+    _parse(c, "", "SELECT k, name FROM e WHERE k >= $1 ORDER BY k")
+    _bind(c, "", "", [1])
+    c.send_raw(b"D", b"P\x00")  # Describe portal -> RowDescription
+    c.send_raw(b"E", b"\x00" + struct.pack("!i", 0))
+    c.send_raw(b"S")
+    msgs = _collect_until_ready(c)
+    types = [t for t, _ in msgs]
+    assert types.count(b"T") == 1  # exactly one RowDescription
+    rows = [b for t, b in msgs if t == b"D"]
+    assert len(rows) == 2
+    # second row carries the escaped-quote string intact
+    assert b"bob's" in rows[1]
+    c.close()
+
+
+def test_extended_protocol_errors_resync(server):
+    c = MiniPgClient(server.port)
+    # Execute of an unknown portal -> error, then resync on Sync
+    c.send_raw(b"E", b"nope\x00" + struct.pack("!i", 0))
     t, body = c.read_message()
-    assert t == b"E" and b"extended" in body
+    assert t == b"E" and b"portal" in body
     c.send_raw(b"S")
     t, _ = c.read_message()
     assert t == b"Z"
-    c.query("CREATE TABLE e (k int64, PRIMARY KEY (k))")
-    _, _, tags, errors = c.query("EXPLAIN SELECT k FROM e")
+    # binary parameters are rejected cleanly
+    c.query("CREATE TABLE be (k int64, PRIMARY KEY (k))")
+    _parse(c, "", "INSERT INTO be VALUES ($1)")
+    t, _ = c.read_message()
+    assert t == b"1"  # ParseComplete
+    body = (b"\x00\x00" + struct.pack("!H", 1)
+            + struct.pack("!H", 1)       # format 1 = binary
+            + struct.pack("!H", 1)
+            + struct.pack("!i", 4) + b"\x00\x00\x00\x07"
+            + struct.pack("!H", 0))
+    c.send_raw(b"B", body)
+    t, body = c.read_message()
+    assert t == b"E" and b"binary" in body
+    c.send_raw(b"S")
+    t, _ = c.read_message()
+    assert t == b"Z"
+    # simple protocol still healthy afterwards
+    _, _, tags, errors = c.query("EXPLAIN SELECT k FROM be")
     assert not errors and tags == ["EXPLAIN"]
+    c.close()
+
+
+def test_param_substitution_is_injection_safe():
+    """Placeholder-looking and quote-carrying parameter VALUES are
+    inert data (code-review security regression)."""
+    from ydb_tpu.api.pgwire import _substitute_params
+
+    sql = _substitute_params("INSERT INTO t VALUES ($1, $2)",
+                             [b"x", b"$1"], [])
+    assert sql == "INSERT INTO t VALUES ('x', '$1')"
+    evil = b"a'; DROP TABLE t; --"
+    sql = _substitute_params("INSERT INTO t VALUES ($1, $2)",
+                             [evil, b"$1"], [])
+    assert sql == ("INSERT INTO t VALUES "
+                   "('a''; DROP TABLE t; --', '$1')")
+    # $n inside a query string literal is untouched
+    sql = _substitute_params("SELECT '$1 off' FROM t WHERE k = $1",
+                             [b"7"], [])
+    assert sql == "SELECT '$1 off' FROM t WHERE k = 7"
+    # explicit text OID forces quoting of numeric-looking strings
+    sql = _substitute_params("INSERT INTO t VALUES ($1)",
+                             [b"42"], [25])
+    assert sql == "INSERT INTO t VALUES ('42')"
+
+
+def test_execute_row_limit_and_portal_suspension(server):
+    c = MiniPgClient(server.port)
+    c.query("CREATE TABLE big (k int64, PRIMARY KEY (k))")
+    c.query("INSERT INTO big VALUES " + ", ".join(
+        f"({i})" for i in range(10)))
+    _parse(c, "", "SELECT k FROM big ORDER BY k")
+    _bind(c, "p1", "", [])
+    # fetch in pages of 4: 4 + 4 + 2
+    for expect_suspend in (True, True, False):
+        c.send_raw(b"E", b"p1\x00" + struct.pack("!i", 4))
+        c.send_raw(b"H")
+    c.send_raw(b"S")
+    msgs = _collect_until_ready(c)
+    types = [t for t, _ in msgs]
+    assert types.count(b"s") == 2          # two suspensions
+    assert types.count(b"D") == 10         # every row exactly once
+    assert any(t == b"C" and b"SELECT 2" in b for t, b in msgs)
+    # re-Execute after completion: zero rows, no duplicates
+    _bind(c, "p2", "", [])
+    c.send_raw(b"E", b"p2\x00" + struct.pack("!i", 0))
+    c.send_raw(b"E", b"p2\x00" + struct.pack("!i", 0))
+    c.send_raw(b"S")
+    msgs = _collect_until_ready(c)
+    assert [t for t, _ in msgs].count(b"D") == 10
+    assert any(t == b"C" and b"SELECT 0" in b for t, b in msgs)
+    c.close()
+
+
+def test_param_substitution_order_and_null(server):
+    c = MiniPgClient(server.port)
+    c.query("CREATE TABLE p (k int64, a int64, b string, "
+            "PRIMARY KEY (k))")
+    # 10+ params: $10 must not be clobbered by $1's value
+    cols = ", ".join(f"c{i} int64" for i in range(9))
+    c.query(f"CREATE TABLE wide (k int64, {cols}, PRIMARY KEY (k))")
+    placeholders = ", ".join(f"${i}" for i in range(1, 11))
+    _parse(c, "", f"INSERT INTO wide VALUES ({placeholders})")
+    _bind(c, "", "", [1, 10, 20, 30, 40, 50, 60, 70, 80, 90])
+    c.send_raw(b"E", b"\x00" + struct.pack("!i", 0))
+    c.send_raw(b"S")
+    types = [t for t, _ in _collect_until_ready(c)]
+    assert b"E" not in types
+    rows, _, _, errors = c.query("SELECT c8 FROM wide WHERE k = 1")
+    assert not errors and rows[0] == ["90"]  # $10's value, not $1's+0
+    # NULL parameter
+    _parse(c, "", "INSERT INTO p VALUES ($1, $2, $3)")
+    _bind(c, "", "", [5, None, "x"])
+    c.send_raw(b"E", b"\x00" + struct.pack("!i", 0))
+    c.send_raw(b"S")
+    assert b"E" not in [t for t, _ in _collect_until_ready(c)]
+    rows, _, _, errors = c.query("SELECT a FROM p WHERE k = 5")
+    assert not errors and rows[0] == [None]
     c.close()

@@ -14,10 +14,17 @@ Supported flow:
   * ParameterStatus + BackendKeyData + ReadyForQuery handshake,
   * simple Query ('Q') with multi-statement strings, text-format
     results (RowDescription/DataRow/CommandComplete),
-  * CancelRequest (connection-level no-op), Terminate ('X'),
-  * extended-protocol messages are answered with a clear error and
-    the stream resynchronizes on Sync — simple-query clients are the
-    compatibility target, exactly like the reference's initial pgwire.
+  * the extended query protocol: Parse/Bind/Describe/Execute/Close/
+    Flush/Sync with text-format $n parameters (inlined at Bind by a
+    quote-aware single-pass scanner; Parse-time type OIDs honored,
+    the unspecified-OID numeric heuristic documented in
+    _render_param), Execute row limits with PortalSuspended, portals
+    surviving Sync inside explicit transactions. Describe(portal)
+    returns the real row shape; Describe(statement) answers NoData
+    (drivers needing statement-level metadata — JDBC default flow —
+    must describe the portal). Binary parameter/result formats are
+    rejected with clear errors,
+  * CancelRequest (connection-level no-op), Terminate ('X').
 
 Every connection owns one session; cluster state is single-writer, so
 statement execution serializes on the shared lock (the same contract as
@@ -62,6 +69,114 @@ _PG_OID = {
     dtypes.Kind.DECIMAL: (1700, -1),
     dtypes.Kind.STRING: (25, -1),
 }
+
+
+class _PgError(Exception):
+    def __init__(self, message: str, code: str = "XX000"):
+        super().__init__(message)
+        self.code = code
+
+
+class _SkipToSync(Exception):
+    """An ErrorResponse was already sent; discard until Sync."""
+
+
+class _Cursor:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.off = 0
+
+    def take(self, n: int) -> bytes:
+        b = self.buf[self.off:self.off + n]
+        self.off += n
+        return b
+
+    def cstr(self) -> str:
+        end = self.buf.index(b"\x00", self.off)
+        s = self.buf[self.off:end].decode("utf-8", "surrogateescape")
+        self.off = end + 1
+        return s
+
+    def u16(self) -> int:
+        return struct.unpack("!H", self.take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("!i", self.take(4))[0]
+
+
+import re as _re
+
+_NUMERIC_RE = _re.compile(r"^[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?$")
+
+# pg type OIDs whose text form may inline unquoted
+_NUMERIC_OIDS = {20, 21, 23, 26, 700, 701, 1700}
+_TEXTUAL_OIDS = {25, 1043, 1042, 18, 19}
+
+
+def _render_param(raw: bytes, oid: int) -> str:
+    if raw is None:
+        return "NULL"
+    text = raw.decode("utf-8", "surrogateescape")
+    if oid in _NUMERIC_OIDS:
+        if not _NUMERIC_RE.match(text):
+            raise _PgError(f"invalid numeric parameter {text!r}",
+                           "22P02")
+        return text
+    if oid == 0 and _NUMERIC_RE.match(text):
+        # unspecified type: numeric-looking text inlines unquoted (a
+        # documented heuristic — drivers that mean the STRING '42'
+        # should declare a text OID at Parse time)
+        return text
+    return "'" + text.replace("'", "''") + "'"
+
+
+def _substitute_params(query: str, params: list,
+                       oids: list[int]) -> str:
+    """Inline text-format parameters into $n placeholders with ONE
+    linear scan that tracks quoting: placeholders inside string
+    literals stay untouched, and inlined values are emitted as opaque
+    units that are never re-scanned (no nested re-substitution, no
+    quote breakout from parameter contents)."""
+    rendered = [
+        _render_param(p, oids[i] if i < len(oids) else 0)
+        for i, p in enumerate(params)
+    ]
+    out = []
+    i = 0
+    n = len(query)
+    in_quote = False
+    while i < n:
+        ch = query[i]
+        if in_quote:
+            out.append(ch)
+            if ch == "'":
+                # doubled quote = escaped quote inside the literal
+                if i + 1 < n and query[i + 1] == "'":
+                    out.append("'")
+                    i += 1
+                else:
+                    in_quote = False
+            i += 1
+            continue
+        if ch == "'":
+            in_quote = True
+            out.append(ch)
+            i += 1
+            continue
+        if ch == "$" and i + 1 < n and query[i + 1].isdigit():
+            j = i + 1
+            while j < n and query[j].isdigit():
+                j += 1
+            idx = int(query[i + 1:j])
+            if not 1 <= idx <= len(rendered):
+                raise _PgError(
+                    f"there is no parameter ${idx}", "08P01")
+            out.append(rendered[idx - 1])
+            i = j
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
 
 
 def _msg(type_byte: bytes, payload: bytes) -> bytes:
@@ -172,6 +287,8 @@ class _Handler(socketserver.BaseRequestHandler):
         session = srv.cluster.session()
         session.principal = getattr(self, "principal", None)
         skip_to_sync = False
+        statements: dict[str, dict] = {}  # Parse'd prepared statements
+        portals: dict[str, dict] = {}     # Bind'd portals
         while True:
             t, body = self._read_message(sock)
             if t == b"X":
@@ -179,21 +296,168 @@ class _Handler(socketserver.BaseRequestHandler):
             if skip_to_sync:
                 if t == b"S":
                     skip_to_sync = False
+                    if session._tx is None:
+                        portals.clear()
                     self._ready(sock)
                 continue
-            if t == b"Q":
-                self._simple_query(srv, sock, session,
-                                   body.rstrip(b"\x00").decode(
-                                       "utf-8", "surrogateescape"))
-                self._ready(sock)
-            elif t in (b"P", b"B", b"D", b"E", b"C", b"F", b"H"):
-                sock.sendall(_error(
-                    "extended query protocol not supported; use "
-                    "simple query", "0A000"))
+            try:
+                if t == b"Q":
+                    self._simple_query(srv, sock, session,
+                                       body.rstrip(b"\x00").decode(
+                                           "utf-8", "surrogateescape"))
+                    self._ready(sock)
+                elif t == b"P":
+                    self._parse_msg(body, statements)
+                    sock.sendall(_msg(b"1", b""))  # ParseComplete
+                elif t == b"B":
+                    self._bind_msg(body, statements, portals)
+                    sock.sendall(_msg(b"2", b""))  # BindComplete
+                elif t == b"D":
+                    self._describe_msg(srv, sock, session, body,
+                                       statements, portals)
+                elif t == b"E":
+                    self._execute_msg(srv, sock, session, body, portals)
+                elif t == b"C":  # Close statement/portal
+                    kind, name = body[0:1], body[1:-1].decode()
+                    (statements if kind == b"S" else portals).pop(
+                        name, None)
+                    sock.sendall(_msg(b"3", b""))  # CloseComplete
+                elif t == b"H":  # Flush: everything is already sent
+                    pass
+                elif t == b"S":
+                    # Sync ends the implicit transaction and its
+                    # portals; inside an explicit BEGIN they survive
+                    # (libpq cursor-style fetch loops rely on this)
+                    if session._tx is None:
+                        portals.clear()
+                    self._ready(sock)
+            except _SkipToSync:
+                skip_to_sync = True  # error already on the wire
+            except _PgError as e:
+                sock.sendall(_error(str(e), e.code))
                 skip_to_sync = True
-            elif t == b"S":
-                self._ready(sock)
+            except (ConnectionError, OSError):
+                raise
+            except Exception as e:  # noqa: BLE001 - wire it to client
+                sock.sendall(_error(str(e), "XX000"))
+                skip_to_sync = True
             # anything else (e.g. stray password): ignore
+
+    # -- extended query protocol (Parse/Bind/Describe/Execute) --
+
+    def _parse_msg(self, body: bytes, statements: dict) -> None:
+        r = _Cursor(body)
+        name = r.cstr()
+        query = r.cstr()
+        n_oids = r.u16()
+        oids = [struct.unpack("!I", r.take(4))[0]
+                for _ in range(n_oids)]
+        statements[name] = {"query": query, "oids": oids}
+
+    def _bind_msg(self, body: bytes, statements: dict,
+                  portals: dict) -> None:
+        r = _Cursor(body)
+        portal = r.cstr()
+        stmt_name = r.cstr()
+        stmt = statements.get(stmt_name)
+        if stmt is None:
+            raise _PgError(f"unknown prepared statement "
+                           f"{stmt_name!r}", "26000")
+        n_fmt = r.u16()
+        fmts = [r.u16() for _ in range(n_fmt)]
+        n_params = r.u16()
+        params = []
+        for i in range(n_params):
+            ln = r.i32()
+            raw = None if ln == -1 else r.take(ln)
+            fmt = fmts[i] if i < len(fmts) else (fmts[0] if fmts else 0)
+            if raw is not None and fmt != 0:
+                raise _PgError("binary parameters not supported",
+                               "0A000")
+            params.append(raw)
+        n_res = r.u16()
+        if any(r.u16() == 1 for _ in range(n_res)):
+            raise _PgError("binary result format not supported",
+                           "0A000")
+        sql = _substitute_params(stmt["query"], params, stmt["oids"])
+        portals[portal] = {"sql": sql, "result": None, "done": False,
+                           "described": False, "sent": 0,
+                           "complete": False}
+
+    def _run_portal(self, srv, session, portal: dict) -> None:
+        if portal["done"]:
+            return
+        with srv.lock:
+            portal["result"] = session.execute(portal["sql"])
+        portal["done"] = True
+
+    def _describe_msg(self, srv, sock, session, body, statements,
+                      portals) -> None:
+        kind, name = body[0:1], body[1:-1].decode()
+        if kind == b"S":
+            if name not in statements:
+                raise _PgError(f"unknown prepared statement {name!r}",
+                               "26000")
+            # parameter types are inferred at bind time (text substitution)
+            sock.sendall(_msg(b"t", struct.pack("!H", 0)))
+            sock.sendall(_msg(b"n", b""))  # NoData until bound
+            return
+        portal = portals.get(name)
+        if portal is None:
+            raise _PgError(f"unknown portal {name!r}", "34000")
+        # the portal runs here (once); Execute streams the cached
+        # result — Describe must announce the real row shape
+        self._run_portal(srv, session, portal)
+        out = portal["result"]
+        if isinstance(out, OracleTable):
+            self._send_rowdesc(
+                sock, [(f.name, f.type.kind,
+                        getattr(f.type, "scale", 0))
+                       for f in out.schema.fields])
+            portal["described"] = True
+        else:
+            sock.sendall(_msg(b"n", b""))  # NoData (DML/DDL)
+
+    def _execute_msg(self, srv, sock, session, body, portals) -> None:
+        r = _Cursor(body)
+        name = r.cstr()
+        max_rows = r.i32()
+        portal = portals.get(name)
+        if portal is None:
+            raise _PgError(f"unknown portal {name!r}", "34000")
+        self._run_portal(srv, session, portal)
+        out = portal["result"]
+        if isinstance(out, OracleTable):
+            if portal["complete"]:  # re-Execute after completion:
+                sock.sendall(_msg(b"C", _cstr("SELECT 0")))
+                return
+            n = out.num_rows
+            start = portal["sent"]
+            take = (n - start if max_rows <= 0
+                    else min(max_rows, n - start))
+            self._send_table(sock, out,
+                             with_rowdesc=not portal["described"],
+                             start=start, limit=take,
+                             send_complete=False)
+            portal["described"] = True  # shape announced at most once
+            portal["sent"] = start + take
+            if portal["sent"] >= n:
+                portal["complete"] = True
+                sock.sendall(_msg(b"C", _cstr(f"SELECT {take}")))
+            else:
+                sock.sendall(_msg(b"s", b""))  # PortalSuspended
+            return
+        if portal["complete"]:
+            # effects applied exactly once; re-Execute re-acks only
+            verb = (portal["sql"].split(None, 1)[0]
+                    if portal["sql"].split() else "OK").upper()
+            sock.sendall(_msg(b"C", _cstr(verb)))
+            return
+        ok = self._send_result(sock, portal["sql"], out,
+                               with_rowdesc=False)
+        portal["complete"] = True
+        if not ok:
+            raise _SkipToSync()
 
     def _simple_query(self, srv, sock, session, text: str):
         statements = [s.strip() for s in text.split(";")]
@@ -211,15 +475,19 @@ class _Handler(socketserver.BaseRequestHandler):
             if not self._send_result(sock, stmt, out):
                 return  # failed DML also aborts the rest
 
-    def _send_result(self, sock, stmt: str, out) -> bool:
+    def _send_result(self, sock, stmt: str, out,
+                     with_rowdesc: bool = True) -> bool:
         """Sends the per-statement response; False = error sent (the
-        caller must abort the rest of the query string, pg semantics)."""
+        caller must abort the rest of the query string, pg semantics).
+        ``with_rowdesc=False`` for the extended protocol, where
+        RowDescription only answers Describe."""
         verb = (stmt.split(None, 1)[0] if stmt.split() else "").upper()
         if out is None:  # DDL
             sock.sendall(_msg(b"C", _cstr(verb or "OK")))
         elif isinstance(out, str):  # EXPLAIN text
-            self._send_rowdesc(
-                sock, [("QUERY PLAN", dtypes.Kind.STRING, 0)])
+            if with_rowdesc:
+                self._send_rowdesc(
+                    sock, [("QUERY PLAN", dtypes.Kind.STRING, 0)])
             for line in out.splitlines():
                 v = line.encode()
                 sock.sendall(_msg(
@@ -227,7 +495,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     + struct.pack("!I", len(v)) + v))
             sock.sendall(_msg(b"C", _cstr("EXPLAIN")))
         elif isinstance(out, OracleTable):
-            self._send_table(sock, out)
+            self._send_table(sock, out, with_rowdesc=with_rowdesc)
         elif isinstance(out, TxResult):
             if not out.committed:
                 sock.sendall(_error(out.error or "not committed",
@@ -249,12 +517,18 @@ class _Handler(socketserver.BaseRequestHandler):
                 + struct.pack("!IhIhih", 0, 0, oid, typlen, -1, 0))
         sock.sendall(_msg(b"T", b"".join(parts)))
 
-    def _send_table(self, sock, out: OracleTable):
+    def _send_table(self, sock, out: OracleTable,
+                    with_rowdesc: bool = True, start: int = 0,
+                    limit: int | None = None,
+                    send_complete: bool = True):
         fields = list(out.schema.fields)
-        self._send_rowdesc(
-            sock, [(f.name, f.type.kind, getattr(f.type, "scale", 0))
-                   for f in fields])
+        if with_rowdesc:
+            self._send_rowdesc(
+                sock,
+                [(f.name, f.type.kind, getattr(f.type, "scale", 0))
+                 for f in fields])
         n = out.num_rows
+        hi = n if limit is None else min(n, start + limit)
         text_cols = []
         for f in fields:
             vals, valid = out.cols[f.name]
@@ -262,14 +536,14 @@ class _Handler(socketserver.BaseRequestHandler):
             if f.type.is_string:
                 decoded = out.strings(f.name)
                 col = [None if not valid[i] else
-                       decoded[i] for i in range(n)]
+                       decoded[i] for i in range(start, hi)]
             else:
                 scale = getattr(f.type, "scale", 0)
                 col = [None if not valid[i] else
                        _format_value(f.type.kind, scale, vals[i])
-                       for i in range(n)]
+                       for i in range(start, hi)]
             text_cols.append(col)
-        for i in range(n):
+        for i in range(hi - start):
             parts = [struct.pack("!H", len(fields))]
             for col in text_cols:
                 v = col[i]
@@ -278,7 +552,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 else:
                     parts.append(struct.pack("!I", len(v)) + v)
             sock.sendall(_msg(b"D", b"".join(parts)))
-        sock.sendall(_msg(b"C", _cstr(f"SELECT {n}")))
+        if send_complete:
+            sock.sendall(_msg(b"C", _cstr(f"SELECT {hi - start}")))
 
 
 class PgWireServer:
