@@ -194,6 +194,22 @@ QUERIES = {
     "q21": ("select Title, count(*) as c from hits "
             "where Title <> '' and URL like '%google%' "
             "group by Title order by c desc, Title limit 10"),
+    "q22": ("select SearchPhrase, min(URL) as u, min(Title) as t, "
+            "count(*) as c, count(distinct UserID) as uu from hits "
+            "where Title like '%news%' "
+            "and URL not like '%.google.%' "
+            "and SearchPhrase <> '' group by SearchPhrase "
+            "order by c desc, SearchPhrase limit 10"),
+    "q24": ("select SearchPhrase, EventTime from hits "
+            "where SearchPhrase <> '' order by EventTime limit 10"),
+    "q25": ("select SearchPhrase from hits where SearchPhrase <> '' "
+            "order by SearchPhrase limit 10"),
+    "q26": ("select SearchPhrase from hits where SearchPhrase <> '' "
+            "order by EventTime, SearchPhrase limit 10"),
+    "q27": ("select CounterID, avg(length(URL)) as l, count(*) as c "
+            "from hits where URL <> '' group by CounterID "
+            "having count(*) > 4 order by l desc, CounterID "
+            "limit 25"),
 }
 
 
@@ -296,6 +312,39 @@ def reference_answers(data: ClickBenchData) -> dict[str, object]:
         t for t, g in zip(titles, googley) if g and t != b"")
     out["q21"] = sorted(c21.items(),
                         key=lambda kv: (-kv[1], kv[0]))[:10]
+
+    g22: dict = {}
+    for u, t, p, uid in zip(urls, titles, phrases,
+                            h["UserID"].tolist()):
+        if p == b"" or b"news" not in t or b".google." in u:
+            continue
+        st = g22.setdefault(p, [u, t, 0, set()])
+        st[0] = min(st[0], u)
+        st[1] = min(st[1], t)
+        st[2] += 1
+        st[3].add(uid)
+    out["q22"] = sorted(
+        ((k, v[0], v[1], v[2], len(v[3])) for k, v in g22.items()),
+        key=lambda r: (-r[3], r[0]))[:10]
+
+    ev = h["EventTime"].tolist()
+    nonempty = [(e, p) for e, p in zip(ev, phrases) if p != b""]
+    # q24 orders by EventTime only: verify the (time, phrase)
+    # MULTISET of the first 10 — ties make the exact order free
+    out["q24"] = sorted(nonempty)[:10]
+    out["q25"] = sorted((p for _e, p in nonempty))[:10]
+    out["q26"] = [p for _e, p in sorted(nonempty)[:10]]
+
+    g27: dict = {}
+    for cid, u in zip(h["CounterID"].tolist(), urls):
+        if u == b"":
+            continue
+        st = g27.setdefault(cid, [0, 0])
+        st[0] += len(u)
+        st[1] += 1
+    out["q27"] = sorted(
+        ((cid, s / n, n) for cid, (s, n) in g27.items() if n > 4),
+        key=lambda r: (-r[1], r[0]))[:25]
     return out
 
 
@@ -410,5 +459,26 @@ def _verify(name: str, out, want, data, pq=None) -> None:
     elif name == "q21":
         got = list(zip(strs("Title"), ints("c")))
         assert got == want, (name, got[:3], want[:3])
+    elif name == "q22":
+        got = list(zip(strs("SearchPhrase"), strs("u"), strs("t"),
+                       ints("c"), ints("uu")))
+        assert got == want, (name, got[:2], want[:2])
+    elif name == "q24":
+        got = sorted(zip(ints("EventTime"), strs("SearchPhrase")))
+        # tie-tolerant: same multiset of (time, phrase), time-ordered
+        assert [e for e, _ in got] == [e for e, _ in want] and \
+            sorted(got) == sorted(want), (name, got[:3], want[:3])
+    elif name in ("q25", "q26"):
+        got = strs("SearchPhrase")
+        assert got == want, (name, got[:3], want[:3])
+    elif name == "q27":
+        got = list(zip(ints("CounterID"),
+                       [float(v) for v in
+                        np.asarray(out.cols["l"][0])],
+                       ints("c")))
+        assert len(got) == len(want)
+        for (gc, gl, gn), (wc, wl, wn) in zip(got, want):
+            assert (gc, gn) == (wc, wn), (name, gc, wc)
+            assert abs(gl - wl) < 1e-9, (name, gl, wl)
     else:
         raise KeyError(name)
