@@ -10,8 +10,8 @@ queries exercise the same shapes: wide scans, high-cardinality group-by,
 COUNT(DISTINCT) — including Q9's mix of distinct and plain aggregates —
 and top-N by aggregate. Canonical answers come from
 ``reference_answers`` — an independent numpy implementation the engine
-results must match exactly (the canondata pattern). The dict below
-covers Q0-Q13.
+results must match exactly (the canondata pattern). The dict below covers 27 of the
+official 43 queries (q0-q22, q24-q27).
 """
 
 from __future__ import annotations
