@@ -124,6 +124,9 @@ class Cluster:
         self._qid_lock = threading.Lock()
         # registered scalar UDFs: name -> (vectorized fn, result type)
         self.udfs: dict[str, tuple] = {}
+        # durable sequence allocator (sequenceshard analog), lazily
+        # booted on first CREATE SEQUENCE / nextval
+        self._sequences = None
         # live-tunable knobs (immediate control board)
         self.icb = ControlBoard()
         self.icb.register("rmw_retries", 5, 1, 100)
@@ -551,6 +554,17 @@ class Cluster:
             return TxResult(0, snap, True)
         return t._commit_ops(ops, lock_ids=locks)
 
+    @property
+    def sequences(self):
+        if self._sequences is None:
+            from ydb_tpu.tablet.kesus import SequenceShard
+
+            with self._qid_lock:  # double-boot would fork the journal
+                if self._sequences is None:
+                    self._sequences = SequenceShard("cluster",
+                                                    self.store)
+        return self._sequences
+
     def insert(self, stmt: ast.Insert) -> TxResult:
         t, arrays, val = self._insert_arrays(stmt)
         res = t.insert(arrays, val)  # journals dict growth via pre_commit
@@ -580,6 +594,19 @@ class Cluster:
             if len(row) != len(names):
                 raise PlanError("row arity mismatch")
             for n, e in zip(names, row):
+                if isinstance(e, ast.FuncCall) and \
+                        e.name == "nextval":
+                    # volatile per-row default from the durable
+                    # sequence allocator (kqp sequencer analog)
+                    if len(e.args) != 1 or not (
+                            isinstance(e.args[0], ast.Literal)
+                            and e.args[0].kind == "string"):
+                        raise PlanError(
+                            "nextval needs a sequence name literal")
+                    arg = e.args[0]
+                    cols[n].append(self.sequences.next_val(arg.value))
+                    validity[n].append(True)
+                    continue
                 v, ok = _literal_value(e, t.schema.field(n).type)
                 cols[n].append(v)
                 validity[n].append(ok)
@@ -992,6 +1019,18 @@ class Session:
             return self._tx_commit()
         if isinstance(planned, ast.Rollback):
             self._tx_release()
+            return None
+        if isinstance(planned, ast.CreateSequence):
+            self._no_tx("DDL")
+            self._check_access("ddl", "/" + planned.name)
+            self.cluster.sequences.create_sequence(
+                planned.name, start=planned.start,
+                increment=planned.increment, cache=planned.cache)
+            return None
+        if isinstance(planned, ast.DropSequence):
+            self._no_tx("DDL")
+            self._check_access("ddl", "/" + planned.name)
+            self.cluster.sequences.drop_sequence(planned.name)
             return None
         if isinstance(planned, ast.CreateTable):
             self._no_tx("DDL")

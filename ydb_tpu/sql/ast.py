@@ -215,6 +215,21 @@ class Explain:
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateSequence:
+    """CREATE SEQUENCE name [START n] [INCREMENT n] [CACHE n]."""
+
+    name: str
+    start: int = 1
+    increment: int = 1
+    cache: int = 100
+
+
+@dataclasses.dataclass(frozen=True)
+class DropSequence:
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Begin:
     """BEGIN: open an interactive transaction on the session."""
 
@@ -230,4 +245,5 @@ class Rollback:
 
 
 Statement = Union[Select, Insert, CreateTable, DropTable, AlterTable,
-                  Update, Delete, Explain, Begin, Commit, Rollback]
+                  Update, Delete, Explain, Begin, Commit, Rollback,
+                  CreateSequence, DropSequence]

@@ -276,8 +276,25 @@ class Parser:
                 break
         return ast.Insert(table, tuple(cols), tuple(rows))
 
-    def parse_create(self) -> ast.CreateTable:
+    def parse_create(self):
         self.expect("kw", "create")
+        if self.peek().kind == "name" and \
+                self.peek().value.lower() == "sequence":
+            self.next()
+            name = self.expect("name").value
+            opts = {"start": 1, "increment": 1, "cache": 100}
+            while self.peek().kind == "name" and \
+                    self.peek().value.lower() in ("start", "increment",
+                                                  "cache"):
+                key = self.next().value.lower()
+                self.accept("kw", "with")
+                neg = (self.peek().kind == "op"
+                       and self.peek().value == "-"
+                       and bool(self.next()))
+                val = int(self.expect("number").value)
+                opts[key] = -val if neg else val
+            return ast.CreateSequence(name, opts["start"],
+                                      opts["increment"], opts["cache"])
         self.expect("kw", "table")
         table = self.expect("name").value
         self.expect("op", "(")
@@ -330,8 +347,12 @@ class Parser:
             self.expect("op", ")")
         return ast.CreateTable(table, tuple(columns), pk, tuple(options))
 
-    def parse_drop(self) -> ast.DropTable:
+    def parse_drop(self):
         self.expect("kw", "drop")
+        if self.peek().kind == "name" and \
+                self.peek().value.lower() == "sequence":
+            self.next()
+            return ast.DropSequence(self.expect("name").value)
         self.expect("kw", "table")
         return ast.DropTable(self.expect("name").value)
 

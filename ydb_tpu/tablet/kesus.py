@@ -232,13 +232,22 @@ class SequenceShard:
     def __init__(self, tablet_id: str, store: BlobStore):
         self.executor = TabletExecutor.boot(
             f"sequence/{tablet_id}", store)
-        # name -> (next_value, values_remaining, increment)
+        # name -> (next_value, values_remaining, increment); the lock
+        # serializes the cache's read-modify-write so concurrent
+        # nextval callers never receive the same value
         self._cache: dict[str, tuple[int, int, int]] = {}
+        import threading
+
+        self._lock = threading.Lock()
 
     def create_sequence(self, name: str, start: int = 1,
                         increment: int = 1, cache: int = 100) -> None:
         if increment == 0:
             raise ValueError("increment must be nonzero")
+        if cache < 1:
+            # cache 0 would never advance the durable counter: every
+            # nextval would return the same value forever
+            raise ValueError("cache must be >= 1")
 
         def fn(txc):
             if txc.get("sequences", (name,)) is not None:
@@ -255,6 +264,7 @@ class SequenceShard:
         self._cache.pop(name, None)
 
     def next_val(self, name: str) -> int:
+      with self._lock:
         val, remaining, inc = self._cache.get(name, (0, 0, 1))
         if remaining <= 0:
             def fn(txc):
