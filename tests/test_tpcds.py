@@ -36,7 +36,10 @@ def catalog(data):
 
 @pytest.mark.parametrize("name", sorted(tpcds.QUERIES))
 def test_query(name, data, db, catalog):
-    pq = plan_select_full(parse(tpcds.QUERIES[name]), catalog)
+    from ydb_tpu.workload.runner import scalar_exec_for
+
+    pq = plan_select_full(parse(tpcds.QUERIES[name]), catalog,
+                          scalar_exec_for(db))
     out = to_host(execute_plan(pq.plan, db))
     want = tpcds.reference_answers(data, [name])[name]
     assert len(want) > 0, f"{name}: vacuous reference (generator issue)"

@@ -13,10 +13,10 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow the official templates (q3, q7, q13, q19, q26, q42,
-q43, q48, q52, q55, q96) restated in the framework dialect (q13/q48
-hoist the join equalities shared by every OR branch — an exact
-identity); each is verified
+Queries follow the official templates (q3, q6, q7, q13, q19, q26,
+q42, q43, q48, q52, q55, q96) restated in the framework dialect
+(q13/q48 hoist the join equalities shared by every OR branch — an
+exact identity); each is verified
 against ``reference_answers`` — an independent numpy implementation
 computed straight off the generated tables (the canondata pattern,
 ydb/tests/functional/tpc).
@@ -58,6 +58,7 @@ DATE_DIM_SCHEMA = dtypes.schema(
     ("d_year", dtypes.INT32, False),
     ("d_moy", dtypes.INT32, False),
     ("d_dom", dtypes.INT32, False),
+    ("d_month_seq", dtypes.INT32, False),
     ("d_day_name", dtypes.STRING, False),
 )
 
@@ -71,6 +72,7 @@ ITEM_SCHEMA = dtypes.schema(
     ("i_manufact_id", dtypes.INT32, False),
     ("i_manufact", dtypes.STRING, False),
     ("i_manager_id", dtypes.INT32, False),
+    ("i_current_price", DEC2, False),
 )
 
 STORE_SCHEMA = dtypes.schema(
@@ -225,6 +227,10 @@ class TpcdsData:
             "d_year": (y.astype(int) + 1970).astype(np.int32),
             "d_moy": ((m - y).astype(int) + 1).astype(np.int32),
             "d_dom": ((ymd - m).astype(int) + 1).astype(np.int32),
+            # months since 1998-01 (a consistent absolute month index)
+            "d_month_seq": (m.astype(int)
+                            - np.datetime64("1998-01", "M")
+                            .astype(int)).astype(np.int32),
             "d_day_name": _enc(
                 self.dicts, "d_day_name",
                 [_DAY_NAMES[d] for d in
@@ -259,6 +265,7 @@ class TpcdsData:
                 [b"manufact#%d" % m for m in manufact_id.tolist()]),
             "i_manager_id": rng.permutation(
                 (np.arange(n) % 100 + 1)).astype(np.int32),
+            "i_current_price": _cents(rng, 0.50, 100.00, n),
         }
 
     def _gen_store(self, rng, n: int):
@@ -407,6 +414,26 @@ where d_date_sk = ss_sold_date_sk
   and d_moy = 11
 group by d_year, i_brand_id, i_brand
 order by d_year, sum_agg desc, i_brand_id
+limit 100""",
+    # q6: states whose customers bought items priced 20% above their
+    # category average, in one chosen month (uncorrelated DISTINCT
+    # subquery for the month + correlated avg-by-category subquery)
+    "q6": """
+select a.ca_state, count(*) as cnt
+from customer_address a, customer c, store_sales s, date_dim d,
+     item i
+where a.ca_address_sk = c.c_current_addr_sk
+  and c.c_customer_sk = s.ss_customer_sk
+  and s.ss_sold_date_sk = d.d_date_sk
+  and s.ss_item_sk = i.i_item_sk
+  and d.d_month_seq = (select distinct d_month_seq from date_dim
+                       where d_year = 2001 and d_moy = 1)
+  and i.i_current_price > 1.2 * (select avg(j.i_current_price)
+                                 from item j
+                                 where j.i_category = i.i_category)
+group by a.ca_state
+having count(*) >= 10
+order by cnt, a.ca_state
 limit 100""",
     # q7: demographic/promotion item averages
     "q7": """
@@ -726,6 +753,46 @@ class _Ref:
                 for k, st in sorted(acc.items())]
         return rows[:100]
 
+    def q6(self):
+        d = self.d
+        dd = d.tables["date_dim"]
+        target_seq = {int(s) for y, m, s in zip(
+            dd["d_year"].tolist(), dd["d_moy"].tolist(),
+            dd["d_month_seq"].tolist()) if y == 2001 and m == 1}
+        assert len(target_seq) == 1
+        seq = next(iter(target_seq))
+        date_ok = {k for k, s in zip(dd["d_date_sk"].tolist(),
+                                     dd["d_month_seq"].tolist())
+                   if s == seq}
+        it = d.tables["item"]
+        cat_sum: dict = collections.defaultdict(lambda: [0, 0])
+        for c, p in zip(it["i_category_id"].tolist(),
+                        it["i_current_price"].tolist()):
+            cat_sum[c][0] += p
+            cat_sum[c][1] += 1
+        cat_avg = {c: s / n for c, (s, n) in cat_sum.items()}
+        pricey = {sk for sk, c, p in zip(
+            it["i_item_sk"].tolist(), it["i_category_id"].tolist(),
+            it["i_current_price"].tolist())
+            if p > 1.2 * cat_avg[c]}
+        cust_addr = dict(zip(
+            d.tables["customer"]["c_customer_sk"].tolist(),
+            d.tables["customer"]["c_current_addr_sk"].tolist()))
+        states = _decode(d, "customer_address", "ca_state")
+        addr_state = dict(zip(
+            d.tables["customer_address"]["ca_address_sk"].tolist(),
+            states.tolist()))
+        ss = d.tables["store_sales"]
+        cnt: dict = collections.Counter()
+        for dk, ck, ik in zip(ss["ss_sold_date_sk"].tolist(),
+                              ss["ss_customer_sk"].tolist(),
+                              ss["ss_item_sk"].tolist()):
+            if dk in date_ok and ik in pricey:
+                cnt[addr_state[cust_addr[ck]]] += 1
+        rows = [(st, n) for st, n in cnt.items() if n >= 10]
+        rows.sort(key=lambda r: (r[1], r[0]))
+        return rows[:100]
+
     def q7(self):
         return self._demo_avgs("store_sales", "ss_", "ss_cdemo_sk")
 
@@ -967,7 +1034,10 @@ def run_tpcds(sf: float = 0.01, queries=None, iterations: int = 1,
     want = reference_answers(data, names) if verify else {}
     results = []
     for name in names:
-        pq = plan_select_full(parse(QUERIES[name]), catalog)
+        from ydb_tpu.workload.runner import scalar_exec_for
+
+        pq = plan_select_full(parse(QUERIES[name]), catalog,
+                              scalar_exec_for(db))
         out = to_host(execute_plan(pq.plan, db))  # warmup/compile
         if verify:
             verify_result(name, out, want[name], data, pq)
@@ -985,6 +1055,7 @@ def run_tpcds(sf: float = 0.01, queries=None, iterations: int = 1,
 _VERIFY_COLS = {
     "q3": (("d_year", "int"), ("i_brand_id", "int"), ("i_brand", "str"),
            ("sum_agg", "dec")),
+    "q6": (("ca_state", "str"), ("cnt", "int")),
     "q7": (("i_item_id", "str"), ("agg1", "avg"), ("agg2", "avg"),
            ("agg3", "avg"), ("agg4", "avg")),
     "q13": (("avg_qty", "avg"), ("avg_esp", "avg"),
