@@ -35,6 +35,7 @@ Phase progress logs to stderr; stdout stays the one JSON line.
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
@@ -48,6 +49,45 @@ def _log(stage: str) -> None:
     """Phase progress to stderr (stdout stays the one JSON line)."""
     print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {stage}",
           file=sys.stderr, flush=True)
+
+
+def probe_backend() -> str | None:
+    """Probe the accelerator backend in a SUBPROCESS with a timeout.
+
+    The axon TPU tunnel can wedge such that ``jax.devices()`` hangs
+    forever (it ate all of round 4 — BENCH_r04 was rc=1 with zero
+    numbers). Probing in a child process bounds the damage: if the child
+    does not report a platform within YDB_TPU_BENCH_PROBE_TIMEOUT
+    (default 120s), the parent falls back to the CPU backend and reports
+    ``extra.tpu_unavailable`` instead of producing nothing. The hung
+    child is deliberately ABANDONED, not killed — killing a process
+    mid-claim wedges the tunnel for hours (learned the hard way).
+
+    Returns the platform string ("tpu"/"axon"/"cpu") or None when the
+    probe hung or crashed.
+    """
+    timeout = float(os.environ.get("YDB_TPU_BENCH_PROBE_TIMEOUT", "120"))
+    code = ("import jax; d = jax.devices(); "
+            "print('PLATFORM:' + d[0].platform, flush=True)")
+    try:
+        child = subprocess.Popen(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True,
+            start_new_session=True)
+    except OSError as e:
+        _log(f"probe spawn failed: {e}")
+        return None
+    try:
+        out, _ = child.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log(f"backend probe hung >{timeout:g}s (tunnel wedged); "
+             "abandoning child, falling back to CPU")
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith("PLATFORM:"):
+            return line.split(":", 1)[1].strip()
+    _log(f"backend probe exited rc={child.returncode} without a platform")
+    return None
 
 
 def _budget_left(budget: float) -> float:
@@ -169,7 +209,25 @@ def main():
     # bench run must always produce its one JSON line
     budget = float(os.environ.get("YDB_TPU_BENCH_BUDGET", "1500"))
 
+    # un-wedgeable backend selection (VERDICT r4 weak #1): probe the
+    # accelerator in a subprocess; on hang/crash, pin the CPU backend
+    # BEFORE any jax backend initialization in this process
+    tpu_unavailable = False
+    if os.environ.get("YDB_TPU_BENCH_FORCE_CPU", "0") not in (
+            "0", "", "off"):
+        platform = "cpu(forced)"
+    else:
+        platform = probe_backend()
+    if platform is None:
+        tpu_unavailable = True
+    _log(f"backend probe: {platform!r}")
+
     import jax
+
+    if tpu_unavailable or platform == "cpu(forced)":
+        # sitecustomize ignores JAX_PLATFORMS env; only the config
+        # update after import works in this environment
+        jax.config.update("jax_platforms", "cpu")
 
     from ydb_tpu.engine.blobs import DirBlobStore
     from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
@@ -182,7 +240,10 @@ def main():
     n_rows = len(li["l_orderkey"])
     src = ColumnSource(li, tpch.LINEITEM_SCHEMA, data.dicts)
 
-    extra = {"sf": sf, "rows": n_rows, "engine_sf": engine_sf}
+    extra = {"sf": sf, "rows": n_rows, "engine_sf": engine_sf,
+             "backend": jax.default_backend()}
+    if tpu_unavailable:
+        extra["tpu_unavailable"] = True
 
     # ---- CPU baseline: averaged over >= 5 runs (VERDICT r3 weak #3) ----
     _log("CPU baselines")
@@ -243,7 +304,7 @@ def main():
     # Pallas one-hot group-by vs XLA scatter A/B (VERDICT r4 item 9):
     # by default on the real chip; force with YDB_TPU_BENCH_PALLAS_COMPARE
     flag = os.environ.get("YDB_TPU_BENCH_PALLAS_COMPARE")
-    ab_enabled = (jax.default_backend() == "tpu" if flag is None
+    ab_enabled = (jax.default_backend() in ("tpu", "axon") if flag is None
                   else flag not in ("0", "", "off"))
     skipped = extra.setdefault("skipped", [])
     if ab_enabled and _budget_left(budget) > 120:
