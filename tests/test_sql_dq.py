@@ -95,6 +95,41 @@ def test_q12_case_agg_through_dq(data, catalog, single_db, dq_sources):
     _match(res, ref, ("l_shipmode", "high_line_count", "low_line_count"))
 
 
+def test_orderby_no_groupby_through_dq(data, catalog, single_db,
+                                       dq_sources):
+    """A group-less ORDER BY (and its LIMIT top-k) must apply ONCE over
+    the merged inputs, not per block — the per-block sort + arrival-order
+    concat regression (SortStep split in kqp/dq_lower._split_at_sort)."""
+    sql = ("SELECT l.l_orderkey AS k, l.l_extendedprice AS p "
+           "FROM lineitem l JOIN orders o ON l.l_orderkey = o.o_orderkey "
+           "ORDER BY p DESC, k LIMIT 50")
+    plan = plan_select_full(parse(sql), catalog).plan
+    ref = to_host(execute_plan(plan, single_db, use_dq=False))
+    rt = SimRuntime(n_nodes=2)
+    res = execute_plan_dq(plan, dq_sources, rt, dicts=data.dicts,
+                          n_tasks=N_TASKS, block_rows=1 << 10)
+    _match(res, ref, ("k", "p"))
+
+
+def test_default_executor_routes_joins_to_dq(catalog, single_db):
+    """execute_plan (the production entry) runs join plans on the DQ
+    stage graph by default; YDB_TPU_DQ=0 (use_dq=False) is the only way
+    back to the recursive walk."""
+    from ydb_tpu.plan import executor as ex
+
+    plan = plan_select_full(parse(TPCH["q3"]), catalog).plan
+    called = []
+    orig = ex._execute_plan_dq
+    ex._execute_plan_dq = lambda p, d: (called.append(1), orig(p, d))[1]
+    try:
+        out = to_host(execute_plan(plan, single_db))
+    finally:
+        ex._execute_plan_dq = orig
+    assert called, "join plan bypassed the DQ executor"
+    ref = to_host(execute_plan(plan, single_db, use_dq=False))
+    _match(out, ref, ("l_orderkey", "revenue"))
+
+
 def test_stage_graph_shape(catalog):
     """q3 lowers to scan stages -> hash-partitioned join stages -> one
     result transform; joins never get a whole-table UnionAll input."""

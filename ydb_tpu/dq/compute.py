@@ -393,15 +393,24 @@ class ComputeActor(Actor):
     # ---- source streaming ----
 
     def _start_source(self):
+        # scan stages stream only the program's required columns (the
+        # scan-executor projection, ScanExecutor.read_cols): stream
+        # sources then skip unread chunks entirely
+        names = None
+        if self.compiled.per_block is not None:
+            names = self.compiled.in_schema.names
+
         def blocks(skip: int):
             # checkpoint resume: seek in O(1) per source rather than
-            # materializing and discarding consumed blocks
+            # materializing and discarding consumed blocks (n_blocks is
+            # only required of sources that actually resume)
             for source in self.sources:
-                nb = source.n_blocks(self.block_rows)
-                if skip >= nb:
-                    skip -= nb
-                    continue
-                yield from source.blocks(self.block_rows,
+                if skip:
+                    nb = source.n_blocks(self.block_rows)
+                    if skip >= nb:
+                        skip -= nb
+                        continue
+                yield from source.blocks(self.block_rows, columns=names,
                                          start_block=skip)
                 skip = 0
 
@@ -581,14 +590,14 @@ class ResultCollector(Actor):
         if message.finished:
             self.done = True
 
-    def table(self) -> OracleTable:
+    def result_block(self) -> TableBlock:
         if not self.payloads:
-            blk = _empty_block(self.schema)
-            return OracleTable.from_block(blk)
+            return _empty_block(self.schema)
         blocks = [payload_to_block(p, self.schema) for p in self.payloads]
-        return OracleTable.from_block(
-            blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
-        )
+        return blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
+
+    def table(self) -> OracleTable:
+        return OracleTable.from_block(self.result_block())
 
 
 @dataclasses.dataclass
@@ -623,19 +632,29 @@ def build_stage_graph(
     checkpoint_storage=None,
     restore_checkpoint: int | None = None,
     block_rows: int = 1 << 16,
+    compile_cache: dict | None = None,
 ) -> GraphHandle:
     """Compile stages, place tasks round-robin over the runtime's nodes,
     wire channels (the executer-actor shape, kqp_executer_impl.h:120 +
     planner kqp_planner.cpp:116). With ``checkpoint_storage``, a
     CheckpointCoordinator is attached; with ``restore_checkpoint``,
-    every task loads its saved state and sources resume mid-stream."""
+    every task loads its saved state and sources resume mid-stream.
+    ``compile_cache`` memoizes compiled stages across graphs (the
+    computation-pattern-cache seam the single-chip executor has)."""
+    from ydb_tpu.engine.scan import required_columns
+
     # schemas flow source -> downstream
     compiled: list[_CompiledStage] = []
     for si, spec in enumerate(stages):
         in_schemas = []
         for inp in spec.inputs:
             if isinstance(inp, SourceInput):
-                in_schemas.append(sources[inp.source_id][0].schema)
+                sch = sources[inp.source_id][0].schema
+                if spec.program is not None:
+                    # scan projection: compile (and later stream) only
+                    # the program's required columns
+                    sch = sch.select(required_columns(spec.program, sch))
+                in_schemas.append(sch)
             else:
                 in_schemas.append(compiled[inp.from_stage].out_schema)
         if not in_schemas:
@@ -651,9 +670,23 @@ def build_stage_graph(
                 f"stage {si}: all inputs must share one schema, got "
                 f"{[s.names for s in in_schemas]}"
             )
-        compiled.append(
-            _CompiledStage(spec, in_schemas, dicts, key_spaces)
-        )
+        ck = None
+        if compile_cache is not None:
+            # dicts participate by identity (aux tables bake dictionary
+            # contents); key_spaces by value — mixing either across one
+            # cache dict must miss, not alias
+            ck = ("dq_stage", spec.program, spec.final_program, spec.join,
+                  spec.dict_aliases, tuple(in_schemas), id(dicts),
+                  tuple(sorted(key_spaces.items()))
+                  if key_spaces else None)
+            hit = compile_cache.get(ck)
+            if hit is not None:
+                compiled.append(hit)
+                continue
+        stage = _CompiledStage(spec, in_schemas, dicts, key_spaces)
+        if ck is not None:
+            compile_cache[ck] = stage
+        compiled.append(stage)
 
     tasks, channels, result_stage = build_tasks(stages)
     systems = list(runtime.nodes.values()) if hasattr(runtime, "nodes") \
@@ -722,11 +755,13 @@ def run_stage_graph(
     checkpoint_storage=None,
     restore_checkpoint: int | None = None,
     block_rows: int = 1 << 16,
+    compile_cache: dict | None = None,
 ) -> OracleTable:
     """Build + run to completion, return the result table."""
     handle = build_stage_graph(
         stages, sources, runtime, dicts, key_spaces, spill_quota_bytes,
-        window, checkpoint_storage, restore_checkpoint, block_rows)
+        window, checkpoint_storage, restore_checkpoint, block_rows,
+        compile_cache)
     handle.start()
     if hasattr(runtime, "dispatch"):
         runtime.dispatch()

@@ -39,6 +39,30 @@ from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, Transform
 from ydb_tpu.ssa import twophase
 
 
+def _split_at_sort(program):
+    """Order-preserving split of a group-less program: ORDER BY / LIMIT
+    (SortStep) and everything after it must run ONCE over the merged
+    inputs, never per block — per-block sort + arrival-order concat
+    would scramble the result. Steps before the sort are row-wise
+    (assign/filter/project) and stay in the per-block phase. When the
+    sort is a keyed top-k, the per-block phase ALSO pre-tops its block
+    (global top-k of per-block top-ks is exact), bounding channel
+    traffic the way the reference's TopSort does."""
+    from ydb_tpu.ssa.program import Program, SortStep
+
+    steps = program.steps
+    si = next((i for i, s in enumerate(steps)
+               if isinstance(s, SortStep)), None)
+    if si is None:
+        return program, None
+    head = list(steps[:si])
+    sort: SortStep = steps[si]
+    if sort.keys and sort.limit is not None:
+        head.append(sort)  # deterministic per-block pre-top-k
+    partial = Program(tuple(head)) if head else None
+    return partial, Program(steps[si:])
+
+
 def plan_to_stages(plan, n_tasks: int = 2) -> list[StageSpec]:
     """Lower a logical plan tree to DQ stages (root must be a Transform,
     which the SQL planner guarantees)."""
@@ -82,6 +106,8 @@ def plan_to_stages(plan, n_tasks: int = 2) -> list[StageSpec]:
             ii = lower(node.input)
             set_output(ii, UnionAll())
             partial, final = twophase.split(node.program)
+            if final is None:
+                partial, final = _split_at_sort(node.program)
             return add(program=partial, final_program=final,
                        inputs=(UnionAllInput(ii),), output=None, tasks=1,
                        dict_aliases=node.dict_aliases)

@@ -1,16 +1,24 @@
-"""Single-chip plan executor: walks the logical plan bottom-up.
+"""Plan executor: DQ stage graph for join-bearing plans, single-chip
+walk for single-stage plans.
 
-The host-side analog of the KQP executer driving stage tasks
-(kqp_executer_impl.h:120) collapsed to one device: scans stream blocks
-through compiled SSA (ydb_tpu.engine.scan), joins run the device kernels
-(ydb_tpu.ssa.join), transforms compile against the inferred intermediate
-schema. Intermediate results materialize as single blocks — streaming
-stage pipelining arrives with the DQ layer.
+The host-side analog of the KQP executer (kqp_executer_impl.h:120):
+every plan containing a join lowers to the DQ task graph — scan stages
+feeding hash-partitioned channels into grace-bucket join stages and a
+final aggregate — executed by credit-flow compute actors
+(kqp/dq_lower.py + dq/compute.py), exactly as the reference routes every
+query through executer → tasks → compute actors (kqp_tasks_graph.cpp:448).
+Single-stage plans (scan → transform, no join) keep the direct
+streaming walk below — the one-task collapse of the same graph: scans
+stream blocks through compiled SSA (ydb_tpu.engine.scan), transforms
+compile against the inferred intermediate schema. The recursive walk
+also remains the fallback for plan shapes that do not lower (a
+CTE-shared subtree feeding two consumers).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -58,11 +66,94 @@ def _materialize(source: ColumnSource, columns) -> TableBlock:
     return blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
 
 
+# DQ is the default executor for join-bearing plans (VERDICT r4 item 2);
+# YDB_TPU_DQ=0 restores the recursive walk for A/B debugging
+_DQ_ON = os.environ.get("YDB_TPU_DQ", "1") not in ("0", "", "off")
+_DQ_TASKS = int(os.environ.get("YDB_TPU_DQ_TASKS", "2"))
+_DQ_BLOCK_ROWS = int(os.environ.get("YDB_TPU_DQ_BLOCK_ROWS",
+                                    str(1 << 20)))
+
+
+def _plan_nodes(plan: PlanNode):
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (LookupJoin, ExpandJoin)):
+            stack += [n.probe, n.build]
+        elif isinstance(n, Transform):
+            stack.append(n.input)
+
+
+def _partition_for_dq(src) -> list:
+    """A table's scan partitions for DQ task feeding: per-shard portion
+    streams for sharded tables (their natural partitioning), round-robin
+    row slices for host-resident sources."""
+    subs = getattr(src, "subs", None)
+    if subs:
+        return list(subs)
+    if isinstance(src, ColumnSource) and src.num_rows > 0:
+        from ydb_tpu.kqp.dq_lower import partition_source
+
+        return partition_source(src, _DQ_TASKS)
+    return [src]
+
+
+def _execute_plan_dq(plan: PlanNode, db: Database) -> TableBlock | None:
+    """Lower to DQ stages and run on an in-process actor system. Returns
+    None when the plan does not lower (the caller falls back to the
+    recursive walk)."""
+    from ydb_tpu.dq.compute import build_stage_graph
+    from ydb_tpu.kqp.dq_lower import plan_to_stages
+    from ydb_tpu.runtime.actors import ActorSystem
+
+    seen: set[int] = set()
+    parts: dict[str, list] = {}
+    for node in _plan_nodes(plan):
+        if id(node) in seen:
+            # a shared subtree (CTE referenced twice) would re-lower —
+            # and re-execute — once per consumer; the recursive walk's
+            # _memo executes it once, so fall back
+            return None
+        seen.add(id(node))
+        if isinstance(node, TableScan) and node.table not in parts:
+            # dict.get never triggers lazy sys-view materialization
+            src = db.sources.get(node.table)
+            if src is None:
+                return None
+            parts[node.table] = _partition_for_dq(src)
+    rt = ActorSystem(node=1)
+    try:
+        stages = plan_to_stages(plan, n_tasks=_DQ_TASKS)
+        handle = build_stage_graph(
+            stages, parts, rt, db.dicts, db.key_spaces,
+            block_rows=_DQ_BLOCK_ROWS, compile_cache=db._compile_cache)
+    except (ValueError, NotImplementedError):
+        # plan shapes that do not lower (e.g. a join-rooted plan with no
+        # result Transform) keep working through the recursive walk
+        return None
+    handle.start()
+    rt.run()
+    if not handle.collector.done:
+        raise RuntimeError("DQ stage graph did not complete")
+    return handle.collector.result_block()
+
+
 def execute_plan(plan: PlanNode, db: Database,
-                 _memo: dict | None = None) -> TableBlock:
-    """Bottom-up plan walk. ``_memo`` dedupes shared subtrees (a CTE
-    referenced from several places executes once per statement)."""
+                 _memo: dict | None = None,
+                 use_dq: bool | None = None) -> TableBlock:
+    """Execute a logical plan: join-bearing plans route through the DQ
+    stage graph (the production executer path); single-stage plans and
+    non-lowerable shapes use the bottom-up walk. ``_memo`` dedupes
+    shared subtrees (a CTE referenced from several places executes once
+    per statement)."""
     if _memo is None:
+        if (use_dq if use_dq is not None else _DQ_ON) and any(
+                isinstance(n, (LookupJoin, ExpandJoin))
+                for n in _plan_nodes(plan)):
+            out = _execute_plan_dq(plan, db)
+            if out is not None:
+                return out
         _memo = {}
     hit = _memo.get(id(plan))
     if hit is not None:
