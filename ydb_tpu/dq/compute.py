@@ -64,6 +64,29 @@ class StartTask:
 
 
 @dataclasses.dataclass
+class WireTask:
+    """Late channel wiring: consumer ActorIds for this task's output
+    channels (possibly on other NODES — the targets ride the
+    interconnect transparently), plus where results and aborts go.
+    Sent by the executer after every task everywhere has registered
+    (the two-phase start the reference's executer does when it wires
+    TEvChannelData routes across compute nodes)."""
+
+    channel_targets: dict[int, ActorId]
+    result_target: ActorId | None = None
+    abort_target: ActorId | None = None
+
+
+@dataclasses.dataclass
+class QueryAborted:
+    """Fatal query error: propagated to the collector so a dead peer
+    (Undelivered channel data) fails the query cleanly instead of
+    hanging it (TEvAbortExecution shape, dq_compute_actor.h:41)."""
+
+    reason: str
+
+
+@dataclasses.dataclass
 class _PumpSource:
     """Self-message: consume ONE source block, then re-arm. Keeps the
     mailbox responsive between blocks so checkpoint barriers (and any
@@ -223,6 +246,8 @@ class ComputeActor(Actor):
         self.window = window
         self.block_rows = block_rows
         self.spiller = spiller or Spiller()
+        self.abort_target: ActorId | None = None
+        self._aborted = False
 
         self._in_finished: set[int] = set()
         # agg stages accumulate partial states THROUGH the spiller
@@ -279,19 +304,39 @@ class ComputeActor(Actor):
 
     def receive(self, message, sender):
         from ydb_tpu.dq.checkpoint import InjectCheckpoint
+        from ydb_tpu.runtime.interconnect import Undelivered
 
         if isinstance(message, StartTask):
             self._start_source()
         elif isinstance(message, _PumpSource):
-            self._pump_source()
+            if not self._aborted:
+                self._pump_source()
+        elif isinstance(message, WireTask):
+            self.channel_targets.update(message.channel_targets)
+            if message.result_target is not None:
+                self.result_target = message.result_target
+            if message.abort_target is not None:
+                self.abort_target = message.abort_target
         elif isinstance(message, InjectCheckpoint):
             # source-side barrier injection: snapshot between blocks
             self._take_checkpoint(message.checkpoint_id)
         elif isinstance(message, ChannelData):
             self.send(sender, ChannelAck(message.channel_id, message.seq))
-            self._on_channel_data(message)
+            if not self._aborted:
+                self._on_channel_data(message)
         elif isinstance(message, ChannelAck):
             self._on_ack(message)
+        elif isinstance(message, Undelivered):
+            # a peer died with our channel data in flight: the query
+            # cannot complete — abort it at the collector and stop
+            # feeding the graph from this task
+            self._aborted = True
+            if self.abort_target is not None:
+                self.send(self.abort_target, QueryAborted(
+                    f"task {self.task.task_id}: channel data undelivered "
+                    f"({message.reason})"))
+        elif isinstance(message, QueryAborted):
+            self._aborted = True
         else:
             raise TypeError(message)
 
@@ -582,8 +627,21 @@ class ResultCollector(Actor):
         self.schema = schema
         self.payloads: list[dict] = []
         self.done = False
+        self.error: str | None = None
 
     def receive(self, message, sender):
+        from ydb_tpu.runtime.interconnect import Undelivered
+
+        if isinstance(message, QueryAborted):
+            if self.error is None:
+                self.error = message.reason
+            return
+        if isinstance(message, Undelivered):
+            # a liveness ping (or any collector-sent envelope) bounced:
+            # the peer node is gone — fail the query
+            if self.error is None:
+                self.error = f"peer unreachable: {message.reason}"
+            return
         assert isinstance(message, ResultData)
         if message.payload is not None:
             self.payloads.append(message.payload)
@@ -598,6 +656,79 @@ class ResultCollector(Actor):
 
     def table(self) -> OracleTable:
         return OracleTable.from_block(self.result_block())
+
+
+def task_partitions(sources: dict[str, list], task: TaskSpec) -> list:
+    """Source partitions assigned to one task: task p of an N-task stage
+    reads partitions p, p+N, p+2N, … so every partition is read exactly
+    once for any task-count / partition-count ratio. The ONE assignment
+    rule — local build, remote task start, and the executer all share it
+    (changing it anywhere else would silently double-read or drop data)."""
+    out: list = []
+    for inp in task.stage_spec.inputs:
+        if isinstance(inp, SourceInput):
+            parts = sources.get(inp.source_id, [])
+            out.extend(parts[task.partition::task.stage_spec.tasks])
+    return out
+
+
+def compile_stages(
+    stages: list[StageSpec],
+    source_schemas: dict[str, dtypes.Schema],
+    dicts=None,
+    key_spaces=None,
+    compile_cache: dict | None = None,
+) -> list[_CompiledStage]:
+    """Compile every stage, flowing schemas source -> downstream. Needs
+    only the SOURCE SCHEMAS, not the data — a remote node re-derives the
+    whole compiled chain from the shipped stage specs (the task-start
+    path, kqp_node_service.cpp:121)."""
+    from ydb_tpu.engine.scan import required_columns
+
+    compiled: list[_CompiledStage] = []
+    for si, spec in enumerate(stages):
+        in_schemas = []
+        for inp in spec.inputs:
+            if isinstance(inp, SourceInput):
+                sch = source_schemas[inp.source_id]
+                if spec.program is not None:
+                    # scan projection: compile (and later stream) only
+                    # the program's required columns
+                    sch = sch.select(required_columns(spec.program, sch))
+                in_schemas.append(sch)
+            else:
+                in_schemas.append(compiled[inp.from_stage].out_schema)
+        if not in_schemas:
+            raise ValueError("stage with no inputs")
+        if spec.join is not None:
+            if len(in_schemas) != 2:
+                raise ValueError(
+                    f"join stage {si} needs exactly (probe, build) inputs")
+        elif any(s != in_schemas[0] for s in in_schemas[1:]):
+            # every channel payload decodes with one schema; unequal
+            # upstream schemas would silently mislabel columns
+            raise ValueError(
+                f"stage {si}: all inputs must share one schema, got "
+                f"{[s.names for s in in_schemas]}"
+            )
+        ck = None
+        if compile_cache is not None:
+            # dicts participate by identity (aux tables bake dictionary
+            # contents); key_spaces by value — mixing either across one
+            # cache dict must miss, not alias
+            ck = ("dq_stage", spec.program, spec.final_program, spec.join,
+                  spec.dict_aliases, tuple(in_schemas), id(dicts),
+                  tuple(sorted(key_spaces.items()))
+                  if key_spaces else None)
+            hit = compile_cache.get(ck)
+            if hit is not None:
+                compiled.append(hit)
+                continue
+        stage = _CompiledStage(spec, in_schemas, dicts, key_spaces)
+        if ck is not None:
+            compile_cache[ck] = stage
+        compiled.append(stage)
+    return compiled
 
 
 @dataclasses.dataclass
@@ -641,52 +772,12 @@ def build_stage_graph(
     every task loads its saved state and sources resume mid-stream.
     ``compile_cache`` memoizes compiled stages across graphs (the
     computation-pattern-cache seam the single-chip executor has)."""
-    from ydb_tpu.engine.scan import required_columns
-
-    # schemas flow source -> downstream
-    compiled: list[_CompiledStage] = []
-    for si, spec in enumerate(stages):
-        in_schemas = []
-        for inp in spec.inputs:
-            if isinstance(inp, SourceInput):
-                sch = sources[inp.source_id][0].schema
-                if spec.program is not None:
-                    # scan projection: compile (and later stream) only
-                    # the program's required columns
-                    sch = sch.select(required_columns(spec.program, sch))
-                in_schemas.append(sch)
-            else:
-                in_schemas.append(compiled[inp.from_stage].out_schema)
-        if not in_schemas:
-            raise ValueError("stage with no inputs")
-        if spec.join is not None:
-            if len(in_schemas) != 2:
-                raise ValueError(
-                    f"join stage {si} needs exactly (probe, build) inputs")
-        elif any(s != in_schemas[0] for s in in_schemas[1:]):
-            # every channel payload decodes with one schema; unequal
-            # upstream schemas would silently mislabel columns
-            raise ValueError(
-                f"stage {si}: all inputs must share one schema, got "
-                f"{[s.names for s in in_schemas]}"
-            )
-        ck = None
-        if compile_cache is not None:
-            # dicts participate by identity (aux tables bake dictionary
-            # contents); key_spaces by value — mixing either across one
-            # cache dict must miss, not alias
-            ck = ("dq_stage", spec.program, spec.final_program, spec.join,
-                  spec.dict_aliases, tuple(in_schemas), id(dicts),
-                  tuple(sorted(key_spaces.items()))
-                  if key_spaces else None)
-            hit = compile_cache.get(ck)
-            if hit is not None:
-                compiled.append(hit)
-                continue
-        stage = _CompiledStage(spec, in_schemas, dicts, key_spaces)
-        if ck is not None:
-            compile_cache[ck] = stage
-        compiled.append(stage)
+    # unreferenced sources may have zero partitions; referenced ones
+    # must not (compile_stages then raises KeyError, as before)
+    source_schemas = {sid: parts[0].schema
+                      for sid, parts in sources.items() if parts}
+    compiled = compile_stages(stages, source_schemas, dicts, key_spaces,
+                              compile_cache)
 
     tasks, channels, result_stage = build_tasks(stages)
     systems = list(runtime.nodes.values()) if hasattr(runtime, "nodes") \
@@ -699,14 +790,7 @@ def build_stage_graph(
     actors: list[ComputeActor] = []
     chan_by_id = {c.channel_id: c for c in channels}
     for i, t in enumerate(tasks):
-        srcs: list[ColumnSource] = []
-        for inp in t.stage_spec.inputs:
-            if isinstance(inp, SourceInput):
-                # strided assignment: task p reads partitions p, p+N, …
-                # so every partition is read exactly once regardless of
-                # the task-count / partition-count ratio
-                parts = sources[inp.source_id]
-                srcs.extend(parts[t.partition::t.stage_spec.tasks])
+        srcs = task_partitions(sources, t)
         a = ComputeActor(
             t, compiled[t.stage], {}, chan_by_id, srcs,
             collector_id,

@@ -1,0 +1,334 @@
+"""DQ node service + cross-process executer: the data plane on the wire.
+
+The reference starts query tasks on remote nodes through a node service
+(TEvStartKqpTasksRequest -> per-task compute actors,
+kqp_node_service.cpp:55,121) and the executer wires channels between
+compute actors on different nodes; channel traffic (TEvChannelData /
+Ack) then flows peer-to-peer over the interconnect
+(dq_compute_actor_channels.h:15). This module is that shape for the TPU
+build:
+
+  * ``DqNodeService`` — an actor registered on every worker node. On
+    ``StartTasks`` it re-derives the compiled stage chain from the
+    shipped stage specs + source schemas (compile_stages — schemas only,
+    no data) and registers one ComputeActor per task, replying
+    ``TasksStarted`` with their ActorIds.
+  * ``DistExecuter`` — builds the task graph, places stages on nodes
+    (scan stages stay where the data lives), starts remote tasks via the
+    services, then two-phase-wires every channel: consumer ActorIds ship
+    in ``WireTask`` once all registrations are back, so ChannelData
+    crosses process boundaries transparently through the interconnect's
+    remote transport. Credit flow (seq/ack windows) is preserved across
+    the TCP hop because acks travel the same wire back.
+
+Failure semantics: a dead peer surfaces as ``Undelivered`` on the
+sender's channel data -> the ComputeActor sends ``QueryAborted`` to the
+collector -> ``DistExecuter.run`` raises with the reason instead of
+hanging (the TEvAbortExecution contract, dq_compute_actor.h:41).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from ydb_tpu import dtypes
+from ydb_tpu.dq.compute import (
+    ComputeActor,
+    QueryAborted,
+    ResultCollector,
+    StartTask,
+    WireTask,
+    compile_stages,
+    task_partitions,
+)
+from ydb_tpu.dq.graph import SourceInput, StageSpec, build_tasks
+from ydb_tpu.dq.spilling import Spiller
+from ydb_tpu.engine.oracle import OracleTable
+from ydb_tpu.runtime.actors import Actor, ActorId
+
+
+@dataclasses.dataclass
+class StartTasks:
+    """Start these tasks on the receiving node (kqp_node_service.cpp:55).
+
+    ``stages`` is the FULL stage list (specs are tiny); the service
+    compiles the chain locally from ``source_schemas`` — table data
+    never ships, only programs and schemas. ``sources`` optionally
+    carries host-resident partitions for scan tasks placed remotely."""
+
+    query_id: str
+    stages: list[StageSpec]
+    tasks: list  # TaskSpec
+    channels: list  # ChannelSpec (full list; tasks index into it)
+    source_schemas: dict[str, dtypes.Schema]
+    dicts: object = None
+    key_spaces: dict | None = None
+    block_rows: int = 1 << 16
+    sources: dict[str, list] | None = None  # source_id -> partitions
+    reply_to: ActorId | None = None
+    # executer's address book: node id -> (host, port). Worker-to-worker
+    # channels need routes the hello handshake alone cannot teach (a
+    # worker only learns the EXECUTER's reverse route) — the reference
+    # solves this with the nameservice table; here the executer ships it
+    peers: dict[int, tuple] | None = None
+
+
+@dataclasses.dataclass
+class TasksStarted:
+    query_id: str
+    actor_of_task: dict[int, ActorId]
+
+
+@dataclasses.dataclass
+class ReleaseQuery:
+    """Stop + deregister a query's compute actors on this node."""
+
+    query_id: str
+
+
+@dataclasses.dataclass
+class Ping:
+    """Liveness probe. Sent by the executer with the collector as the
+    SENDER: a dead peer turns the ping into an Undelivered notification
+    delivered straight to the collector, which fails the query — so a
+    worker death is detected even when no channel data is in flight
+    (the NodeDisconnected subscription the reference's executer holds
+    on the interconnect session)."""
+
+
+class DqNodeService(Actor):
+    """Per-node task host (kqp_node_service.cpp:55). Set
+    ``interconnect`` after construction so shipped peer routes
+    (StartTasks.peers) reach the node's transport."""
+
+    def __init__(self, interconnect=None):
+        super().__init__()
+        self._queries: dict[str, list[ActorId]] = {}
+        self.interconnect = interconnect
+        # compiled stages repeat across queries (prepared statements):
+        # memoize like the executer side does
+        self._compile_cache: dict = {}
+
+    def receive(self, message, sender):
+        from ydb_tpu.runtime.interconnect import Undelivered
+
+        if isinstance(message, StartTasks):
+            self._start(message, sender)
+        elif isinstance(message, ReleaseQuery):
+            for aid in self._queries.pop(message.query_id, []):
+                self.system.stop(aid)
+        elif isinstance(message, Ping):
+            pass  # liveness: delivery (vs Undelivered) is the signal
+        elif isinstance(message, Undelivered):
+            # a reply (TasksStarted) bounced — the executer died. The
+            # worker must survive one peer's death (other queries keep
+            # running); the executer's own failure handling owns cleanup
+            pass
+        else:
+            raise TypeError(message)
+
+    def _start(self, req: StartTasks, sender):
+        if req.peers and self.interconnect is not None:
+            for node, addr in req.peers.items():
+                if node != self.system.node:
+                    self.interconnect.add_peer(node, addr[0], addr[1])
+        compiled = compile_stages(req.stages, req.source_schemas,
+                                  req.dicts, req.key_spaces,
+                                  compile_cache=self._compile_cache)
+        chan_by_id = {c.channel_id: c for c in req.channels}
+        out: dict[int, ActorId] = {}
+        mine: list[ActorId] = []
+        for t in req.tasks:
+            srcs = task_partitions(req.sources or {}, t)
+            a = ComputeActor(
+                t, compiled[t.stage], {}, chan_by_id, srcs,
+                result_target=None,
+                spiller=Spiller(prefix=f"spill/{req.query_id}"
+                                       f"/task{t.task_id}"),
+                block_rows=req.block_rows,
+            )
+            aid = self.system.register(a)
+            out[t.task_id] = aid
+            mine.append(aid)
+        self._queries[req.query_id] = mine
+        self.send(req.reply_to if req.reply_to is not None else sender,
+                  TasksStarted(req.query_id, out))
+
+
+class DistExecuter:
+    """Cross-node query executer (kqp_executer_impl.h:120 shape).
+
+    ``services`` maps remote node id -> DqNodeService ActorId; stages
+    whose placement maps to this node run in-process. The caller owns
+    pumping the local system/interconnect; ``run`` drives it via the
+    supplied ``pump`` callable (defaults to draining the local system)."""
+
+    def __init__(self, system, services: dict[int, ActorId] | None = None,
+                 pump=None, peers: dict[int, tuple] | None = None):
+        self.system = system
+        self.services = dict(services or {})
+        self._pump = pump if pump is not None else self._pump_local
+        # node id -> (host, port); shipped to workers so worker-to-worker
+        # channels have routes (see StartTasks.peers)
+        self.peers = dict(peers or {})
+        self._compile_cache: dict = {}
+        self._seq = 0
+
+    def _pump_local(self):
+        self.system.run()
+        time.sleep(0.002)
+
+    def run(
+        self,
+        stages: list[StageSpec],
+        sources: dict[str, list],
+        placement: dict[int, int] | None = None,
+        dicts=None,
+        key_spaces=None,
+        block_rows: int = 1 << 16,
+        timeout: float = 120.0,
+    ) -> OracleTable:
+        """Execute a stage graph with stages placed across nodes.
+
+        ``placement`` maps stage index -> node id (default: everything
+        local). Scan stages must be placed where their partitions are
+        reachable; this executer ships host-resident partitions of
+        remotely-placed scan stages in StartTasks."""
+        self._seq += 1
+        qid = f"q{self._seq}"
+        local_node = self.system.node
+        placement = placement or {}
+        source_schemas = {sid: parts[0].schema
+                          for sid, parts in sources.items() if parts}
+        compiled = compile_stages(stages, source_schemas, dicts, key_spaces,
+                                  compile_cache=self._compile_cache)
+        tasks, channels, result_stage = build_tasks(stages)
+        chan_by_id = {c.channel_id: c for c in channels}
+
+        collector = ResultCollector(compiled[result_stage].out_schema)
+        collector_id = self.system.register(collector)
+
+        # group tasks by node
+        by_node: dict[int, list] = {}
+        for t in tasks:
+            node = placement.get(t.stage, local_node)
+            by_node.setdefault(node, []).append(t)
+
+        actor_of_task: dict[int, ActorId] = {}
+        local_actors: list[ComputeActor] = []
+        started: set[str] = set()
+        replies: dict[int, TasksStarted] = {}
+        start_error: list[str] = []
+
+        class _Gather(Actor):
+            def receive(self, message, sender):
+                from ydb_tpu.runtime.interconnect import Undelivered
+
+                if isinstance(message, Undelivered):
+                    # StartTasks or a start-phase ping bounced: the
+                    # worker is gone before the query even started
+                    start_error.append(
+                        f"peer unreachable during start: {message.reason}")
+                    return
+                assert isinstance(message, TasksStarted)
+                replies[sender.node] = message
+
+        gather_id = self.system.register(_Gather())
+
+        for node, node_tasks in by_node.items():
+            if node == local_node:
+                for t in node_tasks:
+                    srcs = task_partitions(sources, t)
+                    a = ComputeActor(
+                        t, compiled[t.stage], {}, chan_by_id, srcs,
+                        result_target=collector_id,
+                        spiller=Spiller(prefix=f"spill/{qid}"
+                                               f"/task{t.task_id}"),
+                        block_rows=block_rows,
+                    )
+                    actor_of_task[t.task_id] = self.system.register(a)
+                    local_actors.append(a)
+                continue
+            svc = self.services.get(node)
+            if svc is None:
+                raise ValueError(f"no DqNodeService for node {node}")
+            remote_sources = None
+            ship = {
+                inp.source_id
+                for t in node_tasks
+                for inp in t.stage_spec.inputs
+                if isinstance(inp, SourceInput)
+            }
+            if ship:
+                remote_sources = {sid: sources[sid] for sid in ship}
+            # sender=gather_id: a bounce (dead worker) comes back as
+            # Undelivered to the gather actor, not a silent dead letter
+            self.system.send(svc, StartTasks(
+                qid, stages, node_tasks, channels, source_schemas,
+                dicts, key_spaces, block_rows, remote_sources,
+                reply_to=gather_id, peers=self.peers or None),
+                sender=gather_id)
+            started.add(node)
+
+        deadline = time.monotonic() + timeout
+        remote_nodes = set(by_node) - {local_node}
+        next_ping = time.monotonic() + 0.25
+        while set(replies) < remote_nodes:
+            if start_error:
+                raise RuntimeError(f"query aborted: {start_error[0]}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"task start timed out; missing nodes "
+                    f"{sorted(remote_nodes - set(replies))}")
+            now = time.monotonic()
+            if now >= next_ping:
+                # start-phase liveness: detect a worker that died while
+                # (or before) compiling its tasks
+                for node in remote_nodes - set(replies):
+                    self.system.send(self.services[node], Ping(),
+                                     sender=gather_id)
+                next_ping = now + 0.25
+            self._pump()
+        for msg in replies.values():
+            actor_of_task.update(msg.actor_of_task)
+
+        # two-phase wiring: every task learns its consumers' ActorIds
+        # (local AND remote), results + aborts route to the collector
+        for t in tasks:
+            targets = {
+                ch: actor_of_task[chan_by_id[ch].dst_task]
+                for ch in t.output_channels
+            }
+            self.system.send(actor_of_task[t.task_id], WireTask(
+                targets, result_target=collector_id,
+                abort_target=collector_id))
+        for t in tasks:
+            self.system.send(actor_of_task[t.task_id], StartTask())
+
+        try:
+            next_ping = 0.0
+            while not collector.done:
+                if collector.error is not None:
+                    raise RuntimeError(
+                        f"query aborted: {collector.error}")
+                if time.monotonic() > deadline:
+                    raise TimeoutError("query timed out")
+                now = time.monotonic()
+                if remote_nodes and now >= next_ping:
+                    # liveness: a dead peer bounces the ping back to the
+                    # collector as Undelivered -> query fails fast
+                    for node in remote_nodes:
+                        self.system.send(self.services[node], Ping(),
+                                         sender=collector_id)
+                    next_ping = now + 0.25
+                self._pump()
+            return collector.table()
+        finally:
+            for node in started:
+                self.system.send(self.services[node], ReleaseQuery(qid))
+            for a in local_actors:
+                self.system.stop(a.self_id)
+            self.system.stop(collector_id)
+            self.system.stop(gather_id)
+            self._pump()
