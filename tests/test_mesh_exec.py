@@ -171,3 +171,58 @@ def test_mesh_scan_from_portion_store(tmp_path, data):
     np.testing.assert_array_equal(
         np.asarray(res2.cols["total"][0]),
         np.asarray(ora2.cols["total"][0]))
+
+
+def test_mesh_from_sql_session():
+    """Cluster.enable_mesh routes session SELECTs (join AND scan+agg)
+    SPMD over the mesh, with shard counts != device count grouped via
+    device_partitions — results identical to the non-mesh path
+    (VERDICT r4 item 4: the mesh reachable from SQL text)."""
+    import numpy as np
+
+    from ydb_tpu.kqp.session import Cluster
+    from ydb_tpu.plan import executor as ex
+
+    c = Cluster()
+    s = c.session()
+    s.execute("CREATE TABLE musers (id int64, grp int64, "
+              "PRIMARY KEY (id)) WITH (shards = 3)")
+    s.execute("CREATE TABLE morders (oid int64, uid int64, amount int64,"
+              " PRIMARY KEY (oid)) WITH (shards = 5)")
+    for i in range(0, 120, 30):
+        s.execute("INSERT INTO musers VALUES " + ", ".join(
+            f"({j}, {j % 4})" for j in range(i, i + 30)))
+    for i in range(0, 600, 100):
+        s.execute("INSERT INTO morders VALUES " + ", ".join(
+            f"({j}, {j % 120}, {j % 13})" for j in range(i, i + 100)))
+    q = ("SELECT u.grp AS g, SUM(o.amount) AS total, COUNT(*) AS n "
+         "FROM morders o JOIN musers u ON o.uid = u.id "
+         "GROUP BY u.grp ORDER BY g")
+    q2 = ("SELECT o.uid AS u2, SUM(o.amount) AS t FROM morders o "
+          "GROUP BY o.uid ORDER BY t DESC, u2 LIMIT 5")
+    ref, ref2 = s.execute(q), s.execute(q2)
+    c.enable_mesh()
+    calls = []
+    orig = ex._execute_plan_mesh
+
+    def spy(p, d):
+        r = orig(p, d)
+        calls.append(r)
+        return r
+
+    ex._execute_plan_mesh = spy
+    try:
+        res, res2 = s.execute(q), s.execute(q2)
+    finally:
+        ex._execute_plan_mesh = orig
+    # invoked AND succeeded (a None would mean a silent fallback to
+    # DQ/recursive produced the matching rows, not the mesh)
+    assert len(calls) == 2 and all(r is not None for r in calls), calls
+    for col in ("g", "total", "n"):
+        np.testing.assert_array_equal(
+            np.asarray(res.cols[col][0]), np.asarray(ref.cols[col][0]),
+            err_msg=col)
+    for col in ("u2", "t"):
+        np.testing.assert_array_equal(
+            np.asarray(res2.cols[col][0]), np.asarray(ref2.cols[col][0]),
+            err_msg=col)

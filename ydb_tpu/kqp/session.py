@@ -118,6 +118,8 @@ class Cluster:
         # compute slot for its duration
         self.workload = None
         self.rm = None
+        # optional SPMD mesh execution (enable_mesh)
+        self._mesh_exec = None
         self._query_seq = 0
         import threading
 
@@ -416,7 +418,7 @@ class Cluster:
             limit=None,
         )
         p = plan_select(sel, self.catalog())
-        out = to_host(execute_plan(p, self.snapshot_db(snap)))
+        out = to_host(execute_plan(p, self.snapshot_db(snap, mesh=False)))
         n = out.num_rows
         keys = [
             tuple(int(out.column(f"__pk_{i}")[r])
@@ -678,7 +680,8 @@ class Cluster:
                 access_check(plan_node)
             if stmt_db[0] is None:
                 stmt_db[0] = self.snapshot_db(
-                    snap, include_sys=self.flags.enable_sys_views)
+                    snap, include_sys=self.flags.enable_sys_views,
+                    mesh=False)
             out = to_host(execute_plan(plan_node, stmt_db[0]))
             col = out.schema.names[0]
             v, ok = out.cols[col]
@@ -689,6 +692,67 @@ class Cluster:
 
         return scalar_exec
 
+    def enable_mesh(self, mesh=None) -> None:
+        """Route eligible SELECTs SPMD over the device mesh: every
+        statement's snapshot Database carries a MeshPlanExecutor whose
+        per-device sources are the tables' shard streams grouped onto
+        the mesh (parallel/mesh_exec.device_partitions). The executor
+        (and its jit cache) persists across statements; per-statement
+        state is only the snapshot source map."""
+        from ydb_tpu.parallel.mesh_exec import (
+            MeshDatabase,
+            MeshPlanExecutor,
+        )
+
+        self._mesh_exec = MeshPlanExecutor(
+            MeshDatabase({}, dicts=self.dicts), mesh)
+        self._plan_cache.clear()
+
+    def disable_mesh(self) -> None:
+        self._mesh_exec = None
+
+    def _mesh_snapshot(self, snap: int):
+        """A PER-SNAPSHOT MeshPlanExecutor: fresh source bindings (so
+        concurrent statements never read each other's snapshot) sharing
+        the cluster executor's jit cache. Sources build lazily per table
+        — a statement touching one table doesn't pay partitioning for
+        the whole catalog."""
+        from ydb_tpu.parallel.mesh_exec import (
+            MeshDatabase,
+            MeshPlanExecutor,
+        )
+
+        base = self._mesh_exec
+        cluster = self
+
+        class _Lazy(dict):
+            def __missing__(self, key):
+                from ydb_tpu.datashard.table import RowTable
+                from ydb_tpu.engine.reader import PortionStreamSource
+                from ydb_tpu.parallel.mesh_exec import device_partitions
+
+                t = cluster.tables[key]
+                if isinstance(t, RowTable):
+                    shards = [t.source_at(snap)]
+                else:
+                    shards = [
+                        PortionStreamSource(s, s.visible_portions(snap))
+                        for s in t.shards
+                    ]
+                parts = device_partitions(shards, base.n, t.schema,
+                                          cluster.dicts)
+                self[key] = parts
+                return parts
+
+            def __contains__(self, key):  # eligibility probes ([] builds)
+                return (dict.__contains__(self, key)
+                        or key in cluster.tables)
+
+        ex = MeshPlanExecutor(MeshDatabase(_Lazy(), dicts=self.dicts),
+                              base.mesh)
+        ex._jit_cache = base._jit_cache
+        return ex
+
     def register_udf(self, name: str, fn, out_type) -> None:
         """Register a scalar UDF: ``fn`` takes numpy arrays (one per SQL
         argument) and returns an array; usable in any expression."""
@@ -696,7 +760,12 @@ class Cluster:
         self._plan_cache.clear()
 
     def snapshot_db(self, snap: int | None = None,
-                    include_sys: bool = False) -> Database:
+                    include_sys: bool = False,
+                    mesh: bool = True) -> Database:
+        """``mesh=False`` keeps internal point reads (UPDATE/DELETE RMW
+        pk-selects, scalar-subquery precompute) off the SPMD mesh path —
+        a tiny lookup must not pay device collectives while holding
+        shard locks."""
         from ydb_tpu.datashard.table import RowTable
 
         snap = self.coordinator.read_snapshot() if snap is None else snap
@@ -708,7 +777,10 @@ class Cluster:
                 sources[name] = _merge_shard_sources(t, snap)
         if include_sys:
             sources = _SysLazySources(self, sources)
-        return Database(sources=sources, dicts=self.dicts)
+        db = Database(sources=sources, dicts=self.dicts)
+        if mesh and self._mesh_exec is not None:
+            db.mesh_executor = self._mesh_snapshot(snap)
+        return db
 
     def plan(self, sql: str, snap: int | None = None,
              access_check=None):

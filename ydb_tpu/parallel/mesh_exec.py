@@ -34,7 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ydb_tpu.blocks.block import Column, TableBlock
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.oracle import OracleTable
-from ydb_tpu.engine.scan import ScanExecutor
+from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
 from ydb_tpu.parallel.dist import (
     MeshScan,
     _local,
@@ -68,6 +68,57 @@ class MeshDatabase:
         self.sources = sources
         self.dicts = dicts if dicts is not None else DictionarySet()
         self.key_spaces = key_spaces
+
+
+class _ChainSource:
+    """Several per-shard sources presented as ONE device's scan input
+    (shard count need not equal mesh size: shards group round-robin
+    onto devices). Duck-types the ColumnSource surface ScanExecutor
+    streams from; sub-streams rechunk to ONE fixed capacity so the
+    compiled per-block program never retraces, and start_block seeks
+    work (the stream_blocks contract every other source honors)."""
+
+    def __init__(self, subs: list):
+        self.subs = list(subs)
+        self.schema = subs[0].schema
+        self.dicts = subs[0].dicts
+
+    @property
+    def num_rows(self) -> int:
+        return sum(s.num_rows for s in self.subs)
+
+    def blocks(self, block_rows: int, columns=None, start_block: int = 0):
+        from ydb_tpu.engine.reader import stream_blocks
+
+        names = tuple(columns) if columns is not None else self.schema.names
+        sch = self.schema.select(names)
+        cap = min(block_rows, max(self.num_rows, 1))
+
+        def payloads():
+            for s in self.subs:
+                for b in s.blocks(block_rows, names):
+                    yield b.to_numpy(), b.validity_numpy()
+
+        yield from stream_blocks(payloads(), names, sch, cap,
+                                 start_block=start_block)
+
+
+def device_partitions(sources: list, n: int, schema, dicts) -> list:
+    """Group a table's per-shard sources onto exactly ``n`` mesh devices
+    (round-robin; empty devices get an empty source) — the seam that
+    lets any shard count ride any mesh size."""
+    out = []
+    for d in range(n):
+        g = sources[d::n]
+        if not g:
+            out.append(ColumnSource(
+                {f.name: np.empty(0, dtype=f.type.physical)
+                 for f in schema.fields}, schema, dicts))
+        elif len(g) == 1:
+            out.append(g[0])
+        else:
+            out.append(_ChainSource(g))
+    return out
 
 
 class MeshPlanExecutor:

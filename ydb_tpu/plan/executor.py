@@ -55,6 +55,9 @@ class Database:
     dicts: DictionarySet | None = None
     key_spaces: dict[str, int] | None = None
     _compile_cache: dict = dataclasses.field(default_factory=dict)
+    # when set (Cluster.enable_mesh), eligible plans execute SPMD over
+    # the device mesh (parallel/mesh_exec.py) instead of DQ/recursive
+    mesh_executor: object = None
 
     def invalidate_compile_cache(self):
         self._compile_cache.clear()
@@ -97,6 +100,25 @@ def _partition_for_dq(src) -> list:
 
         return partition_source(src, _DQ_TASKS)
     return [src]
+
+
+def _execute_plan_mesh(plan: PlanNode, db: Database):
+    """SPMD mesh execution for eligible plans (scan+agg and join trees
+    whose tables the mesh database carries). Returns the host-resident
+    OracleTable (to_host passes it through — no device round-trip for a
+    result already gathered), or None when the shape doesn't map
+    (non-root aggregating Transform, missing table) so the caller falls
+    through to DQ/recursive. Real execution defects (shape errors etc.)
+    propagate — only the explicit doesn't-lower signal falls back."""
+    mex = db.mesh_executor
+    for node in _plan_nodes(plan):
+        if isinstance(node, TableScan) and \
+                node.table not in mex.db.sources:
+            return None
+    try:
+        return mex.execute(plan)
+    except NotImplementedError:
+        return None
 
 
 def _execute_plan_dq(plan: PlanNode, db: Database) -> TableBlock | None:
@@ -148,6 +170,10 @@ def execute_plan(plan: PlanNode, db: Database,
     shared subtrees (a CTE referenced from several places executes once
     per statement)."""
     if _memo is None:
+        if db.mesh_executor is not None:
+            out = _execute_plan_mesh(plan, db)
+            if out is not None:
+                return out
         if (use_dq if use_dq is not None else _DQ_ON) and any(
                 isinstance(n, (LookupJoin, ExpandJoin))
                 for n in _plan_nodes(plan)):
@@ -211,5 +237,7 @@ def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
     raise NotImplementedError(plan)
 
 
-def to_host(block: TableBlock) -> OracleTable:
+def to_host(block) -> OracleTable:
+    if isinstance(block, OracleTable):  # mesh results are already host
+        return block
     return OracleTable.from_block(block)
