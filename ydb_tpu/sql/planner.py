@@ -340,7 +340,7 @@ class _Lower:
     # FuncCalls producing a (dictionary-encoded) string column
     _STRING_FUNCS = frozenset({
         "substring", "upper", "lower", "trim", "ltrim", "rtrim",
-        "replace", "concat",
+        "replace", "concat", "gethost", "cutwww",
     })
 
     def _as_string_col(self, e, what: str) -> str:
@@ -417,6 +417,56 @@ class _Lower:
                 hidden, DictMap(col, "xrank", (), p_src), dtypes.INT32)
         return Col(hidden)
 
+    def _string_case(self, e: ast.Case) -> Col:
+        """CASE whose branches are string columns / string literals:
+        lowers to an IF over dictionary ids in ONE shared dictionary
+        (all column branches must share a dictionary source; literal
+        branches encode into it), emitted as a hidden string column so
+        downstream group-bys/projections see a normal dict-encoded
+        column (ClickBench q39's IF(..., Referer, '') AS Src shape)."""
+        import hashlib
+
+        branches = [v for _c, v in e.whens]
+        if e.else_ is not None:
+            branches.append(e.else_)
+        src = None
+        for b in branches:
+            if self._is_string_operand(b):
+                col = self._as_string_col(b, "CASE")
+                s = self.dict_src.get(col, col)
+                if src is None:
+                    src = s
+                elif s != src:
+                    raise PlanError(
+                        f"string CASE branches must share one dictionary"
+                        f" ({src} vs {s})")
+        if src is None:
+            raise PlanError(
+                "string CASE needs at least one string column branch")
+        d = self.dicts[src] if (self.dicts is not None
+                                and src in self.dicts) else None
+        if d is None:
+            raise PlanError(f"string CASE needs a dictionary for {src}")
+
+        def enc(b):
+            if isinstance(b, ast.Literal) and b.kind == "string":
+                val = b.value.encode() if isinstance(b.value, str) \
+                    else b.value
+                return Const(int(d.add(val)), dtypes.STRING)
+            return Col(self._as_string_col(b, "CASE"))
+
+        out = enc(e.else_) if e.else_ is not None \
+            else Const(None, dtypes.STRING)
+        for cond, val in reversed(e.whens):
+            out = Call(Op.IF, self.lower(cond), enc(val), out)
+        tag = hashlib.blake2b(repr(e).encode(),
+                              digest_size=6).hexdigest()
+        hidden = f"__strcase_{tag}"
+        if hidden not in self.types:
+            self.emit_assign(hidden, out, dtypes.STRING)
+            self.dict_src[hidden] = src
+        return Col(hidden)
+
     def lower(self, e: ast.Expr):
         if isinstance(e, ast.Name):
             return Col(self.name_of(e))
@@ -445,6 +495,13 @@ class _Lower:
             inner = self.lower(e.expr)
             return Call(Op.IS_NOT_NULL if e.negated else Op.IS_NULL, inner)
         if isinstance(e, ast.Case):
+            branches = [v for _c, v in e.whens]
+            if e.else_ is not None:
+                branches.append(e.else_)
+            if any(self._is_string_operand(b)
+                   or (isinstance(b, ast.Literal) and b.kind == "string")
+                   for b in branches):
+                return self._string_case(e)
             if e.else_ is None:
                 first = self.lower(e.whens[0][1])
                 t = infer_type(first, None, self.types)
@@ -537,6 +594,10 @@ class _Lower:
         consts = []
         for i in e.items:
             c = self.lower(i)
+            if isinstance(c, Call) and c.op is Op.NEG and \
+                    isinstance(c.args[0], Const):
+                # fold negated literals: IN (-1, 6)
+                c = Const(-c.args[0].value, c.args[0].type)
             if not isinstance(c, Const):
                 raise PlanError("IN items must be literals")
             consts.append(c)
@@ -579,7 +640,8 @@ class _Lower:
                 raise PlanError("substring bounds must be literals")
             start, length = int(e.args[1].value), int(e.args[2].value)
             return self._dict_map(col, "substr", (start, length))
-        if e.name in ("upper", "lower", "trim", "ltrim", "rtrim"):
+        if e.name in ("upper", "lower", "trim", "ltrim", "rtrim",
+                      "gethost", "cutwww"):
             col = self._as_string_col(e.args[0], e.name)
             return self._dict_map(col, e.name, ())
         if e.name == "replace":
@@ -845,6 +907,21 @@ class _SelectPlanner:
 
         binding, join_specs = self._bind(sel)
         scopes = binding.scopes
+
+        # SELECT * expands to every in-scope column in FROM order
+        # (ClickBench q23 shape); duplicate names across scopes surface
+        # as the usual ambiguity errors downstream
+        if any(isinstance(it.expr, ast.Star) for it in sel.items):
+            items = []
+            for it in sel.items:
+                if not isinstance(it.expr, ast.Star):
+                    items.append(it)
+                    continue
+                for s in scopes:
+                    for col in s.names:
+                        items.append(
+                            ast.SelectItem(ast.Name((s.alias, col)), col))
+            sel = dataclasses.replace(sel, items=tuple(items))
 
         # right sides of LEFT JOINs: WHERE on them filters AFTER the join
         left_right_aliases = {

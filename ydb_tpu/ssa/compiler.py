@@ -369,6 +369,17 @@ def dict_map_table(d, out_d, kind: str, args: tuple) -> np.ndarray:
     elif kind == "concat_prefix":
         (lit,) = args
         out = [out_d.add(lit + v) for v in d.values]
+    elif kind == "gethost":
+        # URL -> host part (Url::GetHost): strip scheme, path, query
+        def _host(v: bytes) -> bytes:
+            s = v.split(b"://", 1)[-1]
+            return s.split(b"/", 1)[0].split(b"?", 1)[0]
+
+        out = [out_d.add(_host(v)) for v in d.values]
+    elif kind == "cutwww":
+        # Url::CutWWW: drop one leading "www." if present
+        out = [out_d.add(v[4:] if v.startswith(b"www.") else v)
+               for v in d.values]
     elif kind == "strlen":
         # int output: byte length per dictionary value (no out dict)
         out = [len(v) for v in d.values]
