@@ -13,10 +13,14 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow the official templates (q3, q6, q7, q13, q19, q26,
-q42, q43, q48, q52, q55, q96) restated in the framework dialect
-(q13/q48 hoist the join equalities shared by every OR branch — an
-exact identity); each is verified
+Queries follow the official templates (q3, q6, q7, q13, q15, q19,
+q26, q32, q34, q42, q43, q46, q48, q52, q55, q65, q68, q73, q79, q96,
+q98) restated in the framework dialect: q13/q48 hoist the join
+equalities shared by every OR branch (an exact identity); q34/q73
+rewrite the dep/vehicle ratio as a multiply (exact under the
+vehicle > 0 guard); q98 restates the window partition sum as a
+class-total self-join; q65's month window adapts to our date epoch;
+tie-prone ORDER BYs gain deterministic tiebreakers. Each is verified
 against ``reference_answers`` — an independent numpy implementation
 computed straight off the generated tables (the canondata pattern,
 ydb/tests/functional/tpc).
@@ -48,6 +52,25 @@ _CATEGORIES = [b"Books", b"Children", b"Electronics", b"Home",
 _STORE_NAMES = [b"ought", b"able", b"pri", b"ese", b"anti",
                 b"cally", b"ation", b"eing", b"bar"]
 _GENDERS = [b"M", b"F"]
+# pools cover the spec queries' literal constants (q34/q46/q68/q73/q79
+# counties and cities) so they always select rows at synthetic scale
+_CITIES = [b"Five Forks", b"Oakland", b"Fairview", b"Winchester",
+           b"Farmington", b"Pleasant Hill", b"Bethel", b"Midway",
+           b"Union", b"Salem"]
+_COUNTIES = [b"Salem County", b"Terrell County", b"Arthur County",
+             b"Oglethorpe County", b"Lunenburg County", b"Perry County",
+             b"Halifax County", b"Sumner County", b"Lea County",
+             b"Furnas County", b"Pennington County", b"Bronx County",
+             b"Mobile County", b"Ziebach County"]
+_BUY_POTENTIAL = [b"0-500", b"501-1000", b"1001-5000", b"5001-10000",
+                  b">10000", b"Unknown"]
+_FIRST_NAMES = [b"James", b"Mary", b"John", b"Linda", b"Robert",
+                b"Susan", b"Michael", b"Karen", b"William", b"Nancy",
+                b"David", b"Lisa", b"Richard", b"Betty", b"Joseph"]
+_LAST_NAMES = [b"Smith", b"Johnson", b"Williams", b"Brown", b"Jones",
+               b"Garcia", b"Miller", b"Davis", b"Wilson", b"Moore",
+               b"Taylor", b"Anderson", b"Thomas", b"Jackson", b"White"]
+_SALUTATIONS = [b"Mr.", b"Mrs.", b"Ms.", b"Dr.", b"Miss", b"Sir"]
 _MARITAL = [b"M", b"S", b"D", b"W", b"U"]
 _EDUCATION = [b"Primary", b"Secondary", b"College", b"2 yr Degree",
               b"4 yr Degree", b"Advanced Degree", b"Unknown"]
@@ -60,6 +83,8 @@ DATE_DIM_SCHEMA = dtypes.schema(
     ("d_dom", dtypes.INT32, False),
     ("d_month_seq", dtypes.INT32, False),
     ("d_day_name", dtypes.STRING, False),
+    ("d_dow", dtypes.INT32, False),
+    ("d_qoy", dtypes.INT32, False),
 )
 
 ITEM_SCHEMA = dtypes.schema(
@@ -73,6 +98,10 @@ ITEM_SCHEMA = dtypes.schema(
     ("i_manufact", dtypes.STRING, False),
     ("i_manager_id", dtypes.INT32, False),
     ("i_current_price", DEC2, False),
+    ("i_class_id", dtypes.INT32, False),
+    ("i_class", dtypes.STRING, False),
+    ("i_item_desc", dtypes.STRING, False),
+    ("i_wholesale_cost", DEC2, False),
 )
 
 STORE_SCHEMA = dtypes.schema(
@@ -81,6 +110,9 @@ STORE_SCHEMA = dtypes.schema(
     ("s_store_name", dtypes.STRING, False),
     ("s_gmt_offset", dtypes.INT32, False),
     ("s_zip", dtypes.STRING, False),
+    ("s_city", dtypes.STRING, False),
+    ("s_county", dtypes.STRING, False),
+    ("s_number_employees", dtypes.INT32, False),
 )
 
 TIME_DIM_SCHEMA = dtypes.schema(
@@ -98,6 +130,10 @@ PROMOTION_SCHEMA = dtypes.schema(
 CUSTOMER_SCHEMA = dtypes.schema(
     ("c_customer_sk", dtypes.INT64, False),
     ("c_current_addr_sk", dtypes.INT64, False),
+    ("c_first_name", dtypes.STRING, False),
+    ("c_last_name", dtypes.STRING, False),
+    ("c_salutation", dtypes.STRING, False),
+    ("c_preferred_cust_flag", dtypes.STRING, False),
 )
 
 CUSTOMER_ADDRESS_SCHEMA = dtypes.schema(
@@ -105,6 +141,7 @@ CUSTOMER_ADDRESS_SCHEMA = dtypes.schema(
     ("ca_zip", dtypes.STRING, False),
     ("ca_state", dtypes.STRING, False),
     ("ca_country", dtypes.STRING, False),
+    ("ca_city", dtypes.STRING, False),
 )
 
 CUSTOMER_DEMOGRAPHICS_SCHEMA = dtypes.schema(
@@ -117,6 +154,8 @@ CUSTOMER_DEMOGRAPHICS_SCHEMA = dtypes.schema(
 HOUSEHOLD_DEMOGRAPHICS_SCHEMA = dtypes.schema(
     ("hd_demo_sk", dtypes.INT64, False),
     ("hd_dep_count", dtypes.INT32, False),
+    ("hd_buy_potential", dtypes.STRING, False),
+    ("hd_vehicle_count", dtypes.INT32, False),
 )
 
 STORE_SALES_SCHEMA = dtypes.schema(
@@ -136,6 +175,9 @@ STORE_SALES_SCHEMA = dtypes.schema(
     ("ss_ext_wholesale_cost", DEC2, False),
     ("ss_coupon_amt", DEC2, False),
     ("ss_net_profit", DEC2, False),
+    ("ss_ticket_number", dtypes.INT64, False),
+    ("ss_ext_list_price", DEC2, False),
+    ("ss_ext_tax", DEC2, False),
 )
 
 CATALOG_SALES_SCHEMA = dtypes.schema(
@@ -148,6 +190,8 @@ CATALOG_SALES_SCHEMA = dtypes.schema(
     ("cs_sales_price", DEC2, False),
     ("cs_ext_sales_price", DEC2, False),
     ("cs_coupon_amt", DEC2, False),
+    ("cs_bill_customer_sk", dtypes.INT64, False),
+    ("cs_ext_discount_amt", DEC2, False),
 )
 
 SCHEMAS = {
@@ -207,7 +251,7 @@ class TpcdsData:
         # the spec queries' literal constants still select rows
         self._gen_date_dim()
         self._gen_item(rng, max(2000, int(sf * 18_000)))
-        self._gen_store(rng, max(4, int(sf * 12)))
+        self._gen_store(rng, max(14, int(sf * 12)))
         self._gen_time_dim()
         self._gen_promotion(rng, max(20, int(sf * 300)))
         self._gen_demographics()
@@ -235,6 +279,11 @@ class TpcdsData:
                 self.dicts, "d_day_name",
                 [_DAY_NAMES[d] for d in
                  ((days.astype(int) + 3) % 7).tolist()]),
+            # 0 = Sunday (the spec's convention: d_dow in (6,0) means
+            # Saturday+Sunday)
+            "d_dow": (((days.astype(int) + 3) % 7 + 1) % 7)
+            .astype(np.int32),
+            "d_qoy": (((m - y).astype(int)) // 3 + 1).astype(np.int32),
         }
 
     def _gen_item(self, rng, n: int):
@@ -266,6 +315,16 @@ class TpcdsData:
             "i_manager_id": rng.permutation(
                 (np.arange(n) % 100 + 1)).astype(np.int32),
             "i_current_price": _cents(rng, 0.50, 100.00, n),
+            "i_class_id": (class_id := rng.integers(
+                1, 17, n).astype(np.int32)),
+            "i_class": _enc(self.dicts, "i_class",
+                            [b"class#%02d" % c
+                             for c in class_id.tolist()]),
+            "i_item_desc": _enc(
+                self.dicts, "i_item_desc",
+                [b"desc of item %d" % i
+                 for i in range(1, n + 1)]),
+            "i_wholesale_cost": _cents(rng, 0.30, 80.00, n),
         }
 
     def _gen_store(self, rng, n: int):
@@ -281,6 +340,14 @@ class TpcdsData:
             "s_gmt_offset": np.where(
                 rng.random(n) < 0.8, -5, -6).astype(np.int32),
             "s_zip": _enc(self.dicts, "s_zip", zips),
+            "s_city": _enc(self.dicts, "s_city",
+                           [_CITIES[i % len(_CITIES)]
+                            for i in range(n)]),
+            "s_county": _enc(self.dicts, "s_county",
+                             [_COUNTIES[i % len(_COUNTIES)]
+                              for i in range(n)]),
+            "s_number_employees": rng.integers(
+                180, 310, n).astype(np.int32),
         }
 
     def _gen_time_dim(self):
@@ -319,6 +386,12 @@ class TpcdsData:
         self.tables["household_demographics"] = {
             "hd_demo_sk": np.arange(1, n_hd + 1, dtype=np.int64),
             "hd_dep_count": (np.arange(n_hd) % 10).astype(np.int32),
+            "hd_buy_potential": _enc(
+                self.dicts, "hd_buy_potential",
+                [_BUY_POTENTIAL[i % len(_BUY_POTENTIAL)]
+                 for i in range(n_hd)]),
+            "hd_vehicle_count": ((np.arange(n_hd) // 10) % 5)
+            .astype(np.int32),
         }
 
     _STATES = [b"TX", b"OH", b"OR", b"NM", b"KY", b"VA", b"MS",
@@ -337,11 +410,31 @@ class TpcdsData:
                 self.dicts, "ca_country",
                 [b"United States" if us else b"Canada"
                  for us in rng.random(n_addr) < 0.95]),
+            "ca_city": _enc(
+                self.dicts, "ca_city",
+                [_CITIES[i] for i in
+                 rng.integers(0, len(_CITIES), n_addr).tolist()]),
         }
         self.tables["customer"] = {
             "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
             "c_current_addr_sk": rng.integers(
                 1, n_addr + 1, n_cust, dtype=np.int64),
+            "c_first_name": _enc(
+                self.dicts, "c_first_name",
+                [_FIRST_NAMES[i] for i in rng.integers(
+                    0, len(_FIRST_NAMES), n_cust).tolist()]),
+            "c_last_name": _enc(
+                self.dicts, "c_last_name",
+                [_LAST_NAMES[i] for i in rng.integers(
+                    0, len(_LAST_NAMES), n_cust).tolist()]),
+            "c_salutation": _enc(
+                self.dicts, "c_salutation",
+                [_SALUTATIONS[i] for i in rng.integers(
+                    0, len(_SALUTATIONS), n_cust).tolist()]),
+            "c_preferred_cust_flag": _enc(
+                self.dicts, "c_preferred_cust_flag",
+                [b"Y" if f else b"N"
+                 for f in rng.random(n_cust) < 0.5]),
         }
 
     def _fk(self, rng, table: str, pk: str, n: int) -> np.ndarray:
@@ -352,20 +445,39 @@ class TpcdsData:
         list_price = _cents(rng, 1.00, 200.00, n)
         sales_price = (list_price *
                        rng.integers(20, 101, n) // 100).astype(np.int64)
+        # dsdgen groups store_sales rows into TICKETS: one (customer,
+        # store, date, time, hdemo, addr) purchase spanning 1..24 line
+        # items — the q34/q73 "cnt between" bands need real multi-item
+        # tickets, so per-ticket attributes generate first and expand
+        n_tickets = max(n // 8, 1)
+        t_sizes = rng.integers(1, 25, n_tickets)
+        row_ticket = np.repeat(np.arange(n_tickets), t_sizes)[:n]
+        if len(row_ticket) < n:  # top up: tail rows get fresh tickets
+            extra = np.arange(n_tickets,
+                              n_tickets + n - len(row_ticket))
+            row_ticket = np.concatenate([row_ticket, extra])
+        nt = int(row_ticket.max()) + 1
+        t_date = self._fk(rng, "date_dim", "d_date_sk", nt)
+        t_time = rng.integers(0, 86_400, nt, dtype=np.int64)
+        t_cust = self._fk(rng, "customer", "c_customer_sk", nt)
+        t_cdemo = self._fk(rng, "customer_demographics",
+                           "cd_demo_sk", nt)
+        t_hdemo = self._fk(rng, "household_demographics",
+                           "hd_demo_sk", nt)
+        t_store = self._fk(rng, "store", "s_store_sk", nt)
+        t_addr = self._fk(rng, "customer_address",
+                          "ca_address_sk", nt)
         self.tables["store_sales"] = {
-            "ss_sold_date_sk": self._fk(rng, "date_dim", "d_date_sk", n),
-            "ss_sold_time_sk": rng.integers(0, 86_400, n, dtype=np.int64),
+            "ss_sold_date_sk": t_date[row_ticket],
+            "ss_sold_time_sk": t_time[row_ticket],
             "ss_item_sk": self._fk(rng, "item", "i_item_sk", n),
-            "ss_customer_sk": self._fk(
-                rng, "customer", "c_customer_sk", n),
-            "ss_cdemo_sk": self._fk(
-                rng, "customer_demographics", "cd_demo_sk", n),
-            "ss_hdemo_sk": self._fk(
-                rng, "household_demographics", "hd_demo_sk", n),
-            "ss_store_sk": self._fk(rng, "store", "s_store_sk", n),
+            "ss_customer_sk": t_cust[row_ticket],
+            "ss_cdemo_sk": t_cdemo[row_ticket],
+            "ss_hdemo_sk": t_hdemo[row_ticket],
+            "ss_store_sk": t_store[row_ticket],
             "ss_promo_sk": self._fk(rng, "promotion", "p_promo_sk", n),
-            "ss_addr_sk": self._fk(
-                rng, "customer_address", "ca_address_sk", n),
+            "ss_addr_sk": t_addr[row_ticket],
+            "ss_ticket_number": (row_ticket + 1).astype(np.int64),
             "ss_quantity": qty,
             "ss_list_price": list_price,
             "ss_sales_price": sales_price,
@@ -377,6 +489,10 @@ class TpcdsData:
                 rng.random(n) < 0.2, _cents(rng, 0.0, 50.0, n),
                 0).astype(np.int64),
             "ss_net_profit": _cents(rng, -100.0, 300.0, n),
+            "ss_ext_list_price": list_price * qty,
+            "ss_ext_tax": (sales_price * qty *
+                           rng.integers(0, 9, n) // 100)
+            .astype(np.int64),
         }
 
     def _gen_catalog_sales(self, rng, n: int):
@@ -396,6 +512,11 @@ class TpcdsData:
             "cs_ext_sales_price": sales_price * qty,
             "cs_coupon_amt": np.where(
                 rng.random(n) < 0.2, _cents(rng, 0.0, 60.0, n),
+                0).astype(np.int64),
+            "cs_bill_customer_sk": self._fk(
+                rng, "customer", "c_customer_sk", n),
+            "cs_ext_discount_amt": np.where(
+                rng.random(n) < 0.5, _cents(rng, 0.0, 80.0, n),
                 0).astype(np.int64),
         }
 
@@ -630,6 +751,214 @@ where ss_sold_time_sk = t_time_sk
   and t_minute >= 30
   and hd_dep_count = 7
   and s_store_name = 'ese'""",
+    # q15: catalog sales by customer zip for Q2/1998 under an OR of
+    # zip-prefix / state / price predicates
+    "q15": """
+select ca_zip, sum(cs_sales_price) as total
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and (substring(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405',
+                                   '86475', '85392', '85460', '80348',
+                                   '81792')
+       or ca_state in ('CA', 'WA', 'GA')
+       or cs_sales_price > 500)
+  and cs_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 1998
+group by ca_zip
+order by ca_zip
+limit 100""",
+    # q32: excess discount amount vs 1.3x the per-item average in a
+    # 90-day window (official derives adi over item; grouping by
+    # cs_item_sk is the same partition)
+    "q32": """
+with adi as (
+  select cs_item_sk as adi_item_sk,
+         avg(cs_ext_discount_amt) as avg_discount
+  from catalog_sales, date_dim
+  where d_date between date '2002-03-29' and date '2002-06-27'
+    and d_date_sk = cs_sold_date_sk
+  group by cs_item_sk)
+select sum(cs_ext_discount_amt) as excess
+from catalog_sales, item, date_dim, adi
+where i_manufact_id = 66
+  and i_item_sk = cs_item_sk
+  and d_date between date '2002-03-29' and date '2002-06-27'
+  and d_date_sk = cs_sold_date_sk
+  and cs_item_sk = adi_item_sk
+  and cs_ext_discount_amt > 1.3 * avg_discount""",
+    # q34: customers with 15-20-item tickets on month edges (the
+    # dep/vehicle ratio predicate rewrites as a multiply — exact under
+    # the hd_vehicle_count > 0 guard)
+    "q34": """
+with dn as (
+  select ss_ticket_number, ss_customer_sk, count(*) as cnt
+  from store_sales, date_dim, store, household_demographics
+  where ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and ss_hdemo_sk = hd_demo_sk
+    and (d_dom between 1 and 3 or d_dom between 25 and 28)
+    and (hd_buy_potential = '>10000' or hd_buy_potential = 'Unknown')
+    and hd_vehicle_count > 0
+    and hd_dep_count > 1.2 * hd_vehicle_count
+    and d_year in (2000, 2001, 2002)
+    and s_county in ('Salem County', 'Terrell County', 'Arthur County',
+                     'Oglethorpe County', 'Lunenburg County',
+                     'Perry County', 'Halifax County', 'Sumner County')
+  group by ss_ticket_number, ss_customer_sk)
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from dn, customer
+where ss_customer_sk = c_customer_sk
+  and cnt between 15 and 20
+order by c_last_name, c_first_name, c_salutation,
+         c_preferred_cust_flag desc, ss_ticket_number""",
+    # q46: weekend coupon/profit per ticket in five cities, for
+    # customers whose current city differs from the bought city
+    "q46": """
+with dn as (
+  select ss_ticket_number, ss_customer_sk, ss_addr_sk,
+         ca_city as bought_city, sum(ss_coupon_amt) as amt,
+         sum(ss_net_profit) as profit
+  from store_sales, date_dim, store, household_demographics,
+       customer_address
+  where ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and ss_hdemo_sk = hd_demo_sk
+    and ss_addr_sk = ca_address_sk
+    and (hd_dep_count = 0 or hd_vehicle_count = 1)
+    and d_dow in (6, 0)
+    and d_year in (2000, 2001, 2002)
+    and s_city in ('Five Forks', 'Oakland', 'Fairview', 'Winchester',
+                   'Farmington')
+  group by ss_ticket_number, ss_customer_sk, ss_addr_sk, bought_city)
+select c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, amt, profit
+from dn, customer, customer_address
+where ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ca_city <> bought_city
+order by c_last_name, c_first_name, ca_city, bought_city,
+         ss_ticket_number
+limit 100""",
+    # q65: items whose yearly revenue is under 10% of their store's
+    # average per-item revenue (month window adapted to our epoch)
+    "q65": """
+with sc as (
+  select ss_store_sk as sc_store_sk, ss_item_sk as sc_item_sk,
+         sum(ss_sales_price) as revenue
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk and d_month_seq between 48 and 59
+  group by ss_store_sk, ss_item_sk),
+sb as (
+  select sc_store_sk as sb_store_sk, avg(revenue) as ave
+  from sc
+  group by sc_store_sk)
+select s_store_name, i_item_desc, revenue, i_current_price,
+       i_wholesale_cost, i_brand
+from store, item, sb, sc
+where sb_store_sk = sc_store_sk
+  and revenue <= 0.1 * ave
+  and s_store_sk = sc_store_sk
+  and i_item_sk = sc_item_sk
+order by s_store_name, i_item_desc, revenue, i_current_price,
+         i_wholesale_cost, i_brand
+limit 100""",
+    # q68: month-start sales in two cities, moved-customer filter
+    "q68": """
+with dn as (
+  select ss_ticket_number, ss_customer_sk, ss_addr_sk,
+         ca_city as bought_city,
+         sum(ss_ext_sales_price) as extended_price,
+         sum(ss_ext_list_price) as list_price,
+         sum(ss_ext_tax) as extended_tax
+  from store_sales, date_dim, store, household_demographics,
+       customer_address
+  where ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and ss_hdemo_sk = hd_demo_sk
+    and ss_addr_sk = ca_address_sk
+    and d_dom between 1 and 2
+    and (hd_dep_count = 4 or hd_vehicle_count = 0)
+    and d_year in (1999, 2000, 2001)
+    and s_city in ('Pleasant Hill', 'Bethel')
+  group by ss_ticket_number, ss_customer_sk, ss_addr_sk, bought_city)
+select c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, extended_price, extended_tax, list_price
+from dn, customer, customer_address
+where ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100""",
+    # q73: 1-5-item tickets for high-buy-potential households (the
+    # dep/vehicle > 1 ratio rewrites as dep > vehicle, exact under the
+    # vehicle > 0 guard)
+    "q73": """
+with dj as (
+  select ss_ticket_number, ss_customer_sk, count(*) as cnt
+  from store_sales, date_dim, store, household_demographics
+  where ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and ss_hdemo_sk = hd_demo_sk
+    and d_dom between 1 and 2
+    and (hd_buy_potential = '>10000'
+         or hd_buy_potential = '5001-10000')
+    and hd_vehicle_count > 0
+    and hd_dep_count > hd_vehicle_count
+    and d_year in (2000, 2001, 2002)
+    and s_county in ('Lea County', 'Furnas County',
+                     'Pennington County', 'Bronx County')
+  group by ss_ticket_number, ss_customer_sk)
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from dj, customer
+where ss_customer_sk = c_customer_sk
+  and cnt between 1 and 5
+order by cnt desc, c_last_name, ss_ticket_number""",
+    # q79: Monday coupon/profit per ticket at mid-size stores
+    "q79": """
+with ms as (
+  select ss_ticket_number, ss_customer_sk, s_city,
+         sum(ss_coupon_amt) as amt, sum(ss_net_profit) as profit
+  from store_sales, date_dim, store, household_demographics
+  where ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and ss_hdemo_sk = hd_demo_sk
+    and (hd_dep_count = 0 or hd_vehicle_count > 3)
+    and d_dow = 1
+    and d_year in (1998, 1999, 2000)
+    and s_number_employees between 200 and 295
+  group by ss_ticket_number, ss_customer_sk, ss_addr_sk, s_city)
+select c_last_name, c_first_name, substring(s_city, 1, 30) as city30,
+       ss_ticket_number, amt, profit
+from ms, customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name, city30, profit, ss_ticket_number
+limit 100""",
+    # q98: item revenue + share of its class (the official window
+    # sum over partition restated as a class-total self-join — the
+    # same partition sum, exactly)
+    "q98": """
+with ir as (
+  select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+         sum(ss_ext_sales_price) as itemrevenue
+  from store_sales, item, date_dim
+  where ss_item_sk = i_item_sk
+    and i_category in ('Home', 'Sports', 'Men')
+    and ss_sold_date_sk = d_date_sk
+    and d_date between date '2002-01-05' and date '2002-02-04'
+  group by i_item_id, i_item_desc, i_category, i_class,
+           i_current_price),
+cr as (
+  select i_class as cr_class, sum(itemrevenue) as classrevenue
+  from ir group by i_class)
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       itemrevenue, itemrevenue * 100.0 / classrevenue as revenueratio
+from ir, cr
+where i_class = cr_class
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100""",
 }
 
 
@@ -637,6 +966,11 @@ def _decode(data: TpcdsData, table: str, col: str) -> np.ndarray:
     d = data.dicts[col]
     vals = np.array(d.values + [b""], dtype=object)
     return vals[data.tables[table][col]]
+
+
+def _desc_bytes(b: bytes) -> tuple:
+    """Sort key inverting lexicographic byte order (DESC string sort)."""
+    return tuple(255 - x for x in b) + (256,)
 
 
 def _pk_map(data, table, pk, *cols):
@@ -651,9 +985,10 @@ def reference_answers(data: TpcdsData,
                       queries=None) -> dict[str, list[tuple]]:
     """Independent numpy/python reference results (the canondata)."""
     names = queries or sorted(QUERIES)
+    ref = _Ref(data)  # shared: the lookup-dict helpers memoize on self
     out: dict[str, list[tuple]] = {}
     for name in names:
-        out[name] = getattr(_Ref(data), name)()
+        out[name] = getattr(ref, name)()
     return out
 
 
@@ -1009,6 +1344,352 @@ class _Ref:
                 n += 1
         return [(n,)]
 
+    # ---- batch-1 additions (q15/q32/q34/q46/q65/q68/q73/q79/q98) ----
+
+    def _hd(self):
+        if getattr(self, "_hd_cache", None) is not None:
+            return self._hd_cache
+        hd = self.d.tables["household_demographics"]
+        bp = _decode(self.d, "household_demographics",
+                     "hd_buy_potential")
+        self._hd_cache = {sk: (int(dep), int(veh), b)
+                          for sk, dep, veh, b in zip(
+                              hd["hd_demo_sk"].tolist(),
+                              hd["hd_dep_count"].tolist(),
+                              hd["hd_vehicle_count"].tolist(), bp)}
+        return self._hd_cache
+
+    def _dd(self):
+        if getattr(self, "_dd_cache", None) is not None:
+            return self._dd_cache
+        dd = self.d.tables["date_dim"]
+        self._dd_cache = {
+            sk: (int(y), int(m), int(dom), int(dow), int(q),
+                 int(dt), int(ms))
+            for sk, y, m, dom, dow, q, dt, ms in zip(
+                dd["d_date_sk"].tolist(), dd["d_year"].tolist(),
+                dd["d_moy"].tolist(), dd["d_dom"].tolist(),
+                dd["d_dow"].tolist(), dd["d_qoy"].tolist(),
+                dd["d_date"].tolist(), dd["d_month_seq"].tolist())}
+        return self._dd_cache
+
+    def _cust(self):
+        if getattr(self, "_cust_cache", None) is not None:
+            return self._cust_cache
+        c = self.d.tables["customer"]
+        fn = _decode(self.d, "customer", "c_first_name")
+        ln = _decode(self.d, "customer", "c_last_name")
+        sal = _decode(self.d, "customer", "c_salutation")
+        fl = _decode(self.d, "customer", "c_preferred_cust_flag")
+        self._cust_cache = {
+            sk: (ln[i], fn[i], sal[i], fl[i],
+                 int(c["c_current_addr_sk"][i]))
+            for i, sk in enumerate(c["c_customer_sk"].tolist())}
+        return self._cust_cache
+
+    def q15(self):
+        d = self.d
+        cs = d.tables["catalog_sales"]
+        dd = self._dd()
+        cust = self._cust()
+        ca = d.tables["customer_address"]
+        zips = _decode(d, "customer_address", "ca_zip")
+        states = _decode(d, "customer_address", "ca_state")
+        ai = {sk: i for i, sk in
+              enumerate(ca["ca_address_sk"].tolist())}
+        tz = {b"85669", b"86197", b"88274", b"83405", b"86475",
+              b"85392", b"85460", b"80348", b"81792"}
+        ts = {b"CA", b"WA", b"GA"}
+        acc: dict = collections.defaultdict(int)
+        for dk, ck, sp in zip(cs["cs_sold_date_sk"].tolist(),
+                              cs["cs_bill_customer_sk"].tolist(),
+                              cs["cs_sales_price"].tolist()):
+            y, _m, _dom, _dow, q, _dt, _ms = dd[dk]
+            if y != 1998 or q != 2:
+                continue
+            i = ai[cust[ck][4]]
+            if not (zips[i][:5] in tz or states[i] in ts
+                    or sp > 50000):
+                continue
+            acc[zips[i]] += sp
+        return sorted(acc.items())[:100]
+
+    def q32(self):
+        d = self.d
+        cs = d.tables["catalog_sales"]
+        dd = self._dd()
+        lo = int(np.datetime64("2002-03-29", "D").astype(int))
+        hi = int(np.datetime64("2002-06-27", "D").astype(int))
+        manu = {sk for sk, m in zip(
+            d.tables["item"]["i_item_sk"].tolist(),
+            d.tables["item"]["i_manufact_id"].tolist()) if m == 66}
+        by_item: dict = collections.defaultdict(lambda: [0, 0])
+        rows = []
+        for dk, ik, amt in zip(cs["cs_sold_date_sk"].tolist(),
+                               cs["cs_item_sk"].tolist(),
+                               cs["cs_ext_discount_amt"].tolist()):
+            dt = dd[dk][5]
+            if not (lo <= dt <= hi):
+                continue
+            st = by_item[ik]
+            st[0] += amt
+            st[1] += 1
+            rows.append((ik, amt))
+        excess = 0
+        any_row = False
+        for ik, amt in rows:
+            if ik in manu:
+                sm, n = by_item[ik]
+                if amt > 1.3 * (sm / n):
+                    excess += amt
+                    any_row = True
+        return [(excess if any_row else None,)]
+
+    def _ticket_counts(self, dom_ok, bp_set, dep_pred, years,
+                       county_set):
+        """(ticket, customer) -> line count under q34/q73 filters."""
+        d = self.d
+        ss = d.tables["store_sales"]
+        dd = self._dd()
+        hd = self._hd()
+        counties = _decode(d, "store", "s_county")
+        s_ok = {sk for i, sk in enumerate(
+            d.tables["store"]["s_store_sk"].tolist())
+            if counties[i] in county_set}
+        acc: dict = collections.defaultdict(int)
+        for dk, sk, hk, tn, ck in zip(
+                ss["ss_sold_date_sk"].tolist(),
+                ss["ss_store_sk"].tolist(),
+                ss["ss_hdemo_sk"].tolist(),
+                ss["ss_ticket_number"].tolist(),
+                ss["ss_customer_sk"].tolist()):
+            y, _m, dom, _dow, _q, _dt, _ms = dd[dk]
+            dep, veh, bp = hd[hk]
+            if y not in years or not dom_ok(dom) or sk not in s_ok \
+                    or bp not in bp_set or veh <= 0 \
+                    or not dep_pred(dep, veh):
+                continue
+            acc[(tn, ck)] += 1
+        return acc
+
+    def q34(self):
+        acc = self._ticket_counts(
+            lambda dom: 1 <= dom <= 3 or 25 <= dom <= 28,
+            {b">10000", b"Unknown"},
+            lambda dep, veh: dep > 1.2 * veh,
+            {2000, 2001, 2002},
+            {b"Salem County", b"Terrell County", b"Arthur County",
+             b"Oglethorpe County", b"Lunenburg County",
+             b"Perry County", b"Halifax County", b"Sumner County"})
+        cust = self._cust()
+        rows = [(cust[ck][0], cust[ck][1], cust[ck][2], cust[ck][3],
+                 tn, c)
+                for (tn, ck), c in acc.items() if 15 <= c <= 20]
+        # c_preferred_cust_flag DESC, everything else ASC
+        rows.sort(key=lambda r: (r[0], r[1], r[2],
+                                 _desc_bytes(r[3]), r[4]))
+        return rows
+
+    def q73(self):
+        acc = self._ticket_counts(
+            lambda dom: 1 <= dom <= 2,
+            {b">10000", b"5001-10000"},
+            lambda dep, veh: dep > veh,
+            {2000, 2001, 2002},
+            {b"Lea County", b"Furnas County", b"Pennington County",
+             b"Bronx County"})
+        cust = self._cust()
+        rows = [(cust[ck][0], cust[ck][1], cust[ck][2], cust[ck][3],
+                 tn, c)
+                for (tn, ck), c in acc.items() if 1 <= c <= 5]
+        rows.sort(key=lambda r: (-r[5], r[0], r[4]))
+        return rows
+
+    def _ticket_sums(self, row_ok, cols):
+        """(ticket, customer, addr) -> [sums of cols] under a filter."""
+        d = self.d
+        ss = d.tables["store_sales"]
+        dd = self._dd()
+        hd = self._hd()
+        acc: dict = {}
+        arrs = [ss[c].tolist() for c in cols]
+        for i, (dk, sk, hk, tn, ck, ak) in enumerate(zip(
+                ss["ss_sold_date_sk"].tolist(),
+                ss["ss_store_sk"].tolist(),
+                ss["ss_hdemo_sk"].tolist(),
+                ss["ss_ticket_number"].tolist(),
+                ss["ss_customer_sk"].tolist(),
+                ss["ss_addr_sk"].tolist())):
+            if not row_ok(dd[dk], sk, hd[hk]):
+                continue
+            st = acc.setdefault((tn, ck, ak), [0] * len(cols))
+            for j, a in enumerate(arrs):
+                st[j] += a[i]
+        return acc
+
+    def _city_move_rows(self, acc):
+        """q46/q68 shape: join customer + current address, keep rows
+        whose current city differs from the bought city."""
+        d = self.d
+        cust = self._cust()
+        cities = _decode(d, "customer_address", "ca_city")
+        ai = {sk: i for i, sk in enumerate(
+            d.tables["customer_address"]["ca_address_sk"].tolist())}
+        rows = []
+        for (tn, ck, ak), sums in acc.items():
+            bought = cities[ai[ak]]
+            cur = cities[ai[cust[ck][4]]]
+            if cur == bought:
+                continue
+            rows.append((cust[ck][0], cust[ck][1], cur, bought, tn,
+                         *sums))
+        return rows
+
+    def q46(self):
+        store_ok = self._city_stores(
+            {b"Five Forks", b"Oakland", b"Fairview", b"Winchester",
+             b"Farmington"})
+
+        def ok(dinfo, sk, hdinfo):
+            y, _m, _dom, dow, _q, _dt, _ms = dinfo
+            dep, veh, _bp = hdinfo
+            return (y in (2000, 2001, 2002) and dow in (6, 0)
+                    and sk in store_ok and (dep == 0 or veh == 1))
+
+        acc = self._ticket_sums(ok, ("ss_coupon_amt",
+                                     "ss_net_profit"))
+        rows = self._city_move_rows(acc)
+        rows.sort(key=lambda r: r[:5])
+        return rows[:100]
+
+    def q68(self):
+        store_ok = self._city_stores({b"Pleasant Hill", b"Bethel"})
+
+        def ok(dinfo, sk, hdinfo):
+            y, _m, dom, _dow, _q, _dt, _ms = dinfo
+            dep, veh, _bp = hdinfo
+            return (y in (1999, 2000, 2001) and 1 <= dom <= 2
+                    and sk in store_ok and (dep == 4 or veh == 0))
+
+        acc = self._ticket_sums(ok, ("ss_ext_sales_price",
+                                     "ss_ext_list_price",
+                                     "ss_ext_tax"))
+        rows = [(ln, fn, cur, bought, tn, esp, etax, elp)
+                for ln, fn, cur, bought, tn, esp, elp, etax
+                in self._city_move_rows(acc)]
+        rows.sort(key=lambda r: (r[0], r[4]))
+        return rows[:100]
+
+    def _city_stores(self, names):
+        cities = _decode(self.d, "store", "s_city")
+        return {sk for i, sk in enumerate(
+            self.d.tables["store"]["s_store_sk"].tolist())
+            if cities[i] in names}
+
+    def q79(self):
+        d = self.d
+        st = d.tables["store"]
+        cities = _decode(d, "store", "s_city")
+        emp_ok = {sk: cities[i] for i, sk in
+                  enumerate(st["s_store_sk"].tolist())
+                  if 200 <= st["s_number_employees"][i] <= 295}
+
+        def ok(dinfo, sk, hdinfo):
+            y, _m, _dom, dow, _q, _dt, _ms = dinfo
+            dep, veh, _bp = hdinfo
+            return (y in (1998, 1999, 2000) and dow == 1
+                    and sk in emp_ok and (dep == 0 or veh > 3))
+
+        ss = d.tables["store_sales"]
+        dd = self._dd()
+        hd = self._hd()
+        acc: dict = {}
+        for dk, sk, hk, tn, ck, amt, pr in zip(
+                ss["ss_sold_date_sk"].tolist(),
+                ss["ss_store_sk"].tolist(),
+                ss["ss_hdemo_sk"].tolist(),
+                ss["ss_ticket_number"].tolist(),
+                ss["ss_customer_sk"].tolist(),
+                ss["ss_coupon_amt"].tolist(),
+                ss["ss_net_profit"].tolist()):
+            if not ok(dd[dk], sk, hd[hk]):
+                continue
+            st2 = acc.setdefault((tn, ck, emp_ok.get(sk)), [0, 0])
+            st2[0] += amt
+            st2[1] += pr
+        cust = self._cust()
+        rows = [(cust[ck][0], cust[ck][1], city[:30], tn, a, p)
+                for (tn, ck, city), (a, p) in acc.items()]
+        rows.sort(key=lambda r: (r[0], r[1], r[2], r[5], r[3]))
+        return rows[:100]
+
+    def q65(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        dd = self._dd()
+        rev: dict = collections.defaultdict(int)
+        for dk, sk, ik, sp in zip(ss["ss_sold_date_sk"].tolist(),
+                                  ss["ss_store_sk"].tolist(),
+                                  ss["ss_item_sk"].tolist(),
+                                  ss["ss_sales_price"].tolist()):
+            if 48 <= dd[dk][6] <= 59:
+                rev[(sk, ik)] += sp
+        per_store: dict = collections.defaultdict(list)
+        for (sk, _ik), r in rev.items():
+            per_store[sk].append(r)
+        ave = {sk: sum(v) / len(v) for sk, v in per_store.items()}
+        it = d.tables["item"]
+        ii = {sk: i for i, sk in enumerate(it["i_item_sk"].tolist())}
+        si = {sk: i for i, sk in enumerate(
+            d.tables["store"]["s_store_sk"].tolist())}
+        snames = _decode(d, "store", "s_store_name")
+        descs = _decode(d, "item", "i_item_desc")
+        brands = _decode(d, "item", "i_brand")
+        rows = []
+        for (sk, ik), r in rev.items():
+            if r <= 0.1 * ave[sk]:
+                i = ii[ik]
+                rows.append((snames[si[sk]], descs[i], r,
+                             int(it["i_current_price"][i]),
+                             int(it["i_wholesale_cost"][i]),
+                             brands[i]))
+        rows.sort(key=lambda x: (x[0], x[1], x[2], x[3],
+                                 x[4], x[5]))
+        return rows[:100]
+
+    def q98(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        dd = self._dd()
+        lo = int(np.datetime64("2002-01-05", "D").astype(int))
+        hi = int(np.datetime64("2002-02-04", "D").astype(int))
+        it = d.tables["item"]
+        cats = _decode(d, "item", "i_category")
+        classes = _decode(d, "item", "i_class")
+        ids = _decode(d, "item", "i_item_id")
+        descs = _decode(d, "item", "i_item_desc")
+        ii = {sk: i for i, sk in enumerate(it["i_item_sk"].tolist())}
+        target = {b"Home", b"Sports", b"Men"}
+        acc: dict = collections.defaultdict(int)
+        for dk, ik, p in zip(ss["ss_sold_date_sk"].tolist(),
+                             ss["ss_item_sk"].tolist(),
+                             ss["ss_ext_sales_price"].tolist()):
+            if not (lo <= dd[dk][5] <= hi):
+                continue
+            i = ii[ik]
+            if cats[i] not in target:
+                continue
+            acc[(ids[i], descs[i], cats[i], classes[i],
+                 int(it["i_current_price"][i]))] += p
+        ctot: dict = collections.defaultdict(int)
+        for (_id, _de, _ca, cl, _pr), r in acc.items():
+            ctot[cl] += r
+        rows = [(k[0], k[1], k[2], k[3], k[4], r,
+                 r * 100.0 / ctot[k[3]])
+                for k, r in acc.items()]
+        rows.sort(key=lambda x: (x[2], x[3], x[0], x[1], x[6]))
+        return rows[:100]
+
 
 def run_tpcds(sf: float = 0.01, queries=None, iterations: int = 1,
               seed: int = 42, verify: bool = True):
@@ -1078,6 +1759,32 @@ _VERIFY_COLS = {
     "q55": (("i_brand_id", "int"), ("i_brand", "str"),
             ("ext_price", "dec")),
     "q96": (("cnt", "int"),),
+    "q15": (("ca_zip", "str"), ("total", "dec")),
+    "q32": (("excess", "dec"),),
+    "q34": (("c_last_name", "str"), ("c_first_name", "str"),
+            ("c_salutation", "str"), ("c_preferred_cust_flag", "str"),
+            ("ss_ticket_number", "int"), ("cnt", "int")),
+    "q46": (("c_last_name", "str"), ("c_first_name", "str"),
+            ("ca_city", "str"), ("bought_city", "str"),
+            ("ss_ticket_number", "int"), ("amt", "dec"),
+            ("profit", "dec")),
+    "q65": (("s_store_name", "str"), ("i_item_desc", "str"),
+            ("revenue", "dec"), ("i_current_price", "dec"),
+            ("i_wholesale_cost", "dec"), ("i_brand", "str")),
+    "q68": (("c_last_name", "str"), ("c_first_name", "str"),
+            ("ca_city", "str"), ("bought_city", "str"),
+            ("ss_ticket_number", "int"), ("extended_price", "dec"),
+            ("extended_tax", "dec"), ("list_price", "dec")),
+    "q73": (("c_last_name", "str"), ("c_first_name", "str"),
+            ("c_salutation", "str"), ("c_preferred_cust_flag", "str"),
+            ("ss_ticket_number", "int"), ("cnt", "int")),
+    "q79": (("c_last_name", "str"), ("c_first_name", "str"),
+            ("city30", "str"), ("ss_ticket_number", "int"),
+            ("amt", "dec"), ("profit", "dec")),
+    "q98": (("i_item_id", "str"), ("i_item_desc", "str"),
+            ("i_category", "str"), ("i_class", "str"),
+            ("i_current_price", "dec"), ("itemrevenue", "dec"),
+            ("revenueratio", "avg")),
 }
 
 # reference rows carry avgs pre-descaled; engine avg output of a DEC2
