@@ -5,17 +5,19 @@ ydb/library/benchmarks/queries/tpcds/, run via `ydb workload tpcds` —
 ydb_cli/commands/ydb_benchmark.cpp).
 
 The schema is the subset of TPC-DS's 24 tables that the implemented
-queries touch: the store_sales / catalog_sales fact tables plus the
-date_dim, item, store, time_dim, promotion, customer,
-customer_address, customer_demographics and household_demographics
+queries touch: the store_sales / catalog_sales / web_sales / inventory
+fact tables plus the date_dim, item, store, time_dim, promotion,
+customer, customer_address, customer_demographics,
+household_demographics, warehouse, ship_mode and call_center
 dimensions, with dsdgen's column domains (julian-numbered date
 surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow the official templates (q3, q6, q7, q13, q15, q19,
-q26, q32, q34, q42, q43, q46, q48, q52, q55, q65, q68, q73, q79, q96,
-q98) restated in the framework dialect: q13/q48 hoist the join
+Queries follow 30 official templates (q3, q6, q7, q12, q13, q15, q19,
+q20, q21, q26, q32, q34, q37, q42, q43, q45, q46, q48, q52, q55, q65,
+q68, q69, q73, q79, q82, q92, q96, q98, q99) restated in the framework
+dialect: q13/q48 hoist the join
 equalities shared by every OR branch (an exact identity); q34/q73
 rewrite the dep/vehicle ratio as a multiply (exact under the
 vehicle > 0 guard); q98 restates the window partition sum as a
@@ -71,6 +73,11 @@ _LAST_NAMES = [b"Smith", b"Johnson", b"Williams", b"Brown", b"Jones",
                b"Garcia", b"Miller", b"Davis", b"Wilson", b"Moore",
                b"Taylor", b"Anderson", b"Thomas", b"Jackson", b"White"]
 _SALUTATIONS = [b"Mr.", b"Mrs.", b"Ms.", b"Dr.", b"Miss", b"Sir"]
+_CREDIT_RATINGS = [b"Low Risk", b"Good", b"High Risk", b"Unknown"]
+_SHIP_TYPES = [b"EXPRESS", b"OVERNIGHT", b"REGULAR", b"TWO DAY",
+               b"LIBRARY"]
+_CC_NAMES = [b"NY Metro", b"Mid Atlantic", b"North Midwest",
+             b"Pacific Northwest", b"Central", b"California"]
 _MARITAL = [b"M", b"S", b"D", b"W", b"U"]
 _EDUCATION = [b"Primary", b"Secondary", b"College", b"2 yr Degree",
               b"4 yr Degree", b"Advanced Degree", b"Unknown"]
@@ -134,6 +141,7 @@ CUSTOMER_SCHEMA = dtypes.schema(
     ("c_last_name", dtypes.STRING, False),
     ("c_salutation", dtypes.STRING, False),
     ("c_preferred_cust_flag", dtypes.STRING, False),
+    ("c_current_cdemo_sk", dtypes.INT64, False),
 )
 
 CUSTOMER_ADDRESS_SCHEMA = dtypes.schema(
@@ -142,6 +150,7 @@ CUSTOMER_ADDRESS_SCHEMA = dtypes.schema(
     ("ca_state", dtypes.STRING, False),
     ("ca_country", dtypes.STRING, False),
     ("ca_city", dtypes.STRING, False),
+    ("ca_county", dtypes.STRING, False),
 )
 
 CUSTOMER_DEMOGRAPHICS_SCHEMA = dtypes.schema(
@@ -149,6 +158,8 @@ CUSTOMER_DEMOGRAPHICS_SCHEMA = dtypes.schema(
     ("cd_gender", dtypes.STRING, False),
     ("cd_marital_status", dtypes.STRING, False),
     ("cd_education_status", dtypes.STRING, False),
+    ("cd_purchase_estimate", dtypes.INT32, False),
+    ("cd_credit_rating", dtypes.STRING, False),
 )
 
 HOUSEHOLD_DEMOGRAPHICS_SCHEMA = dtypes.schema(
@@ -180,6 +191,38 @@ STORE_SALES_SCHEMA = dtypes.schema(
     ("ss_ext_tax", DEC2, False),
 )
 
+WEB_SALES_SCHEMA = dtypes.schema(
+    ("ws_sold_date_sk", dtypes.INT64, False),
+    ("ws_item_sk", dtypes.INT64, False),
+    ("ws_bill_customer_sk", dtypes.INT64, False),
+    ("ws_quantity", dtypes.INT32, False),
+    ("ws_sales_price", DEC2, False),
+    ("ws_ext_sales_price", DEC2, False),
+    ("ws_ext_discount_amt", DEC2, False),
+)
+
+INVENTORY_SCHEMA = dtypes.schema(
+    ("inv_date_sk", dtypes.INT64, False),
+    ("inv_item_sk", dtypes.INT64, False),
+    ("inv_warehouse_sk", dtypes.INT64, False),
+    ("inv_quantity_on_hand", dtypes.INT32, False),
+)
+
+WAREHOUSE_SCHEMA = dtypes.schema(
+    ("w_warehouse_sk", dtypes.INT64, False),
+    ("w_warehouse_name", dtypes.STRING, False),
+)
+
+SHIP_MODE_SCHEMA = dtypes.schema(
+    ("sm_ship_mode_sk", dtypes.INT64, False),
+    ("sm_type", dtypes.STRING, False),
+)
+
+CALL_CENTER_SCHEMA = dtypes.schema(
+    ("cc_call_center_sk", dtypes.INT64, False),
+    ("cc_name", dtypes.STRING, False),
+)
+
 CATALOG_SALES_SCHEMA = dtypes.schema(
     ("cs_sold_date_sk", dtypes.INT64, False),
     ("cs_item_sk", dtypes.INT64, False),
@@ -192,6 +235,10 @@ CATALOG_SALES_SCHEMA = dtypes.schema(
     ("cs_coupon_amt", DEC2, False),
     ("cs_bill_customer_sk", dtypes.INT64, False),
     ("cs_ext_discount_amt", DEC2, False),
+    ("cs_ship_date_sk", dtypes.INT64, False),
+    ("cs_warehouse_sk", dtypes.INT64, False),
+    ("cs_ship_mode_sk", dtypes.INT64, False),
+    ("cs_call_center_sk", dtypes.INT64, False),
 )
 
 SCHEMAS = {
@@ -206,6 +253,11 @@ SCHEMAS = {
     "household_demographics": HOUSEHOLD_DEMOGRAPHICS_SCHEMA,
     "store_sales": STORE_SALES_SCHEMA,
     "catalog_sales": CATALOG_SALES_SCHEMA,
+    "web_sales": WEB_SALES_SCHEMA,
+    "inventory": INVENTORY_SCHEMA,
+    "warehouse": WAREHOUSE_SCHEMA,
+    "ship_mode": SHIP_MODE_SCHEMA,
+    "call_center": CALL_CENTER_SCHEMA,
 }
 
 PRIMARY_KEYS = {
@@ -220,6 +272,11 @@ PRIMARY_KEYS = {
     "household_demographics": ("hd_demo_sk",),
     "store_sales": ("ss_item_sk", "ss_sold_date_sk", "ss_sold_time_sk"),
     "catalog_sales": ("cs_item_sk", "cs_sold_date_sk"),
+    "web_sales": ("ws_item_sk", "ws_sold_date_sk"),
+    "inventory": ("inv_date_sk", "inv_item_sk", "inv_warehouse_sk"),
+    "warehouse": ("w_warehouse_sk",),
+    "ship_mode": ("sm_ship_mode_sk",),
+    "call_center": ("cc_call_center_sk",),
 }
 
 
@@ -255,10 +312,13 @@ class TpcdsData:
         self._gen_time_dim()
         self._gen_promotion(rng, max(20, int(sf * 300)))
         self._gen_demographics()
-        self._gen_customer(rng, max(200, int(sf * 100_000)),
-                           max(80, int(sf * 50_000)))
+        self._gen_customer(rng, max(2000, int(sf * 100_000)),
+                           max(400, int(sf * 50_000)))
+        self._gen_warehouses(rng)
         self._gen_store_sales(rng, max(50_000, int(sf * 2_880_404)))
         self._gen_catalog_sales(rng, max(25_000, int(sf * 1_441_548)))
+        self._gen_web_sales(rng, max(15_000, int(sf * 719_384)))
+        self._gen_inventory(rng, max(260_000, int(sf * 11_745_000)))
 
     def _gen_date_dim(self):
         days = _D0 + np.arange(_N_DATES)
@@ -314,7 +374,11 @@ class TpcdsData:
                 [b"manufact#%d" % m for m in manufact_id.tolist()]),
             "i_manager_id": rng.permutation(
                 (np.arange(n) % 100 + 1)).astype(np.int32),
-            "i_current_price": _cents(rng, 0.50, 100.00, n),
+            # dsdgen prices skew low: a fifth of items cluster under
+            # $2 (q21's 0.99-1.49 band must select items at any scale)
+            "i_current_price": np.where(
+                rng.random(n) < 0.2, _cents(rng, 0.50, 2.00, n),
+                _cents(rng, 2.00, 100.00, n)).astype(np.int64),
             "i_class_id": (class_id := rng.integers(
                 1, 17, n).astype(np.int32)),
             "i_class": _enc(self.dicts, "i_class",
@@ -373,14 +437,21 @@ class TpcdsData:
     def _gen_demographics(self):
         combos = [(g, m, e) for g in _GENDERS for m in _MARITAL
                   for e in _EDUCATION]
+        nc = len(combos)
         self.tables["customer_demographics"] = {
-            "cd_demo_sk": np.arange(1, len(combos) + 1, dtype=np.int64),
+            "cd_demo_sk": np.arange(1, nc + 1, dtype=np.int64),
             "cd_gender": _enc(self.dicts, "cd_gender",
                               [c[0] for c in combos]),
             "cd_marital_status": _enc(self.dicts, "cd_marital_status",
                                       [c[1] for c in combos]),
             "cd_education_status": _enc(self.dicts, "cd_education_status",
                                         [c[2] for c in combos]),
+            "cd_purchase_estimate": ((np.arange(nc) % 20 + 1) * 500)
+            .astype(np.int32),
+            "cd_credit_rating": _enc(
+                self.dicts, "cd_credit_rating",
+                [_CREDIT_RATINGS[i % len(_CREDIT_RATINGS)]
+                 for i in range(nc)]),
         }
         n_hd = 7200
         self.tables["household_demographics"] = {
@@ -395,11 +466,19 @@ class TpcdsData:
         }
 
     _STATES = [b"TX", b"OH", b"OR", b"NM", b"KY", b"VA", b"MS",
-               b"CA", b"NY", b"WA", b"GA", b"FL"]
+               b"CA", b"NY", b"WA", b"GA", b"FL", b"MO", b"MN",
+               b"AZ"]
+
+    _SPEC_ZIPS = [b"85669", b"86197", b"88274", b"83405", b"86475",
+                  b"85392", b"85460", b"80348", b"81792"]
 
     def _gen_customer(self, rng, n_cust: int, n_addr: int):
-        zips = [b"%05d" % z for z in
-                rng.integers(10000, 99999, n_addr).tolist()]
+        # every 50th address takes a spec-query zip (q15/q45 prefix
+        # lists) so those OR branches select rows at any scale
+        zips = [self._SPEC_ZIPS[i // 50 % len(self._SPEC_ZIPS)]
+                if i % 50 == 0 else b"%05d" % z
+                for i, z in enumerate(
+                    rng.integers(10000, 99999, n_addr).tolist())]
         state_pick = rng.integers(0, len(self._STATES), n_addr)
         self.tables["customer_address"] = {
             "ca_address_sk": np.arange(1, n_addr + 1, dtype=np.int64),
@@ -414,6 +493,10 @@ class TpcdsData:
                 self.dicts, "ca_city",
                 [_CITIES[i] for i in
                  rng.integers(0, len(_CITIES), n_addr).tolist()]),
+            "ca_county": _enc(
+                self.dicts, "ca_county",
+                [_COUNTIES[i] for i in
+                 rng.integers(0, len(_COUNTIES), n_addr).tolist()]),
         }
         self.tables["customer"] = {
             "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
@@ -435,6 +518,9 @@ class TpcdsData:
                 self.dicts, "c_preferred_cust_flag",
                 [b"Y" if f else b"N"
                  for f in rng.random(n_cust) < 0.5]),
+            "c_current_cdemo_sk": rng.integers(
+                1, len(_GENDERS) * len(_MARITAL) * len(_EDUCATION) + 1,
+                n_cust, dtype=np.int64),
         }
 
     def _fk(self, rng, table: str, pk: str, n: int) -> np.ndarray:
@@ -450,7 +536,11 @@ class TpcdsData:
         # items — the q34/q73 "cnt between" bands need real multi-item
         # tickets, so per-ticket attributes generate first and expand
         n_tickets = max(n // 8, 1)
-        t_sizes = rng.integers(1, 25, n_tickets)
+        # min of two uniforms skews ticket sizes small (dsdgen-like:
+        # most baskets are a few lines) so the cnt-between-1-and-5
+        # bands (q73) select tickets at every scale
+        t_sizes = np.minimum(rng.integers(1, 25, n_tickets),
+                             rng.integers(1, 25, n_tickets))
         row_ticket = np.repeat(np.arange(n_tickets), t_sizes)[:n]
         if len(row_ticket) < n:  # top up: tail rows get fresh tickets
             extra = np.arange(n_tickets,
@@ -518,6 +608,87 @@ class TpcdsData:
             "cs_ext_discount_amt": np.where(
                 rng.random(n) < 0.5, _cents(rng, 0.0, 80.0, n),
                 0).astype(np.int64),
+            "cs_warehouse_sk": self._fk(
+                rng, "warehouse", "w_warehouse_sk", n),
+            "cs_ship_mode_sk": self._fk(
+                rng, "ship_mode", "sm_ship_mode_sk", n),
+            "cs_call_center_sk": self._fk(
+                rng, "call_center", "cc_call_center_sk", n),
+        }
+        # shipping: 1..120 days after the sale (q99 buckets), clamped
+        # into the date_dim domain
+        cs = self.tables["catalog_sales"]
+        max_sk = int(self.tables["date_dim"]["d_date_sk"].max())
+        cs["cs_ship_date_sk"] = np.minimum(
+            cs["cs_sold_date_sk"] + rng.integers(1, 151, n), max_sk)
+
+    def _gen_warehouses(self, rng):
+        self.tables["warehouse"] = {
+            "w_warehouse_sk": np.arange(1, 6, dtype=np.int64),
+            "w_warehouse_name": _enc(
+                self.dicts, "w_warehouse_name",
+                [b"Warehouse number %d distribution" % i
+                 for i in range(1, 6)]),
+        }
+        self.tables["ship_mode"] = {
+            "sm_ship_mode_sk": np.arange(1, 21, dtype=np.int64),
+            "sm_type": _enc(
+                self.dicts, "sm_type",
+                [_SHIP_TYPES[i % len(_SHIP_TYPES)] for i in range(20)]),
+        }
+        self.tables["call_center"] = {
+            "cc_call_center_sk": np.arange(1, 7, dtype=np.int64),
+            "cc_name": _enc(
+                self.dicts, "cc_name",
+                [_CC_NAMES[i % len(_CC_NAMES)] for i in range(6)]),
+        }
+
+    def _gen_web_sales(self, rng, n: int):
+        qty = rng.integers(1, 101, n).astype(np.int32)
+        list_price = _cents(rng, 1.00, 300.00, n)
+        sales_price = (list_price *
+                       rng.integers(20, 101, n) // 100).astype(np.int64)
+        # unique (item, date) pairs back the declared PK
+        items = self.tables["item"]["i_item_sk"]
+        dates = self.tables["date_dim"]["d_date_sk"]
+        cells = rng.choice(len(items) * len(dates), size=n,
+                           replace=False)
+        self.tables["web_sales"] = {
+            "ws_sold_date_sk": dates[cells % len(dates)],
+            "ws_item_sk": items[cells // len(dates)],
+            "ws_bill_customer_sk": self._fk(
+                rng, "customer", "c_customer_sk", n),
+            "ws_quantity": qty,
+            "ws_sales_price": sales_price,
+            "ws_ext_sales_price": sales_price * qty,
+            "ws_ext_discount_amt": np.where(
+                rng.random(n) < 0.5, _cents(rng, 0.0, 90.0, n),
+                0).astype(np.int64),
+        }
+
+    def _gen_inventory(self, rng, n: int):
+        # weekly snapshots: every 7th date_dim day. Rows are a random
+        # sample WITHOUT replacement of the (item, week, warehouse)
+        # grid, interleaved over items: the declared PK triple is
+        # genuinely unique AND every item keeps inventory coverage at
+        # every scale (q37/q82 point bands stay non-vacuous)
+        weekly = self.tables["date_dim"]["d_date_sk"][::7]
+        items = self.tables["item"]["i_item_sk"]
+        wss = self.tables["warehouse"]["w_warehouse_sk"]
+        n_cells = len(items) * len(weekly) * len(wss)
+        n = min(n, n_cells)
+        per_item = len(weekly) * len(wss)
+        cell = np.concatenate([
+            off + rng.permutation(per_item)[:(
+                n // len(items) + (1 if i < n % len(items) else 0))]
+            for i, off in enumerate(
+                range(0, n_cells, per_item))])[:n]
+        self.tables["inventory"] = {
+            "inv_date_sk": weekly[(cell % per_item) // len(wss)],
+            "inv_item_sk": items[cell // per_item],
+            "inv_warehouse_sk": wss[cell % len(wss)],
+            "inv_quantity_on_hand": rng.integers(
+                0, 1000, n).astype(np.int32),
         }
 
     def schema(self, table: str) -> dtypes.Schema:
@@ -958,6 +1129,190 @@ select i_item_id, i_item_desc, i_category, i_class, i_current_price,
 from ir, cr
 where i_class = cr_class
 order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100""",
+    # q12: web twin of q98 (window partition sum restated as a
+    # class-total self-join)
+    "q12": """
+with ir as (
+  select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+         sum(ws_ext_sales_price) as itemrevenue
+  from web_sales, item, date_dim
+  where ws_item_sk = i_item_sk
+    and i_category in ('Electronics', 'Books', 'Women')
+    and ws_sold_date_sk = d_date_sk
+    and d_date between date '1998-01-06' and date '1998-02-05'
+  group by i_item_id, i_item_desc, i_category, i_class,
+           i_current_price),
+cr as (
+  select i_class as cr_class, sum(itemrevenue) as classrevenue
+  from ir group by i_class)
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       itemrevenue, itemrevenue * 100.0 / classrevenue as revenueratio
+from ir, cr
+where i_class = cr_class
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100""",
+    # q20: catalog twin of q98
+    "q20": """
+with ir as (
+  select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+         sum(cs_ext_sales_price) as itemrevenue
+  from catalog_sales, item, date_dim
+  where cs_item_sk = i_item_sk
+    and i_category in ('Shoes', 'Electronics', 'Children')
+    and cs_sold_date_sk = d_date_sk
+    and d_date between date '2001-03-14' and date '2001-04-13'
+  group by i_item_id, i_item_desc, i_category, i_class,
+           i_current_price),
+cr as (
+  select i_class as cr_class, sum(itemrevenue) as classrevenue
+  from ir group by i_class)
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       itemrevenue, itemrevenue * 100.0 / classrevenue as revenueratio
+from ir, cr
+where i_class = cr_class
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100""",
+    # q21: warehouse inventory before/after a date (the ratio band
+    # 2/3 <= after/before <= 3/2 rewritten as multiplies — exact for
+    # before > 0)
+    "q21": """
+with x as (
+  select w_warehouse_name, i_item_id,
+         sum(case when d_date < date '1999-03-20'
+             then inv_quantity_on_hand else 0 end) as inv_before,
+         sum(case when d_date >= date '1999-03-20'
+             then inv_quantity_on_hand else 0 end) as inv_after
+  from inventory, warehouse, item, date_dim
+  where i_current_price between 0.99 and 1.49
+    and i_item_sk = inv_item_sk
+    and inv_warehouse_sk = w_warehouse_sk
+    and inv_date_sk = d_date_sk
+    and d_date between date '1999-02-18' and date '1999-04-19'
+  group by w_warehouse_name, i_item_id)
+select w_warehouse_name, i_item_id, inv_before, inv_after
+from x
+where inv_before > 0
+  and 3 * inv_after >= 2 * inv_before
+  and 2 * inv_after <= 3 * inv_before
+order by w_warehouse_name, i_item_id
+limit 100""",
+    # q37: catalog-sold items with 100-500 on hand in a 60-day window
+    "q37": """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between 39 and 69
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between date '2001-01-16' and date '2001-03-17'
+  and i_manufact_id in (765, 886, 889, 728)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100""",
+    # q45: web sales by zip/county (the official's item-id IN-subquery
+    # over fixed item_sks rewrites to the item_sk set — exact, item
+    # ids are unique per sk)
+    "q45": """
+select ca_zip, ca_county, sum(ws_sales_price) as total
+from web_sales, customer, customer_address, date_dim, item
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_item_sk = i_item_sk
+  and (substring(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405',
+                                   '86475', '85392', '85460', '80348',
+                                   '81792')
+       or i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29))
+  and ws_sold_date_sk = d_date_sk
+  and d_qoy = 1 and d_year = 1998
+group by ca_zip, ca_county
+order by ca_zip, ca_county
+limit 100""",
+    # q69: demographics of customers active in store but not web or
+    # catalog in the window (d_year 2003 adapts to 2001, inside our
+    # five-year date domain)
+    "q69": """
+select cd_gender, cd_marital_status, cd_education_status,
+       count(*) as cnt1, cd_purchase_estimate, count(*) as cnt2,
+       cd_credit_rating, count(*) as cnt3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_state in ('MO', 'MN', 'AZ')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2001 and d_moy between 2 and 4)
+  and not exists (select * from web_sales, date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy between 2 and 4)
+  and not exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_bill_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2001 and d_moy between 2 and 4)
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+limit 100""",
+    # q82: store twin of q37
+    "q82": """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between 49 and 79
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between date '2001-01-28' and date '2001-03-29'
+  and i_manufact_id in (80, 675, 292, 17)
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100""",
+    # q92: web twin of q32 (excess discount vs 1.3x per-item average)
+    "q92": """
+with adi as (
+  select ws_item_sk as adi_item_sk,
+         avg(ws_ext_discount_amt) as avg_discount
+  from web_sales, date_dim
+  where d_date between date '2001-03-12' and date '2001-06-10'
+    and d_date_sk = ws_sold_date_sk
+  group by ws_item_sk)
+select sum(ws_ext_discount_amt) as excess
+from web_sales, item, date_dim, adi
+where i_manufact_id = 356
+  and i_item_sk = ws_item_sk
+  and d_date between date '2001-03-12' and date '2001-06-10'
+  and d_date_sk = ws_sold_date_sk
+  and ws_item_sk = adi_item_sk
+  and ws_ext_discount_amt > 1.3 * avg_discount""",
+    # q99: catalog shipping-delay buckets by warehouse/mode/center
+    # (month window adapted to our epoch)
+    "q99": """
+select substring(w_warehouse_name, 1, 20) as wname, sm_type, cc_name,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk <= 30
+      then 1 else 0 end) as d30,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk > 30
+           and cs_ship_date_sk - cs_sold_date_sk <= 60
+      then 1 else 0 end) as d60,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk > 60
+           and cs_ship_date_sk - cs_sold_date_sk <= 90
+      then 1 else 0 end) as d90,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk > 90
+           and cs_ship_date_sk - cs_sold_date_sk <= 120
+      then 1 else 0 end) as d120,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk > 120
+      then 1 else 0 end) as dmore
+from catalog_sales, warehouse, ship_mode, call_center, date_dim
+where d_month_seq between 36 and 47
+  and cs_ship_date_sk = d_date_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_ship_mode_sk = sm_ship_mode_sk
+  and cs_call_center_sk = cc_call_center_sk
+group by wname, sm_type, cc_name
+order by wname, sm_type, cc_name
 limit 100""",
 }
 
@@ -1414,22 +1769,23 @@ class _Ref:
             acc[zips[i]] += sp
         return sorted(acc.items())[:100]
 
-    def q32(self):
+    def _excess_discount(self, fact, date_col, item_col, amt_col,
+                         manu_id, lo_s, hi_s):
         d = self.d
-        cs = d.tables["catalog_sales"]
+        f = d.tables[fact]
         dd = self._dd()
-        lo = int(np.datetime64("2002-03-29", "D").astype(int))
-        hi = int(np.datetime64("2002-06-27", "D").astype(int))
+        lo = int(np.datetime64(lo_s, "D").astype(int))
+        hi = int(np.datetime64(hi_s, "D").astype(int))
         manu = {sk for sk, m in zip(
             d.tables["item"]["i_item_sk"].tolist(),
-            d.tables["item"]["i_manufact_id"].tolist()) if m == 66}
+            d.tables["item"]["i_manufact_id"].tolist())
+            if m == manu_id}
         by_item: dict = collections.defaultdict(lambda: [0, 0])
         rows = []
-        for dk, ik, amt in zip(cs["cs_sold_date_sk"].tolist(),
-                               cs["cs_item_sk"].tolist(),
-                               cs["cs_ext_discount_amt"].tolist()):
-            dt = dd[dk][5]
-            if not (lo <= dt <= hi):
+        for dk, ik, amt in zip(f[date_col].tolist(),
+                               f[item_col].tolist(),
+                               f[amt_col].tolist()):
+            if not (lo <= dd[dk][5] <= hi):
                 continue
             st = by_item[ik]
             st[0] += amt
@@ -1444,6 +1800,11 @@ class _Ref:
                     excess += amt
                     any_row = True
         return [(excess if any_row else None,)]
+
+    def q32(self):
+        return self._excess_discount(
+            "catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+            "cs_ext_discount_amt", 66, "2002-03-29", "2002-06-27")
 
     def _ticket_counts(self, dom_ok, bp_set, dep_pred, years,
                        county_set):
@@ -1658,36 +2019,251 @@ class _Ref:
         return rows[:100]
 
     def q98(self):
+        return self._class_share(
+            "store_sales", "ss_sold_date_sk", "ss_item_sk",
+            "ss_ext_sales_price", {b"Home", b"Sports", b"Men"},
+            "2002-01-05", "2002-02-04")
+
+    # ---- batch-2 additions (q12/q20/q21/q37/q45/q69/q82/q92/q99) ----
+
+    def _item_info(self):
+        if getattr(self, "_item_cache", None) is None:
+            it = self.d.tables["item"]
+            self._item_cache = ({sk: i for i, sk in
+                                 enumerate(it["i_item_sk"].tolist())},
+                                it)
+        return self._item_cache
+
+    def _class_share(self, fact, date_col, item_col, price_col,
+                     cats, lo_s, hi_s):
         d = self.d
-        ss = d.tables["store_sales"]
+        f = d.tables[fact]
         dd = self._dd()
-        lo = int(np.datetime64("2002-01-05", "D").astype(int))
-        hi = int(np.datetime64("2002-02-04", "D").astype(int))
-        it = d.tables["item"]
-        cats = _decode(d, "item", "i_category")
+        lo = int(np.datetime64(lo_s, "D").astype(int))
+        hi = int(np.datetime64(hi_s, "D").astype(int))
+        ii, it = self._item_info()
+        cats_d = _decode(d, "item", "i_category")
         classes = _decode(d, "item", "i_class")
         ids = _decode(d, "item", "i_item_id")
         descs = _decode(d, "item", "i_item_desc")
-        ii = {sk: i for i, sk in enumerate(it["i_item_sk"].tolist())}
-        target = {b"Home", b"Sports", b"Men"}
         acc: dict = collections.defaultdict(int)
-        for dk, ik, p in zip(ss["ss_sold_date_sk"].tolist(),
-                             ss["ss_item_sk"].tolist(),
-                             ss["ss_ext_sales_price"].tolist()):
+        for dk, ik, p in zip(f[date_col].tolist(),
+                             f[item_col].tolist(),
+                             f[price_col].tolist()):
             if not (lo <= dd[dk][5] <= hi):
                 continue
             i = ii[ik]
-            if cats[i] not in target:
+            if cats_d[i] not in cats:
                 continue
-            acc[(ids[i], descs[i], cats[i], classes[i],
+            acc[(ids[i], descs[i], cats_d[i], classes[i],
                  int(it["i_current_price"][i]))] += p
         ctot: dict = collections.defaultdict(int)
-        for (_id, _de, _ca, cl, _pr), r in acc.items():
+        for (_i, _de, _ca, cl, _pr), r in acc.items():
             ctot[cl] += r
         rows = [(k[0], k[1], k[2], k[3], k[4], r,
                  r * 100.0 / ctot[k[3]])
                 for k, r in acc.items()]
         rows.sort(key=lambda x: (x[2], x[3], x[0], x[1], x[6]))
+        return rows[:100]
+
+    def q12(self):
+        return self._class_share(
+            "web_sales", "ws_sold_date_sk", "ws_item_sk",
+            "ws_ext_sales_price",
+            {b"Electronics", b"Books", b"Women"},
+            "1998-01-06", "1998-02-05")
+
+    def q20(self):
+        return self._class_share(
+            "catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+            "cs_ext_sales_price",
+            {b"Shoes", b"Electronics", b"Children"},
+            "2001-03-14", "2001-04-13")
+
+    def q21(self):
+        d = self.d
+        inv = d.tables["inventory"]
+        dd = self._dd()
+        cut = int(np.datetime64("1999-03-20", "D").astype(int))
+        lo = int(np.datetime64("1999-02-18", "D").astype(int))
+        hi = int(np.datetime64("1999-04-19", "D").astype(int))
+        ii, it = self._item_info()
+        ids = _decode(d, "item", "i_item_id")
+        wnames = _decode(d, "warehouse", "w_warehouse_name")
+        wi = {sk: i for i, sk in enumerate(
+            d.tables["warehouse"]["w_warehouse_sk"].tolist())}
+        acc: dict = collections.defaultdict(lambda: [0, 0])
+        for dk, ik, wk, q in zip(inv["inv_date_sk"].tolist(),
+                                 inv["inv_item_sk"].tolist(),
+                                 inv["inv_warehouse_sk"].tolist(),
+                                 inv["inv_quantity_on_hand"].tolist()):
+            dt = dd[dk][5]
+            if not (lo <= dt <= hi):
+                continue
+            i = ii[ik]
+            if not (99 <= it["i_current_price"][i] <= 149):
+                continue
+            st = acc[(wnames[wi[wk]], ids[i])]
+            if dt < cut:
+                st[0] += q
+            else:
+                st[1] += q
+        rows = [(w, iid, b, a) for (w, iid), (b, a) in acc.items()
+                if b > 0 and 3 * a >= 2 * b and 2 * a <= 3 * b]
+        rows.sort(key=lambda r: (r[0], r[1]))
+        return rows[:100]
+
+    def _inv_items(self, fact, item_col, price_lo, price_hi, manus,
+                   lo_s, hi_s):
+        d = self.d
+        inv = d.tables["inventory"]
+        dd = self._dd()
+        lo = int(np.datetime64(lo_s, "D").astype(int))
+        hi = int(np.datetime64(hi_s, "D").astype(int))
+        ii, it = self._item_info()
+        ids = _decode(d, "item", "i_item_id")
+        descs = _decode(d, "item", "i_item_desc")
+        sold = set(d.tables[fact][item_col].tolist())
+        keep = set()
+        for dk, ik, q in zip(inv["inv_date_sk"].tolist(),
+                             inv["inv_item_sk"].tolist(),
+                             inv["inv_quantity_on_hand"].tolist()):
+            if not (lo <= dd[dk][5] <= hi) or not (100 <= q <= 500):
+                continue
+            i = ii[ik]
+            if not (price_lo <= it["i_current_price"][i] <= price_hi):
+                continue
+            if it["i_manufact_id"][i] not in manus or ik not in sold:
+                continue
+            keep.add((ids[i], descs[i], int(it["i_current_price"][i])))
+        return sorted(keep)[:100]
+
+    def q37(self):
+        return self._inv_items("catalog_sales", "cs_item_sk",
+                               3900, 6900, {765, 886, 889, 728},
+                               "2001-01-16", "2001-03-17")
+
+    def q82(self):
+        return self._inv_items("store_sales", "ss_item_sk",
+                               4900, 7900, {80, 675, 292, 17},
+                               "2001-01-28", "2001-03-29")
+
+    def q45(self):
+        d = self.d
+        ws = d.tables["web_sales"]
+        dd = self._dd()
+        cust = self._cust()
+        ca = d.tables["customer_address"]
+        zips = _decode(d, "customer_address", "ca_zip")
+        counties = _decode(d, "customer_address", "ca_county")
+        ai = {sk: i for i, sk in
+              enumerate(ca["ca_address_sk"].tolist())}
+        tz = {b"85669", b"86197", b"88274", b"83405", b"86475",
+              b"85392", b"85460", b"80348", b"81792"}
+        hot_items = {2, 3, 5, 7, 11, 13, 17, 19, 23, 29}
+        acc: dict = collections.defaultdict(int)
+        for dk, ck, ik, sp in zip(ws["ws_sold_date_sk"].tolist(),
+                                  ws["ws_bill_customer_sk"].tolist(),
+                                  ws["ws_item_sk"].tolist(),
+                                  ws["ws_sales_price"].tolist()):
+            y, _m, _dom, _dow, q, _dt, _ms = dd[dk]
+            if y != 1998 or q != 1:
+                continue
+            i = ai[cust[ck][4]]
+            if not (zips[i][:5] in tz or ik in hot_items):
+                continue
+            acc[(zips[i], counties[i])] += sp
+        return sorted((k[0], k[1], v) for k, v in acc.items())[:100]
+
+    def q69(self):
+        d = self.d
+        dd = self._dd()
+
+        def active(fact, date_col, cust_col):
+            out = set()
+            f = d.tables[fact]
+            for dk, ck in zip(f[date_col].tolist(),
+                              f[cust_col].tolist()):
+                y, m = dd[dk][0], dd[dk][1]
+                if y == 2001 and 2 <= m <= 4:
+                    out.add(ck)
+            return out
+
+        store = active("store_sales", "ss_sold_date_sk",
+                       "ss_customer_sk")
+        web = active("web_sales", "ws_sold_date_sk",
+                     "ws_bill_customer_sk")
+        cat = active("catalog_sales", "cs_sold_date_sk",
+                     "cs_bill_customer_sk")
+        ca = d.tables["customer_address"]
+        states = _decode(d, "customer_address", "ca_state")
+        ai = {sk: i for i, sk in
+              enumerate(ca["ca_address_sk"].tolist())}
+        cd = d.tables["customer_demographics"]
+        g = _decode(d, "customer_demographics", "cd_gender")
+        m_ = _decode(d, "customer_demographics", "cd_marital_status")
+        e = _decode(d, "customer_demographics", "cd_education_status")
+        cr = _decode(d, "customer_demographics", "cd_credit_rating")
+        di = {sk: i for i, sk in enumerate(cd["cd_demo_sk"].tolist())}
+        cust = d.tables["customer"]
+        acc: dict = collections.defaultdict(int)
+        for ck, ak, cdk in zip(cust["c_customer_sk"].tolist(),
+                               cust["c_current_addr_sk"].tolist(),
+                               cust["c_current_cdemo_sk"].tolist()):
+            if states[ai[ak]] not in (b"MO", b"MN", b"AZ"):
+                continue
+            if ck not in store or ck in web or ck in cat:
+                continue
+            i = di[cdk]
+            acc[(g[i], m_[i], e[i],
+                 int(cd["cd_purchase_estimate"][i]), cr[i])] += 1
+        rows = [(k[0], k[1], k[2], c, k[3], c, k[4], c)
+                for k, c in acc.items()]
+        rows.sort(key=lambda r: (r[0], r[1], r[2], r[4], r[6]))
+        return rows[:100]
+
+    def q92(self):
+        return self._excess_discount(
+            "web_sales", "ws_sold_date_sk", "ws_item_sk",
+            "ws_ext_discount_amt", 356, "2001-03-12", "2001-06-10")
+
+    def q99(self):
+        d = self.d
+        cs = d.tables["catalog_sales"]
+        dd = self._dd()
+        wnames = _decode(d, "warehouse", "w_warehouse_name")
+        wi = {sk: i for i, sk in enumerate(
+            d.tables["warehouse"]["w_warehouse_sk"].tolist())}
+        smt = _decode(d, "ship_mode", "sm_type")
+        smi = {sk: i for i, sk in enumerate(
+            d.tables["ship_mode"]["sm_ship_mode_sk"].tolist())}
+        ccn = _decode(d, "call_center", "cc_name")
+        cci = {sk: i for i, sk in enumerate(
+            d.tables["call_center"]["cc_call_center_sk"].tolist())}
+        acc: dict = collections.defaultdict(lambda: [0] * 5)
+        for sold, ship, wk, smk, cck in zip(
+                cs["cs_sold_date_sk"].tolist(),
+                cs["cs_ship_date_sk"].tolist(),
+                cs["cs_warehouse_sk"].tolist(),
+                cs["cs_ship_mode_sk"].tolist(),
+                cs["cs_call_center_sk"].tolist()):
+            if not (36 <= dd[ship][6] <= 47):
+                continue
+            lag = ship - sold
+            st = acc[(wnames[wi[wk]][:20], smt[smi[smk]],
+                      ccn[cci[cck]])]
+            if lag <= 30:
+                st[0] += 1
+            elif lag <= 60:
+                st[1] += 1
+            elif lag <= 90:
+                st[2] += 1
+            elif lag <= 120:
+                st[3] += 1
+            else:
+                st[4] += 1
+        rows = [(k[0], k[1], k[2], *v) for k, v in acc.items()]
+        rows.sort(key=lambda r: (r[0], r[1], r[2]))
         return rows[:100]
 
 
@@ -1785,6 +2361,30 @@ _VERIFY_COLS = {
             ("i_category", "str"), ("i_class", "str"),
             ("i_current_price", "dec"), ("itemrevenue", "dec"),
             ("revenueratio", "avg")),
+    "q12": (("i_item_id", "str"), ("i_item_desc", "str"),
+            ("i_category", "str"), ("i_class", "str"),
+            ("i_current_price", "dec"), ("itemrevenue", "dec"),
+            ("revenueratio", "avg")),
+    "q20": (("i_item_id", "str"), ("i_item_desc", "str"),
+            ("i_category", "str"), ("i_class", "str"),
+            ("i_current_price", "dec"), ("itemrevenue", "dec"),
+            ("revenueratio", "avg")),
+    "q21": (("w_warehouse_name", "str"), ("i_item_id", "str"),
+            ("inv_before", "int"), ("inv_after", "int")),
+    "q37": (("i_item_id", "str"), ("i_item_desc", "str"),
+            ("i_current_price", "dec")),
+    "q45": (("ca_zip", "str"), ("ca_county", "str"),
+            ("total", "dec")),
+    "q69": (("cd_gender", "str"), ("cd_marital_status", "str"),
+            ("cd_education_status", "str"), ("cnt1", "int"),
+            ("cd_purchase_estimate", "int"), ("cnt2", "int"),
+            ("cd_credit_rating", "str"), ("cnt3", "int")),
+    "q82": (("i_item_id", "str"), ("i_item_desc", "str"),
+            ("i_current_price", "dec")),
+    "q92": (("excess", "dec"),),
+    "q99": (("wname", "str"), ("sm_type", "str"), ("cc_name", "str"),
+            ("d30", "int"), ("d60", "int"), ("d90", "int"),
+            ("d120", "int"), ("dmore", "int")),
 }
 
 # reference rows carry avgs pre-descaled; engine avg output of a DEC2
