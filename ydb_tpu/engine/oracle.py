@@ -230,6 +230,20 @@ def _descale_mixed_np(args, ts):
     return out, t_out
 
 
+_F_UN = {Op.SQRT: np.sqrt, Op.EXP: np.exp, Op.LN: np.log,
+         Op.LOG10: np.log10, Op.FLOOR: np.floor, Op.CEIL: np.ceil,
+         Op.ROUND: np.round, Op.SIGN: np.sign, Op.SIN: np.sin,
+         Op.COS: np.cos, Op.TAN: np.tan, Op.ASIN: np.arcsin,
+         Op.ACOS: np.arccos, Op.ATAN: np.arctan, Op.SINH: np.sinh,
+         Op.COSH: np.cosh, Op.TANH: np.tanh, Op.ASINH: np.arcsinh,
+         Op.ACOSH: np.arccosh, Op.ATANH: np.arctanh,
+         Op.CBRT: np.cbrt, Op.LOG2: np.log2, Op.EXP2: np.exp2,
+         Op.TRUNC: np.trunc, Op.RINT: np.round,
+         Op.RADIANS: np.deg2rad, Op.DEGREES: np.rad2deg}
+# ops computed in float64 (everything but the shape-preserving four)
+_F_UN_FLOAT = frozenset(_F_UN) - {Op.FLOOR, Op.CEIL, Op.ROUND, Op.SIGN}
+
+
 def _apply_op(op, expr, args, ts, cols, types, dicts, n) -> ColT:
     # decimal MUL multiplies unscaled values (scales add); only additive and
     # comparison ops align operand scales
@@ -302,12 +316,16 @@ def _apply_op(op, expr, args, ts, cols, types, dicts, n) -> ColT:
         (c, vc), (a, va), (b, vb) = args
         take = c.astype(bool) & vc
         return np.where(take, a, b), vc & np.where(take, va, vb)
-    if op in (Op.CAST_INT32, Op.CAST_INT64, Op.CAST_FLOAT, Op.CAST_DOUBLE):
+    if op in (Op.CAST_INT32, Op.CAST_INT64, Op.CAST_FLOAT,
+              Op.CAST_DOUBLE, Op.CAST_INT8, Op.CAST_INT16,
+              Op.CAST_UINT64, Op.CAST_BOOL):
         a, va = args[0]
         ta = ts[0]
         target = {
             Op.CAST_INT32: np.int32, Op.CAST_INT64: np.int64,
             Op.CAST_FLOAT: np.float32, Op.CAST_DOUBLE: np.float64,
+            Op.CAST_INT8: np.int8, Op.CAST_INT16: np.int16,
+            Op.CAST_UINT64: np.uint64, Op.CAST_BOOL: np.bool_,
         }[op]
         if ta.is_decimal:
             if np.issubdtype(target, np.floating):
@@ -326,22 +344,87 @@ def _apply_op(op, expr, args, ts, cols, types, dicts, n) -> ColT:
             return m.astype(np.int32), va
         dom = (dt - dt.astype("datetime64[M]")).astype(int) + 1
         return dom.astype(np.int32), va
-    if op in (Op.HOUR, Op.MINUTE):
+    if op in (Op.HOUR, Op.MINUTE, Op.SECOND):
         a, va = args[0]
         if ts[0].kind != dtypes.Kind.TIMESTAMP:
             # identical semantics to the JAX lowering: sub-day parts
             # of a DATE are an error, not silent zeros
             raise TypeError(f"{op} needs a timestamp operand")
-        div = 3_600_000_000 if op is Op.HOUR else 60_000_000
+        div = {Op.HOUR: 3_600_000_000, Op.MINUTE: 60_000_000,
+               Op.SECOND: 1_000_000}[op]
         mod = 24 if op is Op.HOUR else 60
         return ((a // div) % mod).astype(np.int32), va
-    if op in (Op.SQRT, Op.EXP, Op.LN, Op.LOG10, Op.FLOOR, Op.CEIL,
-              Op.ROUND, Op.SIGN):
-        f = {Op.SQRT: np.sqrt, Op.EXP: np.exp, Op.LN: np.log,
-             Op.LOG10: np.log10, Op.FLOOR: np.floor, Op.CEIL: np.ceil,
-             Op.ROUND: np.round, Op.SIGN: np.sign}[op]
+    if op in (Op.DAY_OF_WEEK, Op.DAY_OF_YEAR, Op.WEEK, Op.QUARTER):
         a, va = args[0]
+        days = (a // 86_400_000_000
+                if ts[0].kind == dtypes.Kind.TIMESTAMP else a)
+        days = days.astype(np.int64)
+        if op is Op.DAY_OF_WEEK:
+            return ((days + 4) % 7).astype(np.int32), va
+        dt = days.astype("datetime64[D]")
+        if op is Op.QUARTER:
+            m = (dt.astype("datetime64[M]").astype(int) % 12) + 1
+            return ((m - 1) // 3 + 1).astype(np.int32), va
+        jan1 = dt.astype("datetime64[Y]").astype("datetime64[D]")
+        doy = (dt - jan1).astype(int) + 1
+        if op is Op.DAY_OF_YEAR:
+            return doy.astype(np.int32), va
+        return ((doy - 1) // 7 + 1).astype(np.int32), va
+    if op in _F_UN:
+        a, va = args[0]
+        f = _F_UN[op]
+        if op in _F_UN_FLOAT:
+            with np.errstate(all="ignore"):
+                return f(a.astype(np.float64)), va
         return f(a), va
+
+    if op is Op.ERF:
+        import math
+
+        a, va = args[0]
+        return np.vectorize(math.erf)(a.astype(np.float64)), va
+    if op in (Op.ATAN2, Op.HYPOT):
+        (a, va), (b, vb) = args
+        f = np.arctan2 if op is Op.ATAN2 else np.hypot
+        return f(a.astype(np.float64), b.astype(np.float64)), va & vb
+    if op in (Op.BIT_AND, Op.BIT_OR, Op.BIT_XOR, Op.SHIFT_LEFT,
+              Op.SHIFT_RIGHT):
+        (a, va), (b, vb) = args
+        f = {Op.BIT_AND: np.bitwise_and, Op.BIT_OR: np.bitwise_or,
+             Op.BIT_XOR: np.bitwise_xor,
+             Op.SHIFT_LEFT: np.left_shift,
+             Op.SHIFT_RIGHT: np.right_shift}[op]
+        return f(a, b), va & vb
+    if op is Op.BIT_NOT:
+        a, va = args[0]
+        return np.bitwise_not(a), va
+    if op is Op.DIV_INT:
+        (a, va), (b, vb) = args
+        ta, tb = ts[0], ts[1]
+        zero = b == 0
+        if (ta.is_decimal or tb.is_decimal or ta.is_floating
+                or tb.is_floating):
+            sa = 10.0 ** ta.scale if ta.is_decimal else 1.0
+            sb = 10.0 ** tb.scale if tb.is_decimal else 1.0
+            av = a.astype(np.float64) / sa
+            bv = np.where(zero, 1.0, b.astype(np.float64) / sb)
+            return np.trunc(av / bv).astype(np.int64), va & vb & ~zero
+        denom = np.where(zero, 1, b)
+        q = np.sign(a) * np.sign(denom) * (np.abs(a) // np.abs(denom))
+        return q, va & vb & ~zero
+    if op is Op.NULLIF:
+        (a, va), (b, vb) = args
+        ta, tb = ts[0], ts[1]
+        sa = ta.scale if ta.is_decimal else 0
+        sb = tb.scale if tb.is_decimal else 0
+        if ta.is_floating or tb.is_floating:
+            av = a.astype(np.float64) / 10.0 ** sa
+            bv = b.astype(np.float64) / 10.0 ** sb
+            equal = (av == bv) & vb
+        else:
+            m = max(sa, sb)
+            equal = (a * 10 ** (m - sa) == b * 10 ** (m - sb)) & vb
+        return a, va & ~equal
     if op is Op.POW:
         (a, va), (b, vb) = args
         return np.power(a.astype(np.float64), b.astype(np.float64)), va & vb

@@ -618,9 +618,14 @@ class _Lower:
                     f"interval unit {unit} only folds against constant"
                     " dates")
             return Const(n * days, dtypes.INT32)
-        if e.name in ("year", "month", "day", "hour", "minute"):
+        if e.name in ("year", "month", "day", "hour", "minute",
+                      "second", "dayofweek", "dayofyear", "week",
+                      "quarter"):
             op = {"year": Op.YEAR, "month": Op.MONTH, "day": Op.DAY,
-                  "hour": Op.HOUR, "minute": Op.MINUTE}[e.name]
+                  "hour": Op.HOUR, "minute": Op.MINUTE,
+                  "second": Op.SECOND, "dayofweek": Op.DAY_OF_WEEK,
+                  "dayofyear": Op.DAY_OF_YEAR, "week": Op.WEEK,
+                  "quarter": Op.QUARTER}[e.name]
             return Call(op, self.lower(e.args[0]))
         if e.name in ("greatest", "least"):
             if any(self._is_string_operand(a) for a in e.args):
@@ -685,7 +690,9 @@ class _Lower:
             target = e.name[5:]
             op = {"int32": Op.CAST_INT32, "int64": Op.CAST_INT64,
                   "bigint": Op.CAST_INT64, "float": Op.CAST_FLOAT,
-                  "double": Op.CAST_DOUBLE}.get(target)
+                  "double": Op.CAST_DOUBLE, "int8": Op.CAST_INT8,
+                  "int16": Op.CAST_INT16, "uint64": Op.CAST_UINT64,
+                  "bool": Op.CAST_BOOL}.get(target)
             if op is None:
                 raise PlanError(f"cast to {target}")
             return Call(op, self.lower(e.args[0]))
@@ -693,8 +700,29 @@ class _Lower:
                   "ln": Op.LN, "log10": Op.LOG10, "floor": Op.FLOOR,
                   "ceil": Op.CEIL, "round": Op.ROUND,
                   "sign": Op.SIGN, "power": Op.POW, "pow": Op.POW,
-                  "coalesce": Op.COALESCE}
+                  "coalesce": Op.COALESCE, "sin": Op.SIN,
+                  "cos": Op.COS, "tan": Op.TAN, "asin": Op.ASIN,
+                  "acos": Op.ACOS, "atan": Op.ATAN, "sinh": Op.SINH,
+                  "cosh": Op.COSH, "tanh": Op.TANH,
+                  "asinh": Op.ASINH, "acosh": Op.ACOSH,
+                  "atanh": Op.ATANH, "atan2": Op.ATAN2,
+                  "hypot": Op.HYPOT, "cbrt": Op.CBRT, "erf": Op.ERF,
+                  "log2": Op.LOG2, "exp2": Op.EXP2,
+                  "trunc": Op.TRUNC, "rint": Op.RINT,
+                  "radians": Op.RADIANS,
+                  "degrees": Op.DEGREES, "nullif": Op.NULLIF,
+                  "bit_and": Op.BIT_AND, "bit_or": Op.BIT_OR,
+                  "bit_xor": Op.BIT_XOR, "bit_not": Op.BIT_NOT,
+                  "shift_left": Op.SHIFT_LEFT,
+                  "shift_right": Op.SHIFT_RIGHT,
+                  "div": Op.DIV_INT}
         if e.name in simple:
+            if e.name == "nullif" and any(
+                    self._is_string_operand(a) for a in e.args):
+                # dictionary ids from unrelated dictionaries carry no
+                # cross-column equality (same reason greatest refuses)
+                raise PlanError("nullif on string columns is not"
+                                " supported")
             return Call(simple[e.name], *[self.lower(a) for a in e.args])
         if e.name in self.udfs:
             from ydb_tpu.ssa.program import UdfCall

@@ -443,6 +443,11 @@ def _custom_dict_mask(d, pattern) -> np.ndarray:
     raise NotImplementedError(f"custom dict predicate {tag}")
 
 
+def _as_f64(f):
+    """Float-domain math over any numeric input: cast to f64 first."""
+    return lambda *xs: f(*(x.astype(jnp.float64) for x in xs))
+
+
 _SIMPLE_BINOPS = {
     Op.EQ: lambda a, b: a == b,
     Op.NE: lambda a, b: a != b,
@@ -456,6 +461,13 @@ _SIMPLE_BINOPS = {
     Op.XOR: lambda a, b: a ^ b,
     Op.GREATEST: jnp.maximum,
     Op.LEAST: jnp.minimum,
+    Op.ATAN2: _as_f64(jnp.arctan2),
+    Op.HYPOT: _as_f64(jnp.hypot),
+    Op.BIT_AND: lambda a, b: a & b,
+    Op.BIT_OR: lambda a, b: a | b,
+    Op.BIT_XOR: lambda a, b: a ^ b,
+    Op.SHIFT_LEFT: lambda a, b: a << b,
+    Op.SHIFT_RIGHT: lambda a, b: a >> b,
 }
 
 _SIMPLE_UNOPS = {
@@ -470,6 +482,27 @@ _SIMPLE_UNOPS = {
     Op.CEIL: jnp.ceil,
     Op.ROUND: jnp.round,
     Op.SIGN: jnp.sign,
+    Op.SIN: _as_f64(jnp.sin),
+    Op.COS: _as_f64(jnp.cos),
+    Op.TAN: _as_f64(jnp.tan),
+    Op.ASIN: _as_f64(jnp.arcsin),
+    Op.ACOS: _as_f64(jnp.arccos),
+    Op.ATAN: _as_f64(jnp.arctan),
+    Op.SINH: _as_f64(jnp.sinh),
+    Op.COSH: _as_f64(jnp.cosh),
+    Op.TANH: _as_f64(jnp.tanh),
+    Op.ASINH: _as_f64(jnp.arcsinh),
+    Op.ACOSH: _as_f64(jnp.arccosh),
+    Op.ATANH: _as_f64(jnp.arctanh),
+    Op.CBRT: _as_f64(jnp.cbrt),
+    Op.ERF: _as_f64(lambda x: jax.scipy.special.erf(x)),
+    Op.LOG2: _as_f64(jnp.log2),
+    Op.EXP2: _as_f64(jnp.exp2),
+    Op.TRUNC: _as_f64(jnp.trunc),
+    Op.RINT: _as_f64(jnp.round),
+    Op.RADIANS: _as_f64(jnp.deg2rad),
+    Op.DEGREES: _as_f64(jnp.rad2deg),
+    Op.BIT_NOT: lambda a: ~a,
 }
 
 
@@ -602,7 +635,9 @@ def _resolve_call(ctx: _Lowering, call: Call, cur_types, resolve_expr):
             )
 
         return lower, out_t
-    if op in (Op.CAST_INT32, Op.CAST_INT64, Op.CAST_FLOAT, Op.CAST_DOUBLE):
+    if op in (Op.CAST_INT32, Op.CAST_INT64, Op.CAST_FLOAT,
+              Op.CAST_DOUBLE, Op.CAST_INT8, Op.CAST_INT16,
+              Op.CAST_UINT64, Op.CAST_BOOL):
         fa = fns[0]
         ta = ts[0]
         scale = 10.0 ** ta.scale if ta.is_decimal else None
@@ -632,17 +667,85 @@ def _resolve_call(ctx: _Lowering, call: Call, cur_types, resolve_expr):
             return Column(parts[_p], a.validity)
 
         return lower, out_t
-    if op in (Op.HOUR, Op.MINUTE):
+    if op in (Op.HOUR, Op.MINUTE, Op.SECOND):
         fa = fns[0]
         if ts[0].kind != dtypes.Kind.TIMESTAMP:
             raise TypeError(f"{op} needs a timestamp operand")
-        div = 3_600_000_000 if op is Op.HOUR else 60_000_000
+        div = {Op.HOUR: 3_600_000_000, Op.MINUTE: 60_000_000,
+               Op.SECOND: 1_000_000}[op]
         mod = 24 if op is Op.HOUR else 60
 
         def lower(env, aux, _fa=fa, _d=div, _m=mod):
             a = _fa(env, aux)
             return Column(
                 ((a.data // _d) % _m).astype(jnp.int32), a.validity)
+
+        return lower, out_t
+    if op in (Op.DAY_OF_WEEK, Op.DAY_OF_YEAR, Op.WEEK, Op.QUARTER):
+        fa = fns[0]
+        is_ts = ts[0].kind == dtypes.Kind.TIMESTAMP
+
+        def lower(env, aux, _fa=fa, _ts=is_ts, _op=op):
+            a = _fa(env, aux)
+            days = a.data // 86_400_000_000 if _ts else a.data
+            days = days.astype(jnp.int64)
+            if _op is Op.DAY_OF_WEEK:
+                out = (days + 4) % 7  # 1970-01-01 = Thursday; 0=Sunday
+            elif _op is Op.QUARTER:
+                _y, m, _d = kernels.civil_from_days(days)
+                out = (m - 1) // 3 + 1
+            else:
+                y, _m, _d = kernels.civil_from_days(days)
+                doy = days - kernels.days_from_civil(
+                    y, jnp.ones_like(y), jnp.ones_like(y)) + 1
+                out = doy if _op is Op.DAY_OF_YEAR else (doy - 1) // 7 + 1
+            return Column(out.astype(jnp.int32), a.validity)
+
+        return lower, out_t
+    if op is Op.DIV_INT:
+        fa, fb = fns
+        ta, tb = ts[0], ts[1]
+        sa = 10.0 ** ta.scale if ta.is_decimal else 1.0
+        sb = 10.0 ** tb.scale if tb.is_decimal else 1.0
+        descale = (ta.is_decimal or tb.is_decimal or ta.is_floating
+                   or tb.is_floating)
+
+        def lower(env, aux, _fa=fa, _fb=fb, _sa=sa, _sb=sb,
+                  _ds=descale):
+            a, b = _fa(env, aux), _fb(env, aux)
+            if _ds:
+                # integer division of the VALUES: descale, divide,
+                # truncate toward zero -> int64
+                zero = b.data == 0
+                av = a.data.astype(jnp.float64) / _sa
+                bv = jnp.where(zero, 1.0,
+                               b.data.astype(jnp.float64) / _sb)
+                q = jnp.trunc(av / bv).astype(jnp.int64)
+                return Column(q, a.validity & b.validity & ~zero)
+            return kernels.safe_div(a, b, False)
+
+        return lower, out_t
+    if op is Op.NULLIF:
+        fa, fb = fns
+        ta, tb = ts[0], ts[1]
+        # compare in VALUE space (scale-aligned decimals / descaled
+        # floats) but return a's ORIGINAL data + type
+        sa = ta.scale if ta.is_decimal else 0
+        sb = tb.scale if tb.is_decimal else 0
+        use_float = ta.is_floating or tb.is_floating
+        m = max(sa, sb)
+
+        def lower(env, aux, _fa=fa, _fb=fb, _sa=sa, _sb=sb, _m=m,
+                  _ff=use_float):
+            a, b = _fa(env, aux), _fb(env, aux)
+            if _ff:
+                av = a.data.astype(jnp.float64) / (10.0 ** _sa)
+                bv = b.data.astype(jnp.float64) / (10.0 ** _sb)
+            else:
+                av = a.data * (10 ** (_m - _sa))
+                bv = b.data * (10 ** (_m - _sb))
+            equal = (av == bv) & b.validity
+            return Column(a.data, a.validity & ~equal)
 
         return lower, out_t
     if op is Op.IN_SET:

@@ -104,6 +104,17 @@ def civil_from_days(days):
     return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
 
 
+def days_from_civil(y, m, d):
+    """(year, month, day) -> days since 1970-01-01 (inverse of
+    civil_from_days; Hinnant's algorithm, vectorized)."""
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    doy = (153 * jnp.where(m > 2, m - 3, m + 9) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
 # ---------------- filter / compact ----------------
 
 

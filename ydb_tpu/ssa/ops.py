@@ -34,8 +34,38 @@ class Op(enum.Enum):
     MOD = "mod"
     NEG = "neg"
     ABS = "abs"
+    DIV_INT = "div_int"  # integer division; /0 -> NULL like DIV
+    # bit ops (integer domains)
+    BIT_AND = "bit_and"
+    BIT_OR = "bit_or"
+    BIT_XOR = "bit_xor"
+    BIT_NOT = "bit_not"
+    SHIFT_LEFT = "shift_left"
+    SHIFT_RIGHT = "shift_right"
     # math
     SQRT = "sqrt"
+    SIN = "sin"
+    COS = "cos"
+    TAN = "tan"
+    ASIN = "asin"
+    ACOS = "acos"
+    ATAN = "atan"
+    SINH = "sinh"
+    COSH = "cosh"
+    TANH = "tanh"
+    ASINH = "asinh"
+    ACOSH = "acosh"
+    ATANH = "atanh"
+    ATAN2 = "atan2"
+    HYPOT = "hypot"
+    CBRT = "cbrt"
+    ERF = "erf"
+    LOG2 = "log2"
+    EXP2 = "exp2"
+    TRUNC = "trunc"
+    RINT = "rint"
+    RADIANS = "radians"
+    DEGREES = "degrees"
     EXP = "exp"
     LN = "ln"
     LOG10 = "log10"
@@ -51,17 +81,27 @@ class Op(enum.Enum):
     IS_NOT_NULL = "is_not_null"
     COALESCE = "coalesce"
     IF = "if"
+    NULLIF = "nullif"  # NULL when equal, else first arg
     # casts
     CAST_INT32 = "cast_int32"
     CAST_INT64 = "cast_int64"
     CAST_FLOAT = "cast_float"
     CAST_DOUBLE = "cast_double"
+    CAST_INT8 = "cast_int8"
+    CAST_INT16 = "cast_int16"
+    CAST_UINT64 = "cast_uint64"
+    CAST_BOOL = "cast_bool"
     # date parts (DATE=int32 days / TIMESTAMP=int64 us)
     YEAR = "year"
     MONTH = "month"
     DAY = "day"
     HOUR = "hour"
     MINUTE = "minute"
+    SECOND = "second"
+    DAY_OF_WEEK = "day_of_week"    # 0 = Sunday (spec convention)
+    DAY_OF_YEAR = "day_of_year"    # 1-based
+    WEEK = "week"                  # 1 + (doy-1)//7 (simple week-of-year)
+    QUARTER = "quarter"
     # string ops on dictionary ids (plan-time resolved masks)
     DICT_GATHER = "dict_gather"   # aux table lookup by id (masks, ranks)
     IN_SET = "in_set"

@@ -221,9 +221,22 @@ def infer_type(
     if op in (Op.CAST_FLOAT,):
         return dtypes.FLOAT
     if op in (Op.CAST_DOUBLE, Op.SQRT, Op.EXP, Op.LN, Op.LOG10,
-              Op.POW):
+              Op.POW, Op.SIN, Op.COS, Op.TAN, Op.ASIN, Op.ACOS,
+              Op.ATAN, Op.SINH, Op.COSH, Op.TANH, Op.ASINH, Op.ACOSH,
+              Op.ATANH, Op.ATAN2, Op.HYPOT, Op.CBRT, Op.ERF, Op.LOG2,
+              Op.EXP2, Op.TRUNC, Op.RINT, Op.RADIANS, Op.DEGREES):
         return dtypes.DOUBLE
-    if op in (Op.YEAR, Op.MONTH, Op.DAY, Op.HOUR, Op.MINUTE):
+    if op is Op.CAST_INT8:
+        return dtypes.INT8
+    if op is Op.CAST_INT16:
+        return dtypes.INT16
+    if op is Op.CAST_UINT64:
+        return dtypes.UINT64
+    if op is Op.CAST_BOOL:
+        return dtypes.BOOL
+    if op in (Op.YEAR, Op.MONTH, Op.DAY, Op.HOUR, Op.MINUTE,
+              Op.SECOND, Op.DAY_OF_WEEK, Op.DAY_OF_YEAR, Op.WEEK,
+              Op.QUARTER):
         return dtypes.INT32
     arg_ts = [infer_type(a, schema, assigned) for a in expr.args]
     if op is Op.SIGN:
@@ -231,7 +244,8 @@ def infer_type(
         # domain; type it as plain int (physical stays int64)
         return (dtypes.INT64 if arg_ts[0].is_decimal
                 else arg_ts[0])
-    if op in (Op.NEG, Op.ABS, Op.FLOOR, Op.CEIL, Op.ROUND):
+    if op in (Op.NEG, Op.ABS, Op.FLOOR, Op.CEIL, Op.ROUND, Op.BIT_NOT,
+              Op.NULLIF, Op.SHIFT_LEFT, Op.SHIFT_RIGHT):
         return arg_ts[0]
     if op in (Op.COALESCE,):
         return arg_ts[0]
@@ -239,6 +253,12 @@ def infer_type(
         return arg_ts[1]
     if op in (Op.ADD, Op.SUB, Op.MUL, Op.DIV, Op.MOD):
         return _numeric_result(op, arg_ts)
+    if op is Op.DIV_INT:
+        if any(t.is_decimal or t.is_floating for t in arg_ts):
+            return dtypes.INT64  # integer division of the values
+        return _numeric_result(Op.ADD, arg_ts)
+    if op in (Op.BIT_AND, Op.BIT_OR, Op.BIT_XOR):
+        return _numeric_result(Op.ADD, arg_ts)
     if op in (Op.GREATEST, Op.LEAST):
         return _numeric_result(Op.ADD, arg_ts)
     if op is Op.DICT_GATHER:
