@@ -191,3 +191,55 @@ def test_failed_put_rolls_back_and_self_heal_skips_garbage():
     assert not proxy.exists("partial")     # no poisoned remnant
     assert proxy.self_heal(4) >= 1         # heal still works
     assert proxy.get("good") == b"fine"
+
+
+def test_rejoined_disk_resyncs_in_background():
+    """synclog-lite anti-entropy (VERDICT r4 item 8; reference
+    vdisk/syncer/): a disk that was DOWN during writes converges via
+    resync() after rejoining — its designated parts restored, stale
+    versions dropped — so a LATER double-disk outage (block42's full
+    loss tolerance) still leaves every blob readable. Without resync
+    the group would be carrying a silent third effective loss."""
+    from ydb_tpu.blobstorage.group import DSProxy, GroupInfo
+
+    g = GroupInfo(7)
+    p = DSProxy(g)
+    for i in range(6):
+        p.put(f"pre{i}", b"old-%d" % i * 40)
+    # disk 2 dies; writes continue (handoff placement covers it)
+    g.disks[2].down = True
+    for i in range(8):
+        p.put(f"mid{i}", b"during-%d" % i * 40)
+    p.put("pre0", b"overwritten" * 40)   # supersede during the outage
+    p.delete("pre1")                     # delete during the outage
+    # disk 2 rejoins with its OLD data; background resync runs
+    g.disks[2].down = False
+    moved = p.resync()
+    assert moved > 0
+    # the rejoined disk now holds its DESIGNATED parts of every blob
+    # written while it was away (not just readable-via-reconstruct)
+    n = len(g.disks)
+    for i in range(8):
+        bid = f"mid{i}"
+        vid = p._vid(bid, p._seqs(bid)[0])
+        from ydb_tpu.blobstorage.group import hash_rotation
+
+        rot = hash_rotation(bid, n)
+        for part in range(p.codec.total_parts):
+            if g.disks[(part + rot) % n] is g.disks[2]:
+                assert g.disks[2].has_part(vid, part), (bid, part)
+    # stale state reconciled: superseded + deleted versions are gone
+    assert not g.disks[2].list_parts(DSProxy.META_PART, prefix="pre1@")
+    assert len(g.disks[2].list_parts(DSProxy.META_PART,
+                                     prefix="pre0@")) <= 1
+    # NOW kill two DIFFERENT disks — block42's full tolerance — and
+    # everything must still read without any repair pass
+    g.disks[4].down = True
+    g.disks[5].down = True
+    for i in range(6):
+        if i == 1:
+            continue  # deleted
+        want = (b"overwritten" * 40 if i == 0 else b"old-%d" % i * 40)
+        assert p.get(f"pre{i}") == want
+    for i in range(8):
+        assert p.get(f"mid{i}") == b"during-%d" % i * 40

@@ -109,6 +109,49 @@ class DSProxy:
     def __init__(self, group: GroupInfo):
         self.group = group
         self.codec = group.codec
+        # synclog-lite (vdisk/syncer analog): an append-only log of
+        # committed writes + a per-disk watermark of the log position
+        # that disk has fully applied. A disk that was DOWN during
+        # writes falls behind; resync() replays the gap so the rejoined
+        # replica converges in the background instead of only via
+        # read-repair/self-heal. (Process-local like VDisk.down itself:
+        # the outage being simulated is a disk, not the proxy.)
+        # entries: ("put", blob_id) | ("del", blob_id, upto_seq) —
+        # deletes carry the highest version deleted so resync can drop
+        # a rejoined disk's stale copy instead of resurrecting it
+        self.sync_log: list[tuple] = []
+        self.watermark: dict[str, int] = {
+            d.disk_id: 0 for d in self.group.disks
+        }
+        # highest version ever deleted per blob: a re-created blob must
+        # NOT reuse a tombstoned seq (resync would treat it as deleted)
+        self._seq_floor: dict[str, int] = {}
+
+    def _compact_synclog(self) -> None:
+        """Drop log entries every replica has applied."""
+        floor = min(self.watermark.values()) if self.watermark else 0
+        if floor:
+            self.sync_log = self.sync_log[floor:]
+            for k in self.watermark:
+                self.watermark[k] -= floor
+
+    def _prune_meta(self, vid: str) -> None:
+        """META stays only on disks still holding a data part of vid
+        (shared by self-heal and resync repatriation)."""
+        held = set()
+        for d in self.group.disks:
+            try:
+                if any(d.has_part(vid, i)
+                       for i in range(self.codec.total_parts)):
+                    held.add(d.disk_id)
+            except DiskDown:
+                held.add(d.disk_id)  # unknown: keep its META
+        for d in self.group.disks:
+            if d.disk_id not in held:
+                try:
+                    d.delete_part(vid, self.META_PART)
+                except DiskDown:
+                    continue
 
     @staticmethod
     def _vid(blob_id: str, seq: int) -> str:
@@ -133,7 +176,8 @@ class DSProxy:
         # next version = one past the highest stored version of THIS blob
         # (not a process counter: ordering must survive process restarts
         # over persistent backing)
-        seq = max(self._seqs(blob_id), default=0) + 1
+        seq = max(max(self._seqs(blob_id), default=0),
+                  self._seq_floor.get(blob_id, 0)) + 1
         vid = self._vid(blob_id, seq)
         meta = json.dumps({"len": len(data)}).encode()
         n = len(self.group.disks)
@@ -189,6 +233,17 @@ class DSProxy:
         for old in self._seqs(blob_id):
             if old != seq:
                 self._delete_version(blob_id, old)
+        # synclog: record the commit; disks that were fully caught up
+        # AND took part in this write advance their watermark, anyone
+        # down (or skipped) falls behind until resync()
+        self.sync_log.append(("put", blob_id))
+        pos = len(self.sync_log)
+        took = {d.disk_id for d, _i in placed}
+        for d in self.group.disks:
+            if self.watermark.get(d.disk_id, 0) == pos - 1 \
+                    and d.disk_id in took:
+                self.watermark[d.disk_id] = pos
+        self._compact_synclog()
 
     # ---- get: collect parts, reconstruct when disks are down ----
 
@@ -236,8 +291,118 @@ class DSProxy:
                 continue
 
     def delete(self, blob_id: str) -> None:
-        for seq in self._seqs(blob_id):
+        seqs = self._seqs(blob_id)
+        for seq in seqs:
             self._delete_version(blob_id, seq)
+        # deletes are sync events too: a disk down during the delete
+        # must drop its stale parts at resync (the tombstone carries
+        # the highest deleted version so resync cannot resurrect)
+        upto = max(seqs, default=0)
+        if upto:
+            self._seq_floor[blob_id] = max(
+                self._seq_floor.get(blob_id, 0), upto)
+        self.sync_log.append(("del", blob_id, upto))
+        pos = len(self.sync_log)
+        for d in self.group.disks:
+            if self.watermark.get(d.disk_id, 0) == pos - 1 and not d.down:
+                self.watermark[d.disk_id] = pos
+        self._compact_synclog()
+
+    # ---- background resync (vdisk/syncer + synclog analog) ----
+
+    def resync(self) -> int:
+        """Catch rejoined replicas up: replay the lagging UP disks'
+        sync-log gap. For every blob touched while any of them was
+        down, stale/superseded/deleted versions are dropped (delete
+        tombstones carry the highest deleted seq so a stale replica
+        cannot resurrect a blob) and the current version is fully
+        REPATRIATED — every part restored to its designated disk,
+        reconstructing where needed, handoff doubles removed — so the
+        group's full loss tolerance returns, exactly as after
+        self-heal. Advances watermarks; compacts the log when all
+        replicas converge. Returns parts transferred.
+
+        Reference: ydb/core/blobstorage/vdisk/syncer/ (synclog catch-up
+        between group replicas), miniaturized to a per-commit log +
+        per-replica watermark."""
+        n = len(self.group.disks)
+        moved = 0
+        log_len = len(self.sync_log)
+        lagging = [d for d in self.group.disks
+                   if not d.down
+                   and self.watermark.get(d.disk_id, 0) < log_len]
+        if not lagging:
+            return 0
+        incomplete = False
+        wm_floor = min(self.watermark.get(d.disk_id, 0)
+                       for d in lagging)
+        gap = self.sync_log[wm_floor:]
+        max_del: dict[str, int] = {}
+        for ent in gap:
+            if ent[0] == "del":
+                max_del[ent[1]] = max(max_del.get(ent[1], 0), ent[2])
+        for blob_id in dict.fromkeys(e[1] for e in gap):
+            rot = hash_rotation(blob_id, n)
+            # versions at or below a tombstone are DELETED even if a
+            # stale replica still advertises them
+            seqs = [q for q in self._seqs(blob_id)
+                    if q > max_del.get(blob_id, 0)]
+            current = self._vid(blob_id, seqs[0]) if seqs else None
+            pref = blob_id + "@"
+            for disk in lagging:
+                # drop anything this disk holds that is not current
+                # (list_parts returns full vids, prefix included)
+                for vid in disk.list_parts(self.META_PART, prefix=pref):
+                    if vid != current:
+                        for i in range(self.codec.total_parts):
+                            disk.delete_part(vid, i)
+                        disk.delete_part(vid, self.META_PART)
+            if current is None:
+                continue
+            parts, meta = self._gather(current)
+            if meta is None:
+                incomplete = True
+                continue
+            meta_raw = json.dumps({"len": meta["len"]}).encode()
+            # repatriate: every part onto its designated live disk,
+            # handoff copies dropped — restores full loss tolerance
+            for i in range(self.codec.total_parts):
+                disk = self.group.disks[(i + rot) % n]
+                try:
+                    have = disk.has_part(current, i)
+                except DiskDown:
+                    continue
+                if not have:
+                    if i in parts:
+                        part = parts[i]
+                    else:
+                        try:
+                            part = self.codec.reconstruct_part(
+                                parts, i, meta["len"])
+                        except ValueError:
+                            incomplete = True
+                            continue  # unreconstructable right now
+                    disk.put_part(current, i, part)
+                    disk.put_part(current, self.META_PART, meta_raw)
+                    moved += 1
+                for other in self.group.disks:
+                    if other is disk:
+                        continue
+                    try:
+                        other.delete_part(current, i)
+                    except DiskDown:
+                        continue
+            self._prune_meta(current)
+        if incomplete:
+            # something could not repatriate (peer disks down, meta
+            # unreachable): leave watermarks so a later resync RETRIES
+            # the gap — repatriation is idempotent
+            return moved
+        for d in self.group.disks:
+            if not d.down:
+                self.watermark[d.disk_id] = log_len
+        self._compact_synclog()
+        return moved
 
     def list(self, prefix: str = "") -> list[str]:
         seen = set()
@@ -260,6 +425,10 @@ class DSProxy:
         new = replacement if replacement is not None else VDisk(
             old.disk_id + "'")
         self.group.disks[disk_index] = new
+        # the dead disk's watermark must not pin log compaction; the
+        # replacement is fully caught up once this heal completes
+        self.watermark.pop(old.disk_id, None)
+        self.watermark[new.disk_id] = len(self.sync_log)
         n = len(self.group.disks)
         rebuilt = 0
         for blob_id in self.list():
