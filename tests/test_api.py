@@ -182,3 +182,83 @@ def test_topic_streaming_sessions(served):
     got3 = list(tc.stream_read("st_changefeed", "s2",
                                auto_commit=False, idle_timeout_ms=200))
     assert len(got3) == 5
+
+
+def test_export_import_service_roundtrip():
+    """Export/Import gRPC service (ydb_export/ydb_import analog,
+    VERDICT r4 item 9): snapshot a table into the cluster store via the
+    SDK, import it back as a NEW resharded cluster table with string
+    ids remapped into the shared dictionary set."""
+    from ydb_tpu.api.client import ApiError, Driver
+    from ydb_tpu.api.server import make_server
+    from ydb_tpu.kqp.session import Cluster
+
+    c = Cluster()
+    srv, port = make_server(c, 0)
+    srv.start()
+    try:
+        d = Driver(f"127.0.0.1:{port}")
+        q = d.query_client()
+        q.execute("CREATE TABLE inv (id int64, name text, qty int64, "
+                  "PRIMARY KEY (id)) WITH (shards = 2)")
+        q.execute("INSERT INTO inv VALUES (1, 'bolt', 10), "
+                  "(2, 'nut', 20), (3, 'washer', 30)")
+        ex = d.export_client()
+        man = ex.export_table("inv", "inv_snap")
+        assert man["rows"] == 3 and man["parts"] >= 1
+        # a write AFTER the snapshot must not appear in the restore
+        q.execute("INSERT INTO inv VALUES (4, 'screw', 40)")
+        assert ex.import_table("inv_snap", table="inv2", shards=3) == 3
+        out = q.execute("SELECT i.name AS n, i.qty AS v FROM inv2 i "
+                        "ORDER BY v")
+        assert out.to_pydict() == {"n": ["bolt", "nut", "washer"],
+                                   "v": [10, 20, 30]}
+        assert ("inv_snap", 3, 1) in [
+            (n, r, s) for n, r, s in ex.list_backups()]
+        # joins across original + restored prove the shared-dict remap
+        out2 = q.execute(
+            "SELECT a.name AS n FROM inv a JOIN inv2 b "
+            "ON a.name = b.name WHERE b.qty = 20")
+        assert out2.to_pydict()["n"] == ["nut"]
+        import pytest as _pytest
+
+        with _pytest.raises(ApiError):
+            ex.import_table("inv_snap", table="inv2")  # exists
+        with _pytest.raises(ApiError):
+            ex.export_table("nope")
+    finally:
+        srv.stop(0)
+
+
+def test_rate_limiter_service():
+    """RateLimiter gRPC service over runtime.quoter (kesus token
+    buckets): create/acquire/deplete/refill/describe via the SDK."""
+    import time
+
+    from ydb_tpu.api.client import ApiError, Driver
+    from ydb_tpu.api.server import make_server
+    from ydb_tpu.kqp.session import Cluster
+
+    c = Cluster()
+    srv, port = make_server(c, 0)
+    srv.start()
+    try:
+        d = Driver(f"127.0.0.1:{port}")
+        rl = d.rate_limiter_client()
+        rl.create_resource("api/read", rate=50.0, burst=2.0)
+        assert rl.acquire("api/read")[0]
+        assert rl.acquire("api/read")[0]
+        ok, retry = rl.acquire("api/read")
+        assert not ok and retry > 0
+        time.sleep(0.1)  # rate 50/s refills ~5 tokens
+        assert rl.acquire("api/read")[0]
+        desc = rl.describe_resource("api/read")
+        assert desc["rate"] == 50.0 and desc["burst"] == 2.0
+        import pytest as _pytest
+
+        with _pytest.raises(ApiError):
+            rl.acquire("api/missing")
+        with _pytest.raises(ApiError):
+            rl.create_resource("bad", rate=0.0)
+    finally:
+        srv.stop(0)

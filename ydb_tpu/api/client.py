@@ -47,6 +47,12 @@ class Driver:
     def topic_client(self) -> "TopicClient":
         return TopicClient(self)
 
+    def export_client(self) -> "ExportClient":
+        return ExportClient(self)
+
+    def rate_limiter_client(self) -> "RateLimiterClient":
+        return RateLimiterClient(self)
+
     def discovery(self) -> list[tuple[str, int]]:
         resp = self._call("/ydb_tpu.Discovery/ListEndpoints",
                           pb.ListEndpointsRequest(),
@@ -192,3 +198,70 @@ class TopicClient:
             pb.TopicCommitResponse)
         if resp.error:
             raise ApiError(resp.error)
+
+
+class ExportClient:
+    """Export/Import service (ydb_export/ydb_import analog)."""
+
+    def __init__(self, driver: Driver):
+        self.driver = driver
+
+    def export_table(self, table: str, name: str = ""):
+        resp = self.driver._call(
+            "/ydb_tpu.Export/ExportBackup",
+            pb.ExportRequest(table=table, name=name), pb.ExportResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return {"rows": resp.rows, "parts": resp.parts,
+                "snapshot": resp.snapshot}
+
+    def import_table(self, name: str, table: str = "", shards: int = 0):
+        resp = self.driver._call(
+            "/ydb_tpu.Export/ImportBackup",
+            pb.ImportRequest(name=name, table=table, shards=shards),
+            pb.ImportResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.rows
+
+    def list_backups(self):
+        resp = self.driver._call(
+            "/ydb_tpu.Export/ListBackups", pb.ListBackupsRequest(),
+            pb.ListBackupsResponse)
+        return [(b.name, b.rows, b.snapshot) for b in resp.backups]
+
+
+class RateLimiterClient:
+    """RateLimiter service (kesus token buckets over runtime.quoter)."""
+
+    def __init__(self, driver: Driver):
+        self.driver = driver
+
+    def create_resource(self, path: str, rate: float,
+                        burst: float = 0.0):
+        resp = self.driver._call(
+            "/ydb_tpu.RateLimiter/CreateResource",
+            pb.CreateResourceRequest(path=path, rate=rate, burst=burst),
+            pb.CreateResourceResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+
+    def acquire(self, path: str, amount: float = 1.0):
+        """(acquired, retry_after_seconds)"""
+        resp = self.driver._call(
+            "/ydb_tpu.RateLimiter/AcquireResource",
+            pb.AcquireResourceRequest(path=path, amount=amount),
+            pb.AcquireResourceResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.acquired, resp.retry_after_s
+
+    def describe_resource(self, path: str):
+        resp = self.driver._call(
+            "/ydb_tpu.RateLimiter/DescribeResource",
+            pb.DescribeResourceRequest(path=path),
+            pb.DescribeResourceResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return {"rate": resp.rate, "burst": resp.burst,
+                "tokens": resp.tokens}

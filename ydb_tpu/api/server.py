@@ -292,6 +292,166 @@ class RequestProxy:
                 request.consumer, request.offset + 1)
         return pb.TopicCommitResponse()
 
+    # ---- Export/Import (ydb_export/ydb_import analog) ----
+
+    def export_backup(self, request, context):
+        self.check_auth(context)
+        from ydb_tpu.engine.backup import export_table
+        from ydb_tpu.tx import ShardedTable
+
+        # the export streams under the cluster lock: portion metadata
+        # is not safe to read concurrently with locked writers
+        # (compaction/GC under run_background), and the miniature
+        # prefers a stalled RPC to a torn read
+        with self.lock:
+            t = self.cluster.tables.get(request.table)
+            if t is None:
+                return pb.ExportResponse(
+                    error=f"unknown table {request.table}")
+            if not isinstance(t, ShardedTable):
+                return pb.ExportResponse(
+                    error="export supports column-store tables")
+            man = export_table(t, self.cluster.store,
+                               request.name or request.table)
+        return pb.ExportResponse(rows=man["rows"],
+                                 parts=len(man["parts"]),
+                                 snapshot=man["snapshot"])
+
+    def import_backup(self, request, context):
+        """Restore a backup as a CLUSTER table: scheme entry created,
+        string ids remapped from the manifest's dictionaries into the
+        cluster-shared set, rows streamed through the normal insert
+        path (so WAL/portions/dedup semantics all apply)."""
+        self.check_auth(context)
+        import numpy as np
+
+        from ydb_tpu.engine.backup import read_manifest, schema_from_json
+        from ydb_tpu.engine.portion import read_portion_blob
+        from ydb_tpu.scheme.model import TableDescription
+        from ydb_tpu.scheme.shard import SchemeError
+
+        with self.lock:
+            c = self.cluster
+            try:
+                man = read_manifest(c.store, request.name)
+            except KeyError:
+                return pb.ImportResponse(
+                    error=f"no backup {request.name}")
+            target = request.table or man["name"]
+            if target in c.tables:
+                return pb.ImportResponse(
+                    error=f"table {target} already exists")
+            schema = schema_from_json(man["schema"])
+            desc = TableDescription(
+                path="/" + target, schema=schema,
+                primary_key=(man["pk_column"],),
+                n_shards=request.shards or man["n_shards"],
+                store="column", ttl_column=man.get("ttl_column"),
+                upsert=man["upsert"],
+            )
+            try:
+                c.scheme.create_table(desc)
+            except SchemeError as e:
+                return pb.ImportResponse(error=str(e))
+            try:
+                t = c._instantiate(desc)
+                # remap manifest dictionary ids -> cluster-shared ids
+                remap: dict[str, np.ndarray] = {}
+                for col, values in man["dicts"].items():
+                    d = c.dicts.for_column(col)
+                    remap[col] = np.array(
+                        [d.add(v.encode("latin1")) for v in values],
+                        dtype=np.int32)
+                rows = 0
+                for part in man["parts"]:
+                    cols, valid = read_portion_blob(c.store,
+                                                    part["blob_id"])
+                    for col in list(cols):
+                        if col in remap and \
+                                schema.field(col).type.is_string:
+                            cols[col] = remap[col][cols[col]]
+                    t.insert(cols, valid or None)
+                    rows += part["rows"]
+            except Exception as e:  # noqa: BLE001 - import must not
+                # leave a half-populated table registered: roll the DDL
+                # back so a retry does not hit "already exists"
+                t2 = c.tables.pop(target, None)
+                prefixes = t2.storage_prefixes() if t2 is not None \
+                    else []
+                try:
+                    c.scheme.drop_table("/" + target,
+                                        trash_prefixes=prefixes)
+                    c._sweep_trash()
+                except Exception:  # noqa: BLE001 - keep first error
+                    pass
+                return pb.ImportResponse(error=f"import failed: {e}")
+            c._plan_cache.clear()
+        return pb.ImportResponse(rows=rows)
+
+    def list_backups(self, request, context):
+        self.check_auth(context)
+        import json as _json
+
+        out = []
+        with self.lock:
+            for blob_id in self.cluster.store.list("backup/"):
+                if not blob_id.endswith("/manifest"):
+                    continue
+                man = _json.loads(self.cluster.store.get(blob_id))
+                out.append(pb.BackupInfo(name=man["name"],
+                                         rows=man["rows"],
+                                         snapshot=man["snapshot"]))
+        return pb.ListBackupsResponse(backups=out)
+
+    # ---- RateLimiter (ydb_rate_limiter analog over runtime.quoter) ----
+
+    def _quoter(self):
+        from ydb_tpu.runtime.quoter import Quoter
+
+        if self.cluster.quoter is None:
+            self.cluster.quoter = Quoter()
+        return self.cluster.quoter
+
+    def create_resource(self, request, context):
+        self.check_auth(context)
+        if request.rate <= 0:
+            return pb.CreateResourceResponse(error="rate must be > 0")
+        with self.lock:
+            q = self._quoter()
+            if q.exists(request.path):
+                # re-creating would refill the bucket to full burst — a
+                # throttled client could defeat its own limit
+                return pb.CreateResourceResponse(
+                    error=f"resource {request.path} already exists")
+            q.configure(request.path, request.rate,
+                        request.burst if request.burst > 0 else None)
+        return pb.CreateResourceResponse()
+
+    def acquire_resource(self, request, context):
+        self.check_auth(context)
+        amount = request.amount or 1.0
+        with self.lock:
+            q = self._quoter()
+            if q.describe(request.path) is None and not any(
+                    q.exists(p) for p in _ancestors(request.path)):
+                return pb.AcquireResourceResponse(
+                    error=f"no resource {request.path}")
+            ok = q.try_acquire(request.path, amount)
+            retry = 0.0 if ok else q.wait_time(request.path, amount)
+        return pb.AcquireResourceResponse(acquired=ok,
+                                          retry_after_s=retry)
+
+    def describe_resource(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            desc = self._quoter().describe(request.path)
+        if desc is None:
+            return pb.DescribeResourceResponse(
+                error=f"no resource {request.path}")
+        return pb.DescribeResourceResponse(
+            rate=desc["rate"], burst=desc["burst"],
+            tokens=desc["tokens"])
+
     # ---- Discovery ----
 
     def list_endpoints(self, request, context):
@@ -300,6 +460,11 @@ class RequestProxy:
             pb.EndpointInfo(address=a, port=p)
             for a, p in self.endpoints
         ])
+
+
+def _ancestors(path: str) -> list[str]:
+    parts = path.split("/")
+    return ["/".join(parts[:i]) for i in range(1, len(parts))]
 
 
 _SERVICES = {
@@ -327,6 +492,24 @@ _SERVICES = {
                        pb.TopicReadResponse, "unary_stream"),
         "StreamWrite": ("topic_stream_write", pb.StreamWriteItem,
                         pb.StreamWriteAck, "stream_stream"),
+    },
+    "ydb_tpu.Export": {
+        "ExportBackup": ("export_backup", pb.ExportRequest,
+                         pb.ExportResponse),
+        "ImportBackup": ("import_backup", pb.ImportRequest,
+                         pb.ImportResponse),
+        "ListBackups": ("list_backups", pb.ListBackupsRequest,
+                        pb.ListBackupsResponse),
+    },
+    "ydb_tpu.RateLimiter": {
+        "CreateResource": ("create_resource", pb.CreateResourceRequest,
+                           pb.CreateResourceResponse),
+        "AcquireResource": ("acquire_resource",
+                            pb.AcquireResourceRequest,
+                            pb.AcquireResourceResponse),
+        "DescribeResource": ("describe_resource",
+                             pb.DescribeResourceRequest,
+                             pb.DescribeResourceResponse),
     },
     "ydb_tpu.Discovery": {
         "ListEndpoints": ("list_endpoints", pb.ListEndpointsRequest,

@@ -425,12 +425,11 @@ class DSProxy:
         new = replacement if replacement is not None else VDisk(
             old.disk_id + "'")
         self.group.disks[disk_index] = new
-        # the dead disk's watermark must not pin log compaction; the
-        # replacement is fully caught up once this heal completes
+        # the dead disk's watermark must not pin log compaction
         self.watermark.pop(old.disk_id, None)
-        self.watermark[new.disk_id] = len(self.sync_log)
         n = len(self.group.disks)
         rebuilt = 0
+        complete = True
         for blob_id in self.list():
             rot = hash_rotation(blob_id, n)
             for seq in self._seqs(blob_id):
@@ -456,6 +455,7 @@ class DSProxy:
                                 part = self.codec.reconstruct_part(
                                     parts, i, meta["len"])
                             except ValueError:
+                                complete = False
                                 break  # unreconstructable: heal the rest
                         try:
                             disk.put_part(vid, i, part)
@@ -473,19 +473,10 @@ class DSProxy:
                             other.delete_part(vid, i)
                         except DiskDown:
                             continue
-                # META stays only on disks still holding a part
-                held = set()
-                for d in self.group.disks:
-                    try:
-                        if any(d.has_part(vid, i)
-                               for i in range(self.codec.total_parts)):
-                            held.add(d.disk_id)
-                    except DiskDown:
-                        held.add(d.disk_id)  # unknown: keep its META
-                for d in self.group.disks:
-                    if d.disk_id not in held:
-                        try:
-                            d.delete_part(vid, self.META_PART)
-                        except DiskDown:
-                            continue
+                self._prune_meta(vid)
+        # an INCOMPLETE heal (peers down made blobs unreconstructable)
+        # leaves the replacement lagging so resync retries what it can;
+        # rerun self_heal once the peers return for unlogged blobs
+        self.watermark[new.disk_id] = (
+            len(self.sync_log) if complete else 0)
         return rebuilt

@@ -65,6 +65,21 @@ class Quoter:
                 b.tokens -= amount
             return True
 
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            return path in self._buckets
+
+    def describe(self, path: str) -> dict | None:
+        """{"rate", "burst", "tokens"} refreshed to now, or None."""
+        now = self._clock()
+        with self._lock:
+            b = self._buckets.get(path)
+            if b is None:
+                return None
+            b.refill(now)
+            return {"rate": b.rate, "burst": b.burst,
+                    "tokens": b.tokens}
+
     def wait_time(self, path: str, amount: float = 1.0) -> float:
         """Seconds until `amount` could be available (0 = now)."""
         now = self._clock()
