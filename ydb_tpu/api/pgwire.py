@@ -22,7 +22,11 @@ Supported flow:
     surviving Sync inside explicit transactions. Describe(portal)
     returns the real row shape; Describe(statement) answers NoData
     (drivers needing statement-level metadata — JDBC default flow —
-    must describe the portal). Binary parameter/result formats are
+    must describe the portal). Describe(statement) answers the
+    declared parameter oids plus the PLANNED row shape (the JDBC
+    PreparedStatement.getMetaData path), and Bind may request binary
+    result formats for int/float/bool/text columns (fixed-width
+    network-order; text bytes are format-invariant). Binary parameter formats are
     rejected with clear errors,
   * CancelRequest (connection-level no-op), Terminate ('X').
 
@@ -191,6 +195,41 @@ def _error(message: str, code: str = "XX000") -> bytes:
     fields = (b"S" + _cstr("ERROR") + b"V" + _cstr("ERROR")
               + b"C" + _cstr(code) + b"M" + _cstr(message) + b"\x00")
     return _msg(b"E", fields)
+
+
+def _col_fmt(res_fmts, ci: int) -> int:
+    """Per-column result format from Bind's codes: none = text, one =
+    applies to all, else positional (pg protocol 3.0 semantics)."""
+    if not res_fmts:
+        return 0
+    if len(res_fmts) == 1:
+        return res_fmts[0]
+    return res_fmts[ci] if ci < len(res_fmts) else 0
+
+
+# binary result encodings per kind (JDBC's binary transfer mode):
+# network-order fixed-width for ints/floats/bool; text (same bytes)
+# for strings. Kinds absent here refuse binary with 0A000.
+_BIN_PACK = {
+    dtypes.Kind.INT8: "!h", dtypes.Kind.INT16: "!h",
+    dtypes.Kind.UINT8: "!h", dtypes.Kind.INT32: "!i",
+    dtypes.Kind.UINT16: "!i", dtypes.Kind.INT64: "!q",
+    dtypes.Kind.UINT32: "!q",
+    dtypes.Kind.FLOAT: "!f", dtypes.Kind.DOUBLE: "!d",
+}
+
+
+def _binary_value(kind: dtypes.Kind, v) -> bytes:
+    pack = _BIN_PACK.get(kind)
+    if pack is not None:
+        return struct.pack(
+            pack, float(v) if pack in ("!f", "!d") else int(v))
+    if kind == dtypes.Kind.BOOL:
+        return b"\x01" if v else b"\x00"
+    # UINT64 deliberately absent: its advertised oid is 20 (signed
+    # int8), so a '!Q' payload >= 2^63 would silently decode negative
+    raise _PgError(
+        f"binary result format not supported for {kind.name}", "0A000")
 
 
 def _format_value(kind: dtypes.Kind, scale: int, v) -> bytes:
@@ -376,13 +415,12 @@ class _Handler(socketserver.BaseRequestHandler):
                                "0A000")
             params.append(raw)
         n_res = r.u16()
-        if any(r.u16() == 1 for _ in range(n_res)):
-            raise _PgError("binary result format not supported",
-                           "0A000")
+        res_fmts = [r.u16() for _ in range(n_res)]
         sql = _substitute_params(stmt["query"], params, stmt["oids"])
         portals[portal] = {"sql": sql, "result": None, "done": False,
-                           "described": False, "sent": 0,
-                           "complete": False}
+                           "described": stmt.get("described_s", False),
+                           "sent": 0, "complete": False,
+                           "res_fmts": res_fmts}
 
     def _run_portal(self, srv, session, portal: dict) -> None:
         if portal["done"]:
@@ -390,17 +428,40 @@ class _Handler(socketserver.BaseRequestHandler):
         with srv.lock:
             portal["result"] = session.execute(portal["sql"])
         portal["done"] = True
+        # reject unsupported binary columns NOW — a clean ErrorResponse
+        # before any RowDescription/DataRow reaches the wire
+        out = portal["result"]
+        fmts = portal.get("res_fmts")
+        if fmts and isinstance(out, OracleTable):
+            for ci, f in enumerate(out.schema.fields):
+                if _col_fmt(fmts, ci) == 1 and not f.type.is_string \
+                        and f.type.kind not in _BIN_PACK \
+                        and f.type.kind != dtypes.Kind.BOOL:
+                    raise _PgError(
+                        f"binary result format not supported for "
+                        f"{f.type.kind.name}", "0A000")
 
     def _describe_msg(self, srv, sock, session, body, statements,
                       portals) -> None:
         kind, name = body[0:1], body[1:-1].decode()
         if kind == b"S":
-            if name not in statements:
+            stmt = statements.get(name)
+            if stmt is None:
                 raise _PgError(f"unknown prepared statement {name!r}",
                                "26000")
-            # parameter types are inferred at bind time (text substitution)
-            sock.sendall(_msg(b"t", struct.pack("!H", 0)))
-            sock.sendall(_msg(b"n", b""))  # NoData until bound
+            # ParameterDescription: the oids Parse declared
+            oids = stmt["oids"]
+            sock.sendall(_msg(b"t", struct.pack(
+                "!H", len(oids)) + b"".join(
+                struct.pack("!I", o) for o in oids)))
+            cols = self._statement_row_shape(srv, stmt)
+            if cols is None:
+                sock.sendall(_msg(b"n", b""))  # NoData
+            else:
+                self._send_rowdesc(sock, cols)
+                # the client HAS the shape: Execute on portals of this
+                # statement must not inject a duplicate RowDescription
+                stmt["described_s"] = True
             return
         portal = portals.get(name)
         if portal is None:
@@ -413,10 +474,44 @@ class _Handler(socketserver.BaseRequestHandler):
             self._send_rowdesc(
                 sock, [(f.name, f.type.kind,
                         getattr(f.type, "scale", 0))
-                       for f in out.schema.fields])
+                       for f in out.schema.fields],
+                res_fmts=portal.get("res_fmts"))
             portal["described"] = True
         else:
             sock.sendall(_msg(b"n", b""))  # NoData (DML/DDL)
+
+    def _statement_row_shape(self, srv, stmt):
+        """Row shape of a prepared statement WITHOUT executing it (the
+        JDBC PreparedStatement.getMetaData path): plan against the
+        catalog with type-appropriate dummy parameters. Result column
+        types come from the catalog, not the parameter values, so the
+        dummies do not distort the shape. None = NoData (DML/DDL,
+        or a shape we cannot plan without execution)."""
+        try:
+            from ydb_tpu.sql import ast as _ast
+            from ydb_tpu.sql.parser import parse as _parse
+            from ydb_tpu.sql.planner import plan_select_full
+
+            n_params = len(set(_re.findall(r"\$(\d+)",
+                                           stmt["query"])))
+            dummies = []
+            for i in range(n_params):
+                oid = (stmt["oids"][i]
+                       if i < len(stmt["oids"]) else 25)
+                dummies.append(b"" if oid == 25 else b"0")
+            sql = _substitute_params(stmt["query"], dummies,
+                                     stmt["oids"])
+            parsed = _parse(sql)
+            if not isinstance(parsed, _ast.Select):
+                return None
+            with srv.lock:
+                pq = plan_select_full(parsed,
+                                      srv.cluster.catalog())
+            return [(n, pq.out_types[n].kind,
+                     getattr(pq.out_types[n], "scale", 0))
+                    for n in pq.out_names]
+        except Exception:  # noqa: BLE001 - fall back to NoData
+            return None
 
     def _execute_msg(self, srv, sock, session, body, portals) -> None:
         r = _Cursor(body)
@@ -438,7 +533,8 @@ class _Handler(socketserver.BaseRequestHandler):
             self._send_table(sock, out,
                              with_rowdesc=not portal["described"],
                              start=start, limit=take,
-                             send_complete=False)
+                             send_complete=False,
+                             res_fmts=portal.get("res_fmts"))
             portal["described"] = True  # shape announced at most once
             portal["sent"] = start + take
             if portal["sent"] >= n:
@@ -508,35 +604,43 @@ class _Handler(socketserver.BaseRequestHandler):
             sock.sendall(_msg(b"C", _cstr(verb or "OK")))
         return True
 
-    def _send_rowdesc(self, sock, cols):
+    def _send_rowdesc(self, sock, cols, res_fmts=None):
         parts = [struct.pack("!H", len(cols))]
-        for name, kind, _scale in cols:
+        for ci, (name, kind, _scale) in enumerate(cols):
             oid, typlen = _PG_OID[kind]
+            fmt = _col_fmt(res_fmts, ci)
             parts.append(
                 _cstr(name)
-                + struct.pack("!IhIhih", 0, 0, oid, typlen, -1, 0))
+                + struct.pack("!IhIhih", 0, 0, oid, typlen, -1, fmt))
         sock.sendall(_msg(b"T", b"".join(parts)))
 
     def _send_table(self, sock, out: OracleTable,
                     with_rowdesc: bool = True, start: int = 0,
                     limit: int | None = None,
-                    send_complete: bool = True):
+                    send_complete: bool = True,
+                    res_fmts=None):
         fields = list(out.schema.fields)
         if with_rowdesc:
             self._send_rowdesc(
                 sock,
                 [(f.name, f.type.kind, getattr(f.type, "scale", 0))
-                 for f in fields])
+                 for f in fields], res_fmts=res_fmts)
         n = out.num_rows
         hi = n if limit is None else min(n, start + limit)
         text_cols = []
-        for f in fields:
+        for ci, f in enumerate(fields):
             vals, valid = out.cols[f.name]
             valid = np.asarray(valid, dtype=bool)
+            binary = _col_fmt(res_fmts, ci) == 1
             if f.type.is_string:
+                # text and binary wire forms of text ARE the same bytes
                 decoded = out.strings(f.name)
                 col = [None if not valid[i] else
                        decoded[i] for i in range(start, hi)]
+            elif binary:
+                col = [None if not valid[i] else
+                       _binary_value(f.type.kind, vals[i])
+                       for i in range(start, hi)]
             else:
                 scale = getattr(f.type, "scale", 0)
                 col = [None if not valid[i] else
