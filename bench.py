@@ -98,10 +98,11 @@ class _SqlProbeTooSlow(Exception):
     """SQL tier probe exceeded its cap; skip that tier, keep the rest."""
 
 
-def cpu_q1(li, cutoff):
+def cpu_q1(li, cutoff, nls=None):
     """Vectorized single-pass numpy Q1 (the CPU columnar baseline)."""
     m = li["l_shipdate"] <= cutoff
-    nls = int(li["l_linestatus"].max()) + 1
+    if nls is None:
+        nls = int(li["l_linestatus"].max()) + 1
     rf = li["l_returnflag"][m].astype(np.int64)
     ls = li["l_linestatus"][m].astype(np.int64)
     gid = rf * nls + ls
@@ -196,6 +197,100 @@ def pallas_ab(src, blocks, n_rows, block_rows, iters):
         finally:
             pallas_kernels.FORCE = None
     return out
+
+
+def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
+    """Out-of-core engine-tier run at a LARGE scale factor (SURVEY
+    §7.2 item 7): lineitem generates in bounded chunks (the full table
+    never exists in memory), ingests through ColumnShard.write/commit
+    onto disk, and Q1/Q6 scan through the streaming reader. The Q1/Q6
+    baselines accumulate incrementally per generated chunk, so
+    verification is out-of-core too. Records SF, ingest/scan rows/s,
+    on-disk bytes, and peak RSS against an explicit budget
+    (YDB_TPU_BENCH_OOC_RSS_GB, default 24)."""
+    import resource
+
+    import jax
+
+    from ydb_tpu.blocks.dictionary import DictionarySet
+    from ydb_tpu.engine.blobs import DirBlobStore
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+    from ydb_tpu.workload import tpch
+
+    ooc_sf = float(os.environ.get("YDB_TPU_BENCH_OOC_SF", "0"))
+    if not ooc_sf:
+        return
+    budget_gb = float(os.environ.get("YDB_TPU_BENCH_OOC_RSS_GB", "24"))
+    root = os.environ.get("YDB_TPU_BENCH_OOC_DIR")
+    _log(f"ooc tier: sf={ooc_sf:g} rss budget {budget_gb:g} GB")
+    cutoff = tpch._days("1998-12-01") - 90
+    d0, d1 = tpch._days("1994-01-01"), tpch._days("1995-01-01")
+    ooc: dict = {"sf": ooc_sf, "rss_budget_gb": budget_gb}
+    extra["ooc"] = ooc
+    with tempfile.TemporaryDirectory(
+            prefix="ydbtpu_ooc_", dir=root) as tmp:
+        dicts = DictionarySet()
+        shard = ColumnShard(
+            "ooc", tpch.LINEITEM_SCHEMA, DirBlobStore(tmp),
+            dicts=dicts,
+            config=ShardConfig(compact_portion_threshold=10 ** 9,
+                               scan_block_rows=block_rows,
+                               portion_chunk_rows=1 << 18))
+        # incremental Q1/Q6 baselines: accumulated per chunk, O(1) state
+        q1_acc: dict[str, np.ndarray] = {}
+        q6_rev = 0
+        rows = 0
+        t0 = time.perf_counter()
+        for chunk in tpch.lineitem_chunks(ooc_sf, dicts):
+            wid = shard.write(chunk)
+            shard.commit([wid])
+            rows += len(chunk["l_orderkey"])
+            # nls is structurally 2 (the linestatus dictionary holds
+            # exactly O and F): per-chunk inference would mis-bin a
+            # chunk whose rows land on one side of the cutoff
+            base1, _n, nls = cpu_q1(chunk, cutoff, nls=2)
+            for k in ("count", "sum_qty", "sum_base_price",
+                      "sum_disc_price", "sum_charge"):
+                tgt = q1_acc.setdefault(k, np.zeros(16))
+                tgt[base1["gid"]] += base1[k]
+            q6_rev += cpu_q6(chunk, d0, d1)
+        ingest_s = time.perf_counter() - t0
+        ooc["rows"] = rows
+        ooc["ingest_rows_per_sec"] = round(rows / ingest_s)
+        stored = sum(shard.store.size(m.blob_id)
+                     for m in shard.visible_portions())
+        ooc["stored_gb"] = round(stored / 1e9, 2)
+        _log(f"ooc tier: {rows} rows, {ooc['stored_gb']} GB on disk; "
+             "scans")
+
+        def run(prog):
+            def go():
+                return shard.scan(prog)
+            return go
+
+        c1, w1, out1 = timed_cold_warm(run(tpch.q1_program()),
+                                       max(1, iters // 2))
+        c6, w6, out6 = timed_cold_warm(run(tpch.q6_program()),
+                                       max(1, iters // 2))
+        # verify against the incrementally-accumulated baselines
+        res = {n: np.asarray(v[0]) for n, v in out1.cols.items()}
+        gid = (res["l_returnflag"].astype(np.int64) * nls
+               + res["l_linestatus"].astype(np.int64))
+        order = np.argsort(gid)
+        live = np.flatnonzero(q1_acc["count"] > 0)
+        assert np.array_equal(gid[order], live), "ooc q1 keys"
+        assert np.allclose(
+            res["sum_charge"].astype(np.float64)[order],
+            q1_acc["sum_charge"][live], rtol=1e-9), "ooc q1 charge"
+        assert int(np.asarray(out6.cols["revenue"][0])[0]) == q6_rev
+        ooc["q1_cold_rows_per_sec"] = round(rows / c1)
+        ooc["q1_warm_rows_per_sec"] = round(rows / w1)
+        ooc["q6_warm_rows_per_sec"] = round(rows / w6)
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+        ooc["peak_rss_gb"] = round(peak, 2)
+        ooc["within_budget"] = peak <= budget_gb
+        ooc["backend"] = jax.default_backend()
+    _log(f"ooc tier done: peak rss {ooc['peak_rss_gb']} GB")
 
 
 def main():
@@ -471,6 +566,10 @@ def main():
     except Exception as e:  # noqa: BLE001 - storage tiers fail soft:
         # the kernel-tier numbers (already verified) still report
         extra["engine_tier_error"] = repr(e)[-400:]
+    try:
+        run_ooc(extra, iters, block_rows)
+    except Exception as e:  # noqa: BLE001 - OOC is additive evidence
+        extra.setdefault("ooc", {})["error"] = repr(e)[-400:]
     _log("done")
 
     extra["baseline"] = ("vectorized numpy single-pass (mask+bincount), "

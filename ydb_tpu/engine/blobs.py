@@ -45,7 +45,16 @@ class BlobStore:
         raise NotImplementedError
 
 
+    def size(self, blob_id: str) -> int:
+        """Stored byte size; default reads the blob (backends with a
+        cheap stat override this)."""
+        return len(self.get(blob_id))
+
 class MemBlobStore(BlobStore):
+    def size(self, blob_id: str) -> int:
+        with self._lock:
+            return len(self._data[blob_id])
+
     """In-memory store with a sorted key index: ``list(prefix)`` is
     O(log n + matches), not a full scan — every hot path above this
     (DSProxy versions, WAL replay ranges, portion listings) leans on
@@ -89,6 +98,9 @@ class MemBlobStore(BlobStore):
 
 
 class DirBlobStore(BlobStore):
+    def size(self, blob_id: str) -> int:
+        return os.path.getsize(self._path(blob_id))
+
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)

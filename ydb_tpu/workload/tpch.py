@@ -213,6 +213,79 @@ def _encode_values(dicts: DictionarySet, col: str, values) -> np.ndarray:
     return ids[inv].astype(np.int32)
 
 
+def lineitem_chunks(sf: float, dicts: DictionarySet, seed: int = 42,
+                    chunk_orders: int = 1_000_000):
+    """Generate lineitem at ``sf`` in bounded CHUNKS (out-of-core
+    ingest: the whole table never exists in memory). Distribution
+    SHAPES match TpchData._gen_orders_lineitem (a deliberate second
+    copy of those constants: the in-memory generator's single rng
+    stream cannot be chunked without changing every seeded dataset —
+    keep the two in sync when touching either); each chunk draws from
+    its own (seed, chunk) stream so memory is O(chunk), not O(sf).
+    Yields
+    column dicts in LINEITEM_SCHEMA layout; shared string dictionaries
+    populate into ``dicts``."""
+    n_orders = int(1_500_000 * sf)
+    n_part = max(int(200_000 * sf), 1)
+    n_supp = max(int(10_000 * sf), 1)
+    start = _days("1992-01-01")
+    end = _days("1998-08-02")
+    today = _days("1995-06-17")
+    rf_dict = dicts.for_column("l_returnflag")
+    rf_ids = np.array([rf_dict.add(b"R"), rf_dict.add(b"A"),
+                       rf_dict.add(b"N")], dtype=np.int32)
+    ls_dict = dicts.for_column("l_linestatus")
+    ls_ids = np.array([ls_dict.add(b"O"), ls_dict.add(b"F")],
+                      dtype=np.int32)
+    smd = dicts.for_column("l_shipmode")
+    sm_ids = np.array([smd.add(v) for v in SHIPMODES], dtype=np.int32)
+    sid = dicts.for_column("l_shipinstruct")
+    si_ids = np.array([sid.add(v) for v in INSTRUCTS], dtype=np.int32)
+    for c, off in enumerate(range(0, n_orders, chunk_orders)):
+        rng = np.random.default_rng((seed, c))
+        n_o = min(chunk_orders, n_orders - off)
+        o_orderkey = np.arange(off + 1, off + n_o + 1, dtype=np.int64)
+        o_orderdate = rng.integers(start, end + 1, n_o, dtype=np.int32)
+        lines = rng.integers(1, 8, n_o, dtype=np.int32)
+        n_li = int(lines.sum())
+        idx = np.repeat(np.arange(n_o), lines)
+        l_quantity = rng.integers(1, 51, n_li, dtype=np.int64) * 100
+        part_price = rng.integers(90_000, 110_001, n_li, dtype=np.int64)
+        l_extendedprice = (l_quantity // 100) * part_price // 100 * 100
+        ship_delay = rng.integers(1, 122, n_li, dtype=np.int32)
+        l_shipdate = o_orderdate[idx] + ship_delay
+        l_receiptdate = l_shipdate + rng.integers(
+            1, 31, n_li, dtype=np.int32)
+        ret = np.where(l_receiptdate > today, 2,
+                       rng.integers(0, 2, n_li))
+        yield {
+            "l_orderkey": o_orderkey[idx],
+            "l_partkey": rng.integers(1, n_part + 1, n_li,
+                                      dtype=np.int64),
+            "l_suppkey": rng.integers(1, n_supp + 1, n_li,
+                                      dtype=np.int64),
+            "l_linenumber": (
+                np.arange(n_li, dtype=np.int64)
+                - np.repeat(np.cumsum(lines) - lines, lines) + 1
+            ).astype(np.int32),
+            "l_quantity": l_quantity,
+            "l_extendedprice": l_extendedprice,
+            "l_discount": rng.integers(0, 11, n_li, dtype=np.int64),
+            "l_tax": rng.integers(0, 9, n_li, dtype=np.int64),
+            "l_returnflag": rf_ids[ret],
+            "l_linestatus": ls_ids[
+                (l_shipdate <= today).astype(np.int32)],
+            "l_shipdate": l_shipdate.astype(np.int32),
+            "l_commitdate": (o_orderdate[idx] + rng.integers(
+                30, 91, n_li, dtype=np.int32)).astype(np.int32),
+            "l_receiptdate": l_receiptdate.astype(np.int32),
+            "l_shipinstruct": si_ids[
+                rng.integers(0, len(INSTRUCTS), n_li)],
+            "l_shipmode": sm_ids[
+                rng.integers(0, len(SHIPMODES), n_li)],
+        }
+
+
 class TpchData:
     """Generated tables as host numpy column dicts + shared dictionaries."""
 
