@@ -51,10 +51,6 @@ class BlobStore:
         return len(self.get(blob_id))
 
 class MemBlobStore(BlobStore):
-    def size(self, blob_id: str) -> int:
-        with self._lock:
-            return len(self._data[blob_id])
-
     """In-memory store with a sorted key index: ``list(prefix)`` is
     O(log n + matches), not a full scan — every hot path above this
     (DSProxy versions, WAL replay ranges, portion listings) leans on
@@ -67,6 +63,10 @@ class MemBlobStore(BlobStore):
         self._data: dict[str, bytes] = {}
         self._keys: list[str] = []  # sorted key index
         self._lock = threading.Lock()
+
+    def size(self, blob_id: str) -> int:
+        with self._lock:
+            return len(self._data[blob_id])
 
     def put(self, blob_id, data):
         with self._lock:

@@ -42,6 +42,19 @@ from ydb_tpu.runtime.actors import ActorSystem, Envelope
 
 _HDR = struct.Struct("!I")
 
+# wire protocol version: gated in the hello handshake (the reference
+# gates compatibility in interconnect_handshake.cpp) — a peer speaking
+# a different version is REFUSED at session setup with an explicit
+# reason instead of failing cryptically mid-stream on an
+# unpicklable/renamed message class. Bump on incompatible changes to
+# the envelope or channel message formats.
+PROTOCOL_VERSION = 1
+
+
+class HandshakeRejected(OSError):
+    """Peer refused the session permanently (version mismatch): not a
+    transient failure — no reconnect/backoff, the session closes."""
+
 
 @dataclasses.dataclass
 class Undelivered:
@@ -138,6 +151,13 @@ class _Session:
                     _send_frame(self.sock, ("env", env.target, env.sender,
                                             env.message))
                     return
+                except HandshakeRejected as e:
+                    # permanent: close the session so later envelopes
+                    # fail fast instead of re-dialing a refusing peer
+                    self._drop()
+                    self._closed.set()
+                    self.ic._notify_undelivered(env, str(e))
+                    return
                 except OSError as e:
                     self._drop()
                     if attempt >= self.ic.max_retries:
@@ -151,13 +171,26 @@ class _Session:
         s.settimeout(self.ic.timeout)
         self.session_id += 1
         # the hello advertises our own listen port so the peer learns the
-        # reverse route from the same handshake (mutual discovery)
+        # reverse route from the same handshake (mutual discovery), and
+        # the protocol version so incompatible peers are refused HERE
         _send_frame(s, ("hello", self.ic.node, self.session_id,
-                        self.ic.port))
+                        self.ic.port, PROTOCOL_VERSION))
         resp = _recv_frame(s)
+        if isinstance(resp, tuple) and resp[0] == "reject":
+            s.close()
+            raise HandshakeRejected(
+                f"handshake rejected by {self.addr}: {resp[1]}")
         if not (isinstance(resp, tuple) and resp[0] == "hello"):
             s.close()
             raise OSError(f"bad handshake from {self.addr}: {resp!r}")
+        # the gate is MUTUAL: an old listener that accepted our hello
+        # still fails here if its own version differs
+        resp_ver = resp[4] if len(resp) > 4 else 0
+        if resp_ver != PROTOCOL_VERSION:
+            s.close()
+            raise HandshakeRejected(
+                f"peer {self.addr} speaks protocol {resp_ver}, "
+                f"we speak {PROTOCOL_VERSION}")
         self.sock = s
 
     def _drop(self) -> None:
@@ -268,11 +301,21 @@ class Interconnect:
             hello = _recv_frame(conn)
             if not (isinstance(hello, tuple) and hello[0] == "hello"):
                 return
+            peer_ver = hello[4] if len(hello) > 4 else 0
+            if peer_ver != PROTOCOL_VERSION:
+                # version gate (interconnect_handshake.cpp shape): an
+                # incompatible peer gets an explicit reject + reason
+                _send_frame(conn, (
+                    "reject",
+                    f"protocol version {peer_ver} != "
+                    f"{PROTOCOL_VERSION}"))
+                return
             peer_node, peer_port = hello[1], hello[3]
             if peer_port is not None:
                 # learn the reverse route (replies cross a new session)
                 self.add_peer(peer_node, conn.getpeername()[0], peer_port)
-            _send_frame(conn, ("hello", self.node, hello[2], self.port))
+            _send_frame(conn, ("hello", self.node, hello[2],
+                                self.port, PROTOCOL_VERSION))
             while not self._stop.is_set():
                 frame = _recv_frame(conn)
                 if frame is None:
