@@ -34,6 +34,14 @@ class AppConfig:
     scan_block_rows: int = 1 << 20
     compact_portion_threshold: int = 8
     checkpoint_interval: int = 64
+    # load-driven shard management (schemeshard__table_stats.cpp
+    # analog): a column table whose rows/shard exceed the split
+    # threshold doubles its shard count at the next background pass
+    # (0 disables); merges halve when rows/shard fall below
+    # threshold/8 (hysteresis against flapping)
+    split_rows_per_shard: int = 0
+    max_auto_shards: int = 64
+    min_auto_shards: int = 1  # MinPartitionsCount analog
     grpc_port: int = 2136
     data_dir: str | None = None
     auth_tokens: tuple = ()

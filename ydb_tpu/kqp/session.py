@@ -134,6 +134,9 @@ class Cluster:
         self.icb.register("rmw_retries", 5, 1, 100)
         self.icb.register("compact_portion_threshold",
                           self.config.compact_portion_threshold, 2, 1024)
+        self.icb.register("split_rows_per_shard",
+                          self.config.split_rows_per_shard,
+                          0, 1 << 40)
         self.dicts = DictionarySet()  # cluster-wide, shared by all tables
         self._plan_cache: OrderedDict = OrderedDict()
         self._plan_cache_size = (
@@ -373,7 +376,8 @@ class Cluster:
         ICB knobs apply here, so live tuning takes effect without a
         restart."""
         threshold = self.icb.get("compact_portion_threshold")
-        stats = {"cdc_shipped": 0, "compacted": 0}
+        stats = {"cdc_shipped": 0, "compacted": 0, "splits": 0,
+                 "merges": 0}
         for name, t in self.tables.items():
             topic = getattr(t, "changefeed_topic", None)
             if topic is not None:
@@ -384,7 +388,45 @@ class Cluster:
             if hasattr(t, "run_background"):
                 s = t.run_background()
                 stats["compacted"] += s.get("compacted", 0)
+        self._auto_reshard(stats)
         return stats
+
+    def _auto_reshard(self, stats: dict) -> None:
+        """Load-driven splits/merges from table statistics (the
+        schemeshard__table_stats.cpp policy, miniaturized): rows/shard
+        above the split threshold doubles shards; below threshold/8
+        (hysteresis) halves them. Generation-cutover resharding keeps
+        every step durable and query-transparent."""
+        split_at = self.icb.get("split_rows_per_shard")
+        if not split_at:
+            return
+        from ydb_tpu.obs.sysview import table_stats
+
+        for name, st in table_stats(self).items():
+            t = self.tables.get(name)
+            rows = st.get("rows")
+            if t is None or rows is None or not hasattr(t, "reshard"):
+                continue
+            if getattr(t, "upsert", False):
+                # cheap portion-metadata counts include superseded
+                # versions on upsert tables: acting on them would split
+                # on version churn, not logical size
+                continue
+            if rows == 0:
+                # empty = likely pre-split ahead of a bulk load; never
+                # collapse it (the reference guards the same case with
+                # MinPartitionsCount)
+                continue
+            n = len(t.shards)
+            per_shard = rows / n
+            floor = max(self.config.min_auto_shards, 1)
+            if per_shard > split_at and n < self.config.max_auto_shards:
+                self.reshard_table(name, min(n * 2,
+                                             self.config.max_auto_shards))
+                stats["splits"] += 1
+            elif n > floor and per_shard < split_at / 8:
+                self.reshard_table(name, max(n // 2, floor))
+                stats["merges"] += 1
 
     def health(self) -> dict:
         from ydb_tpu.obs.sysview import health_check
