@@ -262,3 +262,71 @@ def test_rate_limiter_service():
             rl.create_resource("bad", rate=0.0)
     finally:
         srv.stop(0)
+
+
+def test_monitoring_coordination_cms_auth_services():
+    """Four more reference gRPC services (10 of 17): Monitoring health,
+    Coordination (kesus sessions + counting semaphores with
+    contention), Cms dynamic config (versioned, stale-version refusal),
+    and Auth WhoAmI on open and token-authenticated clusters."""
+    from ydb_tpu.api.client import Driver
+    from ydb_tpu.api.server import make_server, pb
+    from ydb_tpu.kqp.session import Cluster
+
+    srv, port = make_server(Cluster(), 0)
+    srv.start()
+    try:
+        d = Driver(f"127.0.0.1:{port}")
+        h = d._call("/ydb_tpu.Monitoring/HealthCheck",
+                    pb.HealthCheckRequest(), pb.HealthCheckResponse)
+        assert h.status == "GOOD"
+        mk = pb.CoordSemaphoreRequest
+        s1 = d._call("/ydb_tpu.Coordination/CreateSession",
+                     pb.CoordSessionRequest(),
+                     pb.CoordSessionResponse).session_id
+        s2 = d._call("/ydb_tpu.Coordination/CreateSession",
+                     pb.CoordSessionRequest(),
+                     pb.CoordSessionResponse).session_id
+        d._call("/ydb_tpu.Coordination/CreateSemaphore",
+                mk(name="lock", limit=1), pb.CoordSemaphoreResponse)
+        acq = "/ydb_tpu.Coordination/AcquireSemaphore"
+        assert d._call(acq, mk(session_id=s1, name="lock", count=1),
+                       pb.CoordSemaphoreResponse).acquired
+        assert not d._call(acq, mk(session_id=s2, name="lock", count=1),
+                           pb.CoordSemaphoreResponse).acquired
+        desc = d._call("/ydb_tpu.Coordination/DescribeSemaphore",
+                       mk(name="lock"), pb.CoordSemaphoreResponse)
+        assert desc.count == 1 and desc.limit == 1
+        d._call("/ydb_tpu.Coordination/ReleaseSemaphore",
+                mk(session_id=s1, name="lock"),
+                pb.CoordSemaphoreResponse)
+        assert d._call(acq, mk(session_id=s2, name="lock", count=1),
+                       pb.CoordSemaphoreResponse).acquired
+        v = d._call("/ydb_tpu.Cms/SetConfig",
+                    pb.SetConfigRequest(yaml="n_shards: 8",
+                                        expect_version=-1),
+                    pb.SetConfigResponse)
+        assert not v.error and v.version == 1
+        g = d._call("/ydb_tpu.Cms/GetConfig", pb.GetConfigRequest(),
+                    pb.GetConfigResponse)
+        assert g.yaml.strip() == "n_shards: 8" and g.version == 1
+        stale = d._call("/ydb_tpu.Cms/SetConfig",
+                        pb.SetConfigRequest(yaml="n_shards: 2",
+                                            expect_version=0),
+                        pb.SetConfigResponse)
+        assert stale.error  # optimistic version check
+        w = d._call("/ydb_tpu.Auth/WhoAmI", pb.WhoAmIRequest(),
+                    pb.WhoAmIResponse)
+        assert not w.authenticated
+    finally:
+        srv.stop(0)
+
+    srv2, port2 = make_server(Cluster(), 0, auth_tokens={"tok1"})
+    srv2.start()
+    try:
+        d2 = Driver(f"127.0.0.1:{port2}", auth_token="tok1")
+        w2 = d2._call("/ydb_tpu.Auth/WhoAmI", pb.WhoAmIRequest(),
+                      pb.WhoAmIResponse)
+        assert w2.authenticated and w2.user == "tok1"
+    finally:
+        srv2.stop(0)

@@ -452,6 +452,162 @@ class RequestProxy:
             rate=desc["rate"], burst=desc["burst"],
             tokens=desc["tokens"])
 
+    # ---- Monitoring (ydb_monitoring analog over obs.sysview) ----
+
+    def health_check(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            h = self.cluster.health()
+        return pb.HealthCheckResponse(
+            status=h["status"],
+            issues=[pb.HealthIssue(message=i["message"],
+                                   component=i.get("component", ""),
+                                   severity=i.get("severity", ""))
+                    for i in h.get("issues", [])])
+
+    # ---- Coordination (kesus sessions + semaphores) ----
+
+    def _kesus(self):
+        if getattr(self.cluster, "_coord_kesus", None) is None:
+            from ydb_tpu.tablet.kesus import KesusTablet
+
+            self.cluster._coord_kesus = KesusTablet(
+                "coordination", self.cluster.store)
+        k = self.cluster._coord_kesus
+        # sweep expired sessions on every access: a dead client's
+        # semaphore holds release at its timeout, not never
+        k.tick()
+        return k
+
+    def coord_session(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            sid = self._kesus().attach_session(
+                timeout_s=request.timeout_s or 30.0)
+        return pb.CoordSessionResponse(session_id=sid)
+
+    def coord_create_semaphore(self, request, context):
+        self.check_auth(context)
+        if request.limit < 0:
+            return pb.CoordSemaphoreResponse(
+                error="limit must be positive")
+        try:
+            with self.lock:
+                self._kesus().create_semaphore(
+                    request.name, int(request.limit) or 1)
+        except Exception as e:  # noqa: BLE001
+            return pb.CoordSemaphoreResponse(error=str(e))
+        return pb.CoordSemaphoreResponse()
+
+    def coord_acquire(self, request, context):
+        self.check_auth(context)
+        if request.count < 0:
+            # a negative hold would INCREASE capacity for everyone else
+            return pb.CoordSemaphoreResponse(
+                error="count must be positive")
+        try:
+            with self.lock:
+                ok = self._kesus().acquire(
+                    request.session_id, request.name,
+                    count=int(request.count) or 1,
+                    timeout_s=request.timeout_s or 0.0)
+        except Exception as e:  # noqa: BLE001
+            return pb.CoordSemaphoreResponse(error=str(e))
+        return pb.CoordSemaphoreResponse(acquired=bool(ok))
+
+    def coord_release(self, request, context):
+        self.check_auth(context)
+        try:
+            with self.lock:
+                self._kesus().release(request.session_id, request.name)
+        except Exception as e:  # noqa: BLE001
+            return pb.CoordSemaphoreResponse(error=str(e))
+        return pb.CoordSemaphoreResponse()
+
+    def coord_describe(self, request, context):
+        self.check_auth(context)
+        try:
+            with self.lock:
+                d = self._kesus().describe(request.name)
+        except KeyError:
+            return pb.CoordSemaphoreResponse(
+                error=f"no semaphore {request.name}")
+        except Exception as e:  # noqa: BLE001
+            return pb.CoordSemaphoreResponse(error=str(e))
+        return pb.CoordSemaphoreResponse(
+            count=sum(d.get("owners", {}).values()),
+            limit=d.get("limit", 0),
+            waiters=[int(w) for w in d.get("waiters", [])],
+            owners=[int(o) for o in d.get("owners", {})])
+
+    def coord_ping(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            ok = self._kesus().ping_session(request.session_id)
+        return pb.CoordSessionResponse(
+            session_id=request.session_id,
+            error="" if ok else "unknown session")
+
+    def coord_detach(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            self._kesus().detach_session(request.session_id)
+        return pb.CoordSessionResponse(session_id=request.session_id)
+
+    # ---- Cms (dynamic config over runtime.console) ----
+
+    def _console(self):
+        if getattr(self.cluster, "console", None) is None:
+            from ydb_tpu.runtime.console import Console
+
+            self.cluster.console = Console(self.cluster.store)
+            # accepted configs must APPLY, not just persist: a
+            # subscriber pushes the resolved knobs into the running
+            # cluster (the ConfigsDispatcher contract)
+            proxy = self
+
+            class _Apply:
+                # Console._notify calls subscriber._deliver(console)
+                # (the ConfigsDispatcher contract)
+                def _deliver(self, _console):
+                    proxy._apply_config()
+
+            self.cluster.console.subscribe(_Apply())
+        return self.cluster.console
+
+    def _apply_config(self):
+        cfg = self.cluster.console.resolve()
+        self.cluster.n_shards = cfg.n_shards
+        self.cluster.icb.set("compact_portion_threshold",
+                             cfg.compact_portion_threshold)
+        self.cluster.icb.set("split_rows_per_shard",
+                             cfg.split_rows_per_shard)
+
+    def cms_get_config(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            yaml_text, ver = self._console().get_config()
+        return pb.GetConfigResponse(yaml=yaml_text or "", version=ver)
+
+    def cms_set_config(self, request, context):
+        self.check_auth(context)
+        try:
+            with self.lock:
+                expect = (None if request.expect_version == -1
+                          else int(request.expect_version))
+                ver = self._console().set_config(
+                    request.yaml, expected_version=expect)
+        except Exception as e:  # noqa: BLE001
+            return pb.SetConfigResponse(error=str(e))
+        return pb.SetConfigResponse(version=ver)
+
+    # ---- Auth ----
+
+    def who_am_i(self, request, context):
+        principal = self.check_auth(context)
+        return pb.WhoAmIResponse(user=principal or "",
+                                 authenticated=principal is not None)
+
     # ---- Discovery ----
 
     def list_endpoints(self, request, context):
@@ -510,6 +666,39 @@ _SERVICES = {
         "DescribeResource": ("describe_resource",
                              pb.DescribeResourceRequest,
                              pb.DescribeResourceResponse),
+    },
+    "ydb_tpu.Monitoring": {
+        "HealthCheck": ("health_check", pb.HealthCheckRequest,
+                        pb.HealthCheckResponse),
+    },
+    "ydb_tpu.Coordination": {
+        "CreateSession": ("coord_session", pb.CoordSessionRequest,
+                          pb.CoordSessionResponse),
+        "CreateSemaphore": ("coord_create_semaphore",
+                            pb.CoordSemaphoreRequest,
+                            pb.CoordSemaphoreResponse),
+        "AcquireSemaphore": ("coord_acquire",
+                             pb.CoordSemaphoreRequest,
+                             pb.CoordSemaphoreResponse),
+        "ReleaseSemaphore": ("coord_release",
+                             pb.CoordSemaphoreRequest,
+                             pb.CoordSemaphoreResponse),
+        "DescribeSemaphore": ("coord_describe",
+                              pb.CoordSemaphoreRequest,
+                              pb.CoordSemaphoreResponse),
+        "PingSession": ("coord_ping", pb.CoordSessionRequest,
+                        pb.CoordSessionResponse),
+        "DeleteSession": ("coord_detach", pb.CoordSessionRequest,
+                          pb.CoordSessionResponse),
+    },
+    "ydb_tpu.Cms": {
+        "GetConfig": ("cms_get_config", pb.GetConfigRequest,
+                      pb.GetConfigResponse),
+        "SetConfig": ("cms_set_config", pb.SetConfigRequest,
+                      pb.SetConfigResponse),
+    },
+    "ydb_tpu.Auth": {
+        "WhoAmI": ("who_am_i", pb.WhoAmIRequest, pb.WhoAmIResponse),
     },
     "ydb_tpu.Discovery": {
         "ListEndpoints": ("list_endpoints", pb.ListEndpointsRequest,
