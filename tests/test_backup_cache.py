@@ -108,3 +108,47 @@ def test_page_cache_under_shard_scan(tmp_path):
     s = cache.stats()
     assert s["misses"] == miss_after_first  # second scan: all cached
     assert s["hits"] > 0
+
+
+def test_page_cache_memory_pressure():
+    """shared_sausagecache memory-pressure contract (VERDICT r4
+    missing 8): above the high watermark the cache budget halves and
+    evicts to fit; when pressure clears it grows back toward the
+    configured capacity; reads stay correct throughout."""
+    from ydb_tpu.engine.blobs import CachedBlobStore, MemBlobStore
+
+    base = MemBlobStore()
+    cache = CachedBlobStore(base, capacity_bytes=10_000)
+    for i in range(20):
+        base.put(f"b{i}", bytes([i]) * 400)
+    for i in range(20):
+        assert cache.get(f"b{i}") == bytes([i]) * 400
+    assert cache._bytes > 5_000
+    assert cache.react_to_pressure(0.9) == "shrink"
+    assert cache.capacity_bytes == 5_000 and cache._bytes <= 5_000
+    assert cache.react_to_pressure(0.9) == "shrink"  # keeps halving
+    assert cache.capacity_bytes == 4_096  # floor
+    # reads still correct under the shrunken budget
+    for i in range(20):
+        assert cache.get(f"b{i}") == bytes([i]) * 400
+    assert cache.react_to_pressure(0.5) == "grow"
+    assert cache.capacity_bytes == 8_192
+    assert cache.react_to_pressure(0.5) == "grow"
+    assert cache.capacity_bytes == 10_000  # capped at configured
+    assert cache.react_to_pressure(0.5) == "steady"
+    assert cache.react_to_pressure(0.7) == "steady"  # hysteresis band
+
+
+def test_cluster_background_reacts_to_memory_pressure():
+    import jax  # noqa: F401  (conftest pinned cpu)
+
+    from ydb_tpu.config import AppConfig
+    from ydb_tpu.engine.blobs import CachedBlobStore, MemBlobStore
+    from ydb_tpu.kqp.session import Cluster
+
+    cache = CachedBlobStore(MemBlobStore(), capacity_bytes=1 << 20)
+    c = Cluster(store=cache,
+                config=AppConfig(memory_soft_limit_bytes=1))  # ~inf RSS
+    st = c.run_background()
+    assert st["cache_pressure"] == "shrink"
+    assert cache.capacity_bytes < (1 << 20)

@@ -42,6 +42,9 @@ class AppConfig:
     split_rows_per_shard: int = 0
     max_auto_shards: int = 64
     min_auto_shards: int = 1  # MinPartitionsCount analog
+    # page-cache memory pressure: run_background shrinks the
+    # shared cache as RSS nears this soft limit (0 disables)
+    memory_soft_limit_bytes: int = 0
     grpc_port: int = 2136
     data_dir: str | None = None
     auth_tokens: tuple = ()

@@ -172,6 +172,9 @@ class CachedBlobStore(BlobStore):
 
         self.base = base
         self.capacity_bytes = capacity_bytes
+        # the operator-configured budget: the grow ceiling for
+        # pressure recovery; explicit resize() re-bases it
+        self._configured_capacity = capacity_bytes
         self._lru: "OrderedDict[tuple, bytes]" = OrderedDict()
         self._by_blob: dict[str, set] = {}  # blob_id -> cached keys
         self._bytes = 0
@@ -211,14 +214,49 @@ class CachedBlobStore(BlobStore):
             self._lru[key] = data
             self._by_blob.setdefault(key[0], set()).add(key)
             self._bytes += len(data)
-            while self._bytes > self.capacity_bytes:
-                k, evicted = self._lru.popitem(last=False)
-                self._bytes -= len(evicted)
-                keys = self._by_blob.get(k[0])
-                if keys is not None:
-                    keys.discard(k)
-                    if not keys:
-                        del self._by_blob[k[0]]
+            self._evict_to_fit()
+
+    def _evict_to_fit(self) -> None:
+        """LRU eviction to the budget (caller holds the lock)."""
+        while self._bytes > self.capacity_bytes and self._lru:
+            k, evicted = self._lru.popitem(last=False)
+            self._bytes -= len(evicted)
+            keys = self._by_blob.get(k[0])
+            if keys is not None:
+                keys.discard(k)
+                if not keys:
+                    del self._by_blob[k[0]]
+
+    def resize(self, capacity_bytes: int,
+               rebase: bool = True) -> None:
+        """Shrink/grow the byte budget, evicting LRU pages to fit.
+        ``rebase`` (an explicit operator resize) moves the configured
+        grow ceiling too; pressure reactions pass rebase=False."""
+        with self._lock:
+            self.capacity_bytes = max(0, capacity_bytes)
+            if rebase:
+                self._configured_capacity = self.capacity_bytes
+            self._evict_to_fit()
+
+    def react_to_pressure(self, used_fraction: float,
+                          high: float = 0.85,
+                          low: float = 0.6) -> str:
+        """Memory-pressure integration (the shared_sausagecache +
+        memory-controller contract, shared_sausagecache.cpp:194):
+        above the ``high`` watermark the budget HALVES (floor 4 KiB);
+        below ``low`` it doubles back toward the configured maximum.
+        Returns "shrink" | "grow" | "steady" for observability."""
+        if used_fraction > high:
+            self.resize(max(self.capacity_bytes // 2, 4096),
+                        rebase=False)
+            return "shrink"
+        if used_fraction < low and \
+                self.capacity_bytes < self._configured_capacity:
+            self.resize(min(self.capacity_bytes * 2,
+                            self._configured_capacity),
+                        rebase=False)
+            return "grow"
+        return "steady"
 
     def _invalidate(self, blob_id: str):
         with self._lock:

@@ -65,6 +65,36 @@ def _parse_type(t: str) -> dtypes.LogicalType:
     raise PlanError(f"unknown type {t}")
 
 
+def _find_page_cache(store, depth: int = 4):
+    """Locate a pressure-reactive page cache in a (possibly wrapped)
+    store: walks common wrapper attributes (CachedBlobStore.base,
+    tiered hot/cold, failpoint inner)."""
+    if store is None or depth < 0:
+        return None
+    if hasattr(store, "react_to_pressure"):
+        return store
+    for attr in ("base", "hot", "cold", "inner", "store"):
+        found = _find_page_cache(getattr(store, attr, None), depth - 1)
+        if found is not None:
+            return found
+    return None
+
+
+def _process_rss() -> int:
+    """Current resident set size in bytes (Linux /proc; real page
+    size). 0 when unreadable — pressure reaction then stays idle
+    rather than acting on a lying number (ru_maxrss is PEAK, not
+    current, and platform-dependent in units)."""
+    try:
+        import resource
+
+        with open("/proc/self/statm") as f:
+            return (int(f.read().split()[1])
+                    * resource.getpagesize())
+    except OSError:
+        return 0
+
+
 class Cluster:
     """Storage + schema tablet + plan cache: one in-process database.
 
@@ -389,6 +419,15 @@ class Cluster:
                 s = t.run_background()
                 stats["compacted"] += s.get("compacted", 0)
         self._auto_reshard(stats)
+        # memory pressure: when the store is (or wraps) a shared page
+        # cache, shrink its budget as process RSS approaches the soft
+        # limit and restore it when pressure clears
+        cache = _find_page_cache(self.store)
+        limit = getattr(self.config, "memory_soft_limit_bytes", 0)
+        rss = _process_rss()
+        if cache is not None and limit and rss:
+            stats["cache_pressure"] = cache.react_to_pressure(
+                rss / limit)
         return stats
 
     def _auto_reshard(self, stats: dict) -> None:
