@@ -319,10 +319,16 @@ def main():
 
     import jax
 
-    if tpu_unavailable or platform == "cpu(forced)":
+    if tpu_unavailable or platform in ("cpu", "cpu(forced)"):
         # sitecustomize ignores JAX_PLATFORMS env; only the config
         # update after import works in this environment
         jax.config.update("jax_platforms", "cpu")
+        if "YDB_TPU_BENCH_SF" not in os.environ:
+            # the default SF is sized for the chip; a CPU fallback at
+            # SF-10 would blow any sane wall-clock budget. Rates are
+            # per-row, so the smaller run stays comparable.
+            sf = 1.0
+            _log("cpu fallback: kernel tier auto-reduced to sf=1")
 
     from ydb_tpu.engine.blobs import DirBlobStore
     from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
