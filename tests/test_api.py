@@ -330,3 +330,65 @@ def test_monitoring_coordination_cms_auth_services():
         assert w2.authenticated and w2.user == "tok1"
     finally:
         srv2.stop(0)
+
+
+def test_operation_service_async_export():
+    """Operation service (11th of 17; ydb_operation analog): async
+    export returns an operation id immediately; polling reaches ready
+    with the result; list shows it; cancel forgets finished ops and
+    refuses unknown ids."""
+    import time
+
+    from ydb_tpu.api.client import Driver
+    from ydb_tpu.api.server import make_server, pb
+    from ydb_tpu.kqp.session import Cluster
+
+    srv, port = make_server(Cluster(), 0)
+    srv.start()
+    try:
+        d = Driver(f"127.0.0.1:{port}")
+        q = d.query_client()
+        q.execute("CREATE TABLE t (id int64, v int64, PRIMARY KEY (id))")
+        q.execute("INSERT INTO t VALUES (1, 1), (2, 2)")
+        resp = d._call("/ydb_tpu.Export/ExportBackup",
+                       pb.ExportRequest(table="t", name="snap",
+                                        async_op=True),
+                       pb.ExportResponse)
+        assert resp.operation_id and not resp.error
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = d._call("/ydb_tpu.Operation/GetOperation",
+                         pb.GetOperationRequest(id=resp.operation_id),
+                         pb.OperationStatus)
+            if st.ready:
+                break
+            time.sleep(0.02)
+        assert st.ready and not st.error and st.rows == 2
+        lst = d._call("/ydb_tpu.Operation/ListOperations",
+                      pb.ListOperationsRequest(),
+                      pb.ListOperationsResponse)
+        assert any(o.id == resp.operation_id for o in lst.operations)
+        # async failure surfaces on poll, not as an RPC error
+        bad = d._call("/ydb_tpu.Export/ExportBackup",
+                      pb.ExportRequest(table="nope", async_op=True),
+                      pb.ExportResponse)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st2 = d._call("/ydb_tpu.Operation/GetOperation",
+                          pb.GetOperationRequest(id=bad.operation_id),
+                          pb.OperationStatus)
+            if st2.ready:
+                break
+            time.sleep(0.02)
+        assert st2.ready and "unknown table" in st2.error
+        # cancel: forgets finished, refuses unknown
+        gone = d._call("/ydb_tpu.Operation/CancelOperation",
+                       pb.CancelOperationRequest(id=resp.operation_id),
+                       pb.OperationStatus)
+        assert not gone.error
+        miss = d._call("/ydb_tpu.Operation/GetOperation",
+                       pb.GetOperationRequest(id=resp.operation_id),
+                       pb.OperationStatus)
+        assert miss.error == "unknown operation"
+    finally:
+        srv.stop(0)

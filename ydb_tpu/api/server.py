@@ -45,6 +45,10 @@ class RequestProxy:
         # serializes on this lock
         self.lock = threading.Lock()
         self.endpoints: tuple = ()
+        # long-running operations (Operation service)
+        self._operations: dict = {}
+        self._op_lock = threading.Lock()
+        self._op_seq = 0
 
     def check_auth(self, context) -> str | None:
         """Validates the ticket; returns it (the ACL principal) when
@@ -294,8 +298,7 @@ class RequestProxy:
 
     # ---- Export/Import (ydb_export/ydb_import analog) ----
 
-    def export_backup(self, request, context):
-        self.check_auth(context)
+    def _run_export(self, table: str, name: str) -> dict:
         from ydb_tpu.engine.backup import export_table
         from ydb_tpu.tx import ShardedTable
 
@@ -304,18 +307,95 @@ class RequestProxy:
         # (compaction/GC under run_background), and the miniature
         # prefers a stalled RPC to a torn read
         with self.lock:
-            t = self.cluster.tables.get(request.table)
+            t = self.cluster.tables.get(table)
             if t is None:
-                return pb.ExportResponse(
-                    error=f"unknown table {request.table}")
+                raise ValueError(f"unknown table {table}")
             if not isinstance(t, ShardedTable):
-                return pb.ExportResponse(
-                    error="export supports column-store tables")
-            man = export_table(t, self.cluster.store,
-                               request.name or request.table)
+                raise ValueError("export supports column-store tables")
+            return export_table(t, self.cluster.store, name or table)
+
+    def export_backup(self, request, context):
+        self.check_auth(context)
+        if request.async_op:
+            op_id = self._start_operation(
+                "export", self._run_export, request.table,
+                request.name)
+            return pb.ExportResponse(operation_id=op_id)
+        try:
+            man = self._run_export(request.table, request.name)
+        except ValueError as e:
+            return pb.ExportResponse(error=str(e))
         return pb.ExportResponse(rows=man["rows"],
                                  parts=len(man["parts"]),
                                  snapshot=man["snapshot"])
+
+    # ---- Operation service (long-running ops, ydb_operation analog) --
+
+    def _start_operation(self, kind: str, fn, *args) -> str:
+        with self._op_lock:
+            self._op_seq += 1
+            op_id = f"op-{self._op_seq}"
+            st = {"id": op_id, "kind": kind, "ready": False,
+                  "error": "", "result": None}
+            self._operations[op_id] = st
+            # bounded like the session map: forget the oldest FINISHED
+            # ops so clients that never CancelOperation cannot grow
+            # memory without limit
+            if len(self._operations) > 1024:
+                for old_id in [k for k, v in self._operations.items()
+                               if v["ready"]][:len(self._operations)
+                                              - 1024]:
+                    del self._operations[old_id]
+
+        def run():
+            try:
+                st["result"] = fn(*args)
+            except Exception as e:  # noqa: BLE001 - surfaced on poll
+                st["error"] = str(e)
+            st["ready"] = True
+
+        threading.Thread(target=run, daemon=True).start()
+        return op_id
+
+    def _op_status(self, st) -> "pb.OperationStatus":
+        rows = 0
+        if st["ready"] and st["result"] is not None:
+            rows = st["result"].get("rows", 0)
+        return pb.OperationStatus(id=st["id"], ready=st["ready"],
+                                  error=st["error"], rows=rows,
+                                  kind=st["kind"])
+
+    def get_operation(self, request, context):
+        self.check_auth(context)
+        with self._op_lock:
+            st = self._operations.get(request.id)
+        if st is None:
+            return pb.OperationStatus(id=request.id,
+                                      error="unknown operation")
+        return self._op_status(st)
+
+    def list_operations(self, request, context):
+        self.check_auth(context)
+        with self._op_lock:
+            sts = list(self._operations.values())
+        return pb.ListOperationsResponse(
+            operations=[self._op_status(st) for st in sts])
+
+    def cancel_operation(self, request, context):
+        """Forget a finished operation (running exports hold the
+        cluster lock and complete; cancellation is bookkeeping, as for
+        most of the reference's non-cancellable op kinds)."""
+        self.check_auth(context)
+        with self._op_lock:
+            st = self._operations.get(request.id)
+            if st is None:
+                return pb.OperationStatus(id=request.id,
+                                          error="unknown operation")
+            if st["ready"]:
+                del self._operations[request.id]
+                return self._op_status(st)
+        return pb.OperationStatus(id=request.id,
+                                  error="operation still running")
 
     def import_backup(self, request, context):
         """Restore a backup as a CLUSTER table: scheme entry created,
@@ -666,6 +746,15 @@ _SERVICES = {
         "DescribeResource": ("describe_resource",
                              pb.DescribeResourceRequest,
                              pb.DescribeResourceResponse),
+    },
+    "ydb_tpu.Operation": {
+        "GetOperation": ("get_operation", pb.GetOperationRequest,
+                         pb.OperationStatus),
+        "ListOperations": ("list_operations", pb.ListOperationsRequest,
+                           pb.ListOperationsResponse),
+        "CancelOperation": ("cancel_operation",
+                            pb.CancelOperationRequest,
+                            pb.OperationStatus),
     },
     "ydb_tpu.Monitoring": {
         "HealthCheck": ("health_check", pb.HealthCheckRequest,
