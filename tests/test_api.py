@@ -392,3 +392,39 @@ def test_operation_service_async_export():
         assert miss.error == "unknown operation"
     finally:
         srv.stop(0)
+
+
+def test_scripting_service():
+    """Scripting service (12th of 17): a multi-statement script runs in
+    one session, aborts at the first error with per-statement status,
+    and returns the final SELECT as arrow IPC."""
+    from ydb_tpu.api.arrow_io import ipc_to_table
+    from ydb_tpu.api.client import Driver
+    from ydb_tpu.api.server import make_server, pb
+    from ydb_tpu.kqp.session import Cluster
+
+    srv, port = make_server(Cluster(), 0)
+    srv.start()
+    try:
+        d = Driver(f"127.0.0.1:{port}")
+        r = d._call("/ydb_tpu.Scripting/ExecuteScript",
+                    pb.ExecuteScriptRequest(script=(
+                        "CREATE TABLE t (id int64, v int64, "
+                        "PRIMARY KEY (id)); "
+                        "INSERT INTO t VALUES (1, 10), (2, 20); "
+                        "SELECT t.v AS v FROM t ORDER BY v")),
+                    pb.ExecuteScriptResponse)
+        assert not r.error and len(r.statements) == 3
+        assert ipc_to_table(r.last_result_ipc).to_pydict() == {
+            "v": [10, 20]}
+        bad = d._call("/ydb_tpu.Scripting/ExecuteScript",
+                      pb.ExecuteScriptRequest(script=(
+                          "INSERT INTO t VALUES (3, 30); "
+                          "SELECT nope FROM t; "
+                          "INSERT INTO t VALUES (4, 40)")),
+                      pb.ExecuteScriptResponse)
+        assert bad.error and len(bad.statements) == 2  # aborted at 2nd
+        out = d.query_client().execute("SELECT COUNT(*) AS n FROM t")
+        assert out.to_pydict()["n"] == [3]  # 3rd stmt never ran
+    finally:
+        srv.stop(0)

@@ -329,6 +329,56 @@ class RequestProxy:
                                  parts=len(man["parts"]),
                                  snapshot=man["snapshot"])
 
+    def execute_script(self, request, context):
+        """Multi-statement script in ONE session (ydb_scripting shape):
+        statements run in order, the script aborts at the first error
+        (pg simple-query semantics), and the final SELECT's result
+        ships back as arrow IPC."""
+        principal = self.check_auth(context)
+        session = self.cluster.session()
+        session.principal = principal
+        results = []
+        last_ipc = b""
+        try:
+            for stmt in _split_script(request.script):
+                try:
+                    with self.lock:
+                        out = session.execute(stmt)
+                except Exception as e:  # noqa: BLE001 - abort script
+                    results.append(pb.ScriptStatementResult(
+                        sql=stmt[:128], error=str(e)))
+                    return pb.ExecuteScriptResponse(
+                        error=f"{stmt[:64]}: {e}", statements=results)
+                if isinstance(out, TxResult) and not out.committed:
+                    # a failed COMMIT raises nothing — it reports; the
+                    # script must still abort, not claim success
+                    err = out.error or "not committed"
+                    results.append(pb.ScriptStatementResult(
+                        sql=stmt[:128], error=err))
+                    return pb.ExecuteScriptResponse(
+                        error=f"{stmt[:64]}: {err}",
+                        statements=results)
+                if isinstance(out, OracleTable):
+                    rows = out.num_rows
+                    last_ipc = oracle_to_ipc(out)
+                else:
+                    rows = 0
+                results.append(pb.ScriptStatementResult(
+                    sql=stmt[:128], rows=rows))
+        finally:
+            tx_open = session._tx is not None
+            if tx_open:
+                # an open interactive tx would silently drop buffered
+                # writes AND leak its shard locks: roll it back
+                with self.lock:
+                    session._tx_release()
+        if tx_open:
+            return pb.ExecuteScriptResponse(
+                error="script ended with an open transaction "
+                      "(rolled back)", statements=results)
+        return pb.ExecuteScriptResponse(statements=results,
+                                        last_result_ipc=last_ipc)
+
     # ---- Operation service (long-running ops, ydb_operation analog) --
 
     def _start_operation(self, kind: str, fn, *args) -> str:
@@ -698,6 +748,38 @@ class RequestProxy:
         ])
 
 
+def _split_script(script: str) -> list[str]:
+    """';'-split OUTSIDE single-quoted literals ('' escapes stay
+    inside, matching the SQL tokenizer)."""
+    out, buf, in_str = [], [], False
+    i = 0
+    while i < len(script):
+        ch = script[i]
+        if in_str:
+            if ch == "'":
+                if i + 1 < len(script) and script[i + 1] == "'":
+                    buf.append("''")
+                    i += 2
+                    continue
+                in_str = False
+            buf.append(ch)
+        elif ch == "'":
+            in_str = True
+            buf.append(ch)
+        elif ch == ";":
+            stmt = "".join(buf).strip()
+            if stmt:
+                out.append(stmt)
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    stmt = "".join(buf).strip()
+    if stmt:
+        out.append(stmt)
+    return out
+
+
 def _ancestors(path: str) -> list[str]:
     parts = path.split("/")
     return ["/".join(parts[:i]) for i in range(1, len(parts))]
@@ -746,6 +828,10 @@ _SERVICES = {
         "DescribeResource": ("describe_resource",
                              pb.DescribeResourceRequest,
                              pb.DescribeResourceResponse),
+    },
+    "ydb_tpu.Scripting": {
+        "ExecuteScript": ("execute_script", pb.ExecuteScriptRequest,
+                          pb.ExecuteScriptResponse),
     },
     "ydb_tpu.Operation": {
         "GetOperation": ("get_operation", pb.GetOperationRequest,
