@@ -1,0 +1,322 @@
+"""Table / KeyValue / FederationDiscovery gRPC services (the last of
+the reference's 16 public API services: ydb_table_v1.proto —
+rpc_create_table/rpc_execute_data_query/rpc_load_rows/rpc_read_table;
+ydb_keyvalue_v1.proto; ydb_federation_discovery_v1.proto)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ydb_tpu.api.client import ApiError, Driver
+from ydb_tpu.api.server import make_server
+from ydb_tpu.kqp.session import Cluster
+
+
+@pytest.fixture
+def served():
+    cluster = Cluster()
+    server, port = make_server(cluster, port=0)
+    server.start()
+    driver = Driver(f"127.0.0.1:{port}")
+    yield cluster, driver
+    driver.close()
+    server.stop(0)
+
+
+def test_table_ddl_lifecycle(served):
+    _cluster, driver = served
+    t = driver.table_client()
+    t.create_table(
+        "orders",
+        [("id", "int64", True), ("who", "string", False),
+         ("amt", "float64", False)],
+        primary_key=["id"], store="column", shards=2)
+    d = driver.scheme_client().describe_table("/orders")
+    assert d.shards == 2 and d.store == "column"
+    ver = t.alter_table("orders", [("note", "string")])
+    assert ver > 1
+    d2 = driver.scheme_client().describe_table("/orders")
+    assert "note" in [c.name for c in d2.columns]
+    # duplicate create surfaces as an error, not a crash
+    with pytest.raises(ApiError):
+        t.create_table("orders", [("id", "int64", True)],
+                       primary_key=["id"])
+    t.drop_table("orders")
+    with pytest.raises(ApiError):
+        driver.scheme_client().describe_table("/orders")
+
+
+def test_execute_data_query_tx_control(served):
+    _cluster, driver = served
+    t = driver.table_client()
+    t.create_table("acct", [("id", "int64", True),
+                            ("bal", "int64", False)],
+                   primary_key=["id"], store="row")
+    (_, committed), tx = t.execute(
+        "INSERT INTO acct VALUES (1, 100), (2, 50)")
+    assert committed and tx == ""
+    # interactive tx: begin -> statements under tx_id -> commit
+    _, tx = t.execute("UPDATE acct SET bal = bal - 30 WHERE id = 1",
+                      begin=True)
+    assert tx
+    # another session sees nothing while the tx is open
+    other = driver.table_client()
+    out, _ = other.execute("SELECT bal FROM acct ORDER BY id")
+    assert out.column("bal").to_pylist() == [100, 50]
+    (_, committed), tx3 = t.execute(
+        "UPDATE acct SET bal = bal + 30 WHERE id = 2",
+        tx_id=tx, commit=True)
+    assert committed and tx3 == ""
+    out, _ = other.execute("SELECT bal FROM acct ORDER BY id")
+    assert out.column("bal").to_pylist() == [70, 80]
+    # unknown tx id is rejected
+    with pytest.raises(ApiError):
+        t.execute("SELECT 1 AS one", tx_id="tx-999")
+
+
+def test_bulk_upsert_and_stream_read(served):
+    _cluster, driver = served
+    t = driver.table_client()
+    t.create_table("ev", [("id", "int64", True),
+                          ("tag", "string", False),
+                          ("v", "float64", False)],
+                   primary_key=["id"], store="column", shards=2)
+    n = 10_000
+    at = pa.table({
+        "id": pa.array(np.arange(n, dtype=np.int64)),
+        "tag": pa.array([f"t{i % 7}" for i in range(n)]),
+        "v": pa.array(np.linspace(0.0, 1.0, n)),
+    })
+    assert t.bulk_upsert("ev", at) == n
+    out, _ = t.execute("SELECT count(*) AS c, sum(v) AS s FROM ev")
+    assert out.column("c").to_pylist() == [n]
+    assert abs(out.column("s").to_pylist()[0] - n / 2) < 1.0
+    # streaming ReadTable: batches reassemble to the full table
+    got = pa.concat_tables(
+        t.read_table("ev", columns=["id", "tag"], batch_rows=2048))
+    assert got.num_rows == n
+    assert sorted(got.column("id").to_pylist()) == list(range(n))
+    assert got.column("tag").to_pylist()[:3] is not None
+    # error path: unknown table
+    with pytest.raises(ApiError):
+        list(t.read_table("nope"))
+    with pytest.raises(ApiError):
+        t.bulk_upsert("nope", at)
+    # missing column rejected
+    with pytest.raises(ApiError):
+        t.bulk_upsert("ev", at.drop_columns(["v"]))
+
+
+def test_copy_table_and_explain(served):
+    _cluster, driver = served
+    t = driver.table_client()
+    t.create_table("src", [("id", "int64", True),
+                           ("name", "string", False)],
+                   primary_key=["id"], store="column")
+    t.execute("INSERT INTO src VALUES (1, 'a'), (2, 'b'), (3, 'c')")
+    assert t.copy_table("src", "dst") == 3
+    out, _ = t.execute("SELECT id, name FROM dst ORDER BY id")
+    assert out.column("name").to_pylist() == ["a", "b", "c"]
+    # source unchanged, independent afterwards
+    t.execute("INSERT INTO dst VALUES (4, 'd')")
+    out, _ = t.execute("SELECT count(*) AS c FROM src")
+    assert out.column("c").to_pylist() == [3]
+    plan = t.explain("SELECT id FROM src WHERE id = 2")
+    assert "src" in plan
+    t.close()
+
+
+def test_keyvalue_service(served):
+    cluster, driver = served
+    kv = driver.keyvalue_client()
+    kv.create_volume("vol1")
+    with pytest.raises(ApiError):
+        kv.create_volume("vol1")  # duplicate
+    kv.write("vol1", "a", b"1")
+    kv.write("vol1", "b", b"2")
+    kv.write("vol1", "c", b"3")
+    assert kv.read("vol1", "b") == b"2"
+    assert kv.read("vol1", "nope") is None
+    assert kv.list_range("vol1", "a", "c") == [("a", b"1"),
+                                               ("b", b"2")]
+    assert kv.rename("vol1", "b", "bb")
+    assert kv.read("vol1", "bb") == b"2"
+    assert kv.delete_range("vol1", "a", "b") == 1
+    assert kv.read("vol1", "a") is None
+    with pytest.raises(ApiError):
+        kv.write("ghost", "k", b"v")
+
+    # durability: a NEW proxy over the same store still sees the data
+    server2, port2 = make_server(cluster, port=0)
+    server2.start()
+    d2 = Driver(f"127.0.0.1:{port2}")
+    try:
+        kv2 = d2.keyvalue_client()
+        assert kv2.read("vol1", "bb") == b"2"
+        assert kv2.read("vol1", "c") == b"3"
+        kv2.drop_volume("vol1")
+        with pytest.raises(ApiError):
+            kv2.read("vol1", "bb")
+    finally:
+        d2.close()
+        server2.stop(0)
+
+
+def test_federation_discovery(served):
+    _cluster, driver = served
+    dbs = driver.federation_databases()
+    assert len(dbs) == 1
+    assert dbs[0]["status"] == "AVAILABLE"
+    assert dbs[0]["endpoint"].startswith("127.0.0.1:")
+
+
+def test_copy_table_decimal_roundtrip(served):
+    """DescribeTable->CreateTable type round-trip including decimal
+    (type_to_str's 'decimal(s)' is schema-JSON, not DDL — the copy
+    path must emit a DDL-parseable spelling)."""
+    _cluster, driver = served
+    t = driver.table_client()
+    t.create_table("px", [("id", "int64", True),
+                          ("amt", "decimal(10,2)", False),
+                          ("w", "float64", False)],
+                   primary_key=["id"], store="column")
+    t.execute("INSERT INTO px VALUES (1, 12.50, 0.5), (2, 0.75, 1.5)")
+    assert t.copy_table("px", "px2") == 2
+    out, _ = t.execute("SELECT amt, w FROM px2 ORDER BY id")
+    import decimal
+
+    assert out.column("amt").to_pylist() == [
+        decimal.Decimal("12.50"), decimal.Decimal("0.75")]
+    assert out.column("w").to_pylist() == [0.5, 1.5]
+
+
+def test_table_service_enforces_acls():
+    """The structured Table API honours path ACLs exactly as the SQL
+    front door (principal-less internal sessions are ACL-exempt, so
+    every handler must bind the ticket's principal)."""
+    cluster = Cluster()
+    s = cluster.session()
+    s.execute("CREATE TABLE sec (id int64, v int64, PRIMARY KEY (id)) "
+              "WITH (store = column)")
+    s.execute("INSERT INTO sec VALUES (1, 10)")
+    cluster.scheme.grant("/sec", "alice", ["read", "write"])
+    cluster.scheme.grant("/", "admin", "full")
+    server, port = make_server(cluster, port=0,
+                               auth_tokens={"alice", "admin", "eve"})
+    server.start()
+    try:
+        eve = Driver(f"127.0.0.1:{port}", auth_token="eve")
+        te = eve.table_client()
+        # eve has no grants anywhere: reads, writes, DDL all denied
+        with pytest.raises(ApiError, match="access denied"):
+            list(te.read_table("sec"))
+        with pytest.raises(ApiError, match="access denied"):
+            te.bulk_upsert("sec", pa.table(
+                {"id": pa.array([9], pa.int64()),
+                 "v": pa.array([9], pa.int64())}))
+        with pytest.raises(ApiError, match="access denied"):
+            te.create_table("evil", [("id", "int64", True)],
+                            primary_key=["id"])
+        with pytest.raises(ApiError, match="access denied"):
+            te.drop_table("sec")
+        with pytest.raises(ApiError, match="access denied"):
+            te.copy_table("sec", "sec_copy")
+        eve.close()
+        # alice reads and writes; admin does DDL
+        alice = Driver(f"127.0.0.1:{port}", auth_token="alice")
+        ta = alice.table_client()
+        got = pa.concat_tables(ta.read_table("sec"))
+        assert got.num_rows == 1
+        assert ta.bulk_upsert("sec", pa.table(
+            {"id": pa.array([2], pa.int64()),
+             "v": pa.array([20], pa.int64())})) == 1
+        alice.close()
+        admin = Driver(f"127.0.0.1:{port}", auth_token="admin")
+        tadm = admin.table_client()
+        assert tadm.copy_table("sec", "sec_copy") == 2
+        tadm.drop_table("sec_copy")
+        admin.close()
+    finally:
+        server.stop(0)
+
+
+def test_delete_session_rolls_back_open_tx(served):
+    """Dropping a session with an open interactive tx must release its
+    shard locks (not leak them), so later writers proceed."""
+    _cluster, driver = served
+    t = driver.table_client()
+    t.create_table("lk", [("id", "int64", True), ("v", "int64", False)],
+                   primary_key=["id"], store="row")
+    t.execute("INSERT INTO lk VALUES (1, 1)")
+    _, tx = t.execute("UPDATE lk SET v = 2 WHERE id = 1", begin=True)
+    assert tx
+    t.close()  # DeleteSession with the tx still open
+    # the buffered write vanished and the lock is free
+    t2 = driver.table_client()
+    out, _ = t2.execute("SELECT v FROM lk")
+    assert out.column("v").to_pylist() == [1]
+    (_, ok), _ = t2.execute("UPDATE lk SET v = 7 WHERE id = 1",
+                            begin=True, commit=True)
+    out, _ = t2.execute("SELECT v FROM lk")
+    assert out.column("v").to_pylist() == [7]
+
+
+def test_kv_volume_prefix_names_do_not_collide(served):
+    """Registry probes are exact-key: volume 'a' must not shadow 'ab'."""
+    _cluster, driver = served
+    kv = driver.keyvalue_client()
+    kv.create_volume("ab")
+    kv.create_volume("a")  # exact-key check: no phantom 'exists'
+    kv.write("ab", "k", b"ab-val")
+    kv.write("a", "k", b"a-val")
+    assert kv.read("ab", "k") == b"ab-val"
+    assert kv.read("a", "k") == b"a-val"
+    with pytest.raises(ApiError):
+        kv.read("abc", "k")  # never created
+
+
+def test_bulk_upsert_bool_and_nulls(served):
+    _cluster, driver = served
+    t = driver.table_client()
+    t.create_table("flags", [("id", "int64", True),
+                             ("ok", "bool", False)],
+                   primary_key=["id"], store="column")
+    at = pa.table({"id": pa.array([1, 2, 3], pa.int64()),
+                   "ok": pa.array([True, None, False])})
+    assert t.bulk_upsert("flags", at) == 3
+    out, _ = t.execute("SELECT id, ok FROM flags ORDER BY id")
+    assert out.column("ok").to_pylist() == [True, None, False]
+
+
+def test_out_of_band_rollback_resets_api_tx(served):
+    """SQL ROLLBACK through the Query service on the same session must
+    invalidate the Table service's open tx id (no silent autocommit
+    under a stale id)."""
+    _cluster, driver = served
+    t = driver.table_client()
+    t.create_table("ob", [("id", "int64", True), ("v", "int64", False)],
+                   primary_key=["id"], store="row")
+    t.execute("INSERT INTO ob VALUES (1, 1)")
+    _, tx = t.execute("UPDATE ob SET v = 2 WHERE id = 1", begin=True)
+    assert tx
+    # the Query service shares the session map keyed by session id
+    from ydb_tpu.api.build import ensure_protos
+    pb = ensure_protos()
+    driver._call("/ydb_tpu.Query/ExecuteQuery",
+                 pb.ExecuteQueryRequest(session_id=t.session_id,
+                                        sql="ROLLBACK"),
+                 pb.ExecuteQueryResponse)
+    # stale tx id now rejected instead of silently autocommitting
+    with pytest.raises(ApiError, match="unknown tx"):
+        t.execute("UPDATE ob SET v = 3 WHERE id = 1", tx_id=tx)
+    out, _ = t.execute("SELECT v FROM ob")
+    assert out.column("v").to_pylist() == [1]
+
+
+def test_kv_volume_name_validation(served):
+    _cluster, driver = served
+    kv = driver.keyvalue_client()
+    with pytest.raises(ApiError, match="'/'-free"):
+        kv.create_volume("a/log")
+    with pytest.raises(ApiError, match="'/'-free"):
+        kv.create_volume("")

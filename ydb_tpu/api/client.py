@@ -53,6 +53,20 @@ class Driver:
     def rate_limiter_client(self) -> "RateLimiterClient":
         return RateLimiterClient(self)
 
+    def table_client(self) -> "TableClient":
+        return TableClient(self)
+
+    def keyvalue_client(self) -> "KeyValueClient":
+        return KeyValueClient(self)
+
+    def federation_databases(self) -> list[dict]:
+        resp = self._call(
+            "/ydb_tpu.FederationDiscovery/ListFederationDatabases",
+            pb.ListFederationDatabasesRequest(),
+            pb.ListFederationDatabasesResponse)
+        return [{"name": d.name, "endpoint": d.endpoint,
+                 "status": d.status} for d in resp.databases]
+
     def discovery(self) -> list[tuple[str, int]]:
         resp = self._call("/ydb_tpu.Discovery/ListEndpoints",
                           pb.ListEndpointsRequest(),
@@ -217,7 +231,7 @@ class ExportClient:
 
     def import_table(self, name: str, table: str = "", shards: int = 0):
         resp = self.driver._call(
-            "/ydb_tpu.Export/ImportBackup",
+            "/ydb_tpu.Import/ImportBackup",
             pb.ImportRequest(name=name, table=table, shards=shards),
             pb.ImportResponse)
         if resp.error:
@@ -265,3 +279,194 @@ class RateLimiterClient:
             raise ApiError(resp.error)
         return {"rate": resp.rate, "burst": resp.burst,
                 "tokens": resp.tokens}
+
+
+class TableClient:
+    """Table service (ydb_table_v1 / TTableClient analog): structured
+    DDL, data queries with client tx control, Arrow BulkUpsert,
+    streaming ReadTable."""
+
+    def __init__(self, driver: Driver):
+        self.driver = driver
+        resp = driver._call("/ydb_tpu.Table/CreateSession",
+                            pb.CreateSessionRequest(),
+                            pb.CreateSessionResponse)
+        self.session_id = resp.session_id
+
+    def close(self):
+        self.driver._call("/ydb_tpu.Table/DeleteSession",
+                          pb.DeleteSessionRequest(
+                              session_id=self.session_id),
+                          pb.DeleteSessionResponse)
+
+    def create_table(self, path: str, columns, primary_key,
+                     store: str = "", shards: int = 0):
+        """columns: [(name, type, not_null)] triples."""
+        resp = self.driver._call(
+            "/ydb_tpu.Table/CreateTable",
+            pb.CreateTableRequest(
+                path=path,
+                columns=[pb.TableColumnSpec(
+                    name=n, type=t, not_null=nn)
+                    for n, t, nn in columns],
+                primary_key=list(primary_key),
+                store=store, shards=shards),
+            pb.CreateTableResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+
+    def drop_table(self, path: str):
+        resp = self.driver._call(
+            "/ydb_tpu.Table/DropTable",
+            pb.DropTableRequest(path=path), pb.DropTableResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+
+    def alter_table(self, path: str, add_columns) -> int:
+        """add_columns: [(name, type)]; returns new schema version."""
+        resp = self.driver._call(
+            "/ydb_tpu.Table/AlterTable",
+            pb.AlterTableAddColumnsRequest(
+                path=path,
+                add_columns=[pb.TableColumnSpec(name=n, type=t)
+                             for n, t in add_columns]),
+            pb.AlterTableResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.schema_version
+
+    def copy_table(self, src: str, dst: str) -> int:
+        resp = self.driver._call(
+            "/ydb_tpu.Table/CopyTable",
+            pb.CopyTableRequest(src=src, dst=dst),
+            pb.CopyTableResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.rows
+
+    def execute(self, sql: str, begin: bool = False,
+                commit: bool = False, tx_id: str = ""):
+        """Returns (result, tx_id): result is a pyarrow Table for
+        SELECT, (step, committed) for DML; tx_id is non-empty while an
+        interactive tx stays open."""
+        resp = self.driver._call(
+            "/ydb_tpu.Table/ExecuteDataQuery",
+            pb.ExecuteDataQueryRequest(
+                session_id=self.session_id, sql=sql,
+                tx=pb.TxControl(begin=begin, commit=commit,
+                                tx_id=tx_id)),
+            pb.ExecuteDataQueryResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        if resp.arrow_ipc:
+            return ipc_to_table(resp.arrow_ipc), resp.tx_id
+        return (resp.tx_step, resp.committed), resp.tx_id
+
+    def explain(self, sql: str) -> str:
+        resp = self.driver._call(
+            "/ydb_tpu.Table/ExplainDataQuery",
+            pb.ExplainQueryRequest(sql=sql), pb.ExplainQueryResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.plan_text
+
+    def bulk_upsert(self, table: str, arrow_table) -> int:
+        """pyarrow.Table -> the shards, bypassing SQL compilation."""
+        import io
+
+        import pyarrow as pa
+
+        sink = io.BytesIO()
+        with pa.ipc.new_stream(sink, arrow_table.schema) as w:
+            w.write_table(arrow_table)
+        resp = self.driver._call(
+            "/ydb_tpu.Table/BulkUpsert",
+            pb.BulkUpsertRequest(table=table,
+                                 arrow_ipc=sink.getvalue()),
+            pb.BulkUpsertResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.rows
+
+    def read_table(self, path: str, columns=(), batch_rows: int = 0):
+        """Yields pyarrow Tables (one per server batch)."""
+        rpc = self.driver.channel.unary_stream(
+            "/ydb_tpu.Table/StreamReadTable",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.ReadTableBatch.FromString,
+        )
+        stream = rpc(pb.ReadTableRequest(
+            path=path, columns=list(columns), batch_rows=batch_rows),
+            metadata=self.driver.metadata)
+        for batch in stream:
+            if batch.error:
+                raise ApiError(batch.error)
+            yield ipc_to_table(batch.arrow_ipc)
+
+
+class KeyValueClient:
+    """KeyValue service (ydb_keyvalue_v1 analog over KeyValue tablets)."""
+
+    def __init__(self, driver: Driver):
+        self.driver = driver
+
+    def create_volume(self, path: str):
+        resp = self.driver._call(
+            "/ydb_tpu.KeyValue/CreateVolume",
+            pb.KvVolumeRequest(path=path), pb.KvVolumeResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+
+    def drop_volume(self, path: str):
+        resp = self.driver._call(
+            "/ydb_tpu.KeyValue/DropVolume",
+            pb.KvVolumeRequest(path=path), pb.KvVolumeResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+
+    def write(self, volume: str, key: str, value: bytes):
+        resp = self.driver._call(
+            "/ydb_tpu.KeyValue/ExecuteTransaction",
+            pb.KvWriteRequest(volume=volume, key=key, value=value),
+            pb.KvWriteResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+
+    def read(self, volume: str, key: str) -> bytes | None:
+        resp = self.driver._call(
+            "/ydb_tpu.KeyValue/Read",
+            pb.KvReadRequest(volume=volume, key=key),
+            pb.KvReadResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.value if resp.found else None
+
+    def list_range(self, volume: str, lo: str = "", hi: str = "",
+                   limit: int = 0) -> list[tuple[str, bytes]]:
+        req = pb.KvListRangeRequest(volume=volume, to=hi, limit=limit)
+        setattr(req, "from", lo)
+        resp = self.driver._call("/ydb_tpu.KeyValue/ListRange", req,
+                                 pb.KvListRangeResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return [(p.key, p.value) for p in resp.pairs]
+
+    def delete_range(self, volume: str, lo: str = "",
+                     hi: str = "") -> int:
+        req = pb.KvDeleteRangeRequest(volume=volume, to=hi)
+        setattr(req, "from", lo)
+        resp = self.driver._call("/ydb_tpu.KeyValue/DeleteRange", req,
+                                 pb.KvDeleteRangeResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.deleted
+
+    def rename(self, volume: str, old_key: str, new_key: str) -> bool:
+        resp = self.driver._call(
+            "/ydb_tpu.KeyValue/Rename",
+            pb.KvRenameRequest(volume=volume, old_key=old_key,
+                               new_key=new_key),
+            pb.KvRenameResponse)
+        if resp.error:
+            raise ApiError(resp.error)
+        return resp.renamed

@@ -16,6 +16,7 @@ from collections import OrderedDict
 from concurrent import futures
 
 import grpc
+import numpy as np
 
 from ydb_tpu.api.build import ensure_protos
 from ydb_tpu.api.arrow_io import oracle_to_ipc
@@ -49,6 +50,8 @@ class RequestProxy:
         self._operations: dict = {}
         self._op_lock = threading.Lock()
         self._op_seq = 0
+        # KeyValue volumes (booted on access from the durable registry)
+        self._kv_volumes: dict = {}
 
     def check_auth(self, context) -> str | None:
         """Validates the ticket; returns it (the ACL principal) when
@@ -72,8 +75,18 @@ class RequestProxy:
             session.principal = principal
             self.sessions[sid] = session
             while len(self.sessions) > self.max_sessions:
-                self.sessions.popitem(last=False)
+                old_sid, _ = next(iter(self.sessions.items()))
+                self._drop_session(old_sid)
         return pb.CreateSessionResponse(session_id=sid)
+
+    def _drop_session(self, session_id: str) -> None:
+        """Remove a server-side session; an open interactive tx rolls
+        back first so its shard locks never leak (the hazard
+        execute_script's finally block guards against)."""
+        s = self.sessions.pop(session_id, None)
+        if s is not None and getattr(s, "_tx", None) is not None:
+            s._tx_release()
+            s._api_tx_id = None
 
     def _owned_session(self, session_id, principal, context):
         """Session ids are guessable; a ticket may only drive sessions
@@ -89,7 +102,7 @@ class RequestProxy:
         with self.lock:
             if self._owned_session(request.session_id, principal,
                                    context) is not None:
-                self.sessions.pop(request.session_id, None)
+                self._drop_session(request.session_id)
         return pb.DeleteSessionResponse()
 
     def execute_query(self, request, context):
@@ -453,8 +466,6 @@ class RequestProxy:
         cluster-shared set, rows streamed through the normal insert
         path (so WAL/portions/dedup semantics all apply)."""
         self.check_auth(context)
-        import numpy as np
-
         from ydb_tpu.engine.backup import read_manifest, schema_from_json
         from ydb_tpu.engine.portion import read_portion_blob
         from ydb_tpu.scheme.model import TableDescription
@@ -747,6 +758,390 @@ class RequestProxy:
             for a, p in self.endpoints
         ])
 
+    # ---- FederationDiscovery (ydb_federation_discovery_v1 analog) ----
+
+    def list_federation_databases(self, request, context):
+        """A single-database cluster reports itself as the whole
+        federation (the reference's non-federated deployments answer
+        the same way)."""
+        self.check_auth(context)
+        ep = (f"{self.endpoints[0][0]}:{self.endpoints[0][1]}"
+              if self.endpoints else "")
+        return pb.ListFederationDatabasesResponse(
+            self_location="local",
+            databases=[pb.FederationDatabaseInfo(
+                name="/local", endpoint=ep, status="AVAILABLE")])
+
+    # ---- Table service (ydb_table_v1 analog: structured DDL, tx
+    # control, BulkUpsert, streaming ReadTable) ----
+
+    def _ddl_ast(self):
+        from ydb_tpu.sql import ast as sqlast
+        return sqlast
+
+    def _acl_session(self, principal):
+        """Principal-bound session: its _check_access enforces path
+        ACLs exactly as the SQL front door does (principal=None is the
+        ACL-exempt internal case, so every handler that acts for a
+        client must bind the ticket)."""
+        s = self.cluster.session()
+        s.principal = principal
+        return s
+
+    def _acl_denied(self, principal, *checks) -> str:
+        """checks: (perm, path) pairs; returns the denial message for
+        the response's error field, or '' when allowed."""
+        s = self._acl_session(principal)
+        try:
+            for perm, path in checks:
+                s._check_access(perm, path)
+        except Exception as e:  # noqa: BLE001
+            return str(e)
+        return ""
+
+    def table_create(self, request, context):
+        principal = self.check_auth(context)
+        denied = self._acl_denied(principal,
+                                  ("ddl", "/" + request.path))
+        if denied:
+            return pb.CreateTableResponse(error=denied)
+        sqlast = self._ddl_ast()
+        opts = []
+        if request.store:
+            opts.append(("store", request.store))
+        if request.shards:
+            opts.append(("shards", str(request.shards)))
+        stmt = sqlast.CreateTable(
+            table=request.path,
+            columns=tuple((c.name, c.type, c.not_null)
+                          for c in request.columns),
+            primary_key=tuple(request.primary_key),
+            options=tuple(opts))
+        try:
+            with self.lock:
+                self.cluster.create_table(stmt)
+        except Exception as e:  # noqa: BLE001 - surface to the client
+            return pb.CreateTableResponse(error=str(e))
+        return pb.CreateTableResponse()
+
+    def table_drop(self, request, context):
+        principal = self.check_auth(context)
+        denied = self._acl_denied(principal,
+                                  ("ddl", "/" + request.path))
+        if denied:
+            return pb.DropTableResponse(error=denied)
+        sqlast = self._ddl_ast()
+        try:
+            with self.lock:
+                self.cluster.drop_table(sqlast.DropTable(
+                    table=request.path))
+        except Exception as e:  # noqa: BLE001
+            return pb.DropTableResponse(error=str(e))
+        return pb.DropTableResponse()
+
+    def table_alter(self, request, context):
+        principal = self.check_auth(context)
+        denied = self._acl_denied(principal,
+                                  ("ddl", "/" + request.path))
+        if denied:
+            return pb.AlterTableResponse(error=denied)
+        sqlast = self._ddl_ast()
+        stmt = sqlast.AlterTable(
+            table=request.path,
+            add_columns=tuple((c.name, c.type)
+                              for c in request.add_columns))
+        try:
+            with self.lock:
+                self.cluster.alter_table(stmt)
+                desc = self.cluster.scheme.describe(request.path)
+        except Exception as e:  # noqa: BLE001
+            return pb.AlterTableResponse(error=str(e))
+        return pb.AlterTableResponse(
+            schema_version=desc.schema_version if desc else 0)
+
+    def table_copy(self, request, context):
+        """CopyTable: clone schema, stream every row through the
+        normal insert path (schemeshard copy-table analog; the
+        miniature copies data rather than sharing parts)."""
+        principal = self.check_auth(context)
+        denied = self._acl_denied(principal,
+                                  ("read", "/" + request.src),
+                                  ("ddl", "/" + request.dst))
+        if denied:
+            return pb.CopyTableResponse(error=denied)
+        sqlast = self._ddl_ast()
+
+        with self.lock:
+            desc = self.cluster.scheme.describe(request.src)
+            if desc is None:
+                return pb.CopyTableResponse(
+                    error=f"{request.src} is not a table")
+            stmt = sqlast.CreateTable(
+                table=request.dst,
+                columns=tuple((f.name, _sql_type(f.type),
+                               not f.nullable)
+                              for f in desc.schema.fields),
+                primary_key=tuple(desc.primary_key),
+                options=(("store", desc.store),
+                         ("shards", str(desc.n_shards))))
+            try:
+                self.cluster.create_table(stmt)
+                session = self._acl_session(principal)
+                out = session.execute(
+                    f"SELECT * FROM {request.src}")
+                rows = out.num_rows
+                if rows:
+                    cols, val = _oracle_to_insert(
+                        out, self.cluster.tables[request.src].schema)
+                    self.cluster.tables[request.dst].insert(cols, val)
+                    self.cluster._plan_cache.clear()
+            except Exception as e:  # noqa: BLE001
+                return pb.CopyTableResponse(error=str(e))
+        return pb.CopyTableResponse(rows=rows)
+
+    def table_execute(self, request, context):
+        """ExecuteDataQuery with client-driven TxControl: begin opens
+        an interactive tx (BEGIN), commit closes it (COMMIT), tx_id
+        continues one across calls — the session actor's tx state
+        machine (kqp_session_actor.cpp) driven from the wire."""
+        principal = self.check_auth(context)
+        session = self._owned_session(request.session_id, principal,
+                                      context)
+        if session is None:
+            return pb.ExecuteDataQueryResponse(
+                error=f"unknown session {request.session_id}")
+        tx = request.tx
+        resp = pb.ExecuteDataQueryResponse()
+        with self.lock:
+            # validate the control block BEFORE touching session
+            # state (and inside the lock, so a concurrent call on the
+            # same session cannot slip past): a bad tx_id / double
+            # begin ran no statement, so it must not disturb an
+            # unrelated in-flight transaction
+            open_id = getattr(session, "_api_tx_id", None)
+            if open_id is not None and \
+                    getattr(session, "_tx", None) is None:
+                # the tx was closed out-of-band (SQL COMMIT/ROLLBACK
+                # through another service on this shared session)
+                session._api_tx_id = open_id = None
+            if tx.tx_id and tx.tx_id != open_id:
+                return pb.ExecuteDataQueryResponse(
+                    error=f"unknown tx {tx.tx_id} in this session")
+            if tx.begin and open_id is not None:
+                return pb.ExecuteDataQueryResponse(
+                    error="session already has an open tx")
+            try:
+                if tx.begin and not tx.commit:
+                    # begin+commit together = single-shot autocommit
+                    # (the session's default), so only a bare begin
+                    # opens interactive state
+                    session.execute("BEGIN")
+                    self._tx_seq = getattr(self, "_tx_seq", 0) + 1
+                    session._api_tx_id = f"tx-{self._tx_seq}"
+                out = session.execute(request.sql)
+                if tx.commit and getattr(session, "_api_tx_id",
+                                         None):
+                    res = session.execute("COMMIT")
+                    session._api_tx_id = None
+                    if isinstance(res, TxResult):
+                        resp.tx_step = res.step
+                        resp.committed = res.committed
+                        if not res.committed:
+                            resp.error = res.error or \
+                                "not committed"
+                            return resp
+                elif getattr(session, "_api_tx_id", None):
+                    resp.tx_id = session._api_tx_id
+            except Exception as e:  # noqa: BLE001
+                # a failed statement aborts the interactive tx,
+                # matching the reference's session-actor semantics
+                if getattr(session, "_api_tx_id", None):
+                    session._tx_release()
+                    session._api_tx_id = None
+                return pb.ExecuteDataQueryResponse(error=str(e))
+        if isinstance(out, OracleTable):
+            resp.arrow_ipc = oracle_to_ipc(out)
+        elif isinstance(out, TxResult):
+            resp.tx_step = out.step
+            resp.committed = out.committed
+            if not out.committed:
+                resp.error = out.error or "not committed"
+        return resp
+
+    def table_bulk_upsert(self, request, context):
+        """BulkUpsert: Arrow IPC payload straight into the shards,
+        bypassing SQL compilation (rpc_load_rows.cpp analog — the
+        reference's Arrow-format bulk path made primary)."""
+        principal = self.check_auth(context)
+        denied = self._acl_denied(principal,
+                                  ("write", "/" + request.table))
+        if denied:
+            return pb.BulkUpsertResponse(error=denied)
+        from ydb_tpu.api.arrow_io import ipc_to_table
+
+        with self.lock:
+            t = self.cluster.tables.get(request.table)
+            if t is None:
+                return pb.BulkUpsertResponse(
+                    error=f"unknown table {request.table}")
+            try:
+                at = ipc_to_table(request.arrow_ipc)
+                cols, val = _arrow_to_insert(at, t.schema)
+                res = t.insert(cols, val)
+                self.cluster._plan_cache.clear()
+            except Exception as e:  # noqa: BLE001
+                return pb.BulkUpsertResponse(error=str(e))
+        return pb.BulkUpsertResponse(rows=at.num_rows, tx_step=res.step)
+
+    def table_read_stream(self, request, context):
+        """Server-streaming ReadTable: one consistent snapshot scan,
+        batched as Arrow IPC frames (rpc_read_table.cpp analog)."""
+        principal = self.check_auth(context)
+        batch_rows = request.batch_rows or 65536
+        with self.lock:
+            session = self._acl_session(principal)
+            cols = ", ".join(request.columns) if request.columns \
+                else "*"
+            try:
+                out = session.execute(
+                    f"SELECT {cols} FROM {request.path}")
+            except Exception as e:  # noqa: BLE001
+                yield pb.ReadTableBatch(error=str(e))
+                return
+            # zero-copy slice views under the lock; serialization and
+            # flow control happen OUTSIDE it (result buffers are
+            # private to this query, so no torn reads)
+            slices = []
+            for lo in range(0, out.num_rows, batch_rows) or [0]:
+                sl = OracleTable(
+                    {k: (np.asarray(v[0])[lo:lo + batch_rows],
+                         np.asarray(v[1])[lo:lo + batch_rows])
+                     for k, v in out.cols.items()}, out.schema)
+                sl.dicts = out.dicts
+                slices.append(sl)
+        for sl in slices:
+            yield pb.ReadTableBatch(arrow_ipc=oracle_to_ipc(sl))
+
+    def table_explain(self, request, context):
+        principal = self.check_auth(context)
+        with self.lock:
+            session = self._acl_session(principal)
+            try:
+                plan = session.execute(f"EXPLAIN {request.sql}")
+            except Exception as e:  # noqa: BLE001
+                return pb.ExplainQueryResponse(error=str(e))
+        return pb.ExplainQueryResponse(plan_text=plan or "")
+
+    # ---- KeyValue service (ydb_keyvalue_v1 analog over the KeyValue
+    # tablet: volumes live in the cluster store, reboot-durable) ----
+
+    def _kv_registered(self, path: str) -> bool:
+        """Exact-key registry probe (a prefix listing would make
+        volume 'a' shadow 'ab')."""
+        try:
+            self.cluster.store.get(f"kv/volumes/{path}")
+            return True
+        except KeyError:
+            return False
+
+    def _kv_volume(self, path: str):
+        """Boot-on-access from the durable registry: a proxy restart
+        loses nothing."""
+        from ydb_tpu.tablet.keyvalue import KeyValueTablet
+
+        if path in self._kv_volumes:
+            return self._kv_volumes[path]
+        if not self._kv_registered(path):
+            return None
+        vol = KeyValueTablet.boot(f"kvvol/{path}", self.cluster.store)
+        self._kv_volumes[path] = vol
+        return vol
+
+    def kv_create_volume(self, request, context):
+        self.check_auth(context)
+        from ydb_tpu.tablet.keyvalue import KeyValueTablet
+
+        if "/" in request.path or not request.path:
+            return pb.KvVolumeResponse(
+                error="volume names must be non-empty and '/'-free "
+                      "(they key the tablet store)")
+        with self.lock:
+            if self._kv_registered(request.path):
+                return pb.KvVolumeResponse(
+                    error=f"volume {request.path} exists")
+            self.cluster.store.put(f"kv/volumes/{request.path}", b"1")
+            self._kv_volumes[request.path] = KeyValueTablet.boot(
+                f"kvvol/{request.path}", self.cluster.store)
+        return pb.KvVolumeResponse()
+
+    def kv_drop_volume(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            vol = self._kv_volume(request.path)
+            if vol is None:
+                return pb.KvVolumeResponse(
+                    error=f"no volume {request.path}")
+            vol.delete_range(None, None)
+            self.cluster.store.delete(f"kv/volumes/{request.path}")
+            self._kv_volumes.pop(request.path, None)
+        return pb.KvVolumeResponse()
+
+    def kv_write(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            vol = self._kv_volume(request.volume)
+            if vol is None:
+                return pb.KvWriteResponse(
+                    error=f"no volume {request.volume}")
+            vol.write(request.key, request.value)
+        return pb.KvWriteResponse()
+
+    def kv_read(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            vol = self._kv_volume(request.volume)
+            if vol is None:
+                return pb.KvReadResponse(
+                    error=f"no volume {request.volume}")
+            v = vol.read(request.key)
+        if v is None:
+            return pb.KvReadResponse(found=False)
+        return pb.KvReadResponse(found=True, value=v)
+
+    def kv_list_range(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            vol = self._kv_volume(request.volume)
+            if vol is None:
+                return pb.KvListRangeResponse(
+                    error=f"no volume {request.volume}")
+            pairs = vol.read_range(getattr(request, "from") or None,
+                                   request.to or None,
+                                   limit=request.limit or 1000)
+        return pb.KvListRangeResponse(pairs=[
+            pb.KvPair(key=k, value=v) for k, v in pairs])
+
+    def kv_delete_range(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            vol = self._kv_volume(request.volume)
+            if vol is None:
+                return pb.KvDeleteRangeResponse(
+                    error=f"no volume {request.volume}")
+            n = vol.delete_range(getattr(request, "from") or None,
+                                 request.to or None)
+        return pb.KvDeleteRangeResponse(deleted=n)
+
+    def kv_rename(self, request, context):
+        self.check_auth(context)
+        with self.lock:
+            vol = self._kv_volume(request.volume)
+            if vol is None:
+                return pb.KvRenameResponse(
+                    error=f"no volume {request.volume}")
+            ok = vol.rename(request.old_key, request.new_key)
+        return pb.KvRenameResponse(renamed=ok)
+
 
 def _split_script(script: str) -> list[str]:
     """';'-split OUTSIDE single-quoted literals ('' escapes stay
@@ -778,6 +1173,63 @@ def _split_script(script: str) -> list[str]:
     if stmt:
         out.append(stmt)
     return out
+
+
+def _sql_type(t) -> str:
+    """Type -> DDL spelling that _parse_type round-trips (type_to_str's
+    'decimal(scale)' is the schema-JSON spelling, not valid DDL)."""
+    if t.is_decimal:
+        return f"decimal(38,{t.scale})"
+    return t.kind.value
+
+
+def _oracle_to_insert(out: OracleTable, schema):
+    """Result set -> (columns, validity) in the shard-insert shape
+    (strings back to raw bytes so the target's dictionaries re-encode)."""
+    cols, val = {}, {}
+    for f in schema.fields:
+        ids = np.asarray(out.column(f.name))
+        valid = np.asarray(out.validity(f.name), dtype=bool)
+        if f.type.is_string:
+            d = out.dicts[f.name] if (out.dicts and f.name in
+                                      out.dicts) else None
+            if d is None or len(d) == 0:
+                cols[f.name] = [b""] * len(ids)
+            else:
+                cols[f.name] = d.decode(
+                    np.clip(ids, 0, len(d) - 1))
+        else:
+            cols[f.name] = np.asarray(ids, dtype=f.type.physical)
+        val[f.name] = valid
+    return cols, val
+
+
+def _arrow_to_insert(at, schema):
+    """Arrow IPC payload -> (columns, validity) in the shard-insert
+    shape; column set must cover the schema (BulkUpsert writes whole
+    rows, as the reference's does). Strings stay raw (the target
+    table's own dictionaries re-encode on insert); every other type
+    converts through the one shared rule set in blocks.arrow_bridge."""
+    from ydb_tpu.blocks.arrow_bridge import _column_to_numpy
+    from ydb_tpu.blocks.dictionary import DictionarySet
+
+    names = set(at.column_names)
+    missing = [f.name for f in schema.fields if f.name not in names]
+    if missing:
+        raise ValueError(f"BulkUpsert must set all columns; "
+                         f"missing {missing}")
+    cols, val = {}, {}
+    for f in schema.fields:
+        col = at.column(f.name).combine_chunks()
+        if f.type.is_string:
+            cols[f.name] = ["" if v is None else v
+                            for v in col.to_pylist()]
+            val[f.name] = np.asarray(col.is_valid())
+        else:
+            # dicts arg unused on the non-string path
+            cols[f.name], val[f.name] = _column_to_numpy(
+                col, f, DictionarySet())
+    return cols, val
 
 
 def _ancestors(path: str) -> list[str]:
@@ -814,8 +1266,6 @@ _SERVICES = {
     "ydb_tpu.Export": {
         "ExportBackup": ("export_backup", pb.ExportRequest,
                          pb.ExportResponse),
-        "ImportBackup": ("import_backup", pb.ImportRequest,
-                         pb.ImportResponse),
         "ListBackups": ("list_backups", pb.ListBackupsRequest,
                         pb.ListBackupsResponse),
     },
@@ -878,6 +1328,56 @@ _SERVICES = {
     "ydb_tpu.Discovery": {
         "ListEndpoints": ("list_endpoints", pb.ListEndpointsRequest,
                           pb.ListEndpointsResponse),
+    },
+    "ydb_tpu.FederationDiscovery": {
+        "ListFederationDatabases": (
+            "list_federation_databases",
+            pb.ListFederationDatabasesRequest,
+            pb.ListFederationDatabasesResponse),
+    },
+    "ydb_tpu.Table": {
+        "CreateSession": ("create_session", pb.CreateSessionRequest,
+                          pb.CreateSessionResponse),
+        "DeleteSession": ("delete_session", pb.DeleteSessionRequest,
+                          pb.DeleteSessionResponse),
+        "CreateTable": ("table_create", pb.CreateTableRequest,
+                        pb.CreateTableResponse),
+        "DropTable": ("table_drop", pb.DropTableRequest,
+                      pb.DropTableResponse),
+        "AlterTable": ("table_alter", pb.AlterTableAddColumnsRequest,
+                       pb.AlterTableResponse),
+        "CopyTable": ("table_copy", pb.CopyTableRequest,
+                      pb.CopyTableResponse),
+        "DescribeTable": ("describe_table", pb.DescribeTableRequest,
+                          pb.DescribeTableResponse),
+        "ExecuteDataQuery": ("table_execute",
+                             pb.ExecuteDataQueryRequest,
+                             pb.ExecuteDataQueryResponse),
+        "ExplainDataQuery": ("table_explain", pb.ExplainQueryRequest,
+                             pb.ExplainQueryResponse),
+        "BulkUpsert": ("table_bulk_upsert", pb.BulkUpsertRequest,
+                       pb.BulkUpsertResponse),
+        "StreamReadTable": ("table_read_stream", pb.ReadTableRequest,
+                            pb.ReadTableBatch, "unary_stream"),
+    },
+    "ydb_tpu.KeyValue": {
+        "CreateVolume": ("kv_create_volume", pb.KvVolumeRequest,
+                         pb.KvVolumeResponse),
+        "DropVolume": ("kv_drop_volume", pb.KvVolumeRequest,
+                       pb.KvVolumeResponse),
+        "ExecuteTransaction": ("kv_write", pb.KvWriteRequest,
+                               pb.KvWriteResponse),
+        "Read": ("kv_read", pb.KvReadRequest, pb.KvReadResponse),
+        "ListRange": ("kv_list_range", pb.KvListRangeRequest,
+                      pb.KvListRangeResponse),
+        "DeleteRange": ("kv_delete_range", pb.KvDeleteRangeRequest,
+                        pb.KvDeleteRangeResponse),
+        "Rename": ("kv_rename", pb.KvRenameRequest,
+                   pb.KvRenameResponse),
+    },
+    "ydb_tpu.Import": {
+        "ImportBackup": ("import_backup", pb.ImportRequest,
+                         pb.ImportResponse),
     },
 }
 

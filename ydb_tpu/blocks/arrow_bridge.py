@@ -114,7 +114,8 @@ def _column_to_numpy(
     if pa.types.is_date32(arr.type):
         vals = np.asarray(arr.fill_null(0), dtype="datetime64[D]").astype(np.int32)
         return vals, validity
-    vals = np.asarray(arr.fill_null(0)).astype(t.physical, copy=False)
+    fill = False if pa.types.is_boolean(arr.type) else 0
+    vals = np.asarray(arr.fill_null(fill)).astype(t.physical, copy=False)
     return vals, validity
 
 

@@ -49,6 +49,11 @@ _TYPE_MAP = {
     "uint64": dtypes.UINT64, "float": dtypes.FLOAT, "double": dtypes.DOUBLE,
     "bool": dtypes.BOOL, "date": dtypes.DATE, "timestamp": dtypes.TIMESTAMP,
     "string": dtypes.STRING, "utf8": dtypes.STRING, "text": dtypes.STRING,
+    # Kind.value spellings, so scheme.model.type_to_str output
+    # round-trips back through DDL (DescribeTable -> CreateTable)
+    "uint8": dtypes.UINT8, "uint16": dtypes.UINT16,
+    "uint32": dtypes.UINT32, "float32": dtypes.FLOAT,
+    "float64": dtypes.DOUBLE,
 }
 
 
@@ -56,7 +61,9 @@ def _parse_type(t: str) -> dtypes.LogicalType:
     t = t.lower()
     if t.startswith("decimal"):
         if "(" in t:
-            s = int(t.split(",")[1].rstrip(")"))
+            args = t[t.index("(") + 1:].rstrip(")").split(",")
+            # decimal(p) = scale 0 (SQL standard); decimal(p,s)
+            s = int(args[1]) if len(args) == 2 else 0
         else:
             s = 0
         return dtypes.decimal(s)
