@@ -347,3 +347,133 @@ def test_explain_renders_the_physical_plan(data, db, catalog):
     text2 = s.execute(
         "explain select a.k from kv a, kv b where a.k = b.k")
     assert "Join" in text2
+
+
+# ---------------- UNION [ALL] ----------------
+
+
+def test_union_all_with_rename_order_limit(data, db, catalog):
+    """Branch outputs align by position (second branch's alias differs),
+    trailing ORDER BY/LIMIT bind to the whole union."""
+    li = data.tables["lineitem"]
+    sql = """
+    select l_orderkey, l_quantity from lineitem where l_quantity < 3
+    union all
+    select l_orderkey, l_quantity * 2 as q2 from lineitem
+    where l_quantity > 48
+    order by l_quantity desc limit 5"""
+    from ydb_tpu.sql.planner import plan_select_full
+
+    pq = plan_select_full(parse(sql), catalog)
+    assert pq.out_names == ("l_orderkey", "l_quantity")
+    out = to_host(execute_plan(pq.plan, db))
+    got = np.asarray(out.cols["l_quantity"][0])
+    # l_quantity is decimal(2)-scaled: SQL "< 3" means 300 cents
+    lo = li["l_quantity"][li["l_quantity"] < 300]
+    hi = li["l_quantity"][li["l_quantity"] > 4800] * 2
+    assert len(lo) and len(hi), "both branches must select rows"
+    want = np.sort(np.concatenate([lo, hi]))[::-1][:5]
+    assert np.array_equal(got, want)
+
+
+def test_union_all_in_from_groups_across_branches(data, db, catalog):
+    """The TPC-DS channel-union shape: union in a derived table, one
+    aggregation over all branches, string key decodes via the shared
+    dictionary."""
+    li = data.tables["lineitem"]
+    sql = """
+    select l_returnflag, sum(amt) as total from (
+      select l_returnflag, l_extendedprice as amt from lineitem
+      where l_quantity < 25
+      union all
+      select l_returnflag, l_extendedprice as amt from lineitem
+      where l_quantity >= 25
+    ) u group by l_returnflag order by l_returnflag"""
+    from ydb_tpu.sql.planner import plan_select_full
+
+    pq = plan_select_full(parse(sql), catalog)
+    out = to_host(execute_plan(pq.plan, db))
+    rf = li["l_returnflag"]
+    want = {int(k): int(li["l_extendedprice"][rf == k].sum())
+            for k in np.unique(rf)}
+    got_k = np.asarray(out.cols["l_returnflag"][0])
+    got_v = np.asarray(out.cols["total"][0])
+    assert {int(k): int(v) for k, v in zip(got_k, got_v)} == want
+
+
+def test_union_distinct_dedups(data, db, catalog):
+    sql = ("select l_returnflag from lineitem "
+           "union select l_returnflag from lineitem")
+    from ydb_tpu.sql.planner import plan_select_full
+
+    pq = plan_select_full(parse(sql), catalog)
+    out = to_host(execute_plan(pq.plan, db))
+    got = np.sort(np.asarray(out.cols["l_returnflag"][0]))
+    want = np.unique(data.tables["lineitem"]["l_returnflag"])
+    assert np.array_equal(got, want)
+
+
+def test_union_arity_mismatch_raises(data, db, catalog):
+    from ydb_tpu.sql.planner import plan_select_full
+
+    with pytest.raises(PlanError, match="columns"):
+        plan_select_full(parse(
+            "select l_orderkey, l_quantity from lineitem "
+            "union all select l_orderkey from lineitem"), catalog)
+
+
+def test_union_mixed_chain_rejected():
+    with pytest.raises(SyntaxError, match="mixed UNION"):
+        parse("select 1 as a from t union all select 2 as a from t "
+              "union select 3 as a from t")
+
+
+def test_union_all_permuted_columns(data, db, catalog):
+    """A later branch whose output names PERMUTE the first branch's must
+    remap by position without corrupting either column (code-review
+    regression: sequential renames through one shared env)."""
+    li = data.tables["lineitem"]
+    sql = """
+    select l_orderkey, l_partkey from lineitem where l_quantity < 2
+    union all
+    select l_partkey, l_orderkey from lineitem where l_quantity > 49"""
+    from ydb_tpu.sql.planner import plan_select_full
+
+    pq = plan_select_full(parse(sql), catalog)
+    assert pq.out_names == ("l_orderkey", "l_partkey")
+    out = to_host(execute_plan(pq.plan, db))
+    lo = li["l_quantity"] < 200
+    hi = li["l_quantity"] > 4900
+    want_ok = np.concatenate([li["l_orderkey"][lo], li["l_partkey"][hi]])
+    want_pk = np.concatenate([li["l_partkey"][lo], li["l_orderkey"][hi]])
+    assert np.array_equal(np.asarray(out.cols["l_orderkey"][0]), want_ok)
+    assert np.array_equal(np.asarray(out.cols["l_partkey"][0]), want_pk)
+
+
+def test_union_cte_scoping(data, db, catalog):
+    """A statement-level WITH scopes over every branch; a later branch's
+    own WITH shadows locally without rewriting sibling branches
+    (code-review regression: shared cte dict registered all branches'
+    CTEs before planning any)."""
+    from ydb_tpu.sql.planner import plan_select_full
+
+    li = data.tables["lineitem"]
+    sql = """
+    with base as (select l_orderkey as v from lineitem
+                  where l_quantity < 2)
+    select v from base
+    union all
+    with base as (select l_partkey as v from lineitem
+                  where l_quantity < 2)
+    select v from base"""
+    pq = plan_select_full(parse(sql), catalog)
+    out = to_host(execute_plan(pq.plan, db))
+    m = li["l_quantity"] < 200
+    want = np.concatenate([li["l_orderkey"][m], li["l_partkey"][m]])
+    assert np.array_equal(np.asarray(out.cols["v"][0]), want)
+
+
+def test_union_interior_order_by_rejected():
+    with pytest.raises(SyntaxError, match="non-final UNION branch"):
+        parse("select a from t order by a limit 3 "
+              "union all select a from t")

@@ -502,7 +502,7 @@ class _Handler(socketserver.BaseRequestHandler):
             sql = _substitute_params(stmt["query"], dummies,
                                      stmt["oids"])
             parsed = _parse(sql)
-            if not isinstance(parsed, _ast.Select):
+            if not isinstance(parsed, (_ast.Select, _ast.UnionAll)):
                 return None
             with srv.lock:
                 pq = plan_select_full(parsed,

@@ -895,7 +895,7 @@ class Cluster:
                 stmt.select, self.catalog(),
                 self._stmt_scalar_exec([None], snap, access_check))
             return ("explain", pq.plan)
-        if not isinstance(stmt, ast.Select):
+        if not isinstance(stmt, (ast.Select, ast.UnionAll)):
             return stmt
 
         # one snapshot Database for the whole statement: scalar-subquery

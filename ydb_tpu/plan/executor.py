@@ -33,6 +33,7 @@ from ydb_tpu.ssa import join as join_kernels
 from ydb_tpu.ssa import kernels
 from ydb_tpu.ssa.compiler import compile_program
 from ydb_tpu.plan.nodes import (
+    Concat,
     ExpandJoin,
     LookupJoin,
     PlanNode,
@@ -86,6 +87,8 @@ def _plan_nodes(plan: PlanNode):
             stack += [n.probe, n.build]
         elif isinstance(n, Transform):
             stack.append(n.input)
+        elif isinstance(n, Concat):
+            stack += list(n.inputs)
 
 
 def _partition_for_dq(src) -> list:
@@ -234,6 +237,11 @@ def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
             db._compile_cache[key] = hit
         run, aux = hit
         return run(block, aux)
+    if isinstance(plan, Concat):
+        # branches execute independently (planner guarantees identical
+        # column names/types); live rows append in branch order
+        return concat_blocks(
+            [execute_plan(i, db, _memo) for i in plan.inputs])
     raise NotImplementedError(plan)
 
 

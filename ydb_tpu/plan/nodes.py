@@ -63,7 +63,18 @@ class Transform:
     dict_aliases: tuple[tuple[str, str], ...] = ()
 
 
-PlanNode = Union[TableScan, LookupJoin, ExpandJoin, Transform]
+@dataclasses.dataclass(frozen=True)
+class Concat:
+    """UNION ALL: inputs produce identical column sets; rows append.
+
+    The reference's Extend/UnionAll expression node
+    (yql/essentials/core/type_ann/type_ann_list.cpp); here each input
+    executes independently and the blocks concatenate."""
+
+    inputs: tuple["PlanNode", ...]
+
+
+PlanNode = Union[TableScan, LookupJoin, ExpandJoin, Transform, Concat]
 
 
 def format_plan(plan: PlanNode, indent: int = 0) -> str:
@@ -127,4 +138,8 @@ def format_plan(plan: PlanNode, indent: int = 0) -> str:
             f"{pad}Transform ({prog_summary(plan.program)})",
             format_plan(plan.input, indent + 1),
         ])
+    if isinstance(plan, Concat):
+        return "\n".join(
+            [f"{pad}Concat[{len(plan.inputs)}]"]
+            + [format_plan(i, indent + 1) for i in plan.inputs])
     return f"{pad}{plan!r}"

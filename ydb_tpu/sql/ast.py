@@ -131,7 +131,7 @@ class TableRef:
 class SubquerySource:
     """Derived table: (SELECT ...) AS alias in FROM."""
 
-    select: "Select"
+    select: "Select | UnionAll"
     alias: str
 
 
@@ -164,6 +164,24 @@ class Select:
     distinct: bool = False
     # WITH name AS (select), ...: CTEs usable as FROM sources downstream
     ctes: tuple[tuple[str, "Select"], ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class UnionAll:
+    """SELECT ... UNION ALL SELECT ... [ORDER BY ...] [LIMIT n].
+
+    Branch outputs align by POSITION; names come from the first branch
+    (SQL standard set-operation semantics). ``distinct`` True models
+    plain UNION (duplicate rows collapse). The reference compiles set
+    operations into an Extend/UnionAll expression node
+    (yql/essentials/core/type_ann/type_ann_list.cpp UnionAll); here the
+    planner lowers them to a Concat plan node.
+    """
+
+    selects: tuple["Select", ...]
+    order_by: tuple[OrderItem, ...] = ()
+    limit: int | None = None
+    distinct: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -244,6 +262,6 @@ class Rollback:
     """ROLLBACK: discard the transaction's buffered effects."""
 
 
-Statement = Union[Select, Insert, CreateTable, DropTable, AlterTable,
-                  Update, Delete, Explain, Begin, Commit, Rollback,
-                  CreateSequence, DropSequence]
+Statement = Union[Select, UnionAll, Insert, CreateTable, DropTable,
+                  AlterTable, Update, Delete, Explain, Begin, Commit,
+                  Rollback, CreateSequence, DropSequence]

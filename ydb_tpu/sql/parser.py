@@ -11,6 +11,7 @@ SQL grammar without the generated-parser machinery (yql/sql/v1).
 
 from __future__ import annotations
 
+import dataclasses
 import re
 
 from ydb_tpu.sql import ast
@@ -36,7 +37,7 @@ _KEYWORDS = {
     "else", "end", "date", "interval", "true", "false", "distinct",
     "outer", "exists", "cast", "drop", "alter", "add", "column", "with",
     "update", "set", "delete", "extract", "substring", "for", "explain",
-    "begin", "commit", "rollback", "transaction",
+    "begin", "commit", "rollback", "transaction", "union", "all",
 }
 
 
@@ -117,9 +118,9 @@ class Parser:
     def parse_statement(self) -> ast.Statement:
         if self.peek().value == "explain":
             self.next()
-            stmt = ast.Explain(self.parse_select())
+            stmt = ast.Explain(self.parse_select_or_union())
         elif self.peek().value in ("select", "with"):
-            stmt = self.parse_select()
+            stmt = self.parse_select_or_union()
         elif self.peek().value in ("insert", "upsert"):
             stmt = self.parse_insert()
         elif self.peek().value == "begin":
@@ -147,6 +148,42 @@ class Parser:
         self.expect("eof")
         return stmt
 
+    def parse_select_or_union(self) -> "ast.Select | ast.UnionAll":
+        """A SELECT, or a UNION [ALL] chain of them.
+
+        A trailing ORDER BY / LIMIT parses into the LAST branch; per the
+        SQL standard they bind to the whole set operation, so they hoist
+        onto the UnionAll node. Mixing UNION and UNION ALL in one chain
+        is rejected (the subset keeps one distinct flag per chain).
+        """
+        first = self.parse_select()
+        if self.peek().value != "union":
+            return first
+        branches = [first]
+        kinds = set()
+        while self.kw("union"):
+            kinds.add("all" if self.kw("all") else "distinct")
+            branches.append(self.parse_select())
+        if len(kinds) > 1:
+            raise SyntaxError(
+                "mixed UNION / UNION ALL in one chain is not supported")
+        for b in branches[:-1]:
+            # standard SQL only allows ORDER BY/LIMIT on the WHOLE set
+            # operation (or parenthesized branches, which this subset
+            # does not parse); an interior one would otherwise silently
+            # stay branch-local
+            if b.order_by or b.limit is not None:
+                raise SyntaxError(
+                    "ORDER BY/LIMIT inside a non-final UNION branch is"
+                    " not supported")
+        last = branches[-1]
+        order, limit = last.order_by, last.limit
+        if order or limit is not None:
+            branches[-1] = dataclasses.replace(
+                last, order_by=(), limit=None)
+        return ast.UnionAll(tuple(branches), order, limit,
+                            distinct=kinds == {"distinct"})
+
     def parse_select(self) -> ast.Select:
         ctes: list[tuple[str, ast.Select]] = []
         if self.kw("with"):
@@ -154,7 +191,7 @@ class Parser:
                 name = self.expect("name").value
                 self.expect("kw", "as")
                 self.expect("op", "(")
-                ctes.append((name, self.parse_select()))
+                ctes.append((name, self.parse_select_or_union()))
                 self.expect("op", ")")
                 if not self.accept("op", ","):
                     break
@@ -228,7 +265,7 @@ class Parser:
     def parse_table_ref(self) -> "ast.TableRef | ast.SubquerySource":
         if self.accept("op", "("):
             # derived table: ( SELECT ... ) [AS] alias
-            sub = self.parse_select()
+            sub = self.parse_select_or_union()
             self.expect("op", ")")
             self.kw("as")
             alias = self.expect("name").value

@@ -24,7 +24,9 @@ import numpy as np
 from ydb_tpu import dtypes
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.blocks.dictionary import _as_bytes as _as_b
-from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, Transform
+from ydb_tpu.plan.nodes import (
+    Concat, ExpandJoin, LookupJoin, TableScan, Transform,
+)
 from ydb_tpu.sql import ast
 from ydb_tpu.ssa.ops import Agg, Op
 from ydb_tpu.ssa.program import (
@@ -766,7 +768,10 @@ def plan_select_full(
     uncorrelated scalar subquery eagerly (the KQP precompute-phase
     analog); without it such subqueries raise PlanError.
     """
-    return _SelectPlanner(catalog, scalar_exec, dict(ctes or {})).plan(sel)
+    planner = _SelectPlanner(catalog, scalar_exec, dict(ctes or {}))
+    if isinstance(sel, ast.UnionAll):
+        return planner.plan_union(sel)
+    return planner.plan(sel)
 
 
 class _SelectPlanner:
@@ -779,10 +784,11 @@ class _SelectPlanner:
 
     # -- recursion helper --
 
-    def _sub(self, sel: ast.Select) -> PlannedQuery:
-        sub = _SelectPlanner(
-            self.catalog, self.scalar_exec, dict(self.ctes)
-        ).plan(sel)
+    def _sub(self, sel: "ast.Select | ast.UnionAll") -> PlannedQuery:
+        child = _SelectPlanner(
+            self.catalog, self.scalar_exec, dict(self.ctes))
+        sub = (child.plan_union(sel) if isinstance(sel, ast.UnionAll)
+               else child.plan(sel))
         self.used_scalar_exec |= sub.used_scalar_exec
         return sub
 
@@ -924,6 +930,104 @@ class _SelectPlanner:
         return self._sub(rewritten)
 
     # ---------------- main planning ----------------
+
+    def plan_union(self, u: ast.UnionAll) -> PlannedQuery:
+        """UNION [ALL] chain -> Concat node (+ dedup / sort / limit).
+
+        Branch outputs align by POSITION to the first branch's names;
+        each later branch gets a rename Transform when its names differ.
+        Logical types must match exactly per position, and string
+        columns must share one dictionary source across branches (the
+        concatenated codes decode through a single dictionary)."""
+        # a statement-level WITH parses into the FIRST branch; its CTEs
+        # scope over every branch. A later branch's own WITH (non-
+        # standard but parseable) stays local to that branch: _sub plans
+        # it in a child planner whose cte dict is a copy, so it shadows
+        # without leaking into sibling branches.
+        for name, csub in u.selects[0].ctes:
+            self.ctes[name] = self._sub(csub)
+        subs = [self._sub(
+            dataclasses.replace(b, ctes=()) if i == 0 else b)
+            for i, b in enumerate(u.selects)]
+        first = subs[0]
+        names = first.out_names
+        out_types = dict(first.out_types)
+        dict_aliases = dict(first.dict_aliases)
+        inputs: list = []
+        for bi, sub in enumerate(subs):
+            if len(sub.out_names) != len(names):
+                raise PlanError(
+                    f"UNION branch {bi + 1} yields "
+                    f"{len(sub.out_names)} columns, expected "
+                    f"{len(names)}")
+            renames: list[tuple[str, str]] = []
+            aliases: dict[str, str] = {}
+            for src, dst in zip(sub.out_names, names):
+                t_src, t_dst = sub.out_types[src], out_types[dst]
+                if t_src != t_dst:
+                    raise PlanError(
+                        f"UNION branch {bi + 1} column {src}: type "
+                        f"{t_src} does not match {dst}: {t_dst}")
+                d_src = sub.dict_aliases.get(src, src)
+                if t_dst.is_string:
+                    d_dst = dict_aliases.get(dst, dst)
+                    if bi == 0:
+                        dict_aliases[dst] = d_src
+                    elif d_src != d_dst:
+                        raise PlanError(
+                            f"UNION branches disagree on the "
+                            f"dictionary for {dst}: {d_src} vs {d_dst}")
+                if src != dst:
+                    renames.append((src, dst))
+                    if t_dst.is_string:
+                        aliases[dst] = d_src
+                if t_src.is_string and d_src != src:
+                    aliases[src] = d_src
+            if renames:
+                # two-phase rename through fresh temp names: a direct
+                # Assign(dst, Col(src)) sequence corrupts permuted
+                # column lists (Assign a=b overwrites a before
+                # Assign b=a reads it — assignments share one env)
+                steps: list = []
+                for t, (src, _dst) in enumerate(renames):
+                    steps.append(AssignStep(f"__union_{t}", Col(src)))
+                for t, (_src, dst) in enumerate(renames):
+                    steps.append(AssignStep(dst, Col(f"__union_{t}")))
+                steps.append(ProjectStep(names))
+                inputs.append(Transform(
+                    sub.plan, Program(tuple(steps)),
+                    tuple(sorted(aliases.items()))))
+            else:
+                inputs.append(sub.plan)
+        plan: object = Concat(tuple(inputs))
+
+        post: list = []
+        if u.distinct:
+            post.append(GroupByStep(names, ()))
+        if u.order_by:
+            keys, desc = [], []
+            for o in u.order_by:
+                if not (isinstance(o.expr, ast.Name)
+                        and o.expr.parts[-1] in names):
+                    raise PlanError(
+                        "UNION ORDER BY must reference output columns")
+                keys.append(o.expr.parts[-1])
+                desc.append(o.descending)
+            post.append(SortStep(tuple(keys), tuple(desc), u.limit))
+        elif u.limit is not None:
+            post.append(SortStep((), (), u.limit))
+        if post:
+            aliases = tuple(sorted(
+                (k, v) for k, v in dict_aliases.items() if k != v))
+            plan = Transform(plan, Program(tuple(post)), aliases)
+        return PlannedQuery(
+            plan=plan,
+            out_names=names,
+            out_types=out_types,
+            dict_aliases=dict_aliases,
+            unique_key=names if u.distinct else None,
+            used_scalar_exec=self.used_scalar_exec,
+        )
 
     def plan(self, sel: ast.Select) -> PlannedQuery:
         for name, sub in sel.ctes:
@@ -1334,9 +1438,26 @@ class _SelectPlanner:
                     f"no equi-join condition connects {alias}; cross"
                     " joins are not supported"
                 )
+            kind0 = dict(
+                (j[0], j[2]) for j in join_specs).get(i, "inner")
+            if len(conds) > 2:
+                # the join kernel packs at most two key columns into one
+                # int64 (ssa/join.py _key_i64); further equalities lower
+                # as post-join filters on the carried build columns —
+                # exact for inner joins (a NULL key fails both ways).
+                # LEFT JOIN ON semantics (conditions gate the MATCH, not
+                # the result row) would change, so those keep erroring.
+                if kind0 == "left":
+                    raise PlanError(
+                        "LEFT JOIN with more than two equality"
+                        " conditions is not supported")
+                for la, lc, ra, rc in conds[2:]:
+                    residual.append(ast.BinOp(
+                        "eq", ast.Name((la, lc)), ast.Name((ra, rc))))
+                conds = conds[:2]
             probe_keys = tuple(colmap[(la, lc)] for la, lc, ra, rc in conds)
             build_keys = tuple(rc for la, lc, ra, rc in conds)
-            kind = dict((j[0], j[2]) for j in join_specs).get(i, "inner")
+            kind = kind0
             demanded = [
                 n for n in scope.names
                 if n in demand[alias] and n not in build_keys
