@@ -14,9 +14,13 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow 30 official templates (q3, q6, q7, q12, q13, q15, q19,
-q20, q21, q26, q32, q34, q37, q42, q43, q45, q46, q48, q52, q55, q65,
-q68, q69, q73, q79, q82, q92, q96, q98, q99) restated in the framework
+Queries follow 40 official templates (q1, q3, q6, q7, q12, q13, q15,
+q19, q20, q21, q25, q26, q29, q32, q33, q34, q37, q40, q42, q43, q45,
+q46, q48, q50, q52, q55, q56, q60, q65, q68, q69, q71, q73, q79, q82,
+q92, q93, q96, q98, q99). The channel-union family (q33/q56/q60/q71)
+runs through real UNION ALL planning, and the returns chains
+(q1/q25/q29/q40/q50/q93) join the store/catalog returns tables.
+All are restated in the framework
 dialect: q13/q48 hoist the join
 equalities shared by every OR branch (an exact identity); q34/q73
 rewrite the dep/vehicle ratio as a multiply (exact under the
@@ -79,6 +83,16 @@ _SHIP_TYPES = [b"EXPRESS", b"OVERNIGHT", b"REGULAR", b"TWO DAY",
 _CC_NAMES = [b"NY Metro", b"Mid Atlantic", b"North Midwest",
              b"Pacific Northwest", b"Central", b"California"]
 _MARITAL = [b"M", b"S", b"D", b"W", b"U"]
+# dsdgen color domain subset covering the q56/q60 literal constants
+_COLORS = [b"slate", b"blanched", b"cornsilk", b"chiffon", b"lace",
+           b"lawn", b"orchid", b"salmon", b"powder", b"peru",
+           b"sienna", b"drab", b"grey", b"rosy", b"metallic", b"navy"]
+_REASONS = [b"Package was damaged", b"Stopped working",
+            b"Did not fit", b"Found a better price", b"Not the product",
+            b"Gift exchange", b"Duplicate purchase", b"Parts missing",
+            b"Did not like the color", b"Did not like the model",
+            b"Unauthorized purchase", b"Lost my job",
+            b"reason 13", b"reason 14", b"reason 15"]
 _EDUCATION = [b"Primary", b"Secondary", b"College", b"2 yr Degree",
               b"4 yr Degree", b"Advanced Degree", b"Unknown"]
 
@@ -109,6 +123,7 @@ ITEM_SCHEMA = dtypes.schema(
     ("i_class", dtypes.STRING, False),
     ("i_item_desc", dtypes.STRING, False),
     ("i_wholesale_cost", DEC2, False),
+    ("i_color", dtypes.STRING, False),
 )
 
 STORE_SCHEMA = dtypes.schema(
@@ -120,12 +135,14 @@ STORE_SCHEMA = dtypes.schema(
     ("s_city", dtypes.STRING, False),
     ("s_county", dtypes.STRING, False),
     ("s_number_employees", dtypes.INT32, False),
+    ("s_state", dtypes.STRING, False),
 )
 
 TIME_DIM_SCHEMA = dtypes.schema(
     ("t_time_sk", dtypes.INT64, False),
     ("t_hour", dtypes.INT32, False),
     ("t_minute", dtypes.INT32, False),
+    ("t_meal_time", dtypes.STRING, False),
 )
 
 PROMOTION_SCHEMA = dtypes.schema(
@@ -142,6 +159,7 @@ CUSTOMER_SCHEMA = dtypes.schema(
     ("c_salutation", dtypes.STRING, False),
     ("c_preferred_cust_flag", dtypes.STRING, False),
     ("c_current_cdemo_sk", dtypes.INT64, False),
+    ("c_customer_id", dtypes.STRING, False),
 )
 
 CUSTOMER_ADDRESS_SCHEMA = dtypes.schema(
@@ -151,6 +169,7 @@ CUSTOMER_ADDRESS_SCHEMA = dtypes.schema(
     ("ca_country", dtypes.STRING, False),
     ("ca_city", dtypes.STRING, False),
     ("ca_county", dtypes.STRING, False),
+    ("ca_gmt_offset", dtypes.INT32, False),
 )
 
 CUSTOMER_DEMOGRAPHICS_SCHEMA = dtypes.schema(
@@ -199,6 +218,9 @@ WEB_SALES_SCHEMA = dtypes.schema(
     ("ws_sales_price", DEC2, False),
     ("ws_ext_sales_price", DEC2, False),
     ("ws_ext_discount_amt", DEC2, False),
+    ("ws_bill_addr_sk", dtypes.INT64, False),
+    ("ws_sold_time_sk", dtypes.INT64, False),
+    ("ws_net_profit", DEC2, False),
 )
 
 INVENTORY_SCHEMA = dtypes.schema(
@@ -211,6 +233,7 @@ INVENTORY_SCHEMA = dtypes.schema(
 WAREHOUSE_SCHEMA = dtypes.schema(
     ("w_warehouse_sk", dtypes.INT64, False),
     ("w_warehouse_name", dtypes.STRING, False),
+    ("w_state", dtypes.STRING, False),
 )
 
 SHIP_MODE_SCHEMA = dtypes.schema(
@@ -239,6 +262,35 @@ CATALOG_SALES_SCHEMA = dtypes.schema(
     ("cs_warehouse_sk", dtypes.INT64, False),
     ("cs_ship_mode_sk", dtypes.INT64, False),
     ("cs_call_center_sk", dtypes.INT64, False),
+    ("cs_bill_addr_sk", dtypes.INT64, False),
+    ("cs_sold_time_sk", dtypes.INT64, False),
+    ("cs_order_number", dtypes.INT64, False),
+    ("cs_net_profit", DEC2, False),
+)
+REASON_SCHEMA = dtypes.schema(
+    ("r_reason_sk", dtypes.INT64, False),
+    ("r_reason_desc", dtypes.STRING, False),
+)
+STORE_RETURNS_SCHEMA = dtypes.schema(
+    ("sr_returned_date_sk", dtypes.INT64, False),
+    ("sr_item_sk", dtypes.INT64, False),
+    ("sr_customer_sk", dtypes.INT64, False),
+    ("sr_ticket_number", dtypes.INT64, False),
+    ("sr_store_sk", dtypes.INT64, False),
+    ("sr_reason_sk", dtypes.INT64, False),
+    ("sr_return_quantity", dtypes.INT32, False),
+    ("sr_return_amt", DEC2, False),
+    ("sr_net_loss", DEC2, False),
+)
+CATALOG_RETURNS_SCHEMA = dtypes.schema(
+    ("cr_returned_date_sk", dtypes.INT64, False),
+    ("cr_item_sk", dtypes.INT64, False),
+    ("cr_order_number", dtypes.INT64, False),
+    ("cr_returning_customer_sk", dtypes.INT64, False),
+    ("cr_return_quantity", dtypes.INT32, False),
+    ("cr_return_amount", DEC2, False),
+    ("cr_refunded_cash", DEC2, False),
+    ("cr_net_loss", DEC2, False),
 )
 
 SCHEMAS = {
@@ -258,6 +310,9 @@ SCHEMAS = {
     "warehouse": WAREHOUSE_SCHEMA,
     "ship_mode": SHIP_MODE_SCHEMA,
     "call_center": CALL_CENTER_SCHEMA,
+    "reason": REASON_SCHEMA,
+    "store_returns": STORE_RETURNS_SCHEMA,
+    "catalog_returns": CATALOG_RETURNS_SCHEMA,
 }
 
 PRIMARY_KEYS = {
@@ -277,6 +332,9 @@ PRIMARY_KEYS = {
     "warehouse": ("w_warehouse_sk",),
     "ship_mode": ("sm_ship_mode_sk",),
     "call_center": ("cc_call_center_sk",),
+    "reason": ("r_reason_sk",),
+    "store_returns": ("sr_item_sk", "sr_ticket_number"),
+    "catalog_returns": ("cr_item_sk", "cr_order_number"),
 }
 
 
@@ -315,9 +373,15 @@ class TpcdsData:
         self._gen_customer(rng, max(2000, int(sf * 100_000)),
                            max(400, int(sf * 50_000)))
         self._gen_warehouses(rng)
+        self._gen_reason()
         self._gen_store_sales(rng, max(50_000, int(sf * 2_880_404)))
+        # returns generate BEFORE catalog_sales: a slice of catalog
+        # orders re-buys returned items (the q25/q29 cross-channel
+        # chain needs store-return -> catalog-purchase correlation)
+        self._gen_store_returns(rng)
         self._gen_catalog_sales(rng, max(25_000, int(sf * 1_441_548)))
         self._gen_web_sales(rng, max(15_000, int(sf * 719_384)))
+        self._gen_catalog_returns(rng)
         self._gen_inventory(rng, max(260_000, int(sf * 11_745_000)))
 
     def _gen_date_dim(self):
@@ -389,6 +453,10 @@ class TpcdsData:
                 [b"desc of item %d" % i
                  for i in range(1, n + 1)]),
             "i_wholesale_cost": _cents(rng, 0.30, 80.00, n),
+            "i_color": _enc(
+                self.dicts, "i_color",
+                [_COLORS[c] for c in
+                 rng.integers(0, len(_COLORS), n).tolist()]),
         }
 
     def _gen_store(self, rng, n: int):
@@ -412,14 +480,31 @@ class TpcdsData:
                               for i in range(n)]),
             "s_number_employees": rng.integers(
                 180, 310, n).astype(np.int32),
+            # TN dominates (dsdgen's single-state default; the q1
+            # literal)
+            "s_state": _enc(
+                self.dicts, "s_state",
+                [b"TN" if f else b"SD"
+                 for f in rng.random(n) < 0.8]),
         }
 
     def _gen_time_dim(self):
         sk = np.arange(86_400, dtype=np.int64)
+        hour = (sk // 3600).astype(np.int32)
+        # dsdgen meal times: breakfast 6-9, lunch 11-13, dinner 17-21,
+        # empty otherwise (the spec's NULL; queries test equality only)
+        meal = np.select(
+            [(hour >= 6) & (hour < 9), (hour >= 11) & (hour < 13),
+             (hour >= 17) & (hour < 21)],
+            [0, 1, 2], default=3)
+        meal_names = [b"breakfast", b"lunch", b"dinner", b""]
         self.tables["time_dim"] = {
             "t_time_sk": sk,
-            "t_hour": (sk // 3600).astype(np.int32),
+            "t_hour": hour,
             "t_minute": ((sk % 3600) // 60).astype(np.int32),
+            "t_meal_time": _enc(
+                self.dicts, "t_meal_time",
+                [meal_names[m] for m in meal.tolist()]),
         }
 
     def _gen_promotion(self, rng, n: int):
@@ -497,9 +582,18 @@ class TpcdsData:
                 self.dicts, "ca_county",
                 [_COUNTIES[i] for i in
                  rng.integers(0, len(_COUNTIES), n_addr).tolist()]),
+            # US timezone offsets; -5 dominates (the q33/q60 literal)
+            "ca_gmt_offset": np.select(
+                [rng.random(n_addr) < 0.4,
+                 rng.random(n_addr) < 0.5,
+                 rng.random(n_addr) < 0.5],
+                [-5, -6, -7], default=-8).astype(np.int32),
         }
         self.tables["customer"] = {
             "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
+            "c_customer_id": _enc(
+                self.dicts, "c_customer_id",
+                [b"AAAAAAAA%08dCA" % i for i in range(1, n_cust + 1)]),
             "c_current_addr_sk": rng.integers(
                 1, n_addr + 1, n_cust, dtype=np.int64),
             "c_first_name": _enc(
@@ -605,6 +699,13 @@ class TpcdsData:
                 0).astype(np.int64),
             "cs_bill_customer_sk": self._fk(
                 rng, "customer", "c_customer_sk", n),
+            "cs_bill_addr_sk": self._fk(
+                rng, "customer_address", "ca_address_sk", n),
+            "cs_sold_time_sk": rng.integers(0, 86_400, n,
+                                            dtype=np.int64),
+            # one order per row: returns join on (order, item) exactly
+            "cs_order_number": np.arange(1, n + 1, dtype=np.int64),
+            "cs_net_profit": _cents(rng, -100.0, 300.0, n),
             "cs_ext_discount_amt": np.where(
                 rng.random(n) < 0.5, _cents(rng, 0.0, 80.0, n),
                 0).astype(np.int64),
@@ -615,10 +716,25 @@ class TpcdsData:
             "cs_call_center_sk": self._fk(
                 rng, "call_center", "cc_call_center_sk", n),
         }
-        # shipping: 1..120 days after the sale (q99 buckets), clamped
-        # into the date_dim domain
+        # cross-channel correlation: ~5% of catalog orders are a
+        # customer re-buying an item they returned in a store (the
+        # q25/q29 store->return->catalog chain), sold 1..30 days after
+        # the return
         cs = self.tables["catalog_sales"]
         max_sk = int(self.tables["date_dim"]["d_date_sk"].max())
+        sr = self.tables.get("store_returns")
+        if sr is not None and len(sr["sr_item_sk"]):
+            n_inj = min(len(sr["sr_item_sk"]), n // 20)
+            src = rng.choice(len(sr["sr_item_sk"]), n_inj,
+                             replace=False)
+            dst = rng.choice(n, n_inj, replace=False)
+            cs["cs_bill_customer_sk"][dst] = sr["sr_customer_sk"][src]
+            cs["cs_item_sk"][dst] = sr["sr_item_sk"][src]
+            cs["cs_sold_date_sk"][dst] = np.minimum(
+                sr["sr_returned_date_sk"][src]
+                + rng.integers(1, 31, n_inj), max_sk)
+        # shipping: 1..120 days after the sale (q99 buckets), clamped
+        # into the date_dim domain
         cs["cs_ship_date_sk"] = np.minimum(
             cs["cs_sold_date_sk"] + rng.integers(1, 151, n), max_sk)
 
@@ -629,6 +745,9 @@ class TpcdsData:
                 self.dicts, "w_warehouse_name",
                 [b"Warehouse number %d distribution" % i
                  for i in range(1, 6)]),
+            "w_state": _enc(
+                self.dicts, "w_state",
+                [b"TN", b"SD", b"TN", b"OH", b"GA"]),
         }
         self.tables["ship_mode"] = {
             "sm_ship_mode_sk": np.arange(1, 21, dtype=np.int64),
@@ -664,6 +783,74 @@ class TpcdsData:
             "ws_ext_discount_amt": np.where(
                 rng.random(n) < 0.5, _cents(rng, 0.0, 90.0, n),
                 0).astype(np.int64),
+            "ws_bill_addr_sk": self._fk(
+                rng, "customer_address", "ca_address_sk", n),
+            "ws_sold_time_sk": rng.integers(0, 86_400, n,
+                                            dtype=np.int64),
+            "ws_net_profit": _cents(rng, -100.0, 300.0, n),
+        }
+
+    def _gen_reason(self):
+        self.tables["reason"] = {
+            "r_reason_sk": np.arange(1, len(_REASONS) + 1,
+                                     dtype=np.int64),
+            "r_reason_desc": _enc(self.dicts, "r_reason_desc",
+                                  list(_REASONS)),
+        }
+
+    def _gen_store_returns(self, rng):
+        """~10% of store_sales line items come back 1..60 days later.
+
+        Returns keep the sale's (customer, item, ticket) triple so the
+        q25/q29 chain joins and the q50 day-bucketing land on real
+        matches; the returned quantity is 1..sold quantity."""
+        ss = self.tables["store_sales"]
+        n_ss = len(ss["ss_item_sk"])
+        pick = np.flatnonzero(rng.random(n_ss) < 0.10)
+        # a ticket can hold the same item twice; the returns PK is
+        # (item, ticket), so keep one return per pair
+        key = (ss["ss_item_sk"][pick] * (1 << 32)
+               + ss["ss_ticket_number"][pick])
+        pick = pick[np.unique(key, return_index=True)[1]]
+        n = len(pick)
+        max_sk = int(self.tables["date_dim"]["d_date_sk"].max())
+        ret_qty = rng.integers(1, ss["ss_quantity"][pick] + 1)
+        ret_amt = (ss["ss_sales_price"][pick] * ret_qty).astype(np.int64)
+        self.tables["store_returns"] = {
+            "sr_returned_date_sk": np.minimum(
+                ss["ss_sold_date_sk"][pick]
+                + rng.integers(1, 61, n), max_sk),
+            "sr_item_sk": ss["ss_item_sk"][pick],
+            "sr_customer_sk": ss["ss_customer_sk"][pick],
+            "sr_ticket_number": ss["ss_ticket_number"][pick],
+            "sr_store_sk": ss["ss_store_sk"][pick],
+            "sr_reason_sk": self._fk(rng, "reason", "r_reason_sk", n),
+            "sr_return_quantity": ret_qty.astype(np.int32),
+            "sr_return_amt": ret_amt,
+            "sr_net_loss": _cents(rng, 0.50, 120.00, n),
+        }
+
+    def _gen_catalog_returns(self, rng):
+        """~8% of catalog_sales rows return; the (order, item) pair is
+        the join identity (each generated order holds one line)."""
+        cs = self.tables["catalog_sales"]
+        n_cs = len(cs["cs_item_sk"])
+        pick = np.flatnonzero(rng.random(n_cs) < 0.08)
+        n = len(pick)
+        max_sk = int(self.tables["date_dim"]["d_date_sk"].max())
+        ret_qty = rng.integers(1, cs["cs_quantity"][pick] + 1)
+        self.tables["catalog_returns"] = {
+            "cr_returned_date_sk": np.minimum(
+                cs["cs_sold_date_sk"][pick]
+                + rng.integers(1, 61, n), max_sk),
+            "cr_item_sk": cs["cs_item_sk"][pick],
+            "cr_order_number": cs["cs_order_number"][pick],
+            "cr_returning_customer_sk": cs["cs_bill_customer_sk"][pick],
+            "cr_return_quantity": ret_qty.astype(np.int32),
+            "cr_return_amount": (cs["cs_sales_price"][pick]
+                                 * ret_qty).astype(np.int64),
+            "cr_refunded_cash": _cents(rng, 0.50, 150.00, n),
+            "cr_net_loss": _cents(rng, 0.50, 120.00, n),
         }
 
     def _gen_inventory(self, rng, n: int):
@@ -1313,6 +1500,312 @@ where d_month_seq between 36 and 47
   and cs_call_center_sk = cc_call_center_sk
 group by wname, sm_type, cc_name
 order by wname, sm_type, cc_name
+limit 100""",
+    # q33: Electronics revenue by manufacturer across all three sales
+    # channels (CTE per channel, UNION ALL, re-aggregate;
+    # deterministic i_manufact_id tiebreaker added)
+    "q33": """
+with ss as (
+  select i_manufact_id, sum(ss_ext_sales_price) as total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category = 'Electronics')
+    and ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 5
+    and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id),
+cs as (
+  select i_manufact_id, sum(cs_ext_sales_price) as total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category = 'Electronics')
+    and cs_item_sk = i_item_sk
+    and cs_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 5
+    and cs_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id),
+ws as (
+  select i_manufact_id, sum(ws_ext_sales_price) as total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category = 'Electronics')
+    and ws_item_sk = i_item_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 5
+    and ws_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id)
+select i_manufact_id, sum(total_sales) as total_sales
+from (select i_manufact_id, total_sales from ss
+      union all
+      select i_manufact_id, total_sales from cs
+      union all
+      select i_manufact_id, total_sales from ws) tmp1
+group by i_manufact_id
+order by total_sales, i_manufact_id
+limit 100""",
+    # q56: three-channel revenue for items in chosen colors
+    "q56": """
+with ss as (
+  select i_item_id, sum(ss_ext_sales_price) as total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('slate', 'blanched',
+                                        'cornsilk'))
+    and ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 2
+    and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+cs as (
+  select i_item_id, sum(cs_ext_sales_price) as total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('slate', 'blanched',
+                                        'cornsilk'))
+    and cs_item_sk = i_item_sk
+    and cs_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 2
+    and cs_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+ws as (
+  select i_item_id, sum(ws_ext_sales_price) as total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('slate', 'blanched',
+                                        'cornsilk'))
+    and ws_item_sk = i_item_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 2
+    and ws_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id)
+select i_item_id, sum(total_sales) as total_sales
+from (select i_item_id, total_sales from ss
+      union all
+      select i_item_id, total_sales from cs
+      union all
+      select i_item_id, total_sales from ws) tmp1
+group by i_item_id
+order by total_sales, i_item_id
+limit 100""",
+    # q60: three-channel revenue for the Music category
+    "q60": """
+with ss as (
+  select i_item_id, sum(ss_ext_sales_price) as total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_category = 'Music')
+    and ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 9
+    and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+cs as (
+  select i_item_id, sum(cs_ext_sales_price) as total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_category = 'Music')
+    and cs_item_sk = i_item_sk
+    and cs_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 9
+    and cs_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+ws as (
+  select i_item_id, sum(ws_ext_sales_price) as total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_category = 'Music')
+    and ws_item_sk = i_item_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 9
+    and ws_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id)
+select i_item_id, sum(total_sales) as total_sales
+from (select i_item_id, total_sales from ss
+      union all
+      select i_item_id, total_sales from cs
+      union all
+      select i_item_id, total_sales from ws) tmp1
+group by i_item_id
+order by i_item_id, total_sales
+limit 100""",
+    # q71: brand revenue by meal-time minute across the three channels
+    # (deterministic brand/hour/minute tiebreakers added)
+    "q71": """
+select i_brand_id as brand_id, i_brand as brand,
+       t_hour, t_minute, sum(ext_price) as ext_price
+from item,
+     (select ws_ext_sales_price as ext_price,
+             ws_item_sk as sold_item_sk,
+             ws_sold_time_sk as time_sk
+      from web_sales, date_dim
+      where d_date_sk = ws_sold_date_sk
+        and d_moy = 11 and d_year = 1999
+      union all
+      select cs_ext_sales_price as ext_price,
+             cs_item_sk as sold_item_sk,
+             cs_sold_time_sk as time_sk
+      from catalog_sales, date_dim
+      where d_date_sk = cs_sold_date_sk
+        and d_moy = 11 and d_year = 1999
+      union all
+      select ss_ext_sales_price as ext_price,
+             ss_item_sk as sold_item_sk,
+             ss_sold_time_sk as time_sk
+      from store_sales, date_dim
+      where d_date_sk = ss_sold_date_sk
+        and d_moy = 11 and d_year = 1999) tmp,
+     time_dim
+where sold_item_sk = i_item_sk
+  and i_manager_id = 1
+  and time_sk = t_time_sk
+  and (t_meal_time = 'breakfast' or t_meal_time = 'dinner')
+group by i_brand, i_brand_id, t_hour, t_minute
+order by ext_price desc, brand_id, t_hour, t_minute""",
+    # q1: customers returning over 1.2x their store's average (CTE
+    # referenced twice; correlated per-store average; q6's multiplier
+    # placement)
+    "q1": """
+with customer_total_return as (
+  select sr_customer_sk as ctr_customer_sk,
+         sr_store_sk as ctr_store_sk,
+         sum(sr_return_amt) as ctr_total_return
+  from store_returns, date_dim
+  where sr_returned_date_sk = d_date_sk and d_year = 2000
+  group by sr_customer_sk, sr_store_sk)
+select c_customer_id
+from customer_total_return ctr1, store, customer
+where ctr1.ctr_total_return >
+      1.2 * (select avg(ctr2.ctr_total_return)
+             from customer_total_return ctr2
+             where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  and s_store_sk = ctr1.ctr_store_sk
+  and s_state = 'TN'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id
+limit 100""",
+    # q25: store sale -> store return -> catalog re-purchase profit
+    # chain by item and store
+    "q25": """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) as store_sales_profit,
+       sum(sr_net_loss) as store_returns_loss,
+       sum(cs_net_profit) as catalog_sales_profit
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_moy = 4 and d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 10 and d2.d_year = 2001
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_moy between 4 and 10 and d3.d_year = 2001
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100""",
+    # q29: the same chain, quantities over a wider catalog window
+    "q29": """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) as store_sales_quantity,
+       sum(sr_return_quantity) as store_returns_quantity,
+       sum(cs_quantity) as catalog_sales_quantity
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_moy = 9 and d1.d_year = 1999
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 9 and 12 and d2.d_year = 1999
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_year in (1999, 2000, 2001)
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100""",
+    # q40: catalog sales net of refunds by warehouse state around a
+    # pivot date (left join to returns; NULL refund -> full price)
+    "q40": """
+select w_state, i_item_id,
+  sum(case when d_date < date '2000-03-11' then
+        case when cr_refunded_cash is null then cs_sales_price
+             else cs_sales_price - cr_refunded_cash end
+      else 0 end) as sales_before,
+  sum(case when d_date >= date '2000-03-11' then
+        case when cr_refunded_cash is null then cs_sales_price
+             else cs_sales_price - cr_refunded_cash end
+      else 0 end) as sales_after
+from catalog_sales
+  left join catalog_returns
+    on cs_order_number = cr_order_number
+   and cs_item_sk = cr_item_sk,
+  warehouse, item, date_dim
+where i_current_price between 0.99 and 1.49
+  and i_item_sk = cs_item_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '2000-02-10' and date '2000-04-10'
+group by w_state, i_item_id
+order by w_state, i_item_id
+limit 100""",
+    # q50: return-lag day buckets per store for August-2001 returns
+    "q50": """
+select s_store_name, s_store_id,
+  sum(case when sr_returned_date_sk - ss_sold_date_sk <= 30
+      then 1 else 0 end) as d30,
+  sum(case when sr_returned_date_sk - ss_sold_date_sk > 30
+           and sr_returned_date_sk - ss_sold_date_sk <= 60
+      then 1 else 0 end) as d60,
+  sum(case when sr_returned_date_sk - ss_sold_date_sk > 60
+           and sr_returned_date_sk - ss_sold_date_sk <= 90
+      then 1 else 0 end) as d90,
+  sum(case when sr_returned_date_sk - ss_sold_date_sk > 90
+           and sr_returned_date_sk - ss_sold_date_sk <= 120
+      then 1 else 0 end) as d120,
+  sum(case when sr_returned_date_sk - ss_sold_date_sk > 120
+      then 1 else 0 end) as dmore
+from store_sales, store_returns, store, date_dim d2
+where d2.d_year = 2001 and d2.d_moy = 8
+  and ss_ticket_number = sr_ticket_number
+  and ss_item_sk = sr_item_sk
+  and ss_customer_sk = sr_customer_sk
+  and sr_returned_date_sk = d2.d_date_sk
+  and ss_store_sk = s_store_sk
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id
+limit 100""",
+    # q93: per-customer sales net of returns for one return reason
+    "q93": """
+select ss_customer_sk, sum(act_sales) as sumsales
+from (select ss_customer_sk,
+             (ss_quantity - sr_return_quantity) * ss_sales_price
+               as act_sales
+      from store_sales, store_returns, reason
+      where sr_item_sk = ss_item_sk
+        and sr_ticket_number = ss_ticket_number
+        and sr_reason_sk = r_reason_sk
+        and r_reason_desc = 'Stopped working') t
+group by ss_customer_sk
+order by sumsales, ss_customer_sk
 limit 100""",
 }
 
@@ -2266,6 +2759,324 @@ class _Ref:
         rows.sort(key=lambda r: (r[0], r[1], r[2]))
         return rows[:100]
 
+    # -- channel-union queries (q33/q56/q60/q71) --
+
+    def _item_pos(self):
+        it = self.d.tables["item"]
+        sks = it["i_item_sk"]
+        pos = np.full(int(sks.max()) + 1, -1, dtype=np.int64)
+        pos[sks] = np.arange(len(sks))
+        return pos
+
+    _CHANNELS = (
+        ("store_sales", "ss_sold_date_sk", "ss_item_sk",
+         "ss_addr_sk", "ss_ext_sales_price"),
+        ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+         "cs_bill_addr_sk", "cs_ext_sales_price"),
+        ("web_sales", "ws_sold_date_sk", "ws_item_sk",
+         "ws_bill_addr_sk", "ws_ext_sales_price"),
+    )
+
+    def _chan_union(self, year, moy, item_ok, key_of):
+        """Three-channel union: ext_sales_price summed by an item
+        attribute, branches filtered to (year, moy) x gmt_offset -5."""
+        d = self.d
+        dd = d.tables["date_dim"]
+        dok = dd["d_date_sk"][(dd["d_year"] == year)
+                              & (dd["d_moy"] == moy)]
+        ca = d.tables["customer_address"]
+        aok = ca["ca_address_sk"][ca["ca_gmt_offset"] == -5]
+        pos = self._item_pos()
+        acc: dict = collections.defaultdict(int)
+        for t, dk, ik, ak, p in self._CHANNELS:
+            tb = d.tables[t]
+            m = np.isin(tb[dk], dok) & np.isin(tb[ak], aok)
+            rows = pos[tb[ik][m]]
+            price = tb[p][m]
+            keep = item_ok[rows]
+            for r, pp in zip(rows[keep].tolist(), price[keep].tolist()):
+                acc[key_of(r)] += pp
+        return acc
+
+    def q33(self):
+        it = self.d.tables["item"]
+        cats = _decode(self.d, "item", "i_category")
+        # IN (select i_manufact_id ... where category='Electronics'):
+        # every item of any manufacturer with >= 1 Electronics item
+        # qualifies, regardless of that item's own category
+        manu_ok = set(
+            it["i_manufact_id"][cats == b"Electronics"].tolist())
+        item_ok = np.array(
+            [int(m) in manu_ok for m in it["i_manufact_id"]])
+        acc = self._chan_union(
+            1998, 5, item_ok,
+            lambda r: int(it["i_manufact_id"][r]))
+        return sorted(acc.items(), key=lambda kv: (kv[1], kv[0]))[:100]
+
+    def q56(self):
+        colors = _decode(self.d, "item", "i_color")
+        ids = _decode(self.d, "item", "i_item_id")
+        ok = np.isin(colors, [b"slate", b"blanched", b"cornsilk"])
+        acc = self._chan_union(2001, 2, ok, lambda r: ids[r])
+        return sorted(acc.items(), key=lambda kv: (kv[1], kv[0]))[:100]
+
+    def q60(self):
+        cats = _decode(self.d, "item", "i_category")
+        ids = _decode(self.d, "item", "i_item_id")
+        acc = self._chan_union(1998, 9, cats == b"Music",
+                               lambda r: ids[r])
+        return sorted(acc.items(), key=lambda kv: (kv[0], kv[1]))[:100]
+
+    def q71(self):
+        d = self.d
+        it = d.tables["item"]
+        dd = d.tables["date_dim"]
+        dok = dd["d_date_sk"][(dd["d_year"] == 1999)
+                              & (dd["d_moy"] == 11)]
+        brands = _decode(d, "item", "i_brand")
+        pos = self._item_pos()
+        acc: dict = collections.defaultdict(int)
+        for t, dk, ik, ak, p in self._CHANNELS:
+            tk = {"store_sales": "ss_sold_time_sk",
+                  "catalog_sales": "cs_sold_time_sk",
+                  "web_sales": "ws_sold_time_sk"}[t]
+            tb = d.tables[t]
+            m = np.isin(tb[dk], dok)
+            rows = pos[tb[ik][m]]
+            tks = tb[tk][m]
+            price = tb[p][m]
+            hour = tks // 3600
+            keep = (it["i_manager_id"][rows] == 1) & (
+                ((hour >= 6) & (hour < 9))
+                | ((hour >= 17) & (hour < 21)))
+            for r, tsec, pp in zip(rows[keep].tolist(),
+                                   tks[keep].tolist(),
+                                   price[keep].tolist()):
+                acc[(int(it["i_brand_id"][r]), brands[r],
+                     int(tsec) // 3600,
+                     (int(tsec) % 3600) // 60)] += pp
+        rows_ = [(*k, v) for k, v in acc.items()]
+        rows_.sort(key=lambda r: (-r[4], r[0], r[2], r[3]))
+        return rows_
+
+    # -- returns-chain queries (q1/q25/q29/q40/q50/q93) --
+
+    def _date_cols(self, sks):
+        """(year, moy, date) arrays for date-sk array, via the
+        contiguous sk layout d_date_sk = _D0_SK + row."""
+        dd = self.d.tables["date_dim"]
+        idx = np.asarray(sks) - _D0_SK
+        return dd["d_year"][idx], dd["d_moy"][idx], dd["d_date"][idx]
+
+    def q1(self):
+        d = self.d
+        sr = d.tables["store_returns"]
+        yr, _, _ = self._date_cols(sr["sr_returned_date_sk"])
+        m = yr == 2000
+        acc: dict = collections.defaultdict(int)
+        for c, s, a in zip(sr["sr_customer_sk"][m].tolist(),
+                           sr["sr_store_sk"][m].tolist(),
+                           sr["sr_return_amt"][m].tolist()):
+            acc[(c, s)] += a
+        per_store: dict = collections.defaultdict(list)
+        for (c, s), t in acc.items():
+            per_store[s].append(t)
+        st = d.tables["store"]
+        states = _decode(d, "store", "s_state")
+        tn = {sk for sk, stt in zip(st["s_store_sk"].tolist(), states)
+              if stt == b"TN"}
+        cids = _decode(d, "customer", "c_customer_id")
+        out = []
+        for (c, s), t in acc.items():
+            if s in tn and t > 1.2 * (sum(per_store[s])
+                                      / len(per_store[s])):
+                out.append(cids[c - 1])
+        out.sort()
+        return [(x,) for x in out[:100]]
+
+    def _chain_rows(self, d1_ok, d2_ok, d3_ok):
+        """(ss_row, sr_row, cs_row) triples of the q25/q29 join chain:
+        store sale (d1) -> its return (d2) -> catalog purchases by the
+        same (customer, item) (d3)."""
+        d = self.d
+        ss, sr = d.tables["store_sales"], d.tables["store_returns"]
+        cs = d.tables["catalog_sales"]
+        ss_rows: dict = collections.defaultdict(list)
+        for i, (c, k, t) in enumerate(zip(
+                ss["ss_customer_sk"].tolist(),
+                ss["ss_item_sk"].tolist(),
+                ss["ss_ticket_number"].tolist())):
+            if d1_ok[i]:
+                ss_rows[(c, k, t)].append(i)
+        cs_rows: dict = collections.defaultdict(list)
+        for j, (c, k) in enumerate(zip(
+                cs["cs_bill_customer_sk"].tolist(),
+                cs["cs_item_sk"].tolist())):
+            if d3_ok[j]:
+                cs_rows[(c, k)].append(j)
+        out = []
+        for r, (c, k, t) in enumerate(zip(
+                sr["sr_customer_sk"].tolist(),
+                sr["sr_item_sk"].tolist(),
+                sr["sr_ticket_number"].tolist())):
+            if not d2_ok[r]:
+                continue
+            for i in ss_rows.get((c, k, t), ()):
+                for j in cs_rows.get((c, k), ()):
+                    out.append((i, r, j))
+        return out
+
+    def _chain_agg(self, d1_ok, d2_ok, d3_ok, ss_col, sr_col, cs_col):
+        d = self.d
+        ss, sr = d.tables["store_sales"], d.tables["store_returns"]
+        cs = d.tables["catalog_sales"]
+        it, st = d.tables["item"], d.tables["store"]
+        iids = _decode(d, "item", "i_item_id")
+        idescs = _decode(d, "item", "i_item_desc")
+        sids = _decode(d, "store", "s_store_id")
+        snames = _decode(d, "store", "s_store_name")
+        ipos = self._item_pos()
+        spos = {sk: i for i, sk in enumerate(
+            st["s_store_sk"].tolist())}
+        acc: dict = collections.defaultdict(lambda: [0, 0, 0])
+        for i, r, j in self._chain_rows(d1_ok, d2_ok, d3_ok):
+            ir = ipos[ss["ss_item_sk"][i]]
+            sp = spos[ss["ss_store_sk"][i]]
+            k = (iids[ir], idescs[ir], sids[sp], snames[sp])
+            acc[k][0] += int(ss[ss_col][i])
+            acc[k][1] += int(sr[sr_col][r])
+            acc[k][2] += int(cs[cs_col][j])
+        rows = [(*k, *v) for k, v in sorted(acc.items())]
+        return rows[:100]
+
+    def q25(self):
+        d = self.d
+        y1, m1, _ = self._date_cols(
+            d.tables["store_sales"]["ss_sold_date_sk"])
+        y2, m2, _ = self._date_cols(
+            d.tables["store_returns"]["sr_returned_date_sk"])
+        y3, m3, _ = self._date_cols(
+            d.tables["catalog_sales"]["cs_sold_date_sk"])
+        return self._chain_agg(
+            (y1 == 2001) & (m1 == 4),
+            (y2 == 2001) & (m2 >= 4) & (m2 <= 10),
+            (y3 == 2001) & (m3 >= 4) & (m3 <= 10),
+            "ss_net_profit", "sr_net_loss", "cs_net_profit")
+
+    def q29(self):
+        d = self.d
+        y1, m1, _ = self._date_cols(
+            d.tables["store_sales"]["ss_sold_date_sk"])
+        y2, m2, _ = self._date_cols(
+            d.tables["store_returns"]["sr_returned_date_sk"])
+        y3, _, _ = self._date_cols(
+            d.tables["catalog_sales"]["cs_sold_date_sk"])
+        return self._chain_agg(
+            (y1 == 1999) & (m1 == 9),
+            (y2 == 1999) & (m2 >= 9) & (m2 <= 12),
+            np.isin(y3, (1999, 2000, 2001)),
+            "ss_quantity", "sr_return_quantity", "cs_quantity")
+
+    def q40(self):
+        d = self.d
+        cs = d.tables["catalog_sales"]
+        cr = d.tables["catalog_returns"]
+        it = d.tables["item"]
+        refund = {(o, k): c for o, k, c in zip(
+            cr["cr_order_number"].tolist(),
+            cr["cr_item_sk"].tolist(),
+            cr["cr_refunded_cash"].tolist())}
+        wstates = _decode(d, "warehouse", "w_state")
+        wpos = {sk: i for i, sk in enumerate(
+            d.tables["warehouse"]["w_warehouse_sk"].tolist())}
+        iids = _decode(d, "item", "i_item_id")
+        ipos = self._item_pos()
+        _, _, dates = self._date_cols(cs["cs_sold_date_sk"])
+        pivot = int((np.datetime64("2000-03-11", "D")
+                     - np.datetime64("1970-01-01", "D")).astype(int))
+        lo = pivot - 30
+        hi = pivot + 30
+        acc: dict = collections.defaultdict(lambda: [0, 0])
+        for j, (dt, ik, wk, o, p) in enumerate(zip(
+                dates.tolist(), cs["cs_item_sk"].tolist(),
+                cs["cs_warehouse_sk"].tolist(),
+                cs["cs_order_number"].tolist(),
+                cs["cs_sales_price"].tolist())):
+            if not (lo <= dt <= hi):
+                continue
+            ir = ipos[ik]
+            if not (99 <= it["i_current_price"][ir] <= 149):
+                continue
+            net = p - refund.get((o, ik), 0)
+            k = (wstates[wpos[wk]], iids[ir])
+            acc[k][0 if dt < pivot else 1] += net
+        rows = [(*k, *v) for k, v in sorted(acc.items())]
+        return rows[:100]
+
+    def q50(self):
+        d = self.d
+        ss, sr = d.tables["store_sales"], d.tables["store_returns"]
+        y2, m2, _ = self._date_cols(sr["sr_returned_date_sk"])
+        sold = dict()
+        for i, (c, k, t) in enumerate(zip(
+                ss["ss_customer_sk"].tolist(),
+                ss["ss_item_sk"].tolist(),
+                ss["ss_ticket_number"].tolist())):
+            sold.setdefault((c, k, t), []).append(i)
+        st = d.tables["store"]
+        sids = _decode(d, "store", "s_store_id")
+        snames = _decode(d, "store", "s_store_name")
+        spos = {sk: i for i, sk in enumerate(
+            st["s_store_sk"].tolist())}
+        acc: dict = collections.defaultdict(lambda: [0] * 5)
+        for r in np.flatnonzero((y2 == 2001) & (m2 == 8)).tolist():
+            key = (sr["sr_customer_sk"][r], sr["sr_item_sk"][r],
+                   sr["sr_ticket_number"][r])
+            for i in sold.get(key, ()):
+                lag = int(sr["sr_returned_date_sk"][r]
+                          - ss["ss_sold_date_sk"][i])
+                sp = spos[ss["ss_store_sk"][i]]
+                st_ = acc[(snames[sp], sids[sp])]
+                if lag <= 30:
+                    st_[0] += 1
+                elif lag <= 60:
+                    st_[1] += 1
+                elif lag <= 90:
+                    st_[2] += 1
+                elif lag <= 120:
+                    st_[3] += 1
+                else:
+                    st_[4] += 1
+        rows = [(*k, *v) for k, v in sorted(acc.items())]
+        return rows[:100]
+
+    def q93(self):
+        d = self.d
+        ss, sr = d.tables["store_sales"], d.tables["store_returns"]
+        rdesc = _decode(d, "reason", "r_reason_desc")
+        rok = {sk for sk, t in zip(
+            d.tables["reason"]["r_reason_sk"].tolist(), rdesc)
+            if t == b"Stopped working"}
+        pairs: dict = collections.defaultdict(list)
+        for i, (k, t) in enumerate(zip(
+                ss["ss_item_sk"].tolist(),
+                ss["ss_ticket_number"].tolist())):
+            pairs[(k, t)].append(i)
+        acc: dict = collections.defaultdict(int)
+        for r, (k, t, rk, q) in enumerate(zip(
+                sr["sr_item_sk"].tolist(),
+                sr["sr_ticket_number"].tolist(),
+                sr["sr_reason_sk"].tolist(),
+                sr["sr_return_quantity"].tolist())):
+            if rk not in rok:
+                continue
+            for i in pairs.get((k, t), ()):
+                acc[int(ss["ss_customer_sk"][i])] += (
+                    int(ss["ss_quantity"][i]) - q
+                ) * int(ss["ss_sales_price"][i])
+        rows = sorted(acc.items(), key=lambda kv: (kv[1], kv[0]))
+        return rows[:100]
+
 
 def run_tpcds(sf: float = 0.01, queries=None, iterations: int = 1,
               seed: int = 42, verify: bool = True):
@@ -2357,6 +3168,28 @@ _VERIFY_COLS = {
     "q79": (("c_last_name", "str"), ("c_first_name", "str"),
             ("city30", "str"), ("ss_ticket_number", "int"),
             ("amt", "dec"), ("profit", "dec")),
+    "q1": (("c_customer_id", "str"),),
+    "q25": (("i_item_id", "str"), ("i_item_desc", "str"),
+            ("s_store_id", "str"), ("s_store_name", "str"),
+            ("store_sales_profit", "dec"),
+            ("store_returns_loss", "dec"),
+            ("catalog_sales_profit", "dec")),
+    "q29": (("i_item_id", "str"), ("i_item_desc", "str"),
+            ("s_store_id", "str"), ("s_store_name", "str"),
+            ("store_sales_quantity", "int"),
+            ("store_returns_quantity", "int"),
+            ("catalog_sales_quantity", "int")),
+    "q40": (("w_state", "str"), ("i_item_id", "str"),
+            ("sales_before", "dec"), ("sales_after", "dec")),
+    "q50": (("s_store_name", "str"), ("s_store_id", "str"),
+            ("d30", "int"), ("d60", "int"), ("d90", "int"),
+            ("d120", "int"), ("dmore", "int")),
+    "q93": (("ss_customer_sk", "int"), ("sumsales", "dec")),
+    "q33": (("i_manufact_id", "int"), ("total_sales", "dec")),
+    "q56": (("i_item_id", "str"), ("total_sales", "dec")),
+    "q60": (("i_item_id", "str"), ("total_sales", "dec")),
+    "q71": (("brand_id", "int"), ("brand", "str"), ("t_hour", "int"),
+            ("t_minute", "int"), ("ext_price", "dec")),
     "q98": (("i_item_id", "str"), ("i_item_desc", "str"),
             ("i_category", "str"), ("i_class", "str"),
             ("i_current_price", "dec"), ("itemrevenue", "dec"),
