@@ -211,3 +211,74 @@ def test_crash_mid_compaction_replays_to_precompaction_state():
     # staged blobs were orphan-collected; a fresh compaction completes
     booted.compact()
     assert _count(booted) == pre
+
+
+# ---------------- device block cache (HBM page-cache analog) ----------------
+
+
+def _sum_val(shard, snap=None):
+    prog = Program((
+        GroupByStep(keys=(), aggs=(AggSpec(Agg.SUM, "val", "s"),)),
+    ))
+    return int(shard.scan(prog, snap).cols["s"][0][0])
+
+
+def test_block_cache_hits_and_invalidates_on_commit():
+    """Warm scans reuse device-resident blocks; a commit changes the
+    visible portion set, so the next scan must see the new rows (the
+    shared_sausagecache analog keyed by immutable portion ids)."""
+    shard = _shard(scan_cache_bytes=64 << 20)
+    shard.commit([_write(shard, [1, 2, 3], vals=[10, 20, 30])])
+    snap1 = shard.snap
+    assert _sum_val(shard) == 60
+    assert len(shard._block_cache) == 1
+    # warm scan: same result, served from the cached blocks
+    assert _sum_val(shard) == 60
+    # new commit -> new key -> fresh read sees the extra rows
+    shard.commit([_write(shard, [4], vals=[40])])
+    assert _sum_val(shard) == 100
+    # a warm scan AT THE OLD SNAPSHOT must keep resolving through its
+    # own entry (same portion set as the first scan), never the newer
+    # commit's blocks — and vice versa
+    assert _sum_val(shard, snap1) == 60
+    assert _sum_val(shard, snap1) == 60
+    assert _sum_val(shard) == 100
+    assert len(shard._block_cache) == 2
+    # GC of superseded portions frees their now-unreachable entries
+    shard.compact()
+    shard.gc_blobs(keep_snap=shard.snap)
+    assert _sum_val(shard) == 100
+    live = set(shard.portions)
+    assert all(set(k[0]) <= live for k in shard._block_cache)
+
+
+def test_block_cache_correct_after_compaction_and_ttl():
+    shard = _shard(scan_cache_bytes=64 << 20,
+                   compact_portion_threshold=10 ** 9)
+    for i in range(4):
+        shard.commit([_write(shard, [i * 10 + 1, i * 10 + 2],
+                             ts=[50 + i, 50 + i])])
+    before = _count(shard)
+    assert _sum_val(shard) > 0
+    shard.compact()
+    assert _count(shard) == before  # post-compaction portions re-read
+    evicted = shard.evict_ttl(52)
+    assert evicted > 0
+    assert _count(shard) < before
+
+
+def test_block_cache_respects_budget():
+    """Entries beyond the byte budget evict LRU; an over-budget scan
+    is never pinned at all."""
+    shard = _shard(scan_cache_bytes=1)  # nothing fits
+    shard.commit([_write(shard, list(range(100)))])
+    assert _count(shard) == 100
+    assert len(shard._block_cache) == 0
+    assert shard._block_cache_nbytes == 0
+
+
+def test_block_cache_off_by_default_on_cpu():
+    shard = _shard()
+    shard.commit([_write(shard, [1, 2])])
+    assert _count(shard) == 2
+    assert len(shard._block_cache) == 0

@@ -64,8 +64,9 @@ class OracleTable:
 
     @staticmethod
     def from_block(block) -> "OracleTable":
-        data = block.to_numpy()
-        valid = block.validity_numpy()
+        # one batched device fetch for data + validity together: each
+        # separate fetch costs a device-link round trip
+        data, valid = block.host_columns()
         return OracleTable(
             {n: (data[n], valid[n]) for n in data}, block.schema
         )
