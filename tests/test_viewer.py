@@ -119,3 +119,17 @@ def test_unknown_endpoint_404(served):
     with pytest.raises(urllib.error.HTTPError) as ei:
         get(v, "/viewer/json/nope")
     assert ei.value.code == 404
+
+
+def test_embedded_html_ui(served):
+    """/viewer (and the reference's /monitoring alias) serves the
+    self-contained SPA that polls the JSON endpoints."""
+    _cluster, v = served
+    for path in ("/viewer", "/monitoring"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{v.port}{path}")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/html")
+            body = r.read().decode()
+        assert "ydb_tpu viewer" in body
+        assert "/viewer/json/tablets" in body  # polls the JSON APIs

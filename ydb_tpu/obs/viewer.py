@@ -124,6 +124,10 @@ class Viewer:
             with self.lock:
                 text = self.cluster.counters.encode_prometheus()
             return text.encode(), "text/plain; version=0.0.4"
+        if path in ("/viewer", "/monitoring"):
+            from ydb_tpu.obs.viewer_html import PAGE
+
+            return PAGE.encode(), "text/html; charset=utf-8"
         handlers = {
             "/": self._index,
             "/viewer/json/cluster": self._cluster,
