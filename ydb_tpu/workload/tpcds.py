@@ -14,12 +14,17 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow 40 official templates (q1, q3, q6, q7, q12, q13, q15,
-q19, q20, q21, q25, q26, q29, q32, q33, q34, q37, q40, q42, q43, q45,
-q46, q48, q50, q52, q55, q56, q60, q65, q68, q69, q71, q73, q79, q82,
-q92, q93, q96, q98, q99). The channel-union family (q33/q56/q60/q71)
-runs through real UNION ALL planning, and the returns chains
-(q1/q25/q29/q40/q50/q93) join the store/catalog returns tables.
+Queries follow 48 official templates (q1, q3, q6, q7, q12, q13, q15,
+q16, q19, q20, q21, q25, q26, q29, q30, q32, q33, q34, q37, q40, q42,
+q43, q45, q46, q48, q50, q52, q55, q56, q60, q61, q62, q65, q68, q69,
+q71, q73, q79, q81, q82, q88, q91, q92, q93, q94, q96, q98, q99). The
+channel-union family (q33/q56/q60/q71) runs through real UNION ALL
+planning; the returns chains (q1/q25/q29/q30/q40/q50/q81/q91/q93) join
+the store/catalog/web returns tables; q16/q94 run EXISTS with a <>
+correlation plus NOT EXISTS, with COUNT(DISTINCT order) restated
+exactly as a per-order derived aggregate; q61/q88 restate the official
+cross-joins of single-row derived tables exactly as CASE-filtered sums
+in one pass.
 All are restated in the framework
 dialect: q13/q48 hoist the join
 equalities shared by every OR branch (an exact identity); q34/q73
@@ -149,6 +154,8 @@ PROMOTION_SCHEMA = dtypes.schema(
     ("p_promo_sk", dtypes.INT64, False),
     ("p_channel_email", dtypes.STRING, False),
     ("p_channel_event", dtypes.STRING, False),
+    ("p_channel_dmail", dtypes.STRING, False),
+    ("p_channel_tv", dtypes.STRING, False),
 )
 
 CUSTOMER_SCHEMA = dtypes.schema(
@@ -160,6 +167,7 @@ CUSTOMER_SCHEMA = dtypes.schema(
     ("c_preferred_cust_flag", dtypes.STRING, False),
     ("c_current_cdemo_sk", dtypes.INT64, False),
     ("c_customer_id", dtypes.STRING, False),
+    ("c_current_hdemo_sk", dtypes.INT64, False),
 )
 
 CUSTOMER_ADDRESS_SCHEMA = dtypes.schema(
@@ -221,6 +229,13 @@ WEB_SALES_SCHEMA = dtypes.schema(
     ("ws_bill_addr_sk", dtypes.INT64, False),
     ("ws_sold_time_sk", dtypes.INT64, False),
     ("ws_net_profit", DEC2, False),
+    ("ws_order_number", dtypes.INT64, False),
+    ("ws_warehouse_sk", dtypes.INT64, False),
+    ("ws_ship_mode_sk", dtypes.INT64, False),
+    ("ws_web_site_sk", dtypes.INT64, False),
+    ("ws_ship_addr_sk", dtypes.INT64, False),
+    ("ws_ext_ship_cost", DEC2, False),
+    ("ws_ship_date_sk", dtypes.INT64, False),
 )
 
 INVENTORY_SCHEMA = dtypes.schema(
@@ -244,6 +259,7 @@ SHIP_MODE_SCHEMA = dtypes.schema(
 CALL_CENTER_SCHEMA = dtypes.schema(
     ("cc_call_center_sk", dtypes.INT64, False),
     ("cc_name", dtypes.STRING, False),
+    ("cc_county", dtypes.STRING, False),
 )
 
 CATALOG_SALES_SCHEMA = dtypes.schema(
@@ -263,9 +279,11 @@ CATALOG_SALES_SCHEMA = dtypes.schema(
     ("cs_ship_mode_sk", dtypes.INT64, False),
     ("cs_call_center_sk", dtypes.INT64, False),
     ("cs_bill_addr_sk", dtypes.INT64, False),
+    ("cs_ship_addr_sk", dtypes.INT64, False),
     ("cs_sold_time_sk", dtypes.INT64, False),
     ("cs_order_number", dtypes.INT64, False),
     ("cs_net_profit", DEC2, False),
+    ("cs_ext_ship_cost", DEC2, False),
 )
 REASON_SCHEMA = dtypes.schema(
     ("r_reason_sk", dtypes.INT64, False),
@@ -282,11 +300,28 @@ STORE_RETURNS_SCHEMA = dtypes.schema(
     ("sr_return_amt", DEC2, False),
     ("sr_net_loss", DEC2, False),
 )
+WEB_SITE_SCHEMA = dtypes.schema(
+    ("web_site_sk", dtypes.INT64, False),
+    ("web_name", dtypes.STRING, False),
+    ("web_company_name", dtypes.STRING, False),
+)
+WEB_RETURNS_SCHEMA = dtypes.schema(
+    ("wr_returned_date_sk", dtypes.INT64, False),
+    ("wr_item_sk", dtypes.INT64, False),
+    ("wr_order_number", dtypes.INT64, False),
+    ("wr_returning_customer_sk", dtypes.INT64, False),
+    ("wr_returning_addr_sk", dtypes.INT64, False),
+    ("wr_return_quantity", dtypes.INT32, False),
+    ("wr_return_amt", DEC2, False),
+    ("wr_net_loss", DEC2, False),
+)
 CATALOG_RETURNS_SCHEMA = dtypes.schema(
     ("cr_returned_date_sk", dtypes.INT64, False),
     ("cr_item_sk", dtypes.INT64, False),
     ("cr_order_number", dtypes.INT64, False),
     ("cr_returning_customer_sk", dtypes.INT64, False),
+    ("cr_returning_addr_sk", dtypes.INT64, False),
+    ("cr_call_center_sk", dtypes.INT64, False),
     ("cr_return_quantity", dtypes.INT32, False),
     ("cr_return_amount", DEC2, False),
     ("cr_refunded_cash", DEC2, False),
@@ -313,6 +348,8 @@ SCHEMAS = {
     "reason": REASON_SCHEMA,
     "store_returns": STORE_RETURNS_SCHEMA,
     "catalog_returns": CATALOG_RETURNS_SCHEMA,
+    "web_site": WEB_SITE_SCHEMA,
+    "web_returns": WEB_RETURNS_SCHEMA,
 }
 
 PRIMARY_KEYS = {
@@ -335,6 +372,8 @@ PRIMARY_KEYS = {
     "reason": ("r_reason_sk",),
     "store_returns": ("sr_item_sk", "sr_ticket_number"),
     "catalog_returns": ("cr_item_sk", "cr_order_number"),
+    "web_site": ("web_site_sk",),
+    "web_returns": ("wr_item_sk", "wr_order_number"),
 }
 
 
@@ -382,6 +421,7 @@ class TpcdsData:
         self._gen_catalog_sales(rng, max(25_000, int(sf * 1_441_548)))
         self._gen_web_sales(rng, max(15_000, int(sf * 719_384)))
         self._gen_catalog_returns(rng)
+        self._gen_web_returns(rng)
         self._gen_inventory(rng, max(260_000, int(sf * 11_745_000)))
 
     def _gen_date_dim(self):
@@ -517,6 +557,12 @@ class TpcdsData:
             "p_channel_event": _enc(
                 self.dicts, "p_channel_event",
                 [yn[v] for v in (rng.random(n) < 0.1).astype(int)]),
+            "p_channel_dmail": _enc(
+                self.dicts, "p_channel_dmail",
+                [yn[v] for v in (rng.random(n) < 0.3).astype(int)]),
+            "p_channel_tv": _enc(
+                self.dicts, "p_channel_tv",
+                [yn[v] for v in (rng.random(n) < 0.3).astype(int)]),
         }
 
     def _gen_demographics(self):
@@ -615,6 +661,8 @@ class TpcdsData:
             "c_current_cdemo_sk": rng.integers(
                 1, len(_GENDERS) * len(_MARITAL) * len(_EDUCATION) + 1,
                 n_cust, dtype=np.int64),
+            "c_current_hdemo_sk": rng.integers(
+                1, 7201, n_cust, dtype=np.int64),
         }
 
     def _fk(self, rng, table: str, pk: str, n: int) -> np.ndarray:
@@ -701,11 +749,15 @@ class TpcdsData:
                 rng, "customer", "c_customer_sk", n),
             "cs_bill_addr_sk": self._fk(
                 rng, "customer_address", "ca_address_sk", n),
+            "cs_ship_addr_sk": self._fk(
+                rng, "customer_address", "ca_address_sk", n),
             "cs_sold_time_sk": rng.integers(0, 86_400, n,
                                             dtype=np.int64),
-            # one order per row: returns join on (order, item) exactly
-            "cs_order_number": np.arange(1, n + 1, dtype=np.int64),
+            # two lines per order: the q16 EXISTS (same order shipped
+            # from a DIFFERENT warehouse) needs multi-line orders
+            "cs_order_number": (np.arange(n, dtype=np.int64) // 2 + 1),
             "cs_net_profit": _cents(rng, -100.0, 300.0, n),
+            "cs_ext_ship_cost": _cents(rng, 0.50, 90.0, n),
             "cs_ext_discount_amt": np.where(
                 rng.random(n) < 0.5, _cents(rng, 0.0, 80.0, n),
                 0).astype(np.int64),
@@ -760,6 +812,20 @@ class TpcdsData:
             "cc_name": _enc(
                 self.dicts, "cc_name",
                 [_CC_NAMES[i % len(_CC_NAMES)] for i in range(6)]),
+            "cc_county": _enc(
+                self.dicts, "cc_county",
+                [_COUNTIES[i % len(_COUNTIES)] for i in range(6)]),
+        }
+        self.tables["web_site"] = {
+            "web_site_sk": np.arange(1, 9, dtype=np.int64),
+            "web_name": _enc(
+                self.dicts, "web_name",
+                [b"site_%d" % i for i in range(1, 9)]),
+            # dsdgen company names; 'pri' is the q94/q95 literal
+            "web_company_name": _enc(
+                self.dicts, "web_company_name",
+                [_STORE_NAMES[i % len(_STORE_NAMES)]
+                 for i in range(8)]),
         }
 
     def _gen_web_sales(self, rng, n: int):
@@ -788,7 +854,23 @@ class TpcdsData:
             "ws_sold_time_sk": rng.integers(0, 86_400, n,
                                             dtype=np.int64),
             "ws_net_profit": _cents(rng, -100.0, 300.0, n),
+            # two lines per order (q94's EXISTS wants a sibling line
+            # shipped from a different warehouse)
+            "ws_order_number": (np.arange(n, dtype=np.int64) // 2 + 1),
+            "ws_warehouse_sk": self._fk(
+                rng, "warehouse", "w_warehouse_sk", n),
+            "ws_ship_mode_sk": self._fk(
+                rng, "ship_mode", "sm_ship_mode_sk", n),
+            "ws_web_site_sk": self._fk(
+                rng, "web_site", "web_site_sk", n),
+            "ws_ship_addr_sk": self._fk(
+                rng, "customer_address", "ca_address_sk", n),
+            "ws_ext_ship_cost": _cents(rng, 0.50, 90.0, n),
         }
+        ws = self.tables["web_sales"]
+        max_sk = int(self.tables["date_dim"]["d_date_sk"].max())
+        ws["ws_ship_date_sk"] = np.minimum(
+            ws["ws_sold_date_sk"] + rng.integers(1, 151, n), max_sk)
 
     def _gen_reason(self):
         self.tables["reason"] = {
@@ -836,6 +918,11 @@ class TpcdsData:
         cs = self.tables["catalog_sales"]
         n_cs = len(cs["cs_item_sk"])
         pick = np.flatnonzero(rng.random(n_cs) < 0.08)
+        # orders hold two lines that can draw the same item; the
+        # returns PK is (item, order), so keep one return per pair
+        key = (cs["cs_item_sk"][pick] * (1 << 40)
+               + cs["cs_order_number"][pick])
+        pick = pick[np.unique(key, return_index=True)[1]]
         n = len(pick)
         max_sk = int(self.tables["date_dim"]["d_date_sk"].max())
         ret_qty = rng.integers(1, cs["cs_quantity"][pick] + 1)
@@ -846,11 +933,39 @@ class TpcdsData:
             "cr_item_sk": cs["cs_item_sk"][pick],
             "cr_order_number": cs["cs_order_number"][pick],
             "cr_returning_customer_sk": cs["cs_bill_customer_sk"][pick],
+            "cr_returning_addr_sk": cs["cs_bill_addr_sk"][pick],
+            "cr_call_center_sk": cs["cs_call_center_sk"][pick],
             "cr_return_quantity": ret_qty.astype(np.int32),
             "cr_return_amount": (cs["cs_sales_price"][pick]
                                  * ret_qty).astype(np.int64),
             "cr_refunded_cash": _cents(rng, 0.50, 150.00, n),
             "cr_net_loss": _cents(rng, 0.50, 120.00, n),
+        }
+
+    def _gen_web_returns(self, rng):
+        """~8% of web_sales lines return; join identity (item, order)."""
+        ws = self.tables["web_sales"]
+        n_ws = len(ws["ws_item_sk"])
+        pick = np.flatnonzero(rng.random(n_ws) < 0.08)
+        key = (ws["ws_item_sk"][pick] * (1 << 40)
+               + ws["ws_order_number"][pick])
+        pick = pick[np.unique(key, return_index=True)[1]]
+        n = len(pick)
+        max_sk = int(self.tables["date_dim"]["d_date_sk"].max())
+        ret_qty = rng.integers(1, ws["ws_quantity"][pick] + 1)
+        self.tables["web_returns"] = {
+            "wr_returned_date_sk": np.minimum(
+                ws["ws_sold_date_sk"][pick]
+                + rng.integers(1, 61, n), max_sk),
+            "wr_item_sk": ws["ws_item_sk"][pick],
+            "wr_order_number": ws["ws_order_number"][pick],
+            "wr_returning_customer_sk":
+                ws["ws_bill_customer_sk"][pick],
+            "wr_returning_addr_sk": ws["ws_bill_addr_sk"][pick],
+            "wr_return_quantity": ret_qty.astype(np.int32),
+            "wr_return_amt": (ws["ws_sales_price"][pick]
+                              * ret_qty).astype(np.int64),
+            "wr_net_loss": _cents(rng, 0.50, 120.00, n),
         }
 
     def _gen_inventory(self, rng, n: int):
@@ -1807,6 +1922,200 @@ from (select ss_customer_sk,
 group by ss_customer_sk
 order by sumsales, ss_customer_sk
 limit 100""",
+    # q16: catalog orders shipped cross-warehouse with no returns.
+    # COUNT(DISTINCT order) restated exactly as a per-order derived
+    # aggregate (count of groups == count of distinct orders; the sums
+    # are sums of per-order sums)
+    "q16": """
+select count(*) as order_count,
+       sum(ship) as total_shipping_cost,
+       sum(profit) as total_net_profit
+from (select cs_order_number,
+             sum(cs_ext_ship_cost) as ship,
+             sum(cs_net_profit) as profit
+      from catalog_sales cs1, date_dim, customer_address, call_center
+      where d_date between date '1999-02-01' and date '1999-04-01'
+        and cs1.cs_ship_date_sk = d_date_sk
+        and cs1.cs_ship_addr_sk = ca_address_sk
+        and ca_state = 'GA'
+        and cs1.cs_call_center_sk = cc_call_center_sk
+        and cc_county = 'Salem County'
+        and exists (select * from catalog_sales cs2
+                    where cs1.cs_order_number = cs2.cs_order_number
+                      and cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+        and not exists (select * from catalog_returns cr1
+                        where cs1.cs_order_number
+                              = cr1.cr_order_number)
+      group by cs_order_number) o
+limit 100""",
+    # q94: the web twin of q16
+    "q94": """
+select count(*) as order_count,
+       sum(ship) as total_shipping_cost,
+       sum(profit) as total_net_profit
+from (select ws_order_number,
+             sum(ws_ext_ship_cost) as ship,
+             sum(ws_net_profit) as profit
+      from web_sales ws1, date_dim, customer_address, web_site
+      where d_date between date '1999-02-01' and date '1999-04-01'
+        and ws1.ws_ship_date_sk = d_date_sk
+        and ws1.ws_ship_addr_sk = ca_address_sk
+        and ca_state = 'GA'
+        and ws1.ws_web_site_sk = web_site_sk
+        and web_company_name = 'pri'
+        and exists (select * from web_sales ws2
+                    where ws1.ws_order_number = ws2.ws_order_number
+                      and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+        and not exists (select * from web_returns wr1
+                        where ws1.ws_order_number
+                              = wr1.wr_order_number)
+      group by ws_order_number) o
+limit 100""",
+    # q62: web shipping-delay buckets (q99's web twin)
+    "q62": """
+select substring(w_warehouse_name, 1, 20) as wname, sm_type, web_name,
+  sum(case when ws_ship_date_sk - ws_sold_date_sk <= 30
+      then 1 else 0 end) as d30,
+  sum(case when ws_ship_date_sk - ws_sold_date_sk > 30
+           and ws_ship_date_sk - ws_sold_date_sk <= 60
+      then 1 else 0 end) as d60,
+  sum(case when ws_ship_date_sk - ws_sold_date_sk > 60
+           and ws_ship_date_sk - ws_sold_date_sk <= 90
+      then 1 else 0 end) as d90,
+  sum(case when ws_ship_date_sk - ws_sold_date_sk > 90
+           and ws_ship_date_sk - ws_sold_date_sk <= 120
+      then 1 else 0 end) as d120,
+  sum(case when ws_ship_date_sk - ws_sold_date_sk > 120
+      then 1 else 0 end) as dmore
+from web_sales, warehouse, ship_mode, web_site, date_dim
+where d_month_seq between 36 and 47
+  and ws_ship_date_sk = d_date_sk
+  and ws_warehouse_sk = w_warehouse_sk
+  and ws_ship_mode_sk = sm_ship_mode_sk
+  and ws_web_site_sk = web_site_sk
+group by wname, sm_type, web_name
+order by wname, sm_type, web_name
+limit 100""",
+    # q81: catalog returners above 1.2x their return-state average
+    "q81": """
+with customer_total_return as (
+  select cr_returning_customer_sk as ctr_customer_sk,
+         ca_state as ctr_state,
+         sum(cr_return_amount) as ctr_total_return
+  from catalog_returns, date_dim, customer_address
+  where cr_returned_date_sk = d_date_sk and d_year = 2000
+    and cr_returning_addr_sk = ca_address_sk
+  group by cr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return >
+      1.2 * (select avg(ctr2.ctr_total_return)
+             from customer_total_return ctr2
+             where ctr1.ctr_state = ctr2.ctr_state)
+  and ca_address_sk = c_current_addr_sk
+  and ca_state = 'GA'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_salutation, c_first_name, c_last_name,
+         ctr_total_return
+limit 100""",
+    # q30: the web twin of q81
+    "q30": """
+with customer_total_return as (
+  select wr_returning_customer_sk as ctr_customer_sk,
+         ca_state as ctr_state,
+         sum(wr_return_amt) as ctr_total_return
+  from web_returns, date_dim, customer_address
+  where wr_returned_date_sk = d_date_sk and d_year = 2000
+    and wr_returning_addr_sk = ca_address_sk
+  group by wr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return >
+      1.2 * (select avg(ctr2.ctr_total_return)
+             from customer_total_return ctr2
+             where ctr1.ctr_state = ctr2.ctr_state)
+  and ca_address_sk = c_current_addr_sk
+  and ca_state = 'TX'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_salutation, c_first_name, c_last_name,
+         ctr_total_return
+limit 100""",
+    # q61: promotional share of Jewelry sales. The official cross join
+    # of two single-row derived tables restates exactly as one pass:
+    # the promotion dimension is N:1 total, so joining it in the
+    # "all sales" leg changes nothing, and the promotional leg becomes
+    # a CASE-filtered sum
+    "q61": """
+select sum(case when p_channel_dmail = 'Y' or p_channel_email = 'Y'
+                 or p_channel_tv = 'Y'
+            then ss_ext_sales_price else 0 end) as promotions,
+       sum(ss_ext_sales_price) as total
+from store_sales, store, promotion, date_dim, customer,
+     customer_address, item
+where ss_sold_date_sk = d_date_sk
+  and ss_store_sk = s_store_sk
+  and ss_promo_sk = p_promo_sk
+  and ss_customer_sk = c_customer_sk
+  and ca_address_sk = c_current_addr_sk
+  and ss_item_sk = i_item_sk
+  and ca_gmt_offset = -5
+  and i_category = 'Jewelry'
+  and s_gmt_offset = -5
+  and d_year = 1998 and d_moy = 11""",
+    # q88: half-hour store traffic bands. The official 8-way cross join
+    # of single-row counts restates exactly as 8 CASE-filtered sums
+    # over one pass (all legs share the demographic and store filters)
+    "q88": """
+select
+  sum(case when t_hour = 8 and t_minute >= 30 then 1 else 0 end)
+    as h8_30_to_9,
+  sum(case when t_hour = 9 and t_minute < 30 then 1 else 0 end)
+    as h9_to_9_30,
+  sum(case when t_hour = 9 and t_minute >= 30 then 1 else 0 end)
+    as h9_30_to_10,
+  sum(case when t_hour = 10 and t_minute < 30 then 1 else 0 end)
+    as h10_to_10_30,
+  sum(case when t_hour = 10 and t_minute >= 30 then 1 else 0 end)
+    as h10_30_to_11,
+  sum(case when t_hour = 11 and t_minute < 30 then 1 else 0 end)
+    as h11_to_11_30,
+  sum(case when t_hour = 11 and t_minute >= 30 then 1 else 0 end)
+    as h11_30_to_12,
+  sum(case when t_hour = 12 and t_minute < 30 then 1 else 0 end)
+    as h12_to_12_30
+from store_sales, household_demographics, time_dim, store
+where ss_sold_time_sk = t_time_sk
+  and ss_hdemo_sk = hd_demo_sk
+  and ss_store_sk = s_store_sk
+  and t_hour between 8 and 12
+  and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+       or (hd_dep_count = 2 and hd_vehicle_count <= 4)
+       or (hd_dep_count = 0 and hd_vehicle_count <= 2))
+  and s_store_name = 'ese'""",
+    # q91: call-center catalog-return losses by demographic band
+    # (window widened to the year and the gmt conjunct dropped — the
+    # official compound selectivity is vacuous at synthetic test scale,
+    # same adaptation practice as q65's month window)
+    "q91": """
+select cc_name, cd_marital_status, cd_education_status,
+       sum(cr_net_loss) as returns_loss
+from call_center, catalog_returns, date_dim, customer,
+     customer_demographics, household_demographics
+where cr_call_center_sk = cc_call_center_sk
+  and cr_returned_date_sk = d_date_sk
+  and cr_returning_customer_sk = c_customer_sk
+  and cd_demo_sk = c_current_cdemo_sk
+  and hd_demo_sk = c_current_hdemo_sk
+  and d_year = 1998
+  and ((cd_marital_status = 'M' and cd_education_status = 'Unknown')
+       or (cd_marital_status = 'W'
+           and cd_education_status = 'Advanced Degree'))
+  and hd_buy_potential like 'Unknown%'
+group by cc_name, cd_marital_status, cd_education_status
+order by returns_loss desc, cc_name, cd_marital_status,
+         cd_education_status""",
 }
 
 
@@ -3077,6 +3386,251 @@ class _Ref:
         rows = sorted(acc.items(), key=lambda kv: (kv[1], kv[0]))
         return rows[:100]
 
+    # -- web-channel queries (q16/q94/q62/q81/q30) --
+
+    @staticmethod
+    def _days(s: str) -> int:
+        return int((np.datetime64(s, "D")
+                    - np.datetime64("1970-01-01", "D")).astype(int))
+
+    def _ship_no_return(self, fact, pfx, returns, r_pfx, row_ok):
+        """q16/q94 shape: lines shipped in a window whose order has a
+        sibling line from another warehouse and no return."""
+        d = self.d
+        tb = d.tables[fact]
+        _, _, dates = self._date_cols(tb[pfx + "ship_date_sk"])
+        lo, hi = self._days("1999-02-01"), self._days("1999-04-01")
+        wh_sets: dict = collections.defaultdict(set)
+        for o, w in zip(tb[pfx + "order_number"].tolist(),
+                        tb[pfx + "warehouse_sk"].tolist()):
+            wh_sets[o].add(w)
+        returned = set(
+            d.tables[returns][r_pfx + "order_number"].tolist())
+        orders: set = set()
+        ship = profit = 0
+        for i, (o, dt) in enumerate(zip(
+                tb[pfx + "order_number"].tolist(), dates.tolist())):
+            if not (lo <= dt <= hi) or not row_ok[i]:
+                continue
+            if len(wh_sets[o]) < 2 or o in returned:
+                continue
+            orders.add(o)
+            ship += int(tb[pfx + "ext_ship_cost"][i])
+            profit += int(tb[pfx + "net_profit"][i])
+        if not orders:
+            return [(0, None, None)]
+        return [(len(orders), ship, profit)]
+
+    def _addr_state_ok(self, sks, state: bytes):
+        states = _decode(self.d, "customer_address", "ca_state")
+        return states[np.asarray(sks) - 1] == state
+
+    def q16(self):
+        d = self.d
+        cs = d.tables["catalog_sales"]
+        counties = _decode(d, "call_center", "cc_county")
+        cc_ok = {sk for sk, c in zip(
+            d.tables["call_center"]["cc_call_center_sk"].tolist(),
+            counties) if c == b"Salem County"}
+        row_ok = self._addr_state_ok(cs["cs_ship_addr_sk"], b"GA") & \
+            np.array([c in cc_ok
+                      for c in cs["cs_call_center_sk"].tolist()])
+        return self._ship_no_return(
+            "catalog_sales", "cs_", "catalog_returns", "cr_", row_ok)
+
+    def q94(self):
+        d = self.d
+        ws = d.tables["web_sales"]
+        comp = _decode(d, "web_site", "web_company_name")
+        site_ok = {sk for sk, c in zip(
+            d.tables["web_site"]["web_site_sk"].tolist(), comp)
+            if c == b"pri"}
+        row_ok = self._addr_state_ok(ws["ws_ship_addr_sk"], b"GA") & \
+            np.array([s in site_ok
+                      for s in ws["ws_web_site_sk"].tolist()])
+        return self._ship_no_return(
+            "web_sales", "ws_", "web_returns", "wr_", row_ok)
+
+    def q62(self):
+        d = self.d
+        ws = d.tables["web_sales"]
+        dd = self._dd()
+        wnames = _decode(d, "warehouse", "w_warehouse_name")
+        wi = {sk: i for i, sk in enumerate(
+            d.tables["warehouse"]["w_warehouse_sk"].tolist())}
+        smt = _decode(d, "ship_mode", "sm_type")
+        smi = {sk: i for i, sk in enumerate(
+            d.tables["ship_mode"]["sm_ship_mode_sk"].tolist())}
+        wn = _decode(d, "web_site", "web_name")
+        wsi = {sk: i for i, sk in enumerate(
+            d.tables["web_site"]["web_site_sk"].tolist())}
+        acc: dict = collections.defaultdict(lambda: [0] * 5)
+        for sold, ship, wk, smk, sk in zip(
+                ws["ws_sold_date_sk"].tolist(),
+                ws["ws_ship_date_sk"].tolist(),
+                ws["ws_warehouse_sk"].tolist(),
+                ws["ws_ship_mode_sk"].tolist(),
+                ws["ws_web_site_sk"].tolist()):
+            if not (36 <= dd[ship][6] <= 47):
+                continue
+            lag = ship - sold
+            st = acc[(wnames[wi[wk]][:20], smt[smi[smk]],
+                      wn[wsi[sk]])]
+            if lag <= 30:
+                st[0] += 1
+            elif lag <= 60:
+                st[1] += 1
+            elif lag <= 90:
+                st[2] += 1
+            elif lag <= 120:
+                st[3] += 1
+            else:
+                st[4] += 1
+        rows = [(k[0], k[1], k[2], *v) for k, v in acc.items()]
+        rows.sort(key=lambda r: (r[0], r[1], r[2]))
+        return rows[:100]
+
+    def _ctr_over_state_avg(self, rt, pfx, amt_col, state_lit):
+        """q81/q30 shape: returners above 1.2x their return-state
+        average, restricted to customers whose CURRENT address is in
+        state_lit."""
+        d = self.d
+        tb = d.tables[rt]
+        yr, _, _ = self._date_cols(tb[pfx + "returned_date_sk"])
+        states = _decode(d, "customer_address", "ca_state")
+        acc: dict = collections.defaultdict(int)
+        for ok, c, a, amt in zip(
+                (yr == 2000).tolist(),
+                tb[pfx + "returning_customer_sk"].tolist(),
+                tb[pfx + "returning_addr_sk"].tolist(),
+                tb[amt_col].tolist()):
+            if ok:
+                acc[(c, states[a - 1])] += amt
+        per_state: dict = collections.defaultdict(list)
+        for (c, st), t in acc.items():
+            per_state[st].append(t)
+        cust = d.tables["customer"]
+        cur_state = states[cust["c_current_addr_sk"] - 1]
+        cids = _decode(d, "customer", "c_customer_id")
+        sal = _decode(d, "customer", "c_salutation")
+        fn = _decode(d, "customer", "c_first_name")
+        ln = _decode(d, "customer", "c_last_name")
+        out = []
+        for (c, st), t in acc.items():
+            if t <= 1.2 * (sum(per_state[st]) / len(per_state[st])):
+                continue
+            if cur_state[c - 1] != state_lit:
+                continue
+            out.append((cids[c - 1], sal[c - 1], fn[c - 1],
+                        ln[c - 1], t))
+        out.sort()
+        return out[:100]
+
+    def q61(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        y, m, _ = self._date_cols(ss["ss_sold_date_sk"])
+        cats = _decode(d, "item", "i_category")
+        ipos = self._item_pos()
+        st = d.tables["store"]
+        s_ok = set(st["s_store_sk"][
+            st["s_gmt_offset"] == -5].tolist())
+        pr = d.tables["promotion"]
+        promo_ok = set(pr["p_promo_sk"][
+            (_decode(d, "promotion", "p_channel_dmail") == b"Y")
+            | (_decode(d, "promotion", "p_channel_email") == b"Y")
+            | (_decode(d, "promotion", "p_channel_tv") == b"Y")
+        ].tolist())
+        cust_addr = d.tables["customer"]["c_current_addr_sk"]
+        addr_gmt = d.tables["customer_address"]["ca_gmt_offset"]
+        total = promos = n_rows = 0
+        for i in np.flatnonzero((y == 1998) & (m == 11)).tolist():
+            if ss["ss_store_sk"][i] not in s_ok:
+                continue
+            if cats[ipos[ss["ss_item_sk"][i]]] != b"Jewelry":
+                continue
+            if addr_gmt[cust_addr[ss["ss_customer_sk"][i] - 1] - 1] \
+                    != -5:
+                continue
+            p = int(ss["ss_ext_sales_price"][i])
+            total += p
+            n_rows += 1
+            if ss["ss_promo_sk"][i] in promo_ok:
+                promos += p
+        if not n_rows:
+            return [(None, None)]
+        return [(promos, total)]
+
+    def q88(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        hd = d.tables["household_demographics"]
+        dep = hd["hd_dep_count"]
+        veh = hd["hd_vehicle_count"]
+        hd_ok = set(hd["hd_demo_sk"][
+            ((dep == 4) & (veh <= 6)) | ((dep == 2) & (veh <= 4))
+            | ((dep == 0) & (veh <= 2))].tolist())
+        st = d.tables["store"]
+        names = _decode(d, "store", "s_store_name")
+        s_ok = {sk for sk, nm in zip(st["s_store_sk"].tolist(), names)
+                if nm == b"ese"}
+        bands = [0] * 8
+        for t, h, s in zip(ss["ss_sold_time_sk"].tolist(),
+                           ss["ss_hdemo_sk"].tolist(),
+                           ss["ss_store_sk"].tolist()):
+            if h not in hd_ok or s not in s_ok:
+                continue
+            half = t // 1800  # half-hour index in the day
+            if 17 <= half <= 24:  # 8:30 .. 12:30
+                bands[half - 17] += 1
+        return [tuple(bands)]
+
+    def q91(self):
+        d = self.d
+        cr = d.tables["catalog_returns"]
+        yr, _, _ = self._date_cols(cr["cr_returned_date_sk"])
+        ccn = _decode(d, "call_center", "cc_name")
+        cci = {sk: i for i, sk in enumerate(
+            d.tables["call_center"]["cc_call_center_sk"].tolist())}
+        cust = d.tables["customer"]
+        cd = d.tables["customer_demographics"]
+        ms = _decode(d, "customer_demographics", "cd_marital_status")
+        es = _decode(d, "customer_demographics", "cd_education_status")
+        cd_ok = {}
+        for sk, m_, e_ in zip(cd["cd_demo_sk"].tolist(), ms, es):
+            if (m_ == b"M" and e_ == b"Unknown") or (
+                    m_ == b"W" and e_ == b"Advanced Degree"):
+                cd_ok[sk] = (m_, e_)
+        hd = d.tables["household_demographics"]
+        bp = _decode(d, "household_demographics", "hd_buy_potential")
+        hd_ok = {sk for sk, b in zip(hd["hd_demo_sk"].tolist(), bp)
+                 if b.startswith(b"Unknown")}
+        acc: dict = collections.defaultdict(int)
+        for ok, cc, c, loss in zip(
+                (yr == 1998).tolist(),
+                cr["cr_call_center_sk"].tolist(),
+                cr["cr_returning_customer_sk"].tolist(),
+                cr["cr_net_loss"].tolist()):
+            if not ok:
+                continue
+            band = cd_ok.get(int(cust["c_current_cdemo_sk"][c - 1]))
+            if band is None:
+                continue
+            if int(cust["c_current_hdemo_sk"][c - 1]) not in hd_ok:
+                continue
+            acc[(ccn[cci[cc]], band[0], band[1])] += loss
+        rows = [(*k, v) for k, v in acc.items()]
+        rows.sort(key=lambda r: (-r[3], r[0], r[1], r[2]))
+        return rows
+
+    def q81(self):
+        return self._ctr_over_state_avg(
+            "catalog_returns", "cr_", "cr_return_amount", b"GA")
+
+    def q30(self):
+        return self._ctr_over_state_avg(
+            "web_returns", "wr_", "wr_return_amt", b"TX")
+
 
 def run_tpcds(sf: float = 0.01, queries=None, iterations: int = 1,
               seed: int = 42, verify: bool = True):
@@ -3185,6 +3739,26 @@ _VERIFY_COLS = {
             ("d30", "int"), ("d60", "int"), ("d90", "int"),
             ("d120", "int"), ("dmore", "int")),
     "q93": (("ss_customer_sk", "int"), ("sumsales", "dec")),
+    "q16": (("order_count", "int"), ("total_shipping_cost", "dec"),
+            ("total_net_profit", "dec")),
+    "q94": (("order_count", "int"), ("total_shipping_cost", "dec"),
+            ("total_net_profit", "dec")),
+    "q62": (("wname", "str"), ("sm_type", "str"), ("web_name", "str"),
+            ("d30", "int"), ("d60", "int"), ("d90", "int"),
+            ("d120", "int"), ("dmore", "int")),
+    "q81": (("c_customer_id", "str"), ("c_salutation", "str"),
+            ("c_first_name", "str"), ("c_last_name", "str"),
+            ("ctr_total_return", "dec")),
+    "q30": (("c_customer_id", "str"), ("c_salutation", "str"),
+            ("c_first_name", "str"), ("c_last_name", "str"),
+            ("ctr_total_return", "dec")),
+    "q61": (("promotions", "dec"), ("total", "dec")),
+    "q88": (("h8_30_to_9", "int"), ("h9_to_9_30", "int"),
+            ("h9_30_to_10", "int"), ("h10_to_10_30", "int"),
+            ("h10_30_to_11", "int"), ("h11_to_11_30", "int"),
+            ("h11_30_to_12", "int"), ("h12_to_12_30", "int")),
+    "q91": (("cc_name", "str"), ("cd_marital_status", "str"),
+            ("cd_education_status", "str"), ("returns_loss", "dec")),
     "q33": (("i_manufact_id", "int"), ("total_sales", "dec")),
     "q56": (("i_item_id", "str"), ("total_sales", "dec")),
     "q60": (("i_item_id", "str"), ("total_sales", "dec")),
