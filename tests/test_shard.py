@@ -231,7 +231,7 @@ def test_block_cache_hits_and_invalidates_on_commit():
     shard.commit([_write(shard, [1, 2, 3], vals=[10, 20, 30])])
     snap1 = shard.snap
     assert _sum_val(shard) == 60
-    assert len(shard._block_cache) == 1
+    assert len(shard.block_cache) == 1
     # warm scan: same result, served from the cached blocks
     assert _sum_val(shard) == 60
     # new commit -> new key -> fresh read sees the extra rows
@@ -243,13 +243,13 @@ def test_block_cache_hits_and_invalidates_on_commit():
     assert _sum_val(shard, snap1) == 60
     assert _sum_val(shard, snap1) == 60
     assert _sum_val(shard) == 100
-    assert len(shard._block_cache) == 2
+    assert len(shard.block_cache) == 2
     # GC of superseded portions frees their now-unreachable entries
     shard.compact()
     shard.gc_blobs(keep_snap=shard.snap)
     assert _sum_val(shard) == 100
     live = set(shard.portions)
-    assert all(set(k[0]) <= live for k in shard._block_cache)
+    assert all(set(k[0]) <= live for k in shard.block_cache)
 
 
 def test_block_cache_correct_after_compaction_and_ttl():
@@ -273,12 +273,12 @@ def test_block_cache_respects_budget():
     shard = _shard(scan_cache_bytes=1)  # nothing fits
     shard.commit([_write(shard, list(range(100)))])
     assert _count(shard) == 100
-    assert len(shard._block_cache) == 0
-    assert shard._block_cache_nbytes == 0
+    assert len(shard.block_cache) == 0
+    assert shard.block_cache.nbytes == 0
 
 
 def test_block_cache_off_by_default_on_cpu():
     shard = _shard()
     shard.commit([_write(shard, [1, 2])])
     assert _count(shard) == 2
-    assert len(shard._block_cache) == 0
+    assert len(shard.block_cache) == 0

@@ -424,6 +424,19 @@ class MultiShardStreamSource:
         """Pre-dedup upper bound across all shards."""
         return sum(sub.num_rows for sub in self.subs)
 
+    def device_cache_key(self, read_cols, block_rows: int):
+        """Identity of this source's block stream for the device block
+        cache: per-shard (shard id, visible portion ids) plus the block
+        geometry. Portions are immutable, so equal keys produce equal
+        streams; any commit/compaction changes some shard's portion
+        set and with it the key."""
+        return (
+            tuple((sub.shard.shard_id,
+                   tuple(m.portion_id for m in sub.metas))
+                  for sub in self.subs),
+            tuple(read_cols), block_rows,
+        )
+
     @property
     def chunks_read(self) -> int:
         return sum(sub.chunks_read for sub in self.subs)

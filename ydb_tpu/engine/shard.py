@@ -34,10 +34,8 @@ distributed coordinator (ydb_tpu.tx) supplies cross-shard snapshots.
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import json
-import os
 import threading
 
 import numpy as np
@@ -45,6 +43,7 @@ import numpy as np
 from ydb_tpu import dtypes
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.blobs import BlobStore
+from ydb_tpu.engine.blockcache import DeviceBlockCache
 from ydb_tpu.engine.oracle import OracleTable
 from ydb_tpu.engine.portion import (
     PortionMeta,
@@ -137,11 +136,10 @@ class ColumnShard:
         self._next_write_id = 1
         # compiled-scan cache: (program, key_spaces) -> (executor, sizes)
         self._scan_cache: dict = {}
-        # device block cache: (portion ids, read cols, block rows) ->
-        # (blocks, nbytes); LRU within _scan_cache_budget()
-        self._block_cache: collections.OrderedDict = \
-            collections.OrderedDict()
-        self._block_cache_nbytes = 0
+        # HBM-resident decoded-block cache for warm scans, keyed by the
+        # immutable (portion ids, read cols, block rows)
+        self.block_cache = DeviceBlockCache(
+            budget=self.config.scan_cache_bytes)
         # serializes metadata mutations (portion map, WAL seq, snapshot)
         # so conveyor-driven background work (compaction/TTL/GC) can run
         # concurrently with foreground scans: critical sections cover
@@ -405,98 +403,32 @@ class ColumnShard:
                 program, src, self.config.scan_block_rows, key_spaces
             ).detach()
             self._scan_cache[key] = (ex, sizes)
-        budget = self._scan_cache_budget()
-        cache_key = cached = None
-        if budget > 0:
+        cache_key = None
+        hit_before = self.block_cache.hits
+        if self.block_cache.budget() > 0:
+            # entries referencing a portion that no longer exists
+            # (compacted/TTL'd away and dropped from the portion map)
+            # can never be keyed again by any snapshot: free their
+            # device memory now instead of waiting for LRU
+            with self._meta_lock:
+                live = set(self.portions)
+            self.block_cache.prune(lambda k: set(k[0]) <= live)
             cache_key = (tuple(m.portion_id for m in src.metas),
                          tuple(ex.read_cols),
                          self.config.scan_block_rows)
-            with self._meta_lock:
-                # entries referencing a portion that no longer exists
-                # (compacted/TTL'd away and dropped from the portion
-                # map) can never be keyed again by any snapshot: free
-                # their device memory now instead of waiting for LRU
-                live = set(self.portions)
-                for k in [k for k in self._block_cache
-                          if not set(k[0]) <= live]:
-                    self._block_cache_nbytes -= \
-                        self._block_cache.pop(k)[1]
-                ent = self._block_cache.get(cache_key)
-                if ent is not None:
-                    self._block_cache.move_to_end(cache_key)
-                    cached = ent[0]
-        if cached is not None:
-            out = OracleTable.from_block(ex.run_stream(iter(cached)))
-        elif cache_key is not None:
-            out = OracleTable.from_block(ex.run_stream(
-                self._tee_blocks(
-                    src.blocks(self.config.scan_block_rows,
-                               ex.read_cols),
-                    cache_key, budget)))
-        else:
-            out = OracleTable.from_block(ex.run_stream(
-                src.blocks(self.config.scan_block_rows, ex.read_cols)
-            ))
+        out = OracleTable.from_block(ex.run_stream(
+            self.block_cache.stream(
+                cache_key,
+                lambda: src.blocks(self.config.scan_block_rows,
+                                   ex.read_cols))))
         if _P_SCAN:
             _P_SCAN.fire(shard=self.shard_id,
                          portions=len(src.metas),
                          chunks_read=src.chunks_read,
                          compiled_fresh=hit is None,
-                         block_cache_hit=cached is not None)
+                         block_cache_hit=self.block_cache.hits
+                         > hit_before)
         return out
-
-    _SCAN_CACHE_AUTO_BYTES = 4 << 30
-    _SCAN_CACHE_MAX_ENTRIES = 32
-
-    def _scan_cache_budget(self) -> int:
-        env = os.environ.get("YDB_TPU_SCAN_CACHE_BYTES")
-        if env is not None:
-            try:
-                return int(env)
-            except ValueError:
-                # a bad tuning knob disables the cache; it must never
-                # poison the read path itself
-                return 0
-        if self.config.scan_cache_bytes is not None:
-            return self.config.scan_cache_bytes
-        import jax
-
-        return (self._SCAN_CACHE_AUTO_BYTES
-                if jax.default_backend() in ("tpu", "axon", "gpu")
-                else 0)
-
-    def _tee_blocks(self, blocks, cache_key, budget):
-        """Yield the stream unchanged while collecting device blocks for
-        the cache. Collection stops (and already-pinned blocks release)
-        the moment the running size exceeds the budget, so an over-budget
-        scan never pins more device memory than an uncached one."""
-        collected: list = []
-        nbytes = 0
-        for b in blocks:
-            if collected is not None:
-                nbytes += sum(int(c.data.nbytes) + int(c.validity.nbytes)
-                              for c in b.columns.values())
-                if nbytes > budget:
-                    collected = None  # too big: never cacheable
-                else:
-                    collected.append(b)
-            yield b
-        if collected is not None:
-            with self._meta_lock:
-                old = self._block_cache.pop(cache_key, None)
-                if old is not None:
-                    self._block_cache_nbytes -= old[1]
-                self._block_cache[cache_key] = (collected, nbytes)
-                self._block_cache_nbytes += nbytes
-                # byte budget + entry cap: a commit-heavy workload
-                # produces a fresh key per commit, and stale-but-live
-                # entries should not pile up in device memory
-                while ((self._block_cache_nbytes > budget
-                        or len(self._block_cache)
-                        > self._SCAN_CACHE_MAX_ENTRIES)
-                       and len(self._block_cache) > 1):
-                    _, (_, nb) = self._block_cache.popitem(last=False)
-                    self._block_cache_nbytes -= nb
 
     # ---------------- background: compaction / TTL ----------------
 

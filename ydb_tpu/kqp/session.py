@@ -157,6 +157,12 @@ class Cluster:
         self.rm = None
         # optional SPMD mesh execution (enable_mesh)
         self._mesh_exec = None
+        # HBM device block cache shared by every statement's scans (the
+        # shared-page-cache analog; statement Databases are transient,
+        # the cache is node-scoped)
+        from ydb_tpu.engine.blockcache import DeviceBlockCache
+
+        self.scan_block_cache = DeviceBlockCache()
         self._query_seq = 0
         import threading
 
@@ -373,6 +379,10 @@ class Cluster:
         self.tables.pop(stmt.table, None)
         self._sweep_trash()
         self._plan_cache.clear()
+        # a re-created same-name table reuses shard ids AND restarts
+        # portion ids at 1, so stale entries would collide with the new
+        # table's keys and serve the dropped table's rows
+        self.scan_block_cache.clear()
 
     def _sweep_trash(self) -> None:
         for op_id, prefixes in self.scheme.trash():
@@ -866,6 +876,7 @@ class Cluster:
         if include_sys:
             sources = _SysLazySources(self, sources)
         db = Database(sources=sources, dicts=self.dicts)
+        db.block_cache = self.scan_block_cache
         if mesh and self._mesh_exec is not None:
             db.mesh_executor = self._mesh_snapshot(snap)
         return db

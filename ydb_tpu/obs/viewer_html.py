@@ -50,7 +50,8 @@ PAGE = """<!doctype html>
 <script>
 "use strict";
 const TABS = ["overview", "tablets", "sysviews", "topics", "counters"];
-let tab = location.hash.slice(1) || "overview";
+const tabOf = h => TABS.includes(h) ? h : "overview";
+let tab = tabOf(location.hash.slice(1));
 let sysviewName = "";
 
 const get = p => fetch(p).then(r => r.json());
@@ -152,7 +153,7 @@ async function render() {
   } catch (e) { /* header stays */ }
 }
 window.addEventListener("hashchange", () => {
-  tab = location.hash.slice(1) || "overview";
+  tab = tabOf(location.hash.slice(1));
   render();
 });
 render();

@@ -59,6 +59,11 @@ class Database:
     # when set (Cluster.enable_mesh), eligible plans execute SPMD over
     # the device mesh (parallel/mesh_exec.py) instead of DQ/recursive
     mesh_executor: object = None
+    # cluster-owned DeviceBlockCache: table scans over portion-backed
+    # sources reuse HBM-resident decoded blocks across statements (the
+    # SQL path's share of the shared-page-cache analog). Databases are
+    # per-statement; the cache outlives them.
+    block_cache: object = None
 
     def invalidate_compile_cache(self):
         self._compile_cache.clear()
@@ -205,7 +210,13 @@ def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
                 key_spaces=db.key_spaces,
             ).detach()  # cache compiled state, not the source arrays
             db._compile_cache[key] = ex
-        return ex.run_stream(src.blocks(1 << 22, ex.read_cols))
+        stream = src.blocks(1 << 22, ex.read_cols)
+        bc = db.block_cache
+        key_of = getattr(src, "device_cache_key", None)
+        if bc is not None and key_of is not None and bc.budget() > 0:
+            stream = bc.stream(
+                key_of(ex.read_cols, 1 << 22), lambda: stream)
+        return ex.run_stream(stream)
     if isinstance(plan, LookupJoin):
         probe = execute_plan(plan.probe, db, _memo)
         build = execute_plan(plan.build, db, _memo)
