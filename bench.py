@@ -98,6 +98,13 @@ class _SqlProbeTooSlow(Exception):
     """SQL tier probe exceeded its cap; skip that tier, keep the rest."""
 
 
+class _BudgetSpent(Exception):
+    """Wall-clock budget spent mid-way: skip what remains, keep every
+    number already measured (the JSON line must always print, and the
+    process must exit before any external timeout kills it — a killed
+    TPU claim wedges the tunnel)."""
+
+
 def cpu_q1(li, cutoff, nls=None):
     """Vectorized single-pass numpy Q1 (the CPU columnar baseline)."""
     m = li["l_shipdate"] <= cutoff
@@ -153,17 +160,26 @@ def check_q1(out1, li, nls, base1):
             f"engine/baseline mismatch on {eng_col}")
 
 
-def timed_cold_warm(fn, iters):
-    """(cold_seconds, warm_best_seconds, last_result)."""
+def timed_cold_warm(fn, iters, deadline=None):
+    """(cold_seconds, warm_best_seconds, last_result).
+
+    ``deadline`` (seconds since bench start) bounds the WARM repeats:
+    the budget must hold mid-tier, not just between tiers — an overrun
+    here is what gets the whole bench killed externally (and a killed
+    TPU claim wedges the tunnel for hours). With no warm repeat left,
+    warm reports the cold time."""
     t0 = time.perf_counter()
     out = fn()
     cold = time.perf_counter() - t0
     warm = float("inf")
     for _ in range(iters):
+        if deadline is not None and \
+                time.perf_counter() - _T0 > deadline:
+            break
         t0 = time.perf_counter()
         out = fn()
         warm = min(warm, time.perf_counter() - t0)
-    return cold, warm, out
+    return cold, (cold if warm == float("inf") else warm), out
 
 
 def pallas_ab(src, blocks, n_rows, block_rows, iters):
@@ -389,8 +405,10 @@ def main():
             return out
         return go
 
-    cold1, warm1, out1 = timed_cold_warm(run_kernel(ex1), iters)
-    cold6, warm6, out6 = timed_cold_warm(run_kernel(ex6), iters)
+    cold1, warm1, out1 = timed_cold_warm(run_kernel(ex1), iters,
+                                         budget - 90)
+    cold6, warm6, out6 = timed_cold_warm(run_kernel(ex6), iters,
+                                         budget - 90)
     check_q1(out1, li, nls, base1)
     rev = int(np.asarray(out6.to_numpy()["revenue"])[0])
     assert rev == base6, f"Q6 mismatch {rev} != {base6}"
@@ -471,11 +489,11 @@ def main():
                 return go
 
             _log("engine tier: scans")
+            deadline = budget - 45
             ecold1, ewarm1, eout1 = timed_cold_warm(
-                run_engine(tpch.q1_program()), db_iters)
-            ecold6, ewarm6, eout6 = timed_cold_warm(
-                run_engine(tpch.q6_program()), db_iters)
-            # verify engine results against the baseline
+                run_engine(tpch.q1_program()), db_iters, deadline)
+            # verify + record q1 BEFORE anything else can run out of
+            # budget: measured numbers survive a mid-tier _BudgetSpent
             eres = {n: np.asarray(v[0]) for n, v in eout1.cols.items()}
             eng_gid = (eres["l_returnflag"].astype(np.int64) * enls
                        + eres["l_linestatus"].astype(np.int64))
@@ -484,18 +502,20 @@ def main():
             assert np.allclose(
                 eres["sum_charge"].astype(np.float64)[order],
                 ebase1["sum_charge"], rtol=1e-9)
-            assert int(np.asarray(eout6.cols["revenue"][0])[0]) == ebase6
             extra["engine_q1_cold_rows_per_sec"] = round(e_rows / ecold1)
             extra["engine_q1_warm_rows_per_sec"] = round(e_rows / ewarm1)
+            engine_warm_rps = round(e_rows / ewarm1)
+            if _budget_left(budget) < 45:
+                raise _BudgetSpent("engine_q6,sql_tier:budget")
+            ecold6, ewarm6, eout6 = timed_cold_warm(
+                run_engine(tpch.q6_program()), db_iters, deadline)
+            assert int(np.asarray(eout6.cols["revenue"][0])[0]) == ebase6
             extra["engine_q6_cold_rows_per_sec"] = round(e_rows / ecold6)
             extra["engine_q6_warm_rows_per_sec"] = round(e_rows / ewarm6)
-            engine_warm_rps = round(e_rows / ewarm1)
 
             # ---- sql tier: parse -> plan -> execute over the store ----
             if _budget_left(budget) < 60:
-                raise TimeoutError(
-                    f"bench budget spent before SQL tier "
-                    f"({budget:g}s)")
+                raise _BudgetSpent("sql_tier:budget")
             from ydb_tpu.engine.reader import MultiShardStreamSource
             from ydb_tpu.plan import Database, execute_plan, to_host
             from ydb_tpu.sql.parser import parse
@@ -547,6 +567,11 @@ def main():
                 sources={"lineitem": MultiShardStreamSource(
                     [shard], tpch.LINEITEM_SCHEMA, edicts)},
                 dicts=edicts)
+            # node-scoped HBM block cache, as a Cluster would attach
+            # (warm SQL runs measure device compute, not re-decode)
+            from ydb_tpu.engine.blockcache import DeviceBlockCache
+
+            sql_db.block_cache = DeviceBlockCache()
 
             def run_sql(sql):
                 plan = plan_select_full(parse(sql), catalog).plan
@@ -556,19 +581,24 @@ def main():
                 return go
 
             scold1, swarm1, sout1 = timed_cold_warm(
-                run_sql(TPCH["q1"]), db_iters)
+                run_sql(TPCH["q1"]), db_iters, deadline)
             assert np.allclose(
                 np.sort(np.asarray(sout1.cols["count_order"][0])),
                 np.sort(ebase1["count"]))
-            scold6, swarm6, sout6 = timed_cold_warm(
-                run_sql(TPCH["q6"]), db_iters)
-            assert int(np.asarray(sout6.cols["revenue"][0])[0]) == ebase6
             extra["sql_q1_cold_rows_per_sec"] = round(e_rows / scold1)
             extra["sql_q1_warm_rows_per_sec"] = round(e_rows / swarm1)
+            if _budget_left(budget) < 45:
+                raise _BudgetSpent("sql_q6:budget")
+            scold6, swarm6, sout6 = timed_cold_warm(
+                run_sql(TPCH["q6"]), db_iters, deadline)
+            assert int(np.asarray(sout6.cols["revenue"][0])[0]) == ebase6
             extra["sql_q6_warm_rows_per_sec"] = round(e_rows / swarm6)
     except _SqlProbeTooSlow as e:
         # the engine tier SUCCEEDED; only the SQL tier is skipped
         skipped.append(f"sql_tier:{e}")
+    except _BudgetSpent as e:
+        # everything measured so far stays; what remains is skipped
+        skipped.append(str(e))
     except Exception as e:  # noqa: BLE001 - storage tiers fail soft:
         # the kernel-tier numbers (already verified) still report
         extra["engine_tier_error"] = repr(e)[-400:]
