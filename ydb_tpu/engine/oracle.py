@@ -502,6 +502,23 @@ def _group_by(step: GroupByStep, cols, types, mask, dicts, schema):
             out_cols[spec.out_name] = (
                 acc / np.maximum(nn, 1), nn > 0
             )
+        elif spec.func in (Agg.VAR_SAMP, Agg.STDDEV_SAMP):
+            # deliberately DIFFERENT algorithm from the device plane:
+            # stable two-pass np.var per group, so the oracle
+            # cross-check detects the linear-state formula's
+            # catastrophic-cancellation regime instead of sharing it
+            src_t = types[spec.column]
+            v = lv[lok].astype(np.float64)
+            if src_t.is_decimal:
+                v = v / 10.0 ** src_t.scale
+            gi = inv[lok]
+            var = np.zeros(ngroups, dtype=np.float64)
+            for gidx in range(ngroups):
+                vals = v[gi == gidx]
+                if len(vals) >= 2:
+                    var[gidx] = np.var(vals, ddof=1)
+            out = np.sqrt(var) if spec.func is Agg.STDDEV_SAMP else var
+            out_cols[spec.out_name] = (out, nn > 1)
         elif spec.func in (Agg.MIN, Agg.MAX):
             src_t = types[spec.column]
             vals = lv

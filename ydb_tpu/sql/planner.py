@@ -48,6 +48,8 @@ from ydb_tpu.ssa.program import (
 _AGG_FUNCS = {
     "sum": Agg.SUM, "avg": Agg.AVG, "min": Agg.MIN, "max": Agg.MAX,
     "count": Agg.COUNT, "some": Agg.SOME,
+    "stddev_samp": Agg.STDDEV_SAMP, "stddev": Agg.STDDEV_SAMP,
+    "var_samp": Agg.VAR_SAMP, "variance": Agg.VAR_SAMP,
 }
 
 _CMP = {"eq": Op.EQ, "ne": Op.NE, "lt": Op.LT, "le": Op.LE, "gt": Op.GT,

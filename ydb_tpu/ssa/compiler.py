@@ -1000,6 +1000,22 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
                 elif spec.func is Agg.SOME:
                     data = kernels.scatter_first(c.data, vrow, gid, ng)
                     valid = nn > 0
+                elif spec.func in (Agg.VAR_SAMP, Agg.STDDEV_SAMP):
+                    src_t = cur_types[spec.column]
+                    vals = c.data.astype(jnp.float64)
+                    if src_t.is_decimal:
+                        vals = vals / (10.0 ** src_t.scale)
+                    s = kernels.scatter_sum(
+                        vals, vrow, gid, ng, dtype=jnp.float64)
+                    q = kernels.scatter_sum(
+                        vals * vals, vrow, gid, ng, dtype=jnp.float64)
+                    nf = nn.astype(jnp.float64)
+                    var = (q - s * s / jnp.maximum(nf, 1.0)) \
+                        / jnp.maximum(nf - 1.0, 1.0)
+                    var = jnp.maximum(var, 0.0)  # fp cancellation
+                    data = (jnp.sqrt(var)
+                            if spec.func is Agg.STDDEV_SAMP else var)
+                    valid = nn > 1
                 else:
                     raise NotImplementedError(spec.func)
             new_env[spec.out_name] = Column(data, valid)

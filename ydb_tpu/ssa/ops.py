@@ -118,6 +118,12 @@ class Agg(enum.Enum):
     MAX = "max"
     AVG = "avg"
     SOME = "some"            # any value (first non-null)
+    # sample variance/stddev (TPC-DS q17/q39 stddev_samp): NULL for
+    # groups of fewer than two non-null values. Two-phase split
+    # decomposes them into SUM(x) + SUM(x^2) + COUNT partials, so the
+    # distributed merge stays linear.
+    VAR_SAMP = "var_samp"
+    STDDEV_SAMP = "stddev_samp"
 
 
 #: Merge rule applied when combining partial aggregate states between
@@ -131,4 +137,6 @@ PARTIAL_MERGE = {
     Agg.MIN: Agg.MIN,
     Agg.MAX: Agg.MAX,
     Agg.SOME: Agg.SOME,
+    # VAR/STDDEV never appear in PARTIAL programs (twophase.split
+    # decomposes them into SUM/SUM/COUNT states first); no entry.
 }

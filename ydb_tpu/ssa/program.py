@@ -313,7 +313,7 @@ def agg_result_type(
     if spec.func in (Agg.COUNT, Agg.COUNT_ALL):
         return dtypes.INT64
     t = assigned.get(spec.column) or schema.field(spec.column).type
-    if spec.func is Agg.AVG:
+    if spec.func in (Agg.AVG, Agg.VAR_SAMP, Agg.STDDEV_SAMP):
         return dtypes.DOUBLE
     if spec.func is Agg.SUM:
         if t.is_decimal:

@@ -25,14 +25,17 @@ directly (pure filter/project programs need no merge).
 from __future__ import annotations
 
 from ydb_tpu.ssa.ops import Agg, Op
+from ydb_tpu import dtypes
 from ydb_tpu.ssa.program import (
     AggSpec,
     AssignStep,
     Call,
     Col,
+    Const,
     GroupByStep,
     Program,
     ProjectStep,
+    lit,
 )
 
 
@@ -84,6 +87,11 @@ def split(
     partial_aggs: list[AggSpec] = []
     final_aggs: list[AggSpec] = []
     avg_fixups: list[AssignStep] = []
+    # derived input columns some partial states aggregate over (the
+    # VAR/STDDEV x^2 column); they compute just before the partial
+    # group-by
+    pre_assigns: list[AssignStep] = []
+    _var_cols: set[str] = set()  # VAR/STDDEV state triples per column
     for spec in gb.aggs:
         if spec.func is Agg.AVG:
             s_name = f"__avg_sum_{spec.out_name}"
@@ -117,13 +125,52 @@ def split(
         elif spec.func is Agg.SOME:
             partial_aggs.append(spec)
             final_aggs.append(AggSpec(Agg.SOME, spec.out_name, spec.out_name))
+        elif spec.func in (Agg.VAR_SAMP, Agg.STDDEV_SAMP):
+            # decompose into linear states so the distributed merge is
+            # a plain psum: SUM(x), SUM(x^2), COUNT(x) in VALUE units
+            # (CAST_DOUBLE de-scales decimals); finalize via
+            # var = (sq - sum^2/n) / (n - 1), clamped at 0, NULL for
+            # n < 2 (safe_div on n-1 == 0). Known trade: the linear
+            # form loses precision when |mean| >> stddev (relative
+            # error ~ (mean/stddev)^2 * 2^-52) — the price of
+            # psum-mergeable states; the CPU oracle deliberately uses
+            # stable two-pass var so cross-checks expose that regime.
+            # States are shared per SOURCE column: VAR + STDDEV over
+            # the same column reuse one (sum, sq, count) triple.
+            s_name = f"__var_sum_{spec.column}"
+            q_name = f"__var_sq_{spec.column}"
+            c_name = f"__var_cnt_{spec.column}"
+            if s_name not in _var_cols:
+                _var_cols.add(s_name)
+                xd_name = f"__vd_{spec.column}"
+                pre_assigns.append(AssignStep(
+                    xd_name, Call(Op.CAST_DOUBLE, Col(spec.column))))
+                pre_assigns.append(AssignStep(
+                    q_name, Call(Op.MUL, Col(xd_name), Col(xd_name))))
+                partial_aggs.append(AggSpec(Agg.SUM, xd_name, s_name))
+                partial_aggs.append(AggSpec(Agg.SUM, q_name, q_name))
+                partial_aggs.append(
+                    AggSpec(Agg.COUNT, spec.column, c_name))
+                for nm in (s_name, q_name, c_name):
+                    final_aggs.append(AggSpec(Agg.SUM, nm, nm))
+            var = Call(
+                Op.DIV,
+                Call(Op.SUB, Col(q_name),
+                     Call(Op.DIV,
+                          Call(Op.MUL, Col(s_name), Col(s_name)),
+                          Col(c_name))),
+                Call(Op.SUB, Col(c_name), lit(1)))
+            var = Call(Op.GREATEST, var, Const(0.0, dtypes.DOUBLE))
+            if spec.func is Agg.STDDEV_SAMP:
+                var = Call(Op.SQRT, var)
+            avg_fixups.append(AssignStep(spec.out_name, var))
         else:
             raise NotImplementedError(f"two-phase split of {spec.func}")
 
     if with_row_counts:
         partial_aggs.append(AggSpec(Agg.COUNT_ALL, None, "__rows"))
     partial = Program(
-        program.steps[:gb_idx]
+        program.steps[:gb_idx] + tuple(pre_assigns)
         + (GroupByStep(gb.keys, tuple(partial_aggs), gb.max_groups),)
     )
     out_names = tuple(gb.keys) + tuple(s.out_name for s in gb.aggs)
