@@ -14,10 +14,12 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow 48 official templates (q1, q3, q6, q7, q12, q13, q15,
-q16, q19, q20, q21, q25, q26, q29, q30, q32, q33, q34, q37, q40, q42,
-q43, q45, q46, q48, q50, q52, q55, q56, q60, q61, q62, q65, q68, q69,
-q71, q73, q79, q81, q82, q88, q91, q92, q93, q94, q96, q98, q99). The
+Queries follow 52 official templates (q1, q3, q6, q7, q12, q13, q15,
+q16, q17, q18, q19, q20, q21, q25, q26, q27, q29, q30, q32, q33, q34,
+q37, q39, q40, q42, q43, q45, q46, q48, q50, q52, q55, q56, q60, q61,
+q62, q65, q68, q69, q71, q73, q79, q81, q82, q88, q91, q92, q93, q94,
+q96, q98, q99). q17/q39 exercise the stddev_samp aggregate; ROLLUPs
+(q18/q27) restate flat at their finest grouping. The
 channel-union family (q33/q56/q60/q71) runs through real UNION ALL
 planning; the returns chains (q1/q25/q29/q30/q40/q50/q81/q91/q93) join
 the store/catalog/web returns tables; q16/q94 run EXISTS with a <>
@@ -168,6 +170,8 @@ CUSTOMER_SCHEMA = dtypes.schema(
     ("c_current_cdemo_sk", dtypes.INT64, False),
     ("c_customer_id", dtypes.STRING, False),
     ("c_current_hdemo_sk", dtypes.INT64, False),
+    ("c_birth_month", dtypes.INT32, False),
+    ("c_birth_year", dtypes.INT32, False),
 )
 
 CUSTOMER_ADDRESS_SCHEMA = dtypes.schema(
@@ -187,6 +191,7 @@ CUSTOMER_DEMOGRAPHICS_SCHEMA = dtypes.schema(
     ("cd_education_status", dtypes.STRING, False),
     ("cd_purchase_estimate", dtypes.INT32, False),
     ("cd_credit_rating", dtypes.STRING, False),
+    ("cd_dep_count", dtypes.INT32, False),
 )
 
 HOUSEHOLD_DEMOGRAPHICS_SCHEMA = dtypes.schema(
@@ -583,6 +588,7 @@ class TpcdsData:
                 self.dicts, "cd_credit_rating",
                 [_CREDIT_RATINGS[i % len(_CREDIT_RATINGS)]
                  for i in range(nc)]),
+            "cd_dep_count": (np.arange(nc) % 7).astype(np.int32),
         }
         n_hd = 7200
         self.tables["household_demographics"] = {
@@ -663,6 +669,10 @@ class TpcdsData:
                 n_cust, dtype=np.int64),
             "c_current_hdemo_sk": rng.integers(
                 1, 7201, n_cust, dtype=np.int64),
+            "c_birth_month": rng.integers(
+                1, 13, n_cust).astype(np.int32),
+            "c_birth_year": rng.integers(
+                1924, 1993, n_cust).astype(np.int32),
         }
 
     def _fk(self, rng, table: str, pk: str, n: int) -> np.ndarray:
@@ -2116,6 +2126,104 @@ where cr_call_center_sk = cc_call_center_sk
 group by cc_name, cd_marital_status, cd_education_status
 order by returns_loss desc, cc_name, cd_marital_status,
          cd_education_status""",
+    # q17: quantity statistics (count/avg/stddev_samp) over the store
+    # sale -> return -> catalog re-purchase chain by item and store
+    # state (the cov ratio columns are display math and are omitted)
+    "q17": """
+select i_item_id, i_item_desc, s_state,
+       count(ss_quantity) as store_sales_quantitycount,
+       avg(ss_quantity) as store_sales_quantityave,
+       stddev_samp(ss_quantity) as store_sales_quantitystdev,
+       count(sr_return_quantity) as store_returns_quantitycount,
+       avg(sr_return_quantity) as store_returns_quantityave,
+       stddev_samp(sr_return_quantity) as store_returns_quantitystdev,
+       count(cs_quantity) as catalog_sales_quantitycount,
+       avg(cs_quantity) as catalog_sales_quantityave,
+       stddev_samp(cs_quantity) as catalog_sales_quantitystdev
+from store_sales, store_returns, catalog_sales,
+     date_dim d1, date_dim d2, date_dim d3, store, item
+where d1.d_qoy = 1 and d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_qoy in (1, 2, 3) and d2.d_year = 2001
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_qoy in (1, 2, 3) and d3.d_year = 2001
+group by i_item_id, i_item_desc, s_state
+order by i_item_id, i_item_desc, s_state
+limit 100""",
+    # q39: warehouse/item inventory demand variability across two
+    # consecutive months (cov threshold adapted to the uniform
+    # synthetic quantities: 0.5 instead of 1, same practice as q65)
+    "q39": """
+with inv as (
+  select w_warehouse_sk, i_item_sk, d_moy,
+         stddev_samp(inv_quantity_on_hand) as stdev,
+         avg(inv_quantity_on_hand) as mean
+  from inventory, item, warehouse, date_dim
+  where inv_item_sk = i_item_sk
+    and inv_warehouse_sk = w_warehouse_sk
+    and inv_date_sk = d_date_sk
+    and d_year = 2001
+  group by w_warehouse_sk, i_item_sk, d_moy)
+select inv1.w_warehouse_sk as wsk, inv1.i_item_sk as isk,
+       inv1.d_moy as moy1, inv1.mean as mean1, inv1.stdev as stdev1,
+       inv2.d_moy as moy2, inv2.mean as mean2, inv2.stdev as stdev2
+from inv inv1, inv inv2
+where inv1.i_item_sk = inv2.i_item_sk
+  and inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  and inv1.d_moy = 1
+  and inv2.d_moy = 2
+  and inv1.stdev / inv1.mean > 0.5
+  and inv2.stdev / inv2.mean > 0.5
+order by wsk, isk
+limit 100""",
+    # q27: demographic item averages by store state (ROLLUP restated
+    # flat at its finest grouping, the practice used for every rollup)
+    "q27": """
+select i_item_id, s_state,
+       avg(ss_quantity) as agg1, avg(ss_list_price) as agg2,
+       avg(ss_coupon_amt) as agg3, avg(ss_sales_price) as agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2002 and s_state = 'TN'
+group by i_item_id, s_state
+order by i_item_id, s_state
+limit 100""",
+    # q18: catalog averages by item and bill-to geography for chosen
+    # birth months (ROLLUP restated flat; the unfiltered cd2 join is
+    # N:1 total and drops out)
+    "q18": """
+select i_item_id, ca_country, ca_state, ca_county,
+       avg(cs_quantity) as agg1, avg(cs_list_price) as agg2,
+       avg(cs_coupon_amt) as agg3, avg(cs_sales_price) as agg4,
+       avg(cs_net_profit) as agg5, avg(c_birth_year) as agg6,
+       avg(cd_dep_count) as agg7
+from catalog_sales, customer_demographics, customer,
+     customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk
+  and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd_gender = 'F' and cd_education_status = 'Unknown'
+  and c_birth_month in (1, 6, 8, 9, 12, 2)
+  and d_year = 1998
+  and c_current_addr_sk = ca_address_sk
+  and ca_state in ('MS', 'GA', 'NM', 'OH', 'TX')
+group by i_item_id, ca_country, ca_state, ca_county
+order by i_item_id, ca_country, ca_state, ca_county
+limit 100""",
 }
 
 
@@ -3623,6 +3731,155 @@ class _Ref:
         rows.sort(key=lambda r: (-r[3], r[0], r[1], r[2]))
         return rows
 
+    def q17(self):
+        d = self.d
+        ss, sr = d.tables["store_sales"], d.tables["store_returns"]
+        cs = d.tables["catalog_sales"]
+        y1, m1, _ = self._date_cols(ss["ss_sold_date_sk"])
+        y2, m2, _ = self._date_cols(sr["sr_returned_date_sk"])
+        y3, m3, _ = self._date_cols(cs["cs_sold_date_sk"])
+        qoy = lambda m: (m - 1) // 3 + 1  # noqa: E731
+        triples = self._chain_rows(
+            (y1 == 2001) & (qoy(m1) == 1),
+            (y2 == 2001) & (qoy(m2) <= 3),
+            (y3 == 2001) & (qoy(m3) <= 3))
+        it, st = d.tables["item"], d.tables["store"]
+        iids = _decode(d, "item", "i_item_id")
+        idescs = _decode(d, "item", "i_item_desc")
+        states = _decode(d, "store", "s_state")
+        ipos = self._item_pos()
+        spos = {sk: i for i, sk in enumerate(
+            st["s_store_sk"].tolist())}
+        acc: dict = collections.defaultdict(
+            lambda: ([], [], []))
+        for i, r, j in triples:
+            ir = ipos[ss["ss_item_sk"][i]]
+            sp = spos[ss["ss_store_sk"][i]]
+            vals = acc[(iids[ir], idescs[ir], states[sp])]
+            vals[0].append(int(ss["ss_quantity"][i]))
+            vals[1].append(int(sr["sr_return_quantity"][r]))
+            vals[2].append(int(cs["cs_quantity"][j]))
+
+        def stats(v):
+            sd = float(np.std(v, ddof=1)) if len(v) >= 2 else None
+            return (len(v), float(np.mean(v)), sd)
+
+        rows = [(*k, *stats(v[0]), *stats(v[1]), *stats(v[2]))
+                for k, v in sorted(acc.items())]
+        return rows[:100]
+
+    def q39(self):
+        d = self.d
+        inv = d.tables["inventory"]
+        y, m, _ = self._date_cols(inv["inv_date_sk"])
+        acc: dict = collections.defaultdict(list)
+        sel = np.flatnonzero((y == 2001) & (m <= 2))
+        for w, i, mm, q in zip(
+                inv["inv_warehouse_sk"][sel].tolist(),
+                inv["inv_item_sk"][sel].tolist(), m[sel].tolist(),
+                inv["inv_quantity_on_hand"][sel].tolist()):
+            acc[(w, i, mm)].append(q)
+        st = {}
+        for k, v in acc.items():
+            if len(v) < 2:
+                continue
+            mean = float(np.mean(v))
+            sd = float(np.std(v, ddof=1))
+            if mean > 0 and sd / mean > 0.5:
+                st[k] = (mean, sd)
+        out = []
+        for (w, i, mm), (mean1, sd1) in sorted(st.items()):
+            if mm != 1:
+                continue
+            two = st.get((w, i, 2))
+            if two is not None:
+                out.append((w, i, 1, mean1, sd1, 2, two[0], two[1]))
+        return out[:100]
+
+    def q27(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        y, _, _ = self._date_cols(ss["ss_sold_date_sk"])
+        cd = d.tables["customer_demographics"]
+        g = _decode(d, "customer_demographics", "cd_gender")
+        ms = _decode(d, "customer_demographics", "cd_marital_status")
+        es = _decode(d, "customer_demographics", "cd_education_status")
+        cd_ok = {sk for sk, a, b, c in zip(
+            cd["cd_demo_sk"].tolist(), g, ms, es)
+            if a == b"M" and b == b"S" and c == b"College"}
+        st = d.tables["store"]
+        states = _decode(d, "store", "s_state")
+        s_ok = {sk for sk, sst in zip(st["s_store_sk"].tolist(),
+                                      states) if sst == b"TN"}
+        iids = _decode(d, "item", "i_item_id")
+        ipos = self._item_pos()
+        acc: dict = collections.defaultdict(lambda: [0] * 5)
+        for i in np.flatnonzero(y == 2002).tolist():
+            if ss["ss_cdemo_sk"][i] not in cd_ok:
+                continue
+            if ss["ss_store_sk"][i] not in s_ok:
+                continue
+            a = acc[(iids[ipos[ss["ss_item_sk"][i]]], b"TN")]
+            a[0] += 1
+            a[1] += int(ss["ss_quantity"][i])
+            a[2] += int(ss["ss_list_price"][i])
+            a[3] += int(ss["ss_coupon_amt"][i])
+            a[4] += int(ss["ss_sales_price"][i])
+        rows = [(k[0], k[1], a[1] / a[0], a[2] / a[0] / 100,
+                 a[3] / a[0] / 100, a[4] / a[0] / 100)
+                for k, a in sorted(acc.items())]
+        return rows[:100]
+
+    def q18(self):
+        d = self.d
+        cs = d.tables["catalog_sales"]
+        y, _, _ = self._date_cols(cs["cs_sold_date_sk"])
+        cd = d.tables["customer_demographics"]
+        g = _decode(d, "customer_demographics", "cd_gender")
+        es = _decode(d, "customer_demographics", "cd_education_status")
+        cd_ok = {sk for sk, a, b in zip(cd["cd_demo_sk"].tolist(),
+                                        g, es)
+                 if a == b"F" and b == b"Unknown"}
+        dep = dict(zip(cd["cd_demo_sk"].tolist(),
+                       cd["cd_dep_count"].tolist()))
+        cust = d.tables["customer"]
+        ca = d.tables["customer_address"]
+        ca_states = _decode(d, "customer_address", "ca_state")
+        countries = _decode(d, "customer_address", "ca_country")
+        counties = _decode(d, "customer_address", "ca_county")
+        ok_states = {b"MS", b"GA", b"NM", b"OH", b"TX"}
+        iids = _decode(d, "item", "i_item_id")
+        ipos = self._item_pos()
+        acc: dict = collections.defaultdict(lambda: [0] * 8)
+        for j in np.flatnonzero(y == 1998).tolist():
+            cdk = cs["cs_bill_cdemo_sk"][j]
+            if cdk not in cd_ok:
+                continue
+            c = int(cs["cs_bill_customer_sk"][j]) - 1
+            if int(cust["c_birth_month"][c]) not in (1, 6, 8, 9,
+                                                     12, 2):
+                continue
+            a_row = int(cust["c_current_addr_sk"][c]) - 1
+            if ca_states[a_row] not in ok_states:
+                continue
+            k = (iids[ipos[cs["cs_item_sk"][j]]], countries[a_row],
+                 ca_states[a_row], counties[a_row])
+            a = acc[k]
+            a[0] += 1
+            a[1] += int(cs["cs_quantity"][j])
+            a[2] += int(cs["cs_list_price"][j])
+            a[3] += int(cs["cs_coupon_amt"][j])
+            a[4] += int(cs["cs_sales_price"][j])
+            a[5] += int(cs["cs_net_profit"][j])
+            a[6] += int(cust["c_birth_year"][c])
+            a[7] += int(dep[cdk])
+        rows = [(k[0], k[1], k[2], k[3], a[1] / a[0],
+                 a[2] / a[0] / 100, a[3] / a[0] / 100,
+                 a[4] / a[0] / 100, a[5] / a[0] / 100,
+                 a[6] / a[0], a[7] / a[0])
+                for k, a in sorted(acc.items())]
+        return rows[:100]
+
     def q81(self):
         return self._ctr_over_state_avg(
             "catalog_returns", "cr_", "cr_return_amount", b"GA")
@@ -3753,6 +4010,27 @@ _VERIFY_COLS = {
             ("c_first_name", "str"), ("c_last_name", "str"),
             ("ctr_total_return", "dec")),
     "q61": (("promotions", "dec"), ("total", "dec")),
+    "q17": (("i_item_id", "str"), ("i_item_desc", "str"),
+            ("s_state", "str"),
+            ("store_sales_quantitycount", "int"),
+            ("store_sales_quantityave", "avg"),
+            ("store_sales_quantitystdev", "avg"),
+            ("store_returns_quantitycount", "int"),
+            ("store_returns_quantityave", "avg"),
+            ("store_returns_quantitystdev", "avg"),
+            ("catalog_sales_quantitycount", "int"),
+            ("catalog_sales_quantityave", "avg"),
+            ("catalog_sales_quantitystdev", "avg")),
+    "q39": (("wsk", "int"), ("isk", "int"), ("moy1", "int"),
+            ("mean1", "avg"), ("stdev1", "avg"), ("moy2", "int"),
+            ("mean2", "avg"), ("stdev2", "avg")),
+    "q27": (("i_item_id", "str"), ("s_state", "str"), ("agg1", "avg"),
+            ("agg2", "avg"), ("agg3", "avg"), ("agg4", "avg")),
+    "q18": (("i_item_id", "str"), ("ca_country", "str"),
+            ("ca_state", "str"), ("ca_county", "str"),
+            ("agg1", "avg"), ("agg2", "avg"), ("agg3", "avg"),
+            ("agg4", "avg"), ("agg5", "avg"), ("agg6", "avg"),
+            ("agg7", "avg")),
     "q88": (("h8_30_to_9", "int"), ("h9_to_9_30", "int"),
             ("h9_30_to_10", "int"), ("h10_to_10_30", "int"),
             ("h10_30_to_11", "int"), ("h11_to_11_30", "int"),
