@@ -43,6 +43,10 @@ def test_query(name, data, db, catalog):
     out = to_host(execute_plan(pq.plan, db))
     want = tpcds.reference_answers(data, [name])[name]
     assert len(want) > 0, f"{name}: vacuous reference (generator issue)"
+    if name in ("q38", "q96", "q16", "q94"):
+        # count-shaped queries always yield one row; a zero count would
+        # verify nothing about the join/exists machinery under test
+        assert want[0][0] > 0, f"{name}: zero-count reference"
     tpcds.verify_result(name, out, want, data, pq)
 
 

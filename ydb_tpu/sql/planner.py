@@ -1092,6 +1092,12 @@ class _SelectPlanner:
                 return ast.Between(
                     rewrite_scalars(e.expr), rewrite_scalars(e.low),
                     rewrite_scalars(e.high), e.negated)
+            if isinstance(e, ast.Case):
+                return ast.Case(
+                    tuple((rewrite_scalars(c), rewrite_scalars(v))
+                          for c, v in e.whens),
+                    rewrite_scalars(e.else_)
+                    if e.else_ is not None else None)
             return e
 
         where_conjuncts: list[ast.Expr] = []

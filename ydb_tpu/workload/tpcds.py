@@ -14,12 +14,15 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow 52 official templates (q1, q3, q6, q7, q12, q13, q15,
-q16, q17, q18, q19, q20, q21, q25, q26, q27, q29, q30, q32, q33, q34,
-q37, q39, q40, q42, q43, q45, q46, q48, q50, q52, q55, q56, q60, q61,
-q62, q65, q68, q69, q71, q73, q79, q81, q82, q88, q91, q92, q93, q94,
-q96, q98, q99). q17/q39 exercise the stddev_samp aggregate; ROLLUPs
-(q18/q27) restate flat at their finest grouping. The
+Queries follow 57 official templates (q1, q3, q6, q7, q9, q11, q12,
+q13, q15, q16, q17, q18, q19, q20, q21, q25, q26, q27, q29, q30, q31,
+q32, q33, q34, q37, q38, q39, q40, q42, q43, q45, q46, q48, q50, q52,
+q55, q56, q60, q61, q62, q65, q68, q69, q71, q73, q74, q79, q81, q82,
+q88, q91, q92, q93, q94, q96, q98, q99). q17/q39 exercise the
+stddev_samp aggregate; ROLLUPs (q18/q27) restate flat at their finest
+grouping; q9 picks buckets by CASE over scalar subqueries; q74/q11
+restate the official UNION ALL year_total CTE as one CTE per channel;
+q38's INTERSECT restates as a 1:1 join of distinct triples. The
 channel-union family (q33/q56/q60/q71) runs through real UNION ALL
 planning; the returns chains (q1/q25/q29/q30/q40/q50/q81/q91/q93) join
 the store/catalog/web returns tables; q16/q94 run EXISTS with a <>
@@ -221,6 +224,8 @@ STORE_SALES_SCHEMA = dtypes.schema(
     ("ss_ticket_number", dtypes.INT64, False),
     ("ss_ext_list_price", DEC2, False),
     ("ss_ext_tax", DEC2, False),
+    ("ss_ext_discount_amt", DEC2, False),
+    ("ss_net_paid", DEC2, False),
 )
 
 WEB_SALES_SCHEMA = dtypes.schema(
@@ -241,6 +246,8 @@ WEB_SALES_SCHEMA = dtypes.schema(
     ("ws_ship_addr_sk", dtypes.INT64, False),
     ("ws_ext_ship_cost", DEC2, False),
     ("ws_ship_date_sk", dtypes.INT64, False),
+    ("ws_net_paid", DEC2, False),
+    ("ws_ext_list_price", DEC2, False),
 )
 
 INVENTORY_SCHEMA = dtypes.schema(
@@ -735,6 +742,10 @@ class TpcdsData:
             "ss_ext_tax": (sales_price * qty *
                            rng.integers(0, 9, n) // 100)
             .astype(np.int64),
+            "ss_ext_discount_amt": np.where(
+                rng.random(n) < 0.4, _cents(rng, 0.0, 40.0, n),
+                0).astype(np.int64),
+            "ss_net_paid": sales_price * qty,
         }
 
     def _gen_catalog_sales(self, rng, n: int):
@@ -876,6 +887,8 @@ class TpcdsData:
             "ws_ship_addr_sk": self._fk(
                 rng, "customer_address", "ca_address_sk", n),
             "ws_ext_ship_cost": _cents(rng, 0.50, 90.0, n),
+            "ws_net_paid": sales_price * qty,
+            "ws_ext_list_price": list_price * qty,
         }
         ws = self.tables["web_sales"]
         max_sk = int(self.tables["date_dim"]["d_date_sk"].max())
@@ -2224,6 +2237,188 @@ where cs_sold_date_sk = d_date_sk
 group by i_item_id, ca_country, ca_state, ca_county
 order by i_item_id, ca_country, ca_state, ca_county
 limit 100""",
+    # q9: five quantity-band buckets picked by CASE over scalar
+    # subqueries, driven off a one-row reason scan (count thresholds
+    # adapted to synthetic scale, same practice as q65/q91)
+    "q9": """
+select
+  case when (select count(*) from store_sales
+             where ss_quantity between 1 and 20) > 10000
+       then (select avg(ss_ext_discount_amt) from store_sales
+             where ss_quantity between 1 and 20)
+       else (select avg(ss_net_paid) from store_sales
+             where ss_quantity between 1 and 20) end as bucket1,
+  case when (select count(*) from store_sales
+             where ss_quantity between 21 and 40) > 10000
+       then (select avg(ss_ext_discount_amt) from store_sales
+             where ss_quantity between 21 and 40)
+       else (select avg(ss_net_paid) from store_sales
+             where ss_quantity between 21 and 40) end as bucket2,
+  case when (select count(*) from store_sales
+             where ss_quantity between 41 and 60) > 10000
+       then (select avg(ss_ext_discount_amt) from store_sales
+             where ss_quantity between 41 and 60)
+       else (select avg(ss_net_paid) from store_sales
+             where ss_quantity between 41 and 60) end as bucket3,
+  case when (select count(*) from store_sales
+             where ss_quantity between 61 and 80) > 10000
+       then (select avg(ss_ext_discount_amt) from store_sales
+             where ss_quantity between 61 and 80)
+       else (select avg(ss_net_paid) from store_sales
+             where ss_quantity between 61 and 80) end as bucket4,
+  case when (select count(*) from store_sales
+             where ss_quantity between 81 and 100) > 10000
+       then (select avg(ss_ext_discount_amt) from store_sales
+             where ss_quantity between 81 and 100)
+       else (select avg(ss_net_paid) from store_sales
+             where ss_quantity between 81 and 100) end as bucket5
+from reason
+where r_reason_sk = 1""",
+    # q74: customers whose web spending grew faster than their store
+    # spending year over year. The official UNION ALL year_total CTE
+    # with a literal sale_type column restates exactly as one CTE per
+    # channel (each self-join leg filters to a single sale_type)
+    "q74": """
+with store_total as (
+  select c_customer_id as customer_id,
+         c_first_name as customer_first_name,
+         c_last_name as customer_last_name,
+         d_year as yr, sum(ss_net_paid) as year_total
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year in (1998, 1999)
+  group by c_customer_id, c_first_name, c_last_name, d_year),
+web_total as (
+  select c_customer_id as customer_id,
+         c_first_name as customer_first_name,
+         c_last_name as customer_last_name,
+         d_year as yr, sum(ws_net_paid) as year_total
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year in (1998, 1999)
+  group by c_customer_id, c_first_name, c_last_name, d_year)
+select s2.customer_id, s2.customer_first_name,
+       s2.customer_last_name
+from store_total s1, store_total s2, web_total w1, web_total w2
+where s2.customer_id = s1.customer_id
+  and s1.customer_id = w1.customer_id
+  and s1.customer_id = w2.customer_id
+  and s1.yr = 1998 and s2.yr = 1999
+  and w1.yr = 1998 and w2.yr = 1999
+  and s1.year_total > 0
+  and w1.year_total > 0
+  and w2.year_total / w1.year_total
+      > s2.year_total / s1.year_total
+order by customer_id, customer_first_name, customer_last_name
+limit 100""",
+    # q11: q74's twin over list-price-minus-discount revenue with the
+    # preferred-customer flag carried (same per-channel CTE
+    # restatement of the official UNION ALL year_total)
+    "q11": """
+with store_total as (
+  select c_customer_id as customer_id,
+         c_preferred_cust_flag as flag,
+         d_year as yr,
+         sum(ss_ext_list_price - ss_ext_discount_amt) as year_total
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year in (1998, 1999)
+  group by c_customer_id, c_preferred_cust_flag, d_year),
+web_total as (
+  select c_customer_id as customer_id,
+         c_preferred_cust_flag as flag,
+         d_year as yr,
+         sum(ws_ext_list_price - ws_ext_discount_amt) as year_total
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year in (1998, 1999)
+  group by c_customer_id, c_preferred_cust_flag, d_year)
+select s2.customer_id, s2.flag
+from store_total s1, store_total s2, web_total w1, web_total w2
+where s2.customer_id = s1.customer_id
+  and s1.customer_id = w1.customer_id
+  and s1.customer_id = w2.customer_id
+  and s1.yr = 1998 and s2.yr = 1999
+  and w1.yr = 1998 and w2.yr = 1999
+  and s1.year_total > 0
+  and w1.year_total > 0
+  and w2.year_total / w1.year_total
+      > s2.year_total / s1.year_total
+order by customer_id, flag
+limit 100""",
+    # q31: counties where web sales grew faster than store sales in
+    # consecutive 2000 quarters (6-way self-join of per-channel CTEs;
+    # the zero-denominator CASEs drop to plain >0 guards — a NULL
+    # comparison is never satisfied either way)
+    "q31": """
+with ss as (
+  select ca_county, d_qoy, d_year,
+         sum(ss_ext_sales_price) as store_sales
+  from store_sales, date_dim, customer_address
+  where ss_sold_date_sk = d_date_sk
+    and ss_addr_sk = ca_address_sk
+  group by ca_county, d_qoy, d_year),
+ws as (
+  select ca_county, d_qoy, d_year,
+         sum(ws_ext_sales_price) as web_sales
+  from web_sales, date_dim, customer_address
+  where ws_sold_date_sk = d_date_sk
+    and ws_bill_addr_sk = ca_address_sk
+  group by ca_county, d_qoy, d_year)
+select ss1.ca_county, ss1.d_year,
+       ws2.web_sales / ws1.web_sales as web_q1_q2_increase,
+       ss2.store_sales / ss1.store_sales as store_q1_q2_increase,
+       ws3.web_sales / ws2.web_sales as web_q2_q3_increase,
+       ss3.store_sales / ss2.store_sales as store_q2_q3_increase
+from ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3
+where ss1.d_qoy = 1 and ss1.d_year = 2000
+  and ss1.ca_county = ss2.ca_county
+  and ss2.d_qoy = 2 and ss2.d_year = 2000
+  and ss2.ca_county = ss3.ca_county
+  and ss3.d_qoy = 3 and ss3.d_year = 2000
+  and ss1.ca_county = ws1.ca_county
+  and ws1.d_qoy = 1 and ws1.d_year = 2000
+  and ws1.ca_county = ws2.ca_county
+  and ws2.d_qoy = 2 and ws2.d_year = 2000
+  and ws2.ca_county = ws3.ca_county
+  and ws3.d_qoy = 3 and ws3.d_year = 2000
+  and ws1.web_sales > 0 and ss1.store_sales > 0
+  and ws2.web_sales > 0 and ss2.store_sales > 0
+  and ws2.web_sales / ws1.web_sales
+      > ss2.store_sales / ss1.store_sales
+  and ws3.web_sales / ws2.web_sales
+      > ss3.store_sales / ss2.store_sales
+order by ss1.ca_county""",
+    # q38: customers buying in all three channels in one year. The
+    # official INTERSECT of DISTINCT (last, first, date) triples
+    # restates exactly as a 1:1 join of the three distinct derived
+    # tables on the triple
+    "q38": """
+select count(*) as cnt
+from (select distinct c_last_name as ln, c_first_name as fn,
+             d_date as dt
+      from store_sales, date_dim, customer
+      where ss_sold_date_sk = d_date_sk
+        and ss_customer_sk = c_customer_sk
+        and d_month_seq between 24 and 35) s,
+     (select distinct c_last_name as ln, c_first_name as fn,
+             d_date as dt
+      from catalog_sales, date_dim, customer
+      where cs_sold_date_sk = d_date_sk
+        and cs_bill_customer_sk = c_customer_sk
+        and d_month_seq between 24 and 35) c,
+     (select distinct c_last_name as ln, c_first_name as fn,
+             d_date as dt
+      from web_sales, date_dim, customer
+      where ws_sold_date_sk = d_date_sk
+        and ws_bill_customer_sk = c_customer_sk
+        and d_month_seq between 24 and 35) w
+where s.ln = c.ln and s.fn = c.fn and s.dt = c.dt
+  and s.ln = w.ln and s.fn = w.fn and s.dt = w.dt""",
 }
 
 
@@ -3796,6 +3991,132 @@ class _Ref:
                 out.append((w, i, 1, mean1, sd1, 2, two[0], two[1]))
         return out[:100]
 
+    def q9(self):
+        ss = self.d.tables["store_sales"]
+        q = ss["ss_quantity"]
+        out = []
+        for lo in (1, 21, 41, 61, 81):
+            m = (q >= lo) & (q <= lo + 19)
+            col = ("ss_ext_discount_amt" if int(m.sum()) > 10000
+                   else "ss_net_paid")
+            out.append(float(ss[col][m].mean()) / 100.0)
+        return [tuple(out)]
+
+    def _year_ratio_customers(self, value_cols):
+        """q74/q11 shape: customers whose 1998->1999 web revenue ratio
+        beats the store ratio; ``value_cols`` maps channel prefix ->
+        per-row revenue column(s) (summed when several)."""
+        d = self.d
+
+        def totals(fact, cust_col, date_col, cols):
+            tb = d.tables[fact]
+            y, _, _ = self._date_cols(tb[date_col])
+            vals = tb[cols[0]].astype(np.int64)
+            for extra in cols[1:]:
+                vals = vals - tb[extra]
+            acc: dict = collections.defaultdict(int)
+            sel = np.flatnonzero(np.isin(y, (1998, 1999)))
+            for yy, c, p in zip(y[sel].tolist(),
+                                tb[cust_col][sel].tolist(),
+                                vals[sel].tolist()):
+                acc[(c, yy)] += p
+            return acc
+
+        st = totals("store_sales", "ss_customer_sk",
+                    "ss_sold_date_sk", value_cols["ss_"])
+        wt = totals("web_sales", "ws_bill_customer_sk",
+                    "ws_sold_date_sk", value_cols["ws_"])
+        n_cust = len(d.tables["customer"]["c_customer_sk"])
+        for c in range(1, n_cust + 1):
+            s1, s2 = st.get((c, 1998)), st.get((c, 1999))
+            w1, w2 = wt.get((c, 1998)), wt.get((c, 1999))
+            if None in (s1, s2, w1, w2) or s1 <= 0 or w1 <= 0:
+                continue
+            if w2 / w1 > s2 / s1:
+                yield c
+
+    def q74(self):
+        d = self.d
+        cids = _decode(d, "customer", "c_customer_id")
+        fn = _decode(d, "customer", "c_first_name")
+        ln = _decode(d, "customer", "c_last_name")
+        out = [(cids[c - 1], fn[c - 1], ln[c - 1])
+               for c in self._year_ratio_customers(
+                   {"ss_": ("ss_net_paid",),
+                    "ws_": ("ws_net_paid",)})]
+        out.sort()
+        return out[:100]
+
+    def q11(self):
+        d = self.d
+        cids = _decode(d, "customer", "c_customer_id")
+        flags = _decode(d, "customer", "c_preferred_cust_flag")
+        out = [(cids[c - 1], flags[c - 1])
+               for c in self._year_ratio_customers(
+                   {"ss_": ("ss_ext_list_price",
+                            "ss_ext_discount_amt"),
+                    "ws_": ("ws_ext_list_price",
+                            "ws_ext_discount_amt")})]
+        out.sort()
+        return out[:100]
+
+    def q38(self):
+        d = self.d
+        ln = _decode(d, "customer", "c_last_name")
+        fn = _decode(d, "customer", "c_first_name")
+
+        def triples(fact, cust_col, date_col):
+            tb = d.tables[fact]
+            _, _, dates = self._date_cols(tb[date_col])
+            dd = d.tables["date_dim"]
+            seq_ok = (dd["d_month_seq"] >= 24) & (dd["d_month_seq"]
+                                                  <= 35)
+            ok_dates = set(dd["d_date"][seq_ok].tolist())
+            out = set()
+            for c, dt in zip(tb[cust_col].tolist(), dates.tolist()):
+                if dt in ok_dates:
+                    out.add((ln[c - 1], fn[c - 1], dt))
+            return out
+
+        n = len(triples("store_sales", "ss_customer_sk",
+                        "ss_sold_date_sk")
+                & triples("catalog_sales", "cs_bill_customer_sk",
+                          "cs_sold_date_sk")
+                & triples("web_sales", "ws_bill_customer_sk",
+                          "ws_sold_date_sk"))
+        return [(n,)]
+
+    def q31(self):
+        d = self.d
+        counties = _decode(d, "customer_address", "ca_county")
+
+        def qsums(fact, date_col, addr_col, price_col):
+            tb = d.tables[fact]
+            y, m, _ = self._date_cols(tb[date_col])
+            acc: dict = collections.defaultdict(int)
+            sel = np.flatnonzero((y == 2000) & (m <= 9))
+            for a, mm, p in zip(tb[addr_col][sel].tolist(),
+                                m[sel].tolist(),
+                                tb[price_col][sel].tolist()):
+                acc[(counties[a - 1], (mm - 1) // 3 + 1)] += p
+            return acc
+
+        ssq = qsums("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+                    "ss_ext_sales_price")
+        wsq = qsums("web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+                    "ws_ext_sales_price")
+        out = []
+        for county in sorted(set(k[0] for k in ssq)):
+            s = [ssq.get((county, q)) for q in (1, 2, 3)]
+            w = [wsq.get((county, q)) for q in (1, 2, 3)]
+            if None in s or None in w or s[0] <= 0 or s[1] <= 0 \
+                    or w[0] <= 0 or w[1] <= 0:
+                continue
+            if w[1] / w[0] > s[1] / s[0] and w[2] / w[1] > s[2] / s[1]:
+                out.append((county, 2000, w[1] / w[0], s[1] / s[0],
+                            w[2] / w[1], s[2] / s[1]))
+        return out
+
     def q27(self):
         d = self.d
         ss = d.tables["store_sales"]
@@ -4024,6 +4345,17 @@ _VERIFY_COLS = {
     "q39": (("wsk", "int"), ("isk", "int"), ("moy1", "int"),
             ("mean1", "avg"), ("stdev1", "avg"), ("moy2", "int"),
             ("mean2", "avg"), ("stdev2", "avg")),
+    "q9": (("bucket1", "avg"), ("bucket2", "avg"), ("bucket3", "avg"),
+           ("bucket4", "avg"), ("bucket5", "avg")),
+    "q74": (("customer_id", "str"), ("customer_first_name", "str"),
+            ("customer_last_name", "str")),
+    "q11": (("customer_id", "str"), ("flag", "str")),
+    "q38": (("cnt", "int"),),
+    "q31": (("ca_county", "str"), ("d_year", "int"),
+            ("web_q1_q2_increase", "avg"),
+            ("store_q1_q2_increase", "avg"),
+            ("web_q2_q3_increase", "avg"),
+            ("store_q2_q3_increase", "avg")),
     "q27": (("i_item_id", "str"), ("s_state", "str"), ("agg1", "avg"),
             ("agg2", "avg"), ("agg3", "avg"), ("agg4", "avg")),
     "q18": (("i_item_id", "str"), ("ca_country", "str"),
