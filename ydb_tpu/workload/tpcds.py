@@ -14,15 +14,17 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow 57 official templates (q1, q3, q6, q7, q9, q11, q12,
-q13, q15, q16, q17, q18, q19, q20, q21, q25, q26, q27, q29, q30, q31,
-q32, q33, q34, q37, q38, q39, q40, q42, q43, q45, q46, q48, q50, q52,
-q55, q56, q60, q61, q62, q65, q68, q69, q71, q73, q74, q79, q81, q82,
-q88, q91, q92, q93, q94, q96, q98, q99). q17/q39 exercise the
-stddev_samp aggregate; ROLLUPs (q18/q27) restate flat at their finest
-grouping; q9 picks buckets by CASE over scalar subqueries; q74/q11
-restate the official UNION ALL year_total CTE as one CTE per channel;
-q38's INTERSECT restates as a 1:1 join of distinct triples. The
+Queries follow 60 official templates (q1, q2, q3, q4, q6, q7, q9,
+q11, q12, q13, q15, q16, q17, q18, q19, q20, q21, q25, q26, q27, q29,
+q30, q31, q32, q33, q34, q37, q38, q39, q40, q42, q43, q45, q46, q48,
+q50, q52, q55, q56, q60, q61, q62, q65, q68, q69, q71, q73, q74, q79,
+q81, q82, q88, q89, q91, q92, q93, q94, q96, q98, q99). q17/q39
+exercise the stddev_samp aggregate; ROLLUPs (q18/q27) restate flat at
+their finest grouping; q9 picks buckets by CASE over scalar
+subqueries; q74/q11/q4 restate the official UNION ALL year_total CTE
+as one CTE per channel; q38's INTERSECT restates as a 1:1 join of
+distinct triples; q89 restates AVG() OVER as a per-partition average
+CTE; q2 ratios each week against the same week a year later. The
 channel-union family (q33/q56/q60/q71) runs through real UNION ALL
 planning; the returns chains (q1/q25/q29/q30/q40/q50/q81/q91/q93) join
 the store/catalog/web returns tables; q16/q94 run EXISTS with a <>
@@ -116,6 +118,7 @@ DATE_DIM_SCHEMA = dtypes.schema(
     ("d_day_name", dtypes.STRING, False),
     ("d_dow", dtypes.INT32, False),
     ("d_qoy", dtypes.INT32, False),
+    ("d_week_seq", dtypes.INT32, False),
 )
 
 ITEM_SCHEMA = dtypes.schema(
@@ -248,6 +251,7 @@ WEB_SALES_SCHEMA = dtypes.schema(
     ("ws_ship_date_sk", dtypes.INT64, False),
     ("ws_net_paid", DEC2, False),
     ("ws_ext_list_price", DEC2, False),
+    ("ws_ext_wholesale_cost", DEC2, False),
 )
 
 INVENTORY_SCHEMA = dtypes.schema(
@@ -296,6 +300,8 @@ CATALOG_SALES_SCHEMA = dtypes.schema(
     ("cs_order_number", dtypes.INT64, False),
     ("cs_net_profit", DEC2, False),
     ("cs_ext_ship_cost", DEC2, False),
+    ("cs_ext_list_price", DEC2, False),
+    ("cs_ext_wholesale_cost", DEC2, False),
 )
 REASON_SCHEMA = dtypes.schema(
     ("r_reason_sk", dtypes.INT64, False),
@@ -460,6 +466,9 @@ class TpcdsData:
             "d_dow": (((days.astype(int) + 3) % 7 + 1) % 7)
             .astype(np.int32),
             "d_qoy": (((m - y).astype(int)) // 3 + 1).astype(np.int32),
+            # absolute week index (Monday-anchored weeks since epoch;
+            # q2 joins consecutive years via d_week_seq - 53)
+            "d_week_seq": ((days.astype(int) + 3) // 7).astype(np.int32),
         }
 
     def _gen_item(self, rng, n: int):
@@ -779,6 +788,10 @@ class TpcdsData:
             "cs_order_number": (np.arange(n, dtype=np.int64) // 2 + 1),
             "cs_net_profit": _cents(rng, -100.0, 300.0, n),
             "cs_ext_ship_cost": _cents(rng, 0.50, 90.0, n),
+            "cs_ext_list_price": list_price * qty,
+            "cs_ext_wholesale_cost": (
+                list_price * rng.integers(40, 80, n) // 100
+                * qty).astype(np.int64),
             "cs_ext_discount_amt": np.where(
                 rng.random(n) < 0.5, _cents(rng, 0.0, 80.0, n),
                 0).astype(np.int64),
@@ -889,6 +902,9 @@ class TpcdsData:
             "ws_ext_ship_cost": _cents(rng, 0.50, 90.0, n),
             "ws_net_paid": sales_price * qty,
             "ws_ext_list_price": list_price * qty,
+            "ws_ext_wholesale_cost": (
+                list_price * rng.integers(40, 80, n) // 100
+                * qty).astype(np.int64),
         }
         ws = self.tables["web_sales"]
         max_sk = int(self.tables["date_dim"]["d_date_sk"].max())
@@ -2419,6 +2435,155 @@ from (select distinct c_last_name as ln, c_first_name as fn,
         and d_month_seq between 24 and 35) w
 where s.ln = c.ln and s.fn = c.fn and s.dt = c.dt
   and s.ln = w.ln and s.fn = w.fn and s.dt = w.dt""",
+    # q89: months deviating >10% from the (category, brand, store)
+    # yearly average — the official AVG() OVER (PARTITION BY) restates
+    # exactly as a join against a per-partition average CTE (the q98
+    # practice; company-name column adapted to s_store_name)
+    "q89": """
+with msum as (
+  select i_category, i_brand, s_store_name, d_moy,
+         sum(ss_sales_price) as sum_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_year = 1999
+    and ((i_category in ('Books', 'Electronics', 'Sports')
+          and i_class in ('class#01', 'class#02', 'class#03'))
+         or (i_category in ('Men', 'Jewelry', 'Women')
+             and i_class in ('class#04', 'class#05', 'class#06')))
+  group by i_category, i_brand, s_store_name, d_moy),
+mavg as (
+  select i_category as a_category, i_brand as a_brand,
+         s_store_name as a_store_name,
+         avg(sum_sales) as avg_monthly_sales
+  from msum
+  group by i_category, i_brand, s_store_name)
+select i_category, i_brand, s_store_name, d_moy, sum_sales,
+       avg_monthly_sales,
+       sum_sales - avg_monthly_sales as diff
+from msum, mavg
+where i_category = a_category
+  and i_brand = a_brand
+  and s_store_name = a_store_name
+  and avg_monthly_sales > 0
+  and abs(sum_sales - avg_monthly_sales) / avg_monthly_sales > 0.1
+order by diff, i_category, i_brand, s_store_name, d_moy
+limit 100""",
+    # q2: web+catalog weekly day-of-week sales, each week ratioed to
+    # the same week one year later (53-week shift precomputed in the
+    # second leg; week membership in a year via IN, avoiding the
+    # official's row-duplicating date_dim join; ratios as plain
+    # division, ROUND omitted)
+    "q2": """
+with wscs as (
+  select ws_sold_date_sk as sold_date_sk,
+         ws_ext_sales_price as sales_price
+  from web_sales
+  union all
+  select cs_sold_date_sk as sold_date_sk,
+         cs_ext_sales_price as sales_price
+  from catalog_sales),
+wswscs as (
+  select d_week_seq,
+         sum(case when d_day_name = 'Sunday'
+             then sales_price else 0 end) as sun_sales,
+         sum(case when d_day_name = 'Monday'
+             then sales_price else 0 end) as mon_sales,
+         sum(case when d_day_name = 'Tuesday'
+             then sales_price else 0 end) as tue_sales,
+         sum(case when d_day_name = 'Wednesday'
+             then sales_price else 0 end) as wed_sales,
+         sum(case when d_day_name = 'Thursday'
+             then sales_price else 0 end) as thu_sales,
+         sum(case when d_day_name = 'Friday'
+             then sales_price else 0 end) as fri_sales,
+         sum(case when d_day_name = 'Saturday'
+             then sales_price else 0 end) as sat_sales
+  from wscs, date_dim
+  where d_date_sk = sold_date_sk
+  group by d_week_seq)
+select y.d_week_seq as week1,
+       y.sun_sales / z.sun_sales as sun_ratio,
+       y.mon_sales / z.mon_sales as mon_ratio,
+       y.tue_sales / z.tue_sales as tue_ratio,
+       y.wed_sales / z.wed_sales as wed_ratio,
+       y.thu_sales / z.thu_sales as thu_ratio,
+       y.fri_sales / z.fri_sales as fri_ratio,
+       y.sat_sales / z.sat_sales as sat_ratio
+from (select d_week_seq, sun_sales, mon_sales, tue_sales, wed_sales,
+             thu_sales, fri_sales, sat_sales
+      from wswscs
+      where d_week_seq in (select d_week_seq from date_dim
+                           where d_year = 2001)) y,
+     (select d_week_seq - 53 as week_m53, sun_sales, mon_sales,
+             tue_sales, wed_sales, thu_sales, fri_sales, sat_sales
+      from wswscs
+      where d_week_seq in (select d_week_seq from date_dim
+                           where d_year = 2002)) z
+where y.d_week_seq = z.week_m53
+  and z.sun_sales > 0 and z.mon_sales > 0 and z.tue_sales > 0
+  and z.wed_sales > 0 and z.thu_sales > 0 and z.fri_sales > 0
+  and z.sat_sales > 0
+order by week1""",
+    # q4: customers whose catalog growth beats both store and web
+    # growth (three per-channel CTEs as in q74/q11; the official /2
+    # inside each sum scales every total equally and drops out of the
+    # ratio comparisons; first-year totals of all channels guarded >0)
+    "q4": """
+with store_total as (
+  select c_customer_id as customer_id,
+         c_first_name as customer_first_name,
+         c_last_name as customer_last_name,
+         d_year as yr,
+         sum(ss_ext_list_price - ss_ext_wholesale_cost
+             - ss_ext_discount_amt + ss_ext_sales_price)
+           as year_total
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year in (1998, 1999)
+  group by c_customer_id, c_first_name, c_last_name, d_year),
+cat_total as (
+  select c_customer_id as customer_id, d_year as yr,
+         sum(cs_ext_list_price - cs_ext_wholesale_cost
+             - cs_ext_discount_amt + cs_ext_sales_price)
+           as year_total
+  from customer, catalog_sales, date_dim
+  where c_customer_sk = cs_bill_customer_sk
+    and cs_sold_date_sk = d_date_sk
+    and d_year in (1998, 1999)
+  group by c_customer_id, d_year),
+web_total as (
+  select c_customer_id as customer_id, d_year as yr,
+         sum(ws_ext_list_price - ws_ext_wholesale_cost
+             - ws_ext_discount_amt + ws_ext_sales_price)
+           as year_total
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year in (1998, 1999)
+  group by c_customer_id, d_year)
+select s2.customer_id, s2.customer_first_name,
+       s2.customer_last_name
+from store_total s1, store_total s2, cat_total c1, cat_total c2,
+     web_total w1, web_total w2
+where s2.customer_id = s1.customer_id
+  and s1.customer_id = c1.customer_id
+  and s1.customer_id = c2.customer_id
+  and s1.customer_id = w1.customer_id
+  and s1.customer_id = w2.customer_id
+  and s1.yr = 1998 and s2.yr = 1999
+  and c1.yr = 1998 and c2.yr = 1999
+  and w1.yr = 1998 and w2.yr = 1999
+  and s1.year_total > 0 and c1.year_total > 0
+  and w1.year_total > 0
+  and c2.year_total / c1.year_total
+      > s2.year_total / s1.year_total
+  and c2.year_total / c1.year_total
+      > w2.year_total / w1.year_total
+order by customer_id, customer_first_name, customer_last_name
+limit 100""",
 }
 
 
@@ -4002,30 +4167,37 @@ class _Ref:
             out.append(float(ss[col][m].mean()) / 100.0)
         return [tuple(out)]
 
+    def _year_totals(self, fact, cust_col, date_col, vals):
+        """(customer, year) -> sum of the precomputed per-row ``vals``
+        over 1998/1999 (the q74/q11/q4 year_total accumulation)."""
+        tb = self.d.tables[fact]
+        y, _, _ = self._date_cols(tb[date_col])
+        acc: dict = collections.defaultdict(int)
+        sel = np.flatnonzero(np.isin(y, (1998, 1999)))
+        for yy, c, p in zip(y[sel].tolist(),
+                            tb[cust_col][sel].tolist(),
+                            np.asarray(vals)[sel].tolist()):
+            acc[(c, yy)] += p
+        return acc
+
     def _year_ratio_customers(self, value_cols):
         """q74/q11 shape: customers whose 1998->1999 web revenue ratio
         beats the store ratio; ``value_cols`` maps channel prefix ->
-        per-row revenue column(s) (summed when several)."""
+        per-row revenue column(s) (first minus the rest)."""
         d = self.d
 
-        def totals(fact, cust_col, date_col, cols):
-            tb = d.tables[fact]
-            y, _, _ = self._date_cols(tb[date_col])
-            vals = tb[cols[0]].astype(np.int64)
+        def vals_of(fact, cols):
+            v = d.tables[fact][cols[0]].astype(np.int64)
             for extra in cols[1:]:
-                vals = vals - tb[extra]
-            acc: dict = collections.defaultdict(int)
-            sel = np.flatnonzero(np.isin(y, (1998, 1999)))
-            for yy, c, p in zip(y[sel].tolist(),
-                                tb[cust_col][sel].tolist(),
-                                vals[sel].tolist()):
-                acc[(c, yy)] += p
-            return acc
+                v = v - d.tables[fact][extra]
+            return v
 
-        st = totals("store_sales", "ss_customer_sk",
-                    "ss_sold_date_sk", value_cols["ss_"])
-        wt = totals("web_sales", "ws_bill_customer_sk",
-                    "ws_sold_date_sk", value_cols["ws_"])
+        st = self._year_totals(
+            "store_sales", "ss_customer_sk", "ss_sold_date_sk",
+            vals_of("store_sales", value_cols["ss_"]))
+        wt = self._year_totals(
+            "web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+            vals_of("web_sales", value_cols["ws_"]))
         n_cust = len(d.tables["customer"]["c_customer_sk"])
         for c in range(1, n_cust + 1):
             s1, s2 = st.get((c, 1998)), st.get((c, 1999))
@@ -4047,6 +4219,41 @@ class _Ref:
         out.sort()
         return out[:100]
 
+    def _channel_profit_totals(self, fact, pfx, cust_col):
+        """q4's per-row profit: list - wholesale - discount + sales."""
+        tb = self.d.tables[fact]
+        vals = (tb[pfx + "ext_list_price"].astype(np.int64)
+                - tb[pfx + "ext_wholesale_cost"]
+                - tb[pfx + "ext_discount_amt"]
+                + tb[pfx + "ext_sales_price"])
+        return self._year_totals(fact, cust_col,
+                                 pfx + "sold_date_sk", vals)
+
+    def q4(self):
+        d = self.d
+        cids = _decode(d, "customer", "c_customer_id")
+        fn = _decode(d, "customer", "c_first_name")
+        ln = _decode(d, "customer", "c_last_name")
+        st = self._channel_profit_totals(
+            "store_sales", "ss_", "ss_customer_sk")
+        ct = self._channel_profit_totals(
+            "catalog_sales", "cs_", "cs_bill_customer_sk")
+        wt = self._channel_profit_totals(
+            "web_sales", "ws_", "ws_bill_customer_sk")
+        out = []
+        for c in range(1, len(cids) + 1):
+            legs = [(t.get((c, 1998)), t.get((c, 1999)))
+                    for t in (st, ct, wt)]
+            if any(a is None or b is None for a, b in legs):
+                continue
+            (s1, s2), (c1, c2), (w1, w2) = legs
+            if s1 <= 0 or c1 <= 0 or w1 <= 0:
+                continue
+            if c2 / c1 > s2 / s1 and c2 / c1 > w2 / w1:
+                out.append((cids[c - 1], fn[c - 1], ln[c - 1]))
+        out.sort()
+        return out[:100]
+
     def q11(self):
         d = self.d
         cids = _decode(d, "customer", "c_customer_id")
@@ -4059,6 +4266,78 @@ class _Ref:
                             "ws_ext_discount_amt")})]
         out.sort()
         return out[:100]
+
+    def q89(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        y, m, _ = self._date_cols(ss["ss_sold_date_sk"])
+        cats = _decode(d, "item", "i_category")
+        brands = _decode(d, "item", "i_brand")
+        classes = _decode(d, "item", "i_class")
+        ipos = self._item_pos()
+        snames = _decode(d, "store", "s_store_name")
+        spos = {sk: i for i, sk in enumerate(
+            d.tables["store"]["s_store_sk"].tolist())}
+        set_a_cat = {b"Books", b"Electronics", b"Sports"}
+        set_a_cls = {b"class#01", b"class#02", b"class#03"}
+        set_b_cat = {b"Men", b"Jewelry", b"Women"}
+        set_b_cls = {b"class#04", b"class#05", b"class#06"}
+        acc: dict = collections.defaultdict(int)
+        for i in np.flatnonzero(y == 1999).tolist():
+            ir = ipos[ss["ss_item_sk"][i]]
+            c_, cl = cats[ir], classes[ir]
+            if not ((c_ in set_a_cat and cl in set_a_cls)
+                    or (c_ in set_b_cat and cl in set_b_cls)):
+                continue
+            sp = spos[ss["ss_store_sk"][i]]
+            acc[(c_, brands[ir], snames[sp], int(m[i]))] += int(
+                ss["ss_sales_price"][i])
+        groups: dict = collections.defaultdict(list)
+        for (c_, b, sn, _moy), s in acc.items():
+            groups[(c_, b, sn)].append(s)
+        rows = []
+        for (c_, b, sn, moy), s in acc.items():
+            vals = groups[(c_, b, sn)]
+            avg = (sum(vals) / len(vals)) / 100.0
+            sv = s / 100.0
+            if avg > 0 and abs(sv - avg) / avg > 0.1:
+                rows.append((c_, b, sn, moy, s, avg, sv - avg))
+        rows.sort(key=lambda r: (r[6], r[0], r[1], r[2], r[3]))
+        return rows[:100]
+
+    def q2(self):
+        d = self.d
+        dd = d.tables["date_dim"]
+        dnames = _decode(d, "date_dim", "d_day_name")
+        wk_of = dict(zip(dd["d_date_sk"].tolist(),
+                         dd["d_week_seq"].tolist()))
+        day_of = dict(zip(dd["d_date_sk"].tolist(), dnames))
+        order = [b"Sunday", b"Monday", b"Tuesday", b"Wednesday",
+                 b"Thursday", b"Friday", b"Saturday"]
+        acc: dict = collections.defaultdict(lambda: [0] * 7)
+        for fact, dk, pk in (
+                ("web_sales", "ws_sold_date_sk",
+                 "ws_ext_sales_price"),
+                ("catalog_sales", "cs_sold_date_sk",
+                 "cs_ext_sales_price")):
+            tb = d.tables[fact]
+            for sk, p in zip(tb[dk].tolist(), tb[pk].tolist()):
+                acc[wk_of[sk]][order.index(day_of[sk])] += p
+        weeks_of = {
+            yy: set(dd["d_week_seq"][dd["d_year"] == yy].tolist())
+            for yy in (2001, 2002)}
+        out = []
+        for w in sorted(weeks_of[2001]):
+            if w not in acc or (w + 53) not in acc:
+                continue
+            if (w + 53) not in weeks_of[2002]:
+                continue
+            z = acc[w + 53]
+            if any(v <= 0 for v in z):
+                continue
+            yv = acc[w]
+            out.append((int(w), *(yv[i] / z[i] for i in range(7))))
+        return out
 
     def q38(self):
         d = self.d
@@ -4350,7 +4629,17 @@ _VERIFY_COLS = {
     "q74": (("customer_id", "str"), ("customer_first_name", "str"),
             ("customer_last_name", "str")),
     "q11": (("customer_id", "str"), ("flag", "str")),
+    "q4": (("customer_id", "str"), ("customer_first_name", "str"),
+           ("customer_last_name", "str")),
     "q38": (("cnt", "int"),),
+    "q89": (("i_category", "str"), ("i_brand", "str"),
+            ("s_store_name", "str"), ("d_moy", "int"),
+            ("sum_sales", "dec"), ("avg_monthly_sales", "avg"),
+            ("diff", "avg")),
+    "q2": (("week1", "int"), ("sun_ratio", "avg"),
+           ("mon_ratio", "avg"), ("tue_ratio", "avg"),
+           ("wed_ratio", "avg"), ("thu_ratio", "avg"),
+           ("fri_ratio", "avg"), ("sat_ratio", "avg")),
     "q31": (("ca_county", "str"), ("d_year", "int"),
             ("web_q1_q2_increase", "avg"),
             ("store_q1_q2_increase", "avg"),
