@@ -14,11 +14,12 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow 60 official templates (q1, q2, q3, q4, q6, q7, q9,
-q11, q12, q13, q15, q16, q17, q18, q19, q20, q21, q25, q26, q27, q29,
-q30, q31, q32, q33, q34, q37, q38, q39, q40, q42, q43, q45, q46, q48,
-q50, q52, q55, q56, q60, q61, q62, q65, q68, q69, q71, q73, q74, q79,
-q81, q82, q88, q89, q91, q92, q93, q94, q96, q98, q99). q17/q39
+Queries follow 64 official templates (q1, q2, q3, q4, q6, q7, q9,
+q11, q12, q13, q15, q16, q17, q18, q19, q20, q21, q22, q25, q26, q27,
+q29, q30, q31, q32, q33, q34, q36, q37, q38, q39, q40, q42, q43, q45,
+q46, q48, q50, q52, q53, q55, q56, q60, q61, q62, q65, q68, q69, q71,
+q73, q74, q79, q81, q82, q86, q88, q89, q91, q92, q93, q94, q96, q98,
+q99). q17/q39
 exercise the stddev_samp aggregate; ROLLUPs (q18/q27) restate flat at
 their finest grouping; q9 picks buckets by CASE over scalar
 subqueries; q74/q11/q4 restate the official UNION ALL year_total CTE
@@ -2329,6 +2330,73 @@ where s2.customer_id = s1.customer_id
       > s2.year_total / s1.year_total
 order by customer_id, customer_first_name, customer_last_name
 limit 100""",
+    # q36: gross margin by category/class (ROLLUP + lochierarchy rank
+    # restated flat at the finest grouping; margin sorts via its
+    # output alias)
+    "q36": """
+select sum(ss_net_profit) / sum(ss_ext_sales_price) as gross_margin,
+       i_category, i_class
+from store_sales, date_dim, item, store
+where d_year = 2001
+  and d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and s_state = 'TN'
+group by i_category, i_class
+order by gross_margin, i_category, i_class
+limit 100""",
+    # q86: web revenue by category/class (ROLLUP restated flat)
+    "q86": """
+select sum(ws_net_paid) as total_sum, i_category, i_class
+from web_sales, date_dim, item
+where d_month_seq between 24 and 35
+  and d_date_sk = ws_sold_date_sk
+  and i_item_sk = ws_item_sk
+group by i_category, i_class
+order by total_sum desc, i_category, i_class
+limit 100""",
+    # q22: average inventory quantity by item attributes (ROLLUP
+    # restated flat; i_product_name adapted to i_item_id)
+    "q22": """
+select i_item_id, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) as qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk
+  and inv_item_sk = i_item_sk
+  and d_month_seq between 24 and 35
+group by i_item_id, i_brand, i_class, i_category
+order by qoh, i_item_id, i_brand, i_class, i_category
+limit 100""",
+    # q53: manufacturers whose quarterly revenue deviates >10% from
+    # their yearly average (q89's partition-average restatement by
+    # manufacturer and quarter)
+    "q53": """
+with msum as (
+  select i_manufact_id, d_qoy,
+         sum(ss_sales_price) as sum_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_year = 1999
+    and ((i_category in ('Books', 'Children', 'Electronics')
+          and i_class in ('class#01', 'class#02', 'class#03'))
+         or (i_category in ('Women', 'Music', 'Men')
+             and i_class in ('class#04', 'class#05', 'class#06')))
+  group by i_manufact_id, d_qoy),
+mavg as (
+  select i_manufact_id as a_id,
+         avg(sum_sales) as avg_quarterly_sales
+  from msum
+  group by i_manufact_id)
+select i_manufact_id, d_qoy, sum_sales, avg_quarterly_sales
+from msum, mavg
+where i_manufact_id = a_id
+  and avg_quarterly_sales > 0
+  and abs(sum_sales - avg_quarterly_sales) / avg_quarterly_sales
+      > 0.1
+order by avg_quarterly_sales, sum_sales, i_manufact_id, d_qoy
+limit 100""",
     # q11: q74's twin over list-price-minus-discount revenue with the
     # preferred-customer flag carried (same per-channel CTE
     # restatement of the official UNION ALL year_total)
@@ -4267,6 +4335,107 @@ class _Ref:
         out.sort()
         return out[:100]
 
+    def q36(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        y, _, _ = self._date_cols(ss["ss_sold_date_sk"])
+        cats = _decode(d, "item", "i_category")
+        classes = _decode(d, "item", "i_class")
+        ipos = self._item_pos()
+        st = d.tables["store"]
+        states = _decode(d, "store", "s_state")
+        s_ok = {sk for sk, sst in zip(st["s_store_sk"].tolist(),
+                                      states) if sst == b"TN"}
+        acc: dict = collections.defaultdict(lambda: [0, 0])
+        for i in np.flatnonzero(y == 2001).tolist():
+            if ss["ss_store_sk"][i] not in s_ok:
+                continue
+            ir = ipos[ss["ss_item_sk"][i]]
+            a = acc[(cats[ir], classes[ir])]
+            a[0] += int(ss["ss_net_profit"][i])
+            a[1] += int(ss["ss_ext_sales_price"][i])
+        rows = [(p / s, c_, cl) for (c_, cl), (p, s) in acc.items()
+                if s]
+        rows.sort(key=lambda r: (r[0], r[1], r[2]))
+        return rows[:100]
+
+    def q86(self):
+        d = self.d
+        ws = d.tables["web_sales"]
+        dd = self._dd()
+        cats = _decode(d, "item", "i_category")
+        classes = _decode(d, "item", "i_class")
+        ipos = self._item_pos()
+        acc: dict = collections.defaultdict(int)
+        for dk, ik, p in zip(ws["ws_sold_date_sk"].tolist(),
+                             ws["ws_item_sk"].tolist(),
+                             ws["ws_net_paid"].tolist()):
+            if not (24 <= dd[dk][6] <= 35):
+                continue
+            ir = ipos[ik]
+            acc[(cats[ir], classes[ir])] += p
+        rows = [(v, c_, cl) for (c_, cl), v in acc.items()]
+        rows.sort(key=lambda r: (-r[0], r[1], r[2]))
+        return rows[:100]
+
+    def q22(self):
+        d = self.d
+        inv = d.tables["inventory"]
+        dd = self._dd()
+        iids = _decode(d, "item", "i_item_id")
+        brands = _decode(d, "item", "i_brand")
+        classes = _decode(d, "item", "i_class")
+        cats = _decode(d, "item", "i_category")
+        ipos = self._item_pos()
+        acc: dict = collections.defaultdict(lambda: [0, 0])
+        for dk, ik, q in zip(inv["inv_date_sk"].tolist(),
+                             inv["inv_item_sk"].tolist(),
+                             inv["inv_quantity_on_hand"].tolist()):
+            if not (24 <= dd[dk][6] <= 35):
+                continue
+            ir = ipos[ik]
+            a = acc[(iids[ir], brands[ir], classes[ir], cats[ir])]
+            a[0] += q
+            a[1] += 1
+        rows = [(k[0], k[1], k[2], k[3], s / n)
+                for k, (s, n) in acc.items()]
+        rows.sort(key=lambda r: (r[4], r[0], r[1], r[2], r[3]))
+        return rows[:100]
+
+    def q53(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        y, m, _ = self._date_cols(ss["ss_sold_date_sk"])
+        cats = _decode(d, "item", "i_category")
+        classes = _decode(d, "item", "i_class")
+        it = d.tables["item"]
+        ipos = self._item_pos()
+        set_a_cat = {b"Books", b"Children", b"Electronics"}
+        set_a_cls = {b"class#01", b"class#02", b"class#03"}
+        set_b_cat = {b"Women", b"Music", b"Men"}
+        set_b_cls = {b"class#04", b"class#05", b"class#06"}
+        acc: dict = collections.defaultdict(int)
+        for i in np.flatnonzero(y == 1999).tolist():
+            ir = ipos[ss["ss_item_sk"][i]]
+            c_, cl = cats[ir], classes[ir]
+            if not ((c_ in set_a_cat and cl in set_a_cls)
+                    or (c_ in set_b_cat and cl in set_b_cls)):
+                continue
+            acc[(int(it["i_manufact_id"][ir]),
+                 (int(m[i]) - 1) // 3 + 1)] += int(
+                ss["ss_sales_price"][i])
+        groups: dict = collections.defaultdict(list)
+        for (mid, _q), s in acc.items():
+            groups[mid].append(s)
+        rows = []
+        for (mid, qoy), s in acc.items():
+            avg = (sum(groups[mid]) / len(groups[mid])) / 100.0
+            sv = s / 100.0
+            if avg > 0 and abs(sv - avg) / avg > 0.1:
+                rows.append((mid, qoy, s, avg))
+        rows.sort(key=lambda r: (r[3], r[2], r[0], r[1]))
+        return rows[:100]
+
     def q89(self):
         d = self.d
         ss = d.tables["store_sales"]
@@ -4632,6 +4801,15 @@ _VERIFY_COLS = {
     "q4": (("customer_id", "str"), ("customer_first_name", "str"),
            ("customer_last_name", "str")),
     "q38": (("cnt", "int"),),
+    "q36": (("gross_margin", "avg"), ("i_category", "str"),
+            ("i_class", "str")),
+    "q86": (("total_sum", "dec"), ("i_category", "str"),
+            ("i_class", "str")),
+    "q22": (("i_item_id", "str"), ("i_brand", "str"),
+            ("i_class", "str"), ("i_category", "str"),
+            ("qoh", "avg")),
+    "q53": (("i_manufact_id", "int"), ("d_qoy", "int"),
+            ("sum_sales", "dec"), ("avg_quarterly_sales", "avg")),
     "q89": (("i_category", "str"), ("i_brand", "str"),
             ("s_store_name", "str"), ("d_moy", "int"),
             ("sum_sales", "dec"), ("avg_monthly_sales", "avg"),
