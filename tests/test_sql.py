@@ -531,3 +531,63 @@ def test_block_cache_cleared_on_drop_table(monkeypatch):
     s.execute("insert into t values (1, 222)")
     r = s.execute("select sum(v) as s from t")
     assert int(np.asarray(r.cols["s"][0])[0]) == 222
+
+
+# ---------------- window functions ----------------
+
+
+def test_window_rank_through_sql_and_dq(data, db, catalog):
+    """rank() over a JOIN-bearing plan: the DQ stage graph must treat
+    the WindowStep as a merge barrier (per-task evaluation would rank
+    within partitions of the data, not the data)."""
+    from ydb_tpu.sql.planner import plan_select_full
+
+    li = data.tables["lineitem"]
+    ords = data.tables["orders"]
+    sql = """
+    select l_orderkey, revenue, rank() over (order by revenue desc)
+           as rnk
+    from (select l_orderkey,
+                 sum(l_extendedprice * (1.00 - l_discount)) as revenue
+          from lineitem, orders
+          where l_orderkey = o_orderkey
+            and o_orderdate < date '1995-03-15'
+          group by l_orderkey) r
+    order by rnk, l_orderkey
+    limit 10"""
+    pq = plan_select_full(parse(sql), catalog)
+    out = to_host(execute_plan(pq.plan, db))
+    # independent numpy reference
+    cutoff = (np.datetime64("1995-03-15", "D")
+              - np.datetime64("1970-01-01", "D")).astype(int)
+    omap = {k: d for k, d in zip(ords["o_orderkey"].tolist(),
+                                 ords["o_orderdate"].tolist())}
+    import collections
+    rev = collections.defaultdict(int)
+    for k, p, dsc in zip(li["l_orderkey"].tolist(),
+                         li["l_extendedprice"].tolist(),
+                         li["l_discount"].tolist()):
+        if omap[k] < cutoff:
+            rev[k] += p * (100 - dsc)
+    ranked = sorted(rev.items(), key=lambda kv: (-kv[1], kv[0]))
+    want = []
+    rnk = 0
+    prev = None
+    for i, (k, v) in enumerate(ranked[:10]):
+        if v != prev:
+            rnk = i + 1
+        want.append((k, rnk))
+        prev = v
+    got = list(zip(np.asarray(out.cols["l_orderkey"][0]).tolist(),
+                   np.asarray(out.cols["rnk"][0]).tolist()))
+    assert got == want
+
+
+def test_window_mixed_with_aggregate_rejected(data, db, catalog):
+    with pytest.raises(PlanError, match="window functions cannot mix"):
+        from ydb_tpu.sql.planner import plan_select_full
+
+        plan_select_full(parse(
+            "select sum(l_quantity) as s, "
+            "rank() over (order by l_orderkey) as r from lineitem"),
+            catalog)

@@ -27,6 +27,7 @@ from ydb_tpu.ssa.program import (
     ProjectStep,
     Program,
     SortStep,
+    WindowStep,
     agg_result_type,
     infer_type,
 )
@@ -110,6 +111,51 @@ def run_oracle(
                         for nm, c in cols.items()}
                 n = min(n, step.limit)
                 mask = np.ones(n, dtype=bool)
+        elif isinstance(step, WindowStep):
+            # deliberately DIFFERENT algorithm from the device plane:
+            # python sort + per-partition scan (vs lexsort + segment
+            # cummax), so the cross-check is independent
+            live_idx = np.flatnonzero(mask)
+
+            def keyval(col, i):
+                v = cols[col][0][i]
+                t = types[col]
+                if t.is_string:
+                    return int(dicts[col].sort_rank()[int(v)])
+                return v
+
+            def sort_key(i):
+                parts = [keyval(k, i) for k in step.partition]
+                orders = [
+                    -keyval(k, i) if dsc else keyval(k, i)
+                    for k, dsc in zip(
+                        step.order_keys,
+                        step.descending
+                        or (False,) * len(step.order_keys))]
+                return (parts, orders)
+
+            ranked = sorted(live_idx.tolist(),
+                            key=lambda i: tuple(
+                                map(tuple, sort_key(i))))
+            out = np.zeros(len(mask), dtype=np.int64)
+            prev_part = prev_order = None
+            rown = rank = dense = 0
+            for i in ranked:
+                parts, orders = sort_key(i)
+                if parts != prev_part:
+                    rown = rank = dense = 0
+                    prev_order = None
+                rown += 1
+                if orders != prev_order:
+                    rank = rown
+                    dense += 1
+                out[i] = {"row_number": rown, "rank": rank,
+                          "dense_rank": dense}[step.func]
+                prev_part, prev_order = parts, orders
+            cols[step.out_name] = (out, mask.copy())
+            types[step.out_name] = dtypes.INT64
+            if step.out_name not in names:
+                names.append(step.out_name)
         else:
             raise NotImplementedError(step)
 

@@ -41,23 +41,25 @@ from ydb_tpu.ssa import twophase
 
 def _split_at_sort(program):
     """Order-preserving split of a group-less program: ORDER BY / LIMIT
-    (SortStep) and everything after it must run ONCE over the merged
-    inputs, never per block — per-block sort + arrival-order concat
-    would scramble the result. Steps before the sort are row-wise
-    (assign/filter/project) and stay in the per-block phase. When the
-    sort is a keyed top-k, the per-block phase ALSO pre-tops its block
-    (global top-k of per-block top-ks is exact), bounding channel
-    traffic the way the reference's TopSort does."""
-    from ydb_tpu.ssa.program import Program, SortStep
+    (SortStep) — or a ranking WindowStep, which needs EVERY row at
+    once — and everything after it must run ONCE over the merged
+    inputs, never per block — per-block evaluation + arrival-order
+    concat would scramble the result. Steps before the barrier are
+    row-wise (assign/filter/project) and stay in the per-block phase.
+    When the barrier is a keyed top-k sort, the per-block phase ALSO
+    pre-tops its block (global top-k of per-block top-ks is exact),
+    bounding channel traffic the way the reference's TopSort does."""
+    from ydb_tpu.ssa.program import Program, SortStep, WindowStep
 
     steps = program.steps
     si = next((i for i, s in enumerate(steps)
-               if isinstance(s, SortStep)), None)
+               if isinstance(s, (SortStep, WindowStep))), None)
     if si is None:
         return program, None
     head = list(steps[:si])
-    sort: SortStep = steps[si]
-    if sort.keys and sort.limit is not None:
+    sort = steps[si]
+    if isinstance(sort, SortStep) and sort.keys \
+            and sort.limit is not None:
         head.append(sort)  # deterministic per-block pre-top-k
     partial = Program(tuple(head)) if head else None
     return partial, Program(steps[si:])

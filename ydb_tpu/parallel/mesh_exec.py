@@ -47,7 +47,7 @@ from ydb_tpu.parallel.shuffle import repartition
 from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, Transform
 from ydb_tpu.ssa import join as join_kernels
 from ydb_tpu.ssa import kernels
-from ydb_tpu.ssa.program import SortStep
+from ydb_tpu.ssa.program import SortStep, WindowStep
 
 
 def _round_up(n: int, q: int = 64) -> int:
@@ -306,6 +306,11 @@ class MeshPlanExecutor:
         stacked = self._exec(plan.input, memo)
         has_gb = plan.program.group_by is not None
         has_sort = any(isinstance(s, SortStep) for s in plan.program.steps)
+        if any(isinstance(s, WindowStep) for s in plan.program.steps):
+            # ranking windows need every row at once; a per-shard
+            # elementwise run would rank within shards. Fall back to
+            # the single-chip/DQ path.
+            raise NotImplementedError("window function on the mesh")
         if not (has_gb or has_sort):
             # distributed elementwise transform: stays sharded
             key = ("xform", plan.program, plan.dict_aliases,

@@ -51,6 +51,16 @@ class FuncCall:
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowCall:
+    """fn() OVER (PARTITION BY ... ORDER BY ...) — the ranking window
+    subset (rank / dense_rank / row_number)."""
+
+    func: str
+    partition: tuple["Expr", ...]
+    order: tuple["OrderItem", ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class Between:
     expr: "Expr"
     low: "Expr"

@@ -38,6 +38,7 @@ _KEYWORDS = {
     "outer", "exists", "cast", "drop", "alter", "add", "column", "with",
     "update", "set", "delete", "extract", "substring", "for", "explain",
     "begin", "commit", "rollback", "transaction", "union", "all",
+    "partition",
 }
 
 
@@ -635,8 +636,27 @@ class Parser:
                     while self.accept("op", ","):
                         args.append(self.parse_expr())
                 self.expect("op", ")")
-                return ast.FuncCall(t.value.lower(), tuple(args),
-                                    distinct=distinct)
+                fc = ast.FuncCall(t.value.lower(), tuple(args),
+                                  distinct=distinct)
+                if str(self.peek().value).lower() == "over":
+                    self.next()
+                    self.expect("op", "(")
+                    partition: list = []
+                    if self.kw("partition"):
+                        self.expect("kw", "by")
+                        partition.append(self.parse_expr())
+                        while self.accept("op", ","):
+                            partition.append(self.parse_expr())
+                    order: list = []
+                    if self.kw("order"):
+                        self.expect("kw", "by")
+                        order.append(self.parse_order_item())
+                        while self.accept("op", ","):
+                            order.append(self.parse_order_item())
+                    self.expect("op", ")")
+                    return ast.WindowCall(fc.name, tuple(partition),
+                                          tuple(order))
+                return fc
             parts = [t.value]
             while self.accept("op", "."):
                 parts.append(self.expect("name").value)

@@ -42,6 +42,7 @@ from ydb_tpu.ssa.program import (
     Program,
     ProjectStep,
     SortStep,
+    WindowStep,
     infer_type,
 )
 
@@ -133,6 +134,11 @@ def _walk_names(e):
             yield from _walk_names(v)
         if e.else_ is not None:
             yield from _walk_names(e.else_)
+    elif isinstance(e, ast.WindowCall):
+        for p in e.partition:
+            yield from _walk_names(p)
+        for o in e.order:
+            yield from _walk_names(o.expr)
 
 
 def _contains_agg(e) -> bool:
@@ -1577,6 +1583,12 @@ class _SelectPlanner:
         out_dict_aliases: dict[str, str] = {}
         unique_key: tuple[str, ...] | None = None
         project = None  # deferred final projection (non-agg path)
+        has_window = any(
+            isinstance(i.expr, ast.WindowCall) for i in sel.items)
+        if has_agg and has_window:
+            raise PlanError(
+                "window functions cannot mix with aggregation in one"
+                " SELECT; rank over a subquery of the aggregates")
         if has_agg:
             if sel.distinct:
                 raise PlanError(
@@ -1590,6 +1602,35 @@ class _SelectPlanner:
         else:
             for idx, item in enumerate(sel.items):
                 name = _item_name(item, idx)
+                if isinstance(item.expr, ast.WindowCall):
+                    wc = item.expr
+                    if wc.func not in ("rank", "dense_rank",
+                                       "row_number"):
+                        raise PlanError(
+                            f"unsupported window function {wc.func}")
+
+                    def wcol(e):
+                        if isinstance(e, ast.Name):
+                            return resolve_out(e)
+                        lowered = low.lower(e)
+                        tmp = f"__w{len(steps)}"
+                        steps.append(AssignStep(tmp, lowered))
+                        low.types[tmp] = infer_type(
+                            lowered, None, low.types)
+                        return tmp
+
+                    pcols = tuple(wcol(p) for p in wc.partition)
+                    ocols, descs = [], []
+                    for oi in wc.order:
+                        ocols.append(wcol(oi.expr))
+                        descs.append(oi.descending)
+                    steps.append(WindowStep(
+                        wc.func, pcols, tuple(ocols), tuple(descs),
+                        name))
+                    low.types[name] = dtypes.INT64
+                    out_names.append(name)
+                    out_types[name] = dtypes.INT64
+                    continue
                 if isinstance(item.expr, ast.Name):
                     src = resolve_out(item.expr)
                     if src == name:

@@ -41,6 +41,7 @@ from ydb_tpu.ssa.program import (
     Program,
     SortStep,
     UdfCall,
+    WindowStep,
     agg_result_type,
     infer_type,
 )
@@ -226,6 +227,34 @@ def compile_program(
             plan.append(
                 ("sort", (tuple(step.keys), tuple(desc), step.limit,
                           tuple(ranks))))
+        elif isinstance(step, WindowStep):
+            if step.func not in ("rank", "dense_rank", "row_number"):
+                raise NotImplementedError(
+                    f"window function {step.func}")
+            # string keys compare by dictionary RANK (partition needs
+            # only equality, but ranks are equality-preserving too, so
+            # one treatment covers both roles)
+            wranks = []
+            for k in step.partition + step.order_keys:
+                t = cur_types[k]
+                if t.is_string:
+                    d = ctx.dictionary(k)
+                    if d is None:
+                        raise ValueError(
+                            f"window key on string column {k} needs"
+                            " its dictionary")
+                    wranks.append(
+                        ctx.add_aux(f"wrank.{k}", d.sort_rank()))
+                else:
+                    wranks.append(None)
+            desc = step.descending or (False,) * len(step.order_keys)
+            cur_types[step.out_name] = dtypes.INT64
+            if step.out_name not in cur_names:
+                cur_names.append(step.out_name)
+            plan.append(("window", (
+                step.func, tuple(step.partition),
+                tuple(step.order_keys), tuple(desc), tuple(wranks),
+                step.out_name)))
         else:
             raise NotImplementedError(f"step {step}")
 
@@ -289,6 +318,57 @@ def compile_program(
                 env = {n: blk.columns[n] for n in names}
                 length = blk.length
                 mask = blk.row_mask()
+            elif kind == "window":
+                func, pkeys, okeys, desc, wranks, out_name = payload
+                cap = next(iter(env.values())).data.shape[0]
+                live = mask & (jnp.arange(cap, dtype=jnp.int32)
+                               < length)
+                vals = []
+                for k, rk in zip(pkeys + okeys, wranks):
+                    c = env[k]
+                    if rk is not None:
+                        c = kernels.dict_gather(aux[rk], c)
+                    d_ = c.data
+                    if d_.dtype == jnp.bool_:
+                        d_ = d_.astype(jnp.int32)
+                    vals.append(d_)
+                pvals = vals[:len(pkeys)]
+                ovals = []
+                for d_, dsc in zip(vals[len(pkeys):], desc):
+                    ovals.append(-d_ if dsc else d_)
+                # lexsort: LAST key is primary — liveness first, then
+                # partition, then order keys
+                perm = jnp.lexsort(tuple(
+                    reversed([(~live).astype(jnp.int32)]
+                             + pvals + ovals)))
+                idx = jnp.arange(cap, dtype=jnp.int32)
+
+                def changed(cols_sorted):
+                    ch = idx == 0
+                    for c in cols_sorted:
+                        ch = ch | (c != jnp.roll(c, 1))
+                    return ch
+
+                sp = [c[perm] for c in pvals]
+                so = [c[perm] for c in ovals]
+                new_part = changed(sp)
+                new_order = new_part | changed(so)
+                seg_start = jax.lax.cummax(
+                    jnp.where(new_part, idx, 0))
+                if func == "row_number":
+                    out_sorted = idx - seg_start + 1
+                elif func == "rank":
+                    peer_start = jax.lax.cummax(
+                        jnp.where(new_order, idx, 0))
+                    out_sorted = peer_start - seg_start + 1
+                else:  # dense_rank
+                    dense = jnp.cumsum(new_order.astype(jnp.int64))
+                    out_sorted = dense - dense[seg_start] + 1
+                out = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(
+                    out_sorted.astype(jnp.int64))
+                env[out_name] = Column(out, live)
+                if out_name not in names:
+                    names.append(out_name)
         out_cols = {n: env[n] for n in out_schema.names}
         blk = TableBlock(out_cols, length, out_schema)
         return kernels.compact(blk, mask)

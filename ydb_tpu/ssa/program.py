@@ -163,7 +163,27 @@ class SortStep:
     limit: int | None = None
 
 
-Step = Union[AssignStep, FilterStep, GroupByStep, ProjectStep, SortStep]
+@dataclasses.dataclass(frozen=True)
+class WindowStep:
+    """Ranking window: rank / dense_rank / row_number OVER
+    (PARTITION BY partition ORDER BY order_keys).
+
+    Whole-table semantics: the step must see EVERY row of its input at
+    once, so it may only appear in programs executed over a
+    materialized block (the planner keeps it out of scan pushdown, and
+    the DQ lowering splits it into the merged final phase). Lowers to
+    one device lexsort + segment scans + inverse-permutation scatter.
+    """
+
+    func: str  # rank | dense_rank | row_number
+    partition: tuple[str, ...]
+    order_keys: tuple[str, ...]
+    descending: tuple[bool, ...]
+    out_name: str
+
+
+Step = Union[AssignStep, FilterStep, GroupByStep, ProjectStep, SortStep,
+             WindowStep]
 
 
 @dataclasses.dataclass(frozen=True)

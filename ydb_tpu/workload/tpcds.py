@@ -14,12 +14,13 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow 64 official templates (q1, q2, q3, q4, q6, q7, q9,
+Queries follow 67 official templates (q1, q2, q3, q4, q6, q7, q9,
 q11, q12, q13, q15, q16, q17, q18, q19, q20, q21, q22, q25, q26, q27,
-q29, q30, q31, q32, q33, q34, q36, q37, q38, q39, q40, q42, q43, q45,
-q46, q48, q50, q52, q53, q55, q56, q60, q61, q62, q65, q68, q69, q71,
-q73, q74, q79, q81, q82, q86, q88, q89, q91, q92, q93, q94, q96, q98,
-q99). q17/q39
+q29, q30, q31, q32, q33, q34, q36, q37, q38, q39, q40, q42, q43, q44,
+q45, q46, q48, q50, q52, q53, q55, q56, q60, q61, q62, q65, q67, q68,
+q69, q70, q71, q73, q74, q79, q81, q82, q86, q88, q89, q91, q92, q93,
+q94, q96, q98, q99). q44/q67/q70 run REAL ranking window functions
+(rank / row_number over partitions). q17/q39
 exercise the stddev_samp aggregate; ROLLUPs (q18/q27) restate flat at
 their finest grouping; q9 picks buckets by CASE over scalar
 subqueries; q74/q11/q4 restate the official UNION ALL year_total CTE
@@ -2397,6 +2398,74 @@ where i_manufact_id = a_id
       > 0.1
 order by avg_quarterly_sales, sum_sales, i_manufact_id, d_qoy
 limit 100""",
+    # q67: top-ranked item/month/store revenue cells per category
+    # (ROLLUP restated flat at the finest grouping; i_product_name
+    # adapted to i_item_id; full tiebreakers added to the sort)
+    "q67": """
+select i_category, i_class, i_brand, i_item_id, d_year, d_qoy,
+       d_moy, s_store_id, sumsales, rk
+from (select i_category, i_class, i_brand, i_item_id, d_year,
+             d_qoy, d_moy, s_store_id, sumsales,
+             rank() over (partition by i_category
+                          order by sumsales desc) as rk
+      from (select i_category, i_class, i_brand, i_item_id,
+                   d_year, d_qoy, d_moy, s_store_id,
+                   sum(ss_sales_price * ss_quantity) as sumsales
+            from store_sales, date_dim, store, item
+            where ss_sold_date_sk = d_date_sk
+              and ss_item_sk = i_item_sk
+              and ss_store_sk = s_store_sk
+              and d_month_seq between 24 and 35
+            group by i_category, i_class, i_brand, i_item_id,
+                     d_year, d_qoy, d_moy, s_store_id) t) w
+where rk <= 100
+order by i_category, rk, i_class, i_brand, i_item_id, d_year,
+         d_qoy, d_moy, s_store_id
+limit 100""",
+    # q70: county profit ranked within state (ROLLUP + the
+    # tautological top-5-state IN subquery restated flat — partition
+    # by s_state over one row per state always ranks 1)
+    "q70": """
+select s_state, s_county, sumsales, rk
+from (select s_state, s_county, sumsales,
+             rank() over (partition by s_state
+                          order by sumsales desc) as rk
+      from (select s_state, s_county,
+                   sum(ss_net_profit) as sumsales
+            from store_sales, date_dim, store
+            where ss_sold_date_sk = d_date_sk
+              and ss_store_sk = s_store_sk
+              and d_month_seq between 24 and 35
+            group by s_state, s_county) t) w
+order by s_state, rk, s_county
+limit 100""",
+    # q44: best vs worst items by average profit at one store
+    # (row_number with an item tiebreaker instead of rank, so the
+    # rnk = rnk join never fans out on avg ties)
+    "q44": """
+with v as (
+  select ss_item_sk as item_sk, avg(ss_net_profit) as avgp
+  from store_sales
+  where ss_store_sk = 4
+  group by ss_item_sk)
+select a.rnk as rnk, i1.i_item_id as best_performing,
+       i2.i_item_id as worst_performing
+from (select item_sk, rnk from (
+        select item_sk,
+               row_number() over (order by avgp desc, item_sk)
+                 as rnk from v) x
+      where rnk < 11) a,
+     (select item_sk, rnk from (
+        select item_sk,
+               row_number() over (order by avgp, item_sk)
+                 as rnk from v) y
+      where rnk < 11) b,
+     item i1, item i2
+where a.rnk = b.rnk
+  and i1.i_item_sk = a.item_sk
+  and i2.i_item_sk = b.item_sk
+order by rnk
+limit 100""",
     # q11: q74's twin over list-price-minus-discount revenue with the
     # preferred-customer flag carried (same per-channel CTE
     # restatement of the official UNION ALL year_total)
@@ -4436,6 +4505,104 @@ class _Ref:
         rows.sort(key=lambda r: (r[3], r[2], r[0], r[1]))
         return rows[:100]
 
+    def q67(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        dd = self._dd()
+        cats = _decode(d, "item", "i_category")
+        classes = _decode(d, "item", "i_class")
+        brands = _decode(d, "item", "i_brand")
+        iids = _decode(d, "item", "i_item_id")
+        ipos = self._item_pos()
+        sids = _decode(d, "store", "s_store_id")
+        spos = {sk: i for i, sk in enumerate(
+            d.tables["store"]["s_store_sk"].tolist())}
+        acc: dict = collections.defaultdict(int)
+        for dk, ik, sk, p, q in zip(
+                ss["ss_sold_date_sk"].tolist(),
+                ss["ss_item_sk"].tolist(),
+                ss["ss_store_sk"].tolist(),
+                ss["ss_sales_price"].tolist(),
+                ss["ss_quantity"].tolist()):
+            info = dd[dk]  # (year, moy, dom, dow, qoy, date, mseq)
+            if not (24 <= info[6] <= 35):
+                continue
+            ir = ipos[ik]
+            sp = spos[sk]
+            acc[(cats[ir], classes[ir], brands[ir], iids[ir],
+                 info[0], info[4], info[1], sids[sp])] += p * q
+        by_cat: dict = collections.defaultdict(list)
+        for k, s in acc.items():
+            by_cat[k[0]].append((k, s))
+        rows = []
+        for cat, cells in by_cat.items():
+            cells.sort(key=lambda kv: -kv[1])
+            rk = 0
+            prev = None
+            for i, (k, s) in enumerate(cells):
+                if s != prev:
+                    rk = i + 1
+                if rk > 100:
+                    break
+                rows.append((*k[:4], k[4], k[5], k[6], k[7], s, rk))
+                prev = s
+        rows.sort(key=lambda r: (r[0], r[9], r[1], r[2], r[3], r[4],
+                                 r[5], r[6], r[7]))
+        return rows[:100]
+
+    def q70(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        dd = self._dd()
+        states = _decode(d, "store", "s_state")
+        counties = _decode(d, "store", "s_county")
+        spos = {sk: i for i, sk in enumerate(
+            d.tables["store"]["s_store_sk"].tolist())}
+        acc: dict = collections.defaultdict(int)
+        for dk, sk, p in zip(ss["ss_sold_date_sk"].tolist(),
+                             ss["ss_store_sk"].tolist(),
+                             ss["ss_net_profit"].tolist()):
+            if not (24 <= dd[dk][6] <= 35):
+                continue
+            sp = spos[sk]
+            acc[(states[sp], counties[sp])] += p
+        by_state: dict = collections.defaultdict(list)
+        for (st, co), s in acc.items():
+            by_state[st].append((co, s))
+        rows = []
+        for st, cells in by_state.items():
+            cells.sort(key=lambda kv: -kv[1])
+            rk = 0
+            prev = None
+            for i, (co, s) in enumerate(cells):
+                if s != prev:
+                    rk = i + 1
+                rows.append((st, co, s, rk))
+                prev = s
+        rows.sort(key=lambda r: (r[0], r[3], r[1]))
+        return rows[:100]
+
+    def q44(self):
+        d = self.d
+        ss = d.tables["store_sales"]
+        acc: dict = collections.defaultdict(lambda: [0, 0])
+        for sk, ik, p in zip(ss["ss_store_sk"].tolist(),
+                             ss["ss_item_sk"].tolist(),
+                             ss["ss_net_profit"].tolist()):
+            if sk == 4:
+                a = acc[ik]
+                a[0] += p
+                a[1] += 1
+        avgs = sorted(
+            ((s / n_, ik) for ik, (s, n_) in acc.items()))
+        iids = _decode(d, "item", "i_item_id")
+        ipos = self._item_pos()
+        worst = [ik for _a, ik in avgs[:10]]
+        best = [ik for _a, ik in sorted(
+            ((-a, ik) for a, ik in avgs))[:10]]
+        return [(r + 1, iids[ipos[b]], iids[ipos[w]])
+                for r, (b, w) in enumerate(zip(best, worst))]
+
     def q89(self):
         d = self.d
         ss = d.tables["store_sales"]
@@ -4810,6 +4977,15 @@ _VERIFY_COLS = {
             ("qoh", "avg")),
     "q53": (("i_manufact_id", "int"), ("d_qoy", "int"),
             ("sum_sales", "dec"), ("avg_quarterly_sales", "avg")),
+    "q67": (("i_category", "str"), ("i_class", "str"),
+            ("i_brand", "str"), ("i_item_id", "str"),
+            ("d_year", "int"), ("d_qoy", "int"), ("d_moy", "int"),
+            ("s_store_id", "str"), ("sumsales", "dec"),
+            ("rk", "int")),
+    "q70": (("s_state", "str"), ("s_county", "str"),
+            ("sumsales", "dec"), ("rk", "int")),
+    "q44": (("rnk", "int"), ("best_performing", "str"),
+            ("worst_performing", "str")),
     "q89": (("i_category", "str"), ("i_brand", "str"),
             ("s_store_name", "str"), ("d_moy", "int"),
             ("sum_sales", "dec"), ("avg_monthly_sales", "avg"),
