@@ -14,12 +14,12 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow 67 official templates (q1, q2, q3, q4, q6, q7, q9,
+Queries follow 68 official templates (q1, q2, q3, q4, q6, q7, q9,
 q11, q12, q13, q15, q16, q17, q18, q19, q20, q21, q22, q25, q26, q27,
 q29, q30, q31, q32, q33, q34, q36, q37, q38, q39, q40, q42, q43, q44,
-q45, q46, q48, q50, q52, q53, q55, q56, q60, q61, q62, q65, q67, q68,
-q69, q70, q71, q73, q74, q79, q81, q82, q86, q88, q89, q91, q92, q93,
-q94, q96, q98, q99). q44/q67/q70 run REAL ranking window functions
+q45, q46, q48, q50, q52, q53, q55, q56, q60, q61, q62, q63, q65, q67,
+q68, q69, q70, q71, q73, q74, q79, q81, q82, q86, q88, q89, q91, q92,
+q93, q94, q96, q98, q99). q44/q67/q70 run REAL ranking window functions
 (rank / row_number over partitions). q17/q39
 exercise the stddev_samp aggregate; ROLLUPs (q18/q27) restate flat at
 their finest grouping; q9 picks buckets by CASE over scalar
@@ -2398,6 +2398,34 @@ where i_manufact_id = a_id
       > 0.1
 order by avg_quarterly_sales, sum_sales, i_manufact_id, d_qoy
 limit 100""",
+    # q63: q53's twin — managers whose monthly revenue deviates >10%
+    # from their yearly average
+    "q63": """
+with msum as (
+  select i_manager_id, d_moy,
+         sum(ss_sales_price) as sum_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_year = 1999
+    and ((i_category in ('Books', 'Children', 'Electronics')
+          and i_class in ('class#01', 'class#02', 'class#03'))
+         or (i_category in ('Women', 'Music', 'Men')
+             and i_class in ('class#04', 'class#05', 'class#06')))
+  group by i_manager_id, d_moy),
+mavg as (
+  select i_manager_id as a_id,
+         avg(sum_sales) as avg_monthly_sales
+  from msum
+  group by i_manager_id)
+select i_manager_id, d_moy, sum_sales, avg_monthly_sales
+from msum, mavg
+where i_manager_id = a_id
+  and avg_monthly_sales > 0
+  and abs(sum_sales - avg_monthly_sales) / avg_monthly_sales > 0.1
+order by i_manager_id, avg_monthly_sales, sum_sales, d_moy
+limit 100""",
     # q67: top-ranked item/month/store revenue cells per category
     # (ROLLUP restated flat at the finest grouping; i_product_name
     # adapted to i_item_id; full tiebreakers added to the sort)
@@ -4471,7 +4499,9 @@ class _Ref:
         rows.sort(key=lambda r: (r[4], r[0], r[1], r[2], r[3]))
         return rows[:100]
 
-    def q53(self):
+    def _monthly_dev(self, key_col, period_of, sort_key):
+        """q53/q63 shape: per-(item attribute, period) revenue vs the
+        attribute's average over its periods, >10% deviations kept."""
         d = self.d
         ss = d.tables["store_sales"]
         y, m, _ = self._date_cols(ss["ss_sold_date_sk"])
@@ -4490,20 +4520,29 @@ class _Ref:
             if not ((c_ in set_a_cat and cl in set_a_cls)
                     or (c_ in set_b_cat and cl in set_b_cls)):
                 continue
-            acc[(int(it["i_manufact_id"][ir]),
-                 (int(m[i]) - 1) // 3 + 1)] += int(
+            acc[(int(it[key_col][ir]), period_of(int(m[i])))] += int(
                 ss["ss_sales_price"][i])
         groups: dict = collections.defaultdict(list)
-        for (mid, _q), s in acc.items():
-            groups[mid].append(s)
+        for (kid, _p), s in acc.items():
+            groups[kid].append(s)
         rows = []
-        for (mid, qoy), s in acc.items():
-            avg = (sum(groups[mid]) / len(groups[mid])) / 100.0
+        for (kid, period), s in acc.items():
+            avg = (sum(groups[kid]) / len(groups[kid])) / 100.0
             sv = s / 100.0
             if avg > 0 and abs(sv - avg) / avg > 0.1:
-                rows.append((mid, qoy, s, avg))
-        rows.sort(key=lambda r: (r[3], r[2], r[0], r[1]))
+                rows.append((kid, period, s, avg))
+        rows.sort(key=sort_key)
         return rows[:100]
+
+    def q63(self):
+        return self._monthly_dev(
+            "i_manager_id", lambda m: m,
+            lambda r: (r[0], r[3], r[2], r[1]))
+
+    def q53(self):
+        return self._monthly_dev(
+            "i_manufact_id", lambda m: (m - 1) // 3 + 1,
+            lambda r: (r[3], r[2], r[0], r[1]))
 
     def q67(self):
         d = self.d
@@ -4977,6 +5016,8 @@ _VERIFY_COLS = {
             ("qoh", "avg")),
     "q53": (("i_manufact_id", "int"), ("d_qoy", "int"),
             ("sum_sales", "dec"), ("avg_quarterly_sales", "avg")),
+    "q63": (("i_manager_id", "int"), ("d_moy", "int"),
+            ("sum_sales", "dec"), ("avg_monthly_sales", "avg")),
     "q67": (("i_category", "str"), ("i_class", "str"),
             ("i_brand", "str"), ("i_item_id", "str"),
             ("d_year", "int"), ("d_qoy", "int"), ("d_moy", "int"),
