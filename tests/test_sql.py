@@ -591,3 +591,29 @@ def test_window_mixed_with_aggregate_rejected(data, db, catalog):
             "select sum(l_quantity) as s, "
             "rank() over (order by l_orderkey) as r from lineitem"),
             catalog)
+
+
+def test_or_of_exists_decorrelates():
+    """EXISTS(A) OR EXISTS(B) (and mixed with plain predicates) lowers
+    through the counting scalar-join rewrite (TPC-DS q10/q35 shape)."""
+    c = Cluster(n_shards=1)
+    s = c.session()
+    s.execute("create table cu (id bigint not null, nm string, "
+              "primary key (id))")
+    s.execute("create table w (k bigint not null, cid bigint, "
+              "primary key (k))")
+    s.execute("create table ct (k bigint not null, cid bigint, "
+              "primary key (k))")
+    s.execute("insert into cu values (1,'a'),(2,'b'),(3,'c'),(4,'d')")
+    s.execute("insert into w values (10, 1), (11, 3)")
+    s.execute("insert into ct values (20, 2), (21, 3)")
+    r = s.execute(
+        "select id from cu c where "
+        "exists (select * from w where c.id = cid) "
+        "or exists (select * from ct where c.id = cid) order by id")
+    assert np.asarray(r.cols["id"][0]).tolist() == [1, 2, 3]
+    r2 = s.execute(
+        "select id from cu c where nm = 'd' "
+        "or not exists (select * from w where c.id = cid) "
+        "order by id")
+    assert np.asarray(r2.cols["id"][0]).tolist() == [2, 4]

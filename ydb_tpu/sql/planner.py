@@ -918,6 +918,60 @@ class _SelectPlanner:
         )
         return self._sub(rewritten)
 
+    def _rewrite_or_exists(self, c, binding, scalar_joins, synthetic,
+                           new_sq_name):
+        """EXISTS leaves inside an OR disjunction -> COUNT scalar joins
+        compared against zero. Returns the rebuilt OR expression, or
+        None when the shape doesn't qualify (some leaf is an
+        unsupported subquery form — the caller then reports the usual
+        unsupported-position error)."""
+        leaves: list = []
+
+        def collect(e):
+            if isinstance(e, ast.BinOp) and e.op == "or":
+                return collect(e.left) and collect(e.right)
+            negated = False
+            while isinstance(e, ast.UnOp) and e.op == "not" \
+                    and isinstance(e.operand, ast.Exists):
+                negated = not negated
+                e = e.operand
+            if isinstance(e, ast.Exists):
+                leaves.append(("exists", negated != e.negated, e))
+                return True
+            if _contains_subquery(e):
+                return False  # nested non-EXISTS subquery in the OR
+            leaves.append(("plain", False, e))
+            return True
+
+        if not collect(c) or not any(
+                k == "exists" for k, _n, _e in leaves):
+            return None
+        parts: list = []
+        for kind, negated, e in leaves:
+            if kind == "plain":
+                parts.append(e)
+                continue
+            try:
+                eq, ne_pairs, local = self._correlations(
+                    e.select, binding)
+            except PlanError:
+                return None  # non-equality correlation: fall through
+            if not eq or ne_pairs:
+                return None
+            name = new_sq_name()
+            sub = self._plan_count_sub(
+                e.select, local, [i for _, i in eq], name)
+            scalar_joins.append((name, eq, sub))
+            synthetic[name] = dtypes.INT64
+            cnt = ast.FuncCall(
+                "coalesce", (ast.Name((name,)), ast.Literal(0, "int")))
+            parts.append(ast.BinOp("eq" if negated else "gt", cnt,
+                                   ast.Literal(0, "int")))
+        out = parts[0]
+        for p in parts[1:]:
+            out = ast.BinOp("or", out, p)
+        return out
+
     def _plan_count_sub(self, sub: ast.Select, local, group_cols,
                         name: str) -> PlannedQuery:
         """COUNT(*) of the subquery's rows grouped by correlation columns
@@ -1163,6 +1217,17 @@ class _SelectPlanner:
                     [(c.expr, build_col)], sub,
                 ))
                 continue
+            if not neg and isinstance(c, ast.BinOp) and c.op == "or" \
+                    and _contains_subquery(c):
+                # EXISTS(A) OR EXISTS(B) (the q10/q35 shape): each
+                # EXISTS leaf decorrelates to a per-key COUNT scalar
+                # join (the q21 counting machinery), and the OR
+                # rebuilds over count>0 / count==0 markers
+                rewritten = self._rewrite_or_exists(
+                    c, binding, scalar_joins, synthetic, new_sq_name)
+                if rewritten is not None:
+                    where_conjuncts.append(rewritten)
+                    continue
             if neg:
                 c = ast.UnOp("not", c)
             if _contains_subquery(c):

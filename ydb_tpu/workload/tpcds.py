@@ -14,12 +14,13 @@ surrogate keys, brand/manufact naming, syllable store names,
 gender x marital x education demographics cross product). Money
 columns are decimal(2) scaled int64 like the TPC-H generator.
 
-Queries follow 68 official templates (q1, q2, q3, q4, q6, q7, q9,
-q11, q12, q13, q15, q16, q17, q18, q19, q20, q21, q22, q25, q26, q27,
-q29, q30, q31, q32, q33, q34, q36, q37, q38, q39, q40, q42, q43, q44,
-q45, q46, q48, q50, q52, q53, q55, q56, q60, q61, q62, q63, q65, q67,
-q68, q69, q70, q71, q73, q74, q79, q81, q82, q86, q88, q89, q91, q92,
-q93, q94, q96, q98, q99). q44/q67/q70 run REAL ranking window functions
+Queries follow 70 official templates (q1, q2, q3, q4, q6, q7, q9,
+q10, q11, q12, q13, q15, q16, q17, q18, q19, q20, q21, q22, q25, q26,
+q27, q29, q30, q31, q32, q33, q34, q35, q36, q37, q38, q39, q40, q42,
+q43, q44, q45, q46, q48, q50, q52, q53, q55, q56, q60, q61, q62, q63,
+q65, q67, q68, q69, q70, q71, q73, q74, q79, q81, q82, q86, q88, q89,
+q91, q92, q93, q94, q96, q98, q99). q10/q35 run EXISTS plus an OR of
+EXISTS (counting decorrelation). q44/q67/q70 run REAL ranking window functions
 (rank / row_number over partitions). q17/q39
 exercise the stddev_samp aggregate; ROLLUPs (q18/q27) restate flat at
 their finest grouping; q9 picks buckets by CASE over scalar
@@ -2398,6 +2399,60 @@ where i_manufact_id = a_id
       > 0.1
 order by avg_quarterly_sales, sum_sales, i_manufact_id, d_qoy
 limit 100""",
+    # q10: demographics of county customers who bought in a store AND
+    # in at least one remote channel in the window (EXISTS plus an
+    # OR of EXISTS, decorrelated through counting scalar joins;
+    # dep-employed/college columns adapted to cd_dep_count)
+    "q10": """
+select cd_gender, cd_marital_status, cd_education_status,
+       cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+       count(*) as cnt
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_county in ('Salem County', 'Terrell County',
+                    'Arthur County', 'Oglethorpe County',
+                    'Lunenburg County')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2002 and d_moy between 1 and 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_moy between 1 and 4)
+       or exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_bill_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2002 and d_moy between 1 and 4))
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count
+limit 100""",
+    # q35: q10's state-level twin with dep-count statistics
+    "q35": """
+select ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       count(*) as cnt1, min(cd_dep_count) as mn,
+       max(cd_dep_count) as mx, avg(cd_dep_count) as av
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2002 and d_qoy < 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_qoy < 4)
+       or exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_bill_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2002 and d_qoy < 4))
+group by ca_state, cd_gender, cd_marital_status, cd_dep_count
+order by ca_state, cd_gender, cd_marital_status, cd_dep_count
+limit 100""",
     # q63: q53's twin — managers whose monthly revenue deviates >10%
     # from their yearly average
     "q63": """
@@ -4534,6 +4589,70 @@ class _Ref:
         rows.sort(key=sort_key)
         return rows[:100]
 
+    def _bought_in(self, fact, cust_col, date_col, date_ok):
+        tb = self.d.tables[fact]
+        y_m = self._date_cols(tb[date_col])
+        ok = date_ok(*y_m)
+        return set(tb[cust_col][ok].tolist())
+
+    def _q10_shape(self, date_ok):
+        """Customers with a store purchase AND a web-or-catalog
+        purchase in the window -> their cdemo rows."""
+        store = self._bought_in("store_sales", "ss_customer_sk",
+                                "ss_sold_date_sk", date_ok)
+        remote = (self._bought_in("web_sales", "ws_bill_customer_sk",
+                                  "ws_sold_date_sk", date_ok)
+                  | self._bought_in("catalog_sales",
+                                    "cs_bill_customer_sk",
+                                    "cs_sold_date_sk", date_ok))
+        return store & remote
+
+    def q10(self):
+        d = self.d
+        ok_counties = {b"Salem County", b"Terrell County",
+                       b"Arthur County", b"Oglethorpe County",
+                       b"Lunenburg County"}
+        counties = _decode(d, "customer_address", "ca_county")
+        cust = d.tables["customer"]
+        cd = d.tables["customer_demographics"]
+        g = _decode(d, "customer_demographics", "cd_gender")
+        ms = _decode(d, "customer_demographics", "cd_marital_status")
+        es = _decode(d, "customer_demographics",
+                     "cd_education_status")
+        cr = _decode(d, "customer_demographics", "cd_credit_rating")
+        buyers = self._q10_shape(
+            lambda y, m, _d: (y == 2002) & (m >= 1) & (m <= 4))
+        acc: dict = collections.Counter()
+        for c in buyers:
+            a_row = int(cust["c_current_addr_sk"][c - 1]) - 1
+            if counties[a_row] not in ok_counties:
+                continue
+            i = int(cust["c_current_cdemo_sk"][c - 1]) - 1
+            acc[(g[i], ms[i], es[i],
+                 int(cd["cd_purchase_estimate"][i]), cr[i],
+                 int(cd["cd_dep_count"][i]))] += 1
+        rows = [(*k, n) for k, n in sorted(acc.items())]
+        return rows[:100]
+
+    def q35(self):
+        d = self.d
+        cust = d.tables["customer"]
+        cd = d.tables["customer_demographics"]
+        g = _decode(d, "customer_demographics", "cd_gender")
+        ms = _decode(d, "customer_demographics", "cd_marital_status")
+        states = _decode(d, "customer_address", "ca_state")
+        buyers = self._q10_shape(
+            lambda y, m, _d: (y == 2002) & (m <= 9))
+        acc: dict = collections.Counter()
+        for c in buyers:
+            a_row = int(cust["c_current_addr_sk"][c - 1]) - 1
+            i = int(cust["c_current_cdemo_sk"][c - 1]) - 1
+            dep = int(cd["cd_dep_count"][i])
+            acc[(states[a_row], g[i], ms[i], dep)] += 1
+        rows = [(*k, n, k[3], k[3], float(k[3]))
+                for k, n in sorted(acc.items())]
+        return rows[:100]
+
     def q63(self):
         return self._monthly_dev(
             "i_manager_id", lambda m: m,
@@ -5016,6 +5135,15 @@ _VERIFY_COLS = {
             ("qoh", "avg")),
     "q53": (("i_manufact_id", "int"), ("d_qoy", "int"),
             ("sum_sales", "dec"), ("avg_quarterly_sales", "avg")),
+    "q10": (("cd_gender", "str"), ("cd_marital_status", "str"),
+            ("cd_education_status", "str"),
+            ("cd_purchase_estimate", "int"),
+            ("cd_credit_rating", "str"), ("cd_dep_count", "int"),
+            ("cnt", "int")),
+    "q35": (("ca_state", "str"), ("cd_gender", "str"),
+            ("cd_marital_status", "str"), ("cd_dep_count", "int"),
+            ("cnt1", "int"), ("mn", "int"), ("mx", "int"),
+            ("av", "avg")),
     "q63": (("i_manager_id", "int"), ("d_moy", "int"),
             ("sum_sales", "dec"), ("avg_monthly_sales", "avg")),
     "q67": (("i_category", "str"), ("i_class", "str"),
