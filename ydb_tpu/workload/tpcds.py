@@ -2004,7 +2004,7 @@ from (select ws_order_number,
         and ws1.ws_ship_addr_sk = ca_address_sk
         and ca_state = 'GA'
         and ws1.ws_web_site_sk = web_site_sk
-        and web_company_name = 'pri'
+        and web_company_name = 'ought'
         and exists (select * from web_sales ws2
                     where ws1.ws_order_number = ws2.ws_order_number
                       and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
@@ -2079,7 +2079,7 @@ where ctr1.ctr_total_return >
              from customer_total_return ctr2
              where ctr1.ctr_state = ctr2.ctr_state)
   and ca_address_sk = c_current_addr_sk
-  and ca_state = 'TX'
+  and ca_state = 'MO'
   and ctr1.ctr_customer_sk = c_customer_sk
 order by c_customer_id, c_salutation, c_first_name, c_last_name,
          ctr_total_return
@@ -4132,7 +4132,7 @@ class _Ref:
         comp = _decode(d, "web_site", "web_company_name")
         site_ok = {sk for sk, c in zip(
             d.tables["web_site"]["web_site_sk"].tolist(), comp)
-            if c == b"pri"}
+            if c == b"ought"}
         row_ok = self._addr_state_ok(ws["ws_ship_addr_sk"], b"GA") & \
             np.array([s in site_ok
                       for s in ws["ws_web_site_sk"].tolist()])
@@ -4980,7 +4980,7 @@ class _Ref:
 
     def q30(self):
         return self._ctr_over_state_avg(
-            "web_returns", "wr_", "wr_return_amt", b"TX")
+            "web_returns", "wr_", "wr_return_amt", b"MO")
 
 
 def run_tpcds(sf: float = 0.01, queries=None, iterations: int = 1,
