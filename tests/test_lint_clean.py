@@ -1,0 +1,173 @@
+"""Trace-safety lint: rule unit tests + the tier-1 enforcement that the
+whole ydb_tpu tree lints clean (any new jit-hazard pattern fails CI
+until fixed or explicitly suppressed)."""
+
+from pathlib import Path
+
+from ydb_tpu.analysis.lint import RULES, lint_paths, lint_source, main
+
+PKG = Path(__file__).resolve().parents[1] / "ydb_tpu"
+
+
+def codes(src: str) -> list:
+    return [f.code for f in lint_source(src, "t.py")]
+
+
+# ---------------- enforcement ----------------
+
+
+def test_repo_lints_clean():
+    findings = lint_paths([PKG])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_code_clean_and_dirty(tmp_path, capsys):
+    assert main([str(PKG)]) == 0
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x=[]):\n    return x\n")
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "L005" in out
+
+
+def test_json_report(tmp_path, capsys):
+    import json
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x=[]):\n    return x\n")
+    assert main([str(bad), "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep[0]["code"] == "L005"
+    assert rep[0]["line"] == 1
+
+
+# ---------------- rules ----------------
+
+
+def test_host_sync_item_in_traced_fn():
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    y = jnp.sum(x)\n"
+           "    return y.item()\n")
+    assert "L001" in codes(src)
+
+
+def test_host_sync_float_of_jnp():
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    return float(jnp.mean(x))\n")
+    assert "L001" in codes(src)
+
+
+def test_item_outside_traced_fn_ok():
+    # host-side result marshalling (viewer/fq service) is fine
+    src = ("import numpy as np\n"
+           "def f(v):\n"
+           "    return [x.item() for x in np.asarray(v)]\n")
+    assert codes(src) == []
+
+
+def test_python_branch_on_traced():
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    if jnp.any(x > 0):\n"
+           "        return 1\n"
+           "    return 0\n")
+    assert "L002" in codes(src)
+
+
+def test_branch_on_materialized_value_ok():
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    if int(jnp.sum(x)) > 0:  # explicit host round-trip\n"
+           "        return 1\n"
+           "    return 0\n")
+    assert "L002" not in codes(src)
+
+
+def test_branch_on_static_dtype_predicate_ok():
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    y = jnp.sum(x)\n"
+           "    if jnp.issubdtype(x.dtype, jnp.floating):\n"
+           "        return y\n"
+           "    return -y\n")
+    assert codes(src) == []
+
+
+def test_wall_clock_in_trace():
+    src = ("import time\n"
+           "import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    t = time.time()\n"
+           "    return jnp.sum(x) + t\n")
+    assert "L003" in codes(src)
+
+
+def test_wall_clock_in_host_fn_ok():
+    src = ("import time\n"
+           "def f():\n"
+           "    return time.time()\n")
+    assert codes(src) == []
+
+
+def test_unseeded_randomness():
+    assert "L004" in codes(
+        "import numpy as np\ndef f():\n    return np.random.rand(3)\n")
+    assert "L004" in codes(
+        "import numpy as np\n"
+        "def f():\n    return np.random.default_rng()\n")
+    assert codes(
+        "import numpy as np\n"
+        "def f():\n    return np.random.default_rng(42)\n") == []
+
+
+def test_mutable_default_arg():
+    assert "L005" in codes("def f(x={}):\n    return x\n")
+    assert "L005" in codes("def f(x=set()):\n    return x\n")
+    assert codes("def f(x=None):\n    return x\n") == []
+
+
+def test_set_iteration_order():
+    assert "L006" in codes(
+        "def f(v):\n    return [x for x in set(v)]\n")
+    assert "L006" in codes(
+        "def f():\n    for x in {1, 2}:\n        pass\n")
+    assert codes(
+        "def f(v):\n    return [x for x in sorted(set(v))]\n") == []
+
+
+# ---------------- suppression ----------------
+
+
+def test_suppression_same_line_and_name_alias():
+    src = ("def f(x=[]):  # ydb-lint: disable=L005\n"
+           "    return x\n")
+    assert codes(src) == []
+    src = ("def f(x=[]):  # ydb-lint: disable=mutable-default-arg\n"
+           "    return x\n")
+    assert codes(src) == []
+
+
+def test_suppression_line_above():
+    src = ("# ydb-lint: disable=L005\n"
+           "def f(x=[]):\n"
+           "    return x\n")
+    assert codes(src) == []
+
+
+def test_suppression_is_per_rule():
+    src = ("def f(x=[]):  # ydb-lint: disable=L001\n"
+           "    return x\n")
+    assert "L005" in codes(src)
+
+
+def test_skip_file():
+    src = ("# ydb-lint: skip-file\n"
+           "def f(x=[]):\n"
+           "    return x\n")
+    assert codes(src) == []
+
+
+def test_rule_table_is_stable():
+    assert set(RULES) == {"L001", "L002", "L003", "L004", "L005", "L006"}

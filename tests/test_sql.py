@@ -533,6 +533,43 @@ def test_block_cache_cleared_on_drop_table(monkeypatch):
     assert int(np.asarray(r.cols["s"][0])[0]) == 222
 
 
+def test_block_cache_pruned_for_gcd_portions(monkeypatch):
+    """Compaction/TTL churn must not leave cluster-cache entries keyed
+    by GC'd portion ids pinning HBM budget until LRU pressure: the
+    per-statement Database snapshot prunes against the live portion
+    sets, mirroring ColumnShard.scan's per-shard prune (ADVICE r5)."""
+    monkeypatch.setenv("YDB_TPU_SCAN_CACHE_BYTES", str(64 << 20))
+    c = Cluster(n_shards=1)
+    s = c.session()
+    s.execute("create table t (k bigint not null, v bigint, "
+              "primary key (k))")
+    s.execute("insert into t values (1, 10)")
+    s.execute("insert into t values (2, 20)")  # second portion
+    r = s.execute("select sum(v) as s from t")  # warm: keys current set
+    assert int(np.asarray(r.cols["s"][0])[0]) == 30
+    assert len(c.scan_block_cache) >= 1
+    shard = c.tables["t"].shards[0]
+    shard.compact()
+    shard.gc_blobs(keep_snap=shard.snap)  # pre-compaction portions die
+    live = set(shard.portions)
+    # the warm entry references dead portion ids until the next
+    # statement snapshot prunes it
+    assert any(
+        not live.issuperset(pids)
+        for key in c.scan_block_cache for _, pids in key[0])
+    r = s.execute("select sum(v) as s from t")
+    assert int(np.asarray(r.cols["s"][0])[0]) == 30
+    for key in c.scan_block_cache:
+        for _sid, pids in key[0]:
+            assert live.issuperset(pids), key
+    # the emergency valve (budget -> 0 mid-process) frees everything:
+    # entries cached under the old budget can never be served again
+    assert len(c.scan_block_cache) >= 1
+    monkeypatch.setenv("YDB_TPU_SCAN_CACHE_BYTES", "0")
+    s.execute("select sum(v) as s from t")
+    assert len(c.scan_block_cache) == 0
+
+
 # ---------------- window functions ----------------
 
 
@@ -591,6 +628,44 @@ def test_window_mixed_with_aggregate_rejected(data, db, catalog):
             "select sum(l_quantity) as s, "
             "rank() over (order by l_orderkey) as r from lineitem"),
             catalog)
+
+
+def test_ranking_window_with_args_is_a_syntax_error():
+    """rank(x) OVER (...) used to silently DROP the argument list; it
+    must fail at parse time instead of rewriting the query's meaning."""
+    with pytest.raises(SyntaxError, match="no arguments"):
+        parse("select rank(l_quantity) over (order by l_orderkey) as r"
+              " from lineitem")
+    with pytest.raises(SyntaxError, match="no arguments"):
+        parse("select dense_rank(distinct l_tax) over"
+              " (order by l_orderkey) as r from lineitem")
+    # argument-free ranking calls still parse
+    parse("select row_number() over (order by l_orderkey) as r"
+          " from lineitem")
+
+
+def test_nested_window_rejected_with_targeted_error(catalog):
+    """Windows hidden inside expressions or WHERE/HAVING used to fall
+    through to a generic late PlanError; they must fail with the
+    targeted top-level-select-items message."""
+    with pytest.raises(PlanError, match="top-level select items"):
+        plan_select(parse(
+            "select rank() over (order by l_orderkey) + 1 as r"
+            " from lineitem"), catalog)
+    with pytest.raises(PlanError, match="not allowed in WHERE"):
+        plan_select(parse(
+            "select l_orderkey from lineitem"
+            " where rank() over (order by l_orderkey) < 5"), catalog)
+    with pytest.raises(PlanError, match="not allowed in HAVING"):
+        plan_select(parse(
+            "select l_orderkey, sum(l_quantity) as s from lineitem"
+            " group by l_orderkey"
+            " having rank() over (order by l_orderkey) < 5"), catalog)
+    # nested windows inside a DERIVED TABLE get the same treatment
+    with pytest.raises(PlanError, match="top-level select items"):
+        plan_select(parse(
+            "select r from (select rank() over (order by l_orderkey)"
+            " * 2 as r from lineitem) t"), catalog)
 
 
 def test_or_of_exists_decorrelates():

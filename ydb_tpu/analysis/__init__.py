@@ -1,0 +1,24 @@
+"""Static analysis: SSA program verification + trace-safety lint.
+
+Two pillars (README.md in this directory):
+  * ``verify`` — the typed SSA program checker every SQL→SSA lowering
+    passes through before any JAX trace (the TProgramContainer::Init
+    analog, ydb/core/tx/program/program.cpp:553).
+  * ``lint`` — an AST linter over the Python tree flagging jit-hazard
+    patterns (host syncs, Python control flow on traced values,
+    wall-clock/randomness inside traces, mutable defaults,
+    nondeterministic set iteration). ``python -m ydb_tpu.analysis.lint``.
+"""
+
+from ydb_tpu.analysis.diagnostics import (  # noqa: F401
+    Diagnostic,
+    PlanError,
+    VerificationError,
+)
+from ydb_tpu.analysis.verify import (  # noqa: F401
+    ProgramAnalysis,
+    analyze_program,
+    check_program,
+    infer_nullable,
+    verify_program,
+)

@@ -1,0 +1,374 @@
+"""Trace-safety lint: AST checks for jit-hazard patterns.
+
+JAX traces Python once and replays the compiled computation; code that
+is harmless in eager Python silently corrupts or de-optimizes a traced
+function. The classic hazards (each is a rule below):
+
+  L001 host-sync-in-trace      ``.item()`` / ``float(jnp...)`` /
+                               ``np.asarray(jnp...)`` inside a function
+                               that builds traced values: forces a
+                               device sync per call, or fails under jit
+  L002 python-branch-on-traced ``if``/``while`` on a ``jnp`` boolean:
+                               trace-time constant folding or a
+                               ConcretizationTypeError, never data-
+                               dependent control flow
+  L003 wall-clock-in-trace     ``time.time()`` etc. inside traced code
+                               bakes the clock of the FIRST trace into
+                               the compiled program
+  L004 unseeded-randomness     legacy ``np.random.*`` global RNG /
+                               argless ``default_rng()`` / stdlib
+                               ``random.*``: irreproducible plans and
+                               divergent retraces
+  L005 mutable-default-arg     ``def f(x=[])``: one shared list across
+                               every call — a classic cache poisoner
+  L006 set-iteration-order     iterating a set literal / ``set(...)``
+                               feeds hash order into trace order; two
+                               processes compile different programs
+
+"Trace-suspect" means the function's own body calls into ``jnp.*`` /
+``jax.lax.*`` / ``jax.nn.*`` — the practical signature of code that
+runs under trace in this repo (lowering closures, kernels). L004-L006
+apply everywhere.
+
+Suppression: append ``# ydb-lint: disable=L001`` (or the rule name;
+comma-separate several; ``all`` kills every rule) to the offending
+line, or place it alone on the line above. ``# ydb-lint: skip-file``
+within the first ten lines skips the file.
+
+Run: ``python -m ydb_tpu.analysis.lint [path ...] [--json]``
+(default path: the ydb_tpu package). Exit code 1 on any unsuppressed
+finding; ``--json`` emits a machine-readable report.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import sys
+from pathlib import Path
+
+RULES = {
+    "L001": "host-sync-in-trace",
+    "L002": "python-branch-on-traced",
+    "L003": "wall-clock-in-trace",
+    "L004": "unseeded-randomness",
+    "L005": "mutable-default-arg",
+    "L006": "set-iteration-order",
+}
+_NAME_TO_CODE = {v: k for k, v in RULES.items()}
+
+_TRACE_ROOTS = ("jnp.", "jax.lax.", "jax.nn.", "jax.scipy.")
+_CLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.time_ns", "time.monotonic_ns",
+}
+_STDLIB_RANDOM = {
+    "random.random", "random.randint", "random.randrange",
+    "random.choice", "random.choices", "random.shuffle",
+    "random.sample", "random.uniform", "random.gauss",
+}
+#: host materializers: a jnp call wrapped in one of these is an
+#: EXPLICIT device->host transfer, not an accidental trace hazard
+_MATERIALIZERS = {"int", "float", "bool", "len", "str", "repr"}
+_MATERIALIZER_ROOTS = {"np.asarray", "np.array", "jax.device_get"}
+#: static METADATA predicates: they return plain Python values at trace
+#: time (dtype algebra, shape queries) — branching on them is fine
+_STATIC_JNP = {
+    "jnp.issubdtype", "jnp.iinfo", "jnp.finfo", "jnp.result_type",
+    "jnp.dtype", "jnp.shape", "jnp.ndim",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*ydb-lint:\s*disable=([\w\-,]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*ydb-lint:\s*skip-file")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    file: str
+    line: int
+    col: int
+    code: str
+    name: str
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}:{self.col}: "
+                f"{self.code} [{self.name}] {self.message}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _dotted(node) -> str:
+    """Dotted name of an attribute/name chain ('' if not a plain one)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_trace_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    root = _dotted(node.func)
+    if root in _STATIC_JNP:
+        return False
+    return any(root.startswith(p) for p in _TRACE_ROOTS)
+
+
+def _has_trace_call(node, *, through_materializers: bool) -> bool:
+    """Does the subtree contain a jnp/jax.lax call? With
+    ``through_materializers`` False, subtrees under an explicit host
+    materializer (int(...), np.asarray(...)) do not count."""
+    if _is_trace_call(node):
+        return True
+    if not through_materializers and isinstance(node, ast.Call):
+        fn = node.func
+        if (isinstance(fn, ast.Name) and fn.id in _MATERIALIZERS) or \
+                _dotted(fn) in _MATERIALIZER_ROOTS:
+            return False
+    return any(
+        _has_trace_call(c, through_materializers=through_materializers)
+        for c in ast.iter_child_nodes(node))
+
+
+class _FunctionChecker(ast.NodeVisitor):
+    """Per-function trace-hazard rules (L001-L003). Nested functions are
+    handled by their own checker instance (a nested def is its own
+    trace unit — lowering closures)."""
+
+    def __init__(self, out: list, filename: str, fn: ast.AST):
+        self.out = out
+        self.filename = filename
+        self.fn = fn
+
+    def run(self):
+        for stmt in self.fn.body:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):  # do not descend: own unit
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _emit(self, node, code, message):
+        self.out.append(Finding(
+            self.filename, node.lineno, node.col_offset, code,
+            RULES[code], message))
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" \
+                and not node.args:
+            self._emit(node, "L001",
+                       ".item() forces a device sync inside traced code"
+                       " (and fails under jit); keep values on device or"
+                       " materialize once outside the trace")
+        root = _dotted(fn)
+        if (isinstance(fn, ast.Name) and fn.id in ("int", "float", "bool")
+                or root in _MATERIALIZER_ROOTS):
+            if any(_has_trace_call(a, through_materializers=True)
+                   for a in node.args):
+                what = root or fn.id
+                self._emit(node, "L001",
+                           f"{what}(...) over a jnp expression"
+                           " materializes a traced value; hoist the"
+                           " host conversion out of the traced function")
+        if root in _CLOCK_CALLS:
+            self._emit(node, "L003",
+                       f"{root}() inside traced code bakes the clock of"
+                       " the first trace into the compiled program; pass"
+                       " timestamps in as arguments")
+        self.generic_visit(node)
+
+    def _check_branch(self, node, kind: str):
+        if _has_trace_call(node.test, through_materializers=False):
+            self._emit(node, "L002",
+                       f"Python `{kind}` on a jnp expression: under jit"
+                       " this folds at trace time or raises; use"
+                       " jnp.where / lax.cond / lax.while_loop")
+
+    def visit_If(self, node):
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+
+class _ModuleChecker(ast.NodeVisitor):
+    """Whole-file rules (L004-L006) + dispatch of trace-suspect
+    functions to _FunctionChecker."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.out: list = []
+
+    def _emit(self, node, code, message):
+        self.out.append(Finding(
+            self.filename, node.lineno, node.col_offset, code,
+            RULES[code], message))
+
+    # ---- L005: mutable default arguments ----
+
+    def _check_defaults(self, node):
+        args = node.args
+        for d in list(args.defaults) + [
+                d for d in args.kw_defaults if d is not None]:
+            bad = None
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                bad = type(d).__name__.lower()
+            elif isinstance(d, ast.Call) and isinstance(d.func, ast.Name) \
+                    and d.func.id in ("list", "dict", "set", "bytearray"):
+                bad = f"{d.func.id}()"
+            if bad is not None:
+                self._emit(d, "L005",
+                           f"mutable default argument {bad} is shared"
+                           " across calls; default to None and build"
+                           " inside the function")
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        if _has_trace_call(node, through_materializers=True):
+            _FunctionChecker(self.out, self.filename, node).run()
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    # ---- L004: nondeterministic randomness ----
+
+    def visit_Call(self, node):
+        root = _dotted(node.func)
+        if root.startswith("np.random.") or \
+                root.startswith("numpy.random."):
+            tail = root.split("random.", 1)[1]
+            if tail == "default_rng":
+                if not node.args and not node.keywords:
+                    self._emit(node, "L004",
+                               "default_rng() without a seed is"
+                               " irreproducible; pass an explicit seed")
+            elif tail not in ("Generator", "SeedSequence"):
+                self._emit(node, "L004",
+                           f"legacy global RNG np.random.{tail}(...) is"
+                           " process-global state; use a seeded"
+                           " np.random.default_rng(seed)")
+        elif root in _STDLIB_RANDOM:
+            self._emit(node, "L004",
+                       f"{root}() uses the process-global stdlib RNG;"
+                       " use a seeded random.Random(seed) instance")
+        self.generic_visit(node)
+
+    # ---- L006: set iteration order ----
+
+    def _check_iter(self, node, it):
+        bad = isinstance(it, ast.Set) or (
+            isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id in ("set", "frozenset"))
+        if bad:
+            self._emit(node, "L006",
+                       "iterating a set: hash order is process-dependent"
+                       " and would feed nondeterminism into trace/plan"
+                       " order; iterate sorted(...) or a tuple")
+
+    def visit_For(self, node):
+        self._check_iter(node, node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension_generators(self, node):
+        for gen in node.generators:
+            self._check_iter(gen.iter, gen.iter)
+
+    def visit_ListComp(self, node):
+        self.visit_comprehension_generators(node)
+        self.generic_visit(node)
+
+    visit_SetComp = visit_ListComp
+    visit_DictComp = visit_ListComp
+    visit_GeneratorExp = visit_ListComp
+
+
+def _suppressed_codes(line: str) -> set:
+    m = _SUPPRESS_RE.search(line)
+    if not m:
+        return set()
+    out = set()
+    for tok in m.group(1).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok.lower() == "all":
+            out.update(RULES)
+        elif tok.upper() in RULES:
+            out.add(tok.upper())
+        elif tok.lower() in _NAME_TO_CODE:
+            out.add(_NAME_TO_CODE[tok.lower()])
+    return out
+
+
+def lint_source(src: str, filename: str = "<string>") -> list:
+    """Lint one source text; returns unsuppressed findings sorted by
+    position."""
+    lines = src.splitlines()
+    for ln in lines[:10]:
+        if _SKIP_FILE_RE.search(ln):
+            return []
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as e:
+        return [Finding(filename, e.lineno or 0, e.offset or 0, "L000",
+                        "syntax-error", str(e.msg))]
+    checker = _ModuleChecker(filename)
+    checker.visit(tree)
+    kept = []
+    for f in sorted(checker.out,
+                    key=lambda f: (f.line, f.col, f.code)):
+        here = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        above = lines[f.line - 2] if 1 < f.line <= len(lines) + 1 else ""
+        sup = _suppressed_codes(here)
+        if above.strip().startswith("#"):
+            sup |= _suppressed_codes(above)
+        if f.code not in sup:
+            kept.append(f)
+    return kept
+
+
+def lint_paths(paths) -> list:
+    findings: list = []
+    for p in paths:
+        p = Path(p)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            findings.extend(
+                lint_source(f.read_text(encoding="utf-8"), str(f)))
+    return findings
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    if not paths:
+        paths = [str(Path(__file__).resolve().parents[1])]  # ydb_tpu/
+    findings = lint_paths(paths)
+    if as_json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

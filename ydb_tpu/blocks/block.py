@@ -205,6 +205,14 @@ def concat_blocks(blocks: list[TableBlock], capacity: int | None = None) -> Tabl
     if not blocks:
         raise ValueError("concat of no blocks")
     schema = blocks[0].schema
+    if len(blocks) > 1:
+        # a row may come from any branch, so a column is nullable as
+        # soon as ANY branch's is (branch schemas share names/types)
+        schema = dtypes.Schema(tuple(
+            dtypes.Field(
+                f.name, f.type,
+                any(b.schema.field(f.name).nullable for b in blocks))
+            for f in schema.fields))
     arrays: dict[str, np.ndarray] = {}
     validity: dict[str, np.ndarray] = {}
     for name in schema.names:

@@ -599,18 +599,21 @@ def _assemble(payloads: list[dict], schema: dtypes.Schema) -> TableBlock:
 def _join_out_schema(j, probe_schema: dtypes.Schema,
                      build_schema: dtypes.Schema) -> dtypes.Schema:
     """Static output schema of a join stage."""
+    left = j.kind == "left"  # NULL-extended build payload is nullable
     if not j.expand:
         if j.kind in ("semi", "anti"):
             return probe_schema
         fields = list(probe_schema.fields)
         for n in j.payload:
-            fields.append(dtypes.Field(n + j.suffix,
-                                       build_schema.field(n).type))
+            f = build_schema.field(n)
+            fields.append(dtypes.Field(n + j.suffix, f.type,
+                                       f.nullable or left))
         return dtypes.Schema(tuple(fields))
     fields = [probe_schema.field(n) for n in j.probe_payload]
     for n in j.build_payload:
-        fields.append(dtypes.Field(n + j.suffix,
-                                   build_schema.field(n).type))
+        f = build_schema.field(n)
+        fields.append(dtypes.Field(n + j.suffix, f.type,
+                                   f.nullable or left))
     return dtypes.Schema(tuple(fields))
 
 

@@ -182,6 +182,16 @@ class ScanExecutor:
         self.combine_every = combine_every
         self.read_cols = required_columns(program, source.schema)
         in_schema = source.schema.select(self.read_cols)
+        # verify the ORIGINAL program before the two-phase rewrite:
+        # diagnostics then point at the caller's step indices, not at
+        # synthesized partial/final steps (which compile_program still
+        # re-checks as its own precondition). Its nullability also
+        # types the RESULT schema below: the original program knows
+        # keyed AVG over a non-null input is never NULL, while the
+        # rewritten final program only sees a division fixup.
+        from ydb_tpu.analysis.verify import check_program
+
+        self._out_nullable = check_program(program, in_schema).out_nullable
         self.partial_prog, self.final_prog = twophase.split(program)
         self.partial = compile_program(
             self.partial_prog, in_schema, source.dicts, key_spaces
@@ -221,7 +231,8 @@ class ScanExecutor:
             self._final_aux = {
                 k: jnp.asarray(v) for k, v in self.final.aux.items()
             }
-            self.out_schema = self.final.out_schema
+            self.out_schema = self._stamp_nullability(
+                self.final.out_schema)
             final_run = self.final.run
 
             @jax.jit
@@ -231,7 +242,8 @@ class ScanExecutor:
             self._finalize_jit = _finalize
         else:
             self.final = None
-            self.out_schema = self.partial.out_schema
+            self.out_schema = self._stamp_nullability(
+                self.partial.out_schema)
             self._final_aux = {}
             self._finalize_jit = jax.jit(
                 lambda parts, aux: merge_blocks_device(list(parts)))
@@ -280,9 +292,26 @@ class ScanExecutor:
                 admit(merged)
         if self.final is None:
             # pure filter/project program: block outputs concatenate
-            return (partials[0] if len(partials) == 1
-                    else concat_blocks(partials))
-        return self.finalize(partials)
+            out = (partials[0] if len(partials) == 1
+                   else concat_blocks(partials))
+        else:
+            out = self.finalize(partials)
+        return self._retype(out)
+
+    def _stamp_nullability(self, sch: dtypes.Schema) -> dtypes.Schema:
+        """Original-program nullability over a rewritten-program schema
+        (the two-phase rewrite's fixups would widen it: AVG restated as
+        a division fixup loses never-NULL knowledge)."""
+        return dtypes.Schema(tuple(
+            dtypes.Field(f.name, f.type,
+                         self._out_nullable.get(f.name, f.nullable))
+            for f in sch.fields))
+
+    def _retype(self, blk: TableBlock) -> TableBlock:
+        sch = self._stamp_nullability(blk.schema)
+        if sch == blk.schema:
+            return blk
+        return TableBlock(blk.columns, blk.length, sch)
 
     def execute(self) -> OracleTable:
         return OracleTable.from_block(self.run_stream(

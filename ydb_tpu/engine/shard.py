@@ -127,6 +127,9 @@ class ColumnShard:
         self.snap: int = 0           # last committed snapshot
         self.next_portion_id = 1
         self.portions: dict[int, PortionMeta] = {}
+        # bumped whenever a portion id VANISHES from the map (gc): lets
+        # cluster-level cache pruning skip work while the set is stable
+        self.meta_gen = 0
         # WAL-replay holding pen for staged compaction outputs: they only
         # activate when the cluster's compact_commit record arrives, so a
         # crash mid-compaction loses nothing and duplicates nothing
@@ -623,6 +626,7 @@ class ColumnShard:
             blob_ids = [self.portions[pid].blob_id for pid in dead]
             for pid in dead:
                 del self.portions[pid]
+            self.meta_gen += 1
         for bid in blob_ids:
             self.store.delete(bid)
         return len(dead)

@@ -163,6 +163,7 @@ class Cluster:
         from ydb_tpu.engine.blockcache import DeviceBlockCache
 
         self.scan_block_cache = DeviceBlockCache()
+        self._prune_stamp = None  # last pruned (shard, meta_gen) set
         self._query_seq = 0
         import threading
 
@@ -867,6 +868,7 @@ class Cluster:
         from ydb_tpu.datashard.table import RowTable
 
         snap = self.coordinator.read_snapshot() if snap is None else snap
+        self._prune_scan_cache()
         sources = {}
         for name, t in self.tables.items():
             if isinstance(t, RowTable):
@@ -880,6 +882,59 @@ class Cluster:
         if mesh and self._mesh_exec is not None:
             db.mesh_executor = self._mesh_snapshot(snap)
         return db
+
+    def _prune_scan_cache(self) -> None:
+        """Free cluster-cache entries pinned by GC'd portions.
+
+        ColumnShard.scan prunes its per-shard cache before every scan;
+        the cluster-scoped ``scan_block_cache`` (keyed by
+        MultiShardStreamSource.device_cache_key: per-shard visible
+        portion-id tuples) had no such hook — under compaction/TTL
+        churn, entries naming vanished portions could pin HBM until LRU
+        pressure. Snapshotting a Database is the natural choke point:
+        every statement passes through it, and an entry referencing a
+        portion absent from the live portion maps can never be keyed
+        again by any future snapshot."""
+        if not len(self.scan_block_cache):
+            return
+        if self.scan_block_cache.budget() <= 0:
+            # the operator's emergency valve (YDB_TPU_SCAN_CACHE_BYTES=0)
+            # closed mid-process: entries cached under the earlier budget
+            # can never be served again, so free the HBM outright
+            self.scan_block_cache.clear()
+            return
+        # portions only vanish on GC (meta_gen bumps) or reshard (the
+        # shard set changes): while the stamp is stable there is nothing
+        # to prune, so the per-statement steady state stays O(shards)
+        stamp = tuple(
+            (s.shard_id, getattr(s, "meta_gen", 0))
+            for t in self.tables.values()
+            for s in getattr(t, "shards", ()))
+        if stamp == self._prune_stamp:
+            return
+        live: dict[str, set] = {}
+        for t in self.tables.values():
+            for s in getattr(t, "shards", ()):
+                portions = getattr(s, "portions", None)
+                if portions is None:
+                    continue
+                lock = getattr(s, "_meta_lock", None)
+                if lock is not None:
+                    with lock:
+                        pids = set(portions)
+                else:
+                    pids = set(portions)
+                live.setdefault(s.shard_id, set()).update(pids)
+
+        def alive(key) -> bool:
+            try:
+                return all(
+                    sid in live and live[sid].issuperset(pids)
+                    for sid, pids in key[0])
+            except (TypeError, ValueError, IndexError):
+                return True  # unknown key shape: never drop blindly
+        self.scan_block_cache.prune(alive)
+        self._prune_stamp = stamp
 
     def plan(self, sql: str, snap: int | None = None,
              access_check=None):

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu import dtypes
+from ydb_tpu.analysis.verify import check_program
 from ydb_tpu.blocks.block import TableBlock, concat_blocks
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.oracle import OracleTable
@@ -239,6 +240,11 @@ def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
         key = (plan.program, plan.dict_aliases, block.schema)
         hit = db._compile_cache.get(key)
         if hit is None:
+            # mandatory precondition (ydb_tpu.analysis): surface
+            # step-indexed diagnostics for malformed programs before
+            # any trace work; compile_program re-checks, but this keeps
+            # the executor the choke point even if lowering changes
+            check_program(plan.program, block.schema)
             cp = compile_program(
                 plan.program, block.schema, db.dicts, db.key_spaces,
                 dict_aliases=dict(plan.dict_aliases),

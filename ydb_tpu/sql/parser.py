@@ -639,6 +639,18 @@ class Parser:
                 fc = ast.FuncCall(t.value.lower(), tuple(args),
                                   distinct=distinct)
                 if str(self.peek().value).lower() == "over":
+                    if fc.name in ("rank", "dense_rank", "row_number") \
+                            and (fc.args or fc.distinct or fc.star):
+                        # the reference rejects these at translation
+                        # time too; silently dropping the argument list
+                        # would rewrite the query's meaning
+                        found = ("DISTINCT" if fc.distinct else
+                                 "*" if fc.star else
+                                 f"{len(fc.args)} argument(s)")
+                        raise SyntaxError(
+                            f"window function {fc.name}() takes no"
+                            f" arguments and no DISTINCT/*; found"
+                            f" {found} at {t.pos}")
                     self.next()
                     self.expect("op", "(")
                     partition: list = []

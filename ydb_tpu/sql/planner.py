@@ -73,8 +73,10 @@ class Catalog:
     udfs: dict[str, tuple] = dataclasses.field(default_factory=dict)
 
 
-class PlanError(Exception):
-    pass
+# PlanError now lives with the static-analysis diagnostics (the
+# verifier raises VerificationError, a PlanError subclass, so the SQL
+# surface reports one error family); re-exported here for compatibility.
+from ydb_tpu.analysis.diagnostics import PlanError  # noqa: E402,F401
 
 
 @dataclasses.dataclass
@@ -161,6 +163,61 @@ def _contains_agg(e) -> bool:
             _contains_agg(c) or _contains_agg(v) for c, v in e.whens
         ) or (e.else_ is not None and _contains_agg(e.else_))
     return False
+
+
+def _contains_window(e) -> bool:
+    """A WindowCall anywhere in the expression tree (not descending into
+    subqueries — those plan themselves and run their own check)."""
+    if isinstance(e, ast.WindowCall):
+        return True
+    if isinstance(e, ast.BinOp):
+        return _contains_window(e.left) or _contains_window(e.right)
+    if isinstance(e, ast.UnOp):
+        return _contains_window(e.operand)
+    if isinstance(e, ast.FuncCall):
+        return any(_contains_window(a) for a in e.args)
+    if isinstance(e, ast.Between):
+        return any(_contains_window(x) for x in (e.expr, e.low, e.high))
+    if isinstance(e, (ast.Like, ast.IsNull)):
+        return _contains_window(e.expr)
+    if isinstance(e, ast.InList):
+        return _contains_window(e.expr) or any(
+            _contains_window(i) for i in e.items)
+    if isinstance(e, ast.Case):
+        return any(
+            _contains_window(c) or _contains_window(v) for c, v in e.whens
+        ) or (e.else_ is not None and _contains_window(e.else_))
+    return False
+
+
+def _reject_nested_windows(sel: ast.Select) -> None:
+    """Window functions are supported only as whole top-level select
+    items; anything else (rank() + 1, windows in WHERE/HAVING/GROUP
+    BY/ORDER BY) must fail with a targeted message, not a late generic
+    'cannot lower' (ADVICE round 5, planner has_window)."""
+    for item in sel.items:
+        if isinstance(item.expr, ast.Star) or isinstance(
+                item.expr, ast.WindowCall):
+            continue
+        if _contains_window(item.expr):
+            raise PlanError(
+                "window functions are only allowed as top-level select"
+                " items; compute rank() in a subquery and transform it"
+                " in the outer SELECT")
+    for clause, e in (("WHERE", sel.where), ("HAVING", sel.having)):
+        if e is not None and _contains_window(e):
+            raise PlanError(
+                f"window functions are not allowed in {clause}; rank in"
+                " a subquery and filter the outer SELECT")
+    for e in sel.group_by:
+        if _contains_window(e):
+            raise PlanError(
+                "window functions are not allowed in GROUP BY")
+    for o in sel.order_by:
+        if _contains_window(o.expr):
+            raise PlanError(
+                "window functions are not allowed in ORDER BY; ORDER BY"
+                " the aliased select item instead")
 
 
 def _contains_subquery(e) -> bool:
@@ -1092,6 +1149,10 @@ class _SelectPlanner:
         )
 
     def plan(self, sel: ast.Select) -> PlannedQuery:
+        # every SELECT — top-level, CTE, derived table, union branch —
+        # funnels through here, so nested windows fail with the
+        # targeted message wherever they hide
+        _reject_nested_windows(sel)
         for name, sub in sel.ctes:
             self.ctes[name] = self._sub(sub)
 

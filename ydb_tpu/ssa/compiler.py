@@ -129,6 +129,20 @@ def compile_program(
     partial_slots: bool = False,
     dict_aliases: dict[str, str] | None = None,
 ) -> CompiledProgram:
+    # mandatory precondition: no program reaches the trace unverified.
+    # Malformed programs raise VerificationError (a PlanError) with
+    # step-indexed diagnostics instead of an opaque trace-time failure.
+    # (Lazy import: ydb_tpu.ssa.__init__ imports this module, and the
+    # verifier's own program imports would re-enter it mid-init.)
+    from ydb_tpu.analysis import verify as _verify
+
+    analysis = _verify.check_program(program, schema)
+    out_nullable = analysis.out_nullable
+    if partial_slots and program.group_by is not None:
+        # slot layouts keep dead group slots in place (invalid values,
+        # zero counts) so every output column is effectively nullable
+        out_nullable = {n: True for n in out_nullable}
+
     ctx = _Lowering(schema, dicts, key_spaces, partial_slots, dict_aliases)
 
     # ---- static pass: resolve plan, types, aux tables, output schema ----
@@ -259,7 +273,8 @@ def compile_program(
             raise NotImplementedError(f"step {step}")
 
     out_schema = dtypes.Schema(
-        tuple(dtypes.Field(n, cur_types[n]) for n in cur_names)
+        tuple(dtypes.Field(n, cur_types[n], out_nullable.get(n, True))
+              for n in cur_names)
     )
 
     # ---- trace-time pass ----

@@ -86,6 +86,7 @@ def lookup_join(
     build_keys: list[str],
     payload: list[str],
     suffix: str = "",
+    null_extended: bool = False,
 ) -> tuple[TableBlock, jax.Array]:
     """N:1 equi-join: gather ``payload`` columns from build into probe.
 
@@ -122,7 +123,10 @@ def lookup_join(
         f = build.schema.field(name)
         from ydb_tpu import dtypes
 
-        sch = sch.with_field(dtypes.Field(out_name, f.type))
+        # a LEFT join NULL-extends unmatched rows, so its payload is
+        # nullable no matter what the build side declares
+        sch = sch.with_field(
+            dtypes.Field(out_name, f.type, f.nullable or null_extended))
     return TableBlock(out_cols, probe.length, sch), found
 
 
@@ -151,7 +155,7 @@ def run_equi_join(
     if not expand:
         joined, found = lookup_join(
             probe, build, list(probe_keys), list(build_keys),
-            list(payload), suffix)
+            list(payload), suffix, null_extended=(kind == "left"))
         if kind == "inner":
             return kernels.compact(joined, found)
         if kind == "left":
@@ -242,7 +246,8 @@ def expand_join(
         out_name = name + build_suffix
         cols[out_name] = Column(c.data[b_src], c.validity[b_src] & matched)
         f = build.schema.field(name)
-        fields.append(dtypes.Field(out_name, f.type))
+        fields.append(dtypes.Field(
+            out_name, f.type, f.nullable or kind == "left"))
     length = jnp.minimum(total, out_capacity).astype(jnp.int32)
     return (
         TableBlock(cols, length, dtypes.Schema(tuple(fields))),
