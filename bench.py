@@ -29,7 +29,12 @@ not), YDB_TPU_BENCH_ITERS (default 5), YDB_TPU_BENCH_BLOCK_ROWS
 tiers are skipped once spent so the JSON line always prints),
 YDB_TPU_BENCH_SKIP_ENGINE=1 (kernel-only quick mode),
 YDB_TPU_BENCH_PALLAS_COMPARE=1 (force the in-process A/B of the Pallas
-one-hot group-by vs the XLA scatter path; default on for TPU backends).
+one-hot group-by vs the XLA scatter path; default on for TPU backends),
+YDB_TPU_BENCH_FUSED_COMPARE=0 (skip the fused-vs-per-agg group-by A/B,
+which is on by default on every backend and reports
+fused/peragg_q1_rows_per_sec + fused_speedup). Engine-tier runs also
+report per-stage scan seconds (engine_q{1,6}_stage_seconds:
+read/merge/stage/compute) from the streaming reader's StageTimer.
 Phase progress logs to stderr; stdout stays the one JSON line.
 """
 
@@ -182,21 +187,21 @@ def timed_cold_warm(fn, iters, deadline=None):
     return cold, (cold if warm == float("inf") else warm), out
 
 
-def pallas_ab(src, blocks, n_rows, block_rows, iters):
-    """In-process A/B: q1 with the Pallas one-hot group-by forced ON vs
-    OFF. Fresh executors per side — enabled() is consulted at trace
-    time, and separate function objects trace separately. (No
-    subprocesses: a child python would try to claim the TPU the parent
-    already holds and hang on the tunnel.)"""
+def _q1_flag_ab(src, blocks, n_rows, block_rows, iters, sides, set_flag):
+    """In-process q1 A/B over a trace-time force flag: fresh executors
+    per side — the flag is consulted at trace time, and separate
+    function objects trace separately. (No subprocesses: a child python
+    would try to claim the TPU the parent already holds and hang on the
+    tunnel.) ``sides`` maps label -> forced flag value; ``set_flag``
+    applies it (None restores the default)."""
     import jax
 
     from ydb_tpu.engine.scan import ScanExecutor
-    from ydb_tpu.ssa import pallas_kernels
     from ydb_tpu.workload import tpch
 
     out = {}
-    for label, force in (("pallas", True), ("scatter", False)):
-        pallas_kernels.FORCE = force
+    for label, force in sides:
+        set_flag(force)
         try:
             ex = ScanExecutor(tpch.q1_program(), src,
                               block_rows=block_rows)
@@ -211,8 +216,37 @@ def pallas_ab(src, blocks, n_rows, block_rows, iters):
         except Exception as e:  # noqa: BLE001 - report, don't die
             out[f"{label}_error"] = repr(e)[-300:]
         finally:
-            pallas_kernels.FORCE = None
+            set_flag(None)
     return out
+
+
+def fused_ab(src, blocks, n_rows, block_rows, iters):
+    """Fused single-contraction group-by vs the per-aggregate reduction
+    path (PR 3 acceptance: fused kernel-tier Q1 warm >= 2x per-agg on
+    CPU)."""
+    from ydb_tpu.ssa import kernels
+
+    def set_flag(v):
+        kernels.FUSED_FORCE = v
+
+    out = _q1_flag_ab(src, blocks, n_rows, block_rows, iters,
+                      (("fused", True), ("peragg", False)), set_flag)
+    if "fused_q1_rows_per_sec" in out and "peragg_q1_rows_per_sec" in out:
+        out["fused_speedup"] = round(
+            out["fused_q1_rows_per_sec"]
+            / max(out["peragg_q1_rows_per_sec"], 1), 2)
+    return out
+
+
+def pallas_ab(src, blocks, n_rows, block_rows, iters):
+    """Pallas one-hot group-by forced ON vs OFF (the XLA scatter path)."""
+    from ydb_tpu.ssa import pallas_kernels
+
+    def set_flag(v):
+        pallas_kernels.FORCE = v
+
+    return _q1_flag_ab(src, blocks, n_rows, block_rows, iters,
+                       (("pallas", True), ("scatter", False)), set_flag)
 
 
 def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
@@ -420,12 +454,25 @@ def main():
                    if nm in ex1.read_cols)
     extra["kernel_hbm_gb_per_sec"] = round(q1_bytes / warm1 / 1e9, 1)
 
+    skipped = extra.setdefault("skipped", [])
+
+    # fused vs per-aggregate group-by A/B (PR 3 acceptance): on by
+    # default for every backend; YDB_TPU_BENCH_FUSED_COMPARE=0 skips
+    fflag = os.environ.get("YDB_TPU_BENCH_FUSED_COMPARE")
+    fused_enabled = (fflag not in ("0", "", "off")) if fflag is not None \
+        else True
+    if fused_enabled and _budget_left(budget) > 120:
+        _log("fused group-by A/B")
+        extra.update(fused_ab(src, blocks, n_rows, block_rows,
+                              max(2, iters // 2)))
+    elif fused_enabled:
+        skipped.append("fused_ab:budget")
+
     # Pallas one-hot group-by vs XLA scatter A/B (VERDICT r4 item 9):
     # by default on the real chip; force with YDB_TPU_BENCH_PALLAS_COMPARE
     flag = os.environ.get("YDB_TPU_BENCH_PALLAS_COMPARE")
     ab_enabled = (jax.default_backend() in ("tpu", "axon") if flag is None
                   else flag not in ("0", "", "off"))
-    skipped = extra.setdefault("skipped", [])
     if ab_enabled and _budget_left(budget) > 120:
         _log("pallas A/B")
         extra.update(pallas_ab(src, blocks, n_rows, block_rows,
@@ -504,6 +551,12 @@ def main():
                 ebase1["sum_charge"], rtol=1e-9)
             extra["engine_q1_cold_rows_per_sec"] = round(e_rows / ecold1)
             extra["engine_q1_warm_rows_per_sec"] = round(e_rows / ewarm1)
+            # per-stage scan attribution of the LAST (warm) q1 run:
+            # read (blob IO) / merge (K-way dedup) / stage (block build
+            # + device transfer) / compute (device dispatch) seconds —
+            # concurrent stages overlap, so they may sum past wall time
+            extra["engine_q1_stage_seconds"] = dict(
+                shard.last_scan_stages)
             engine_warm_rps = round(e_rows / ewarm1)
             if _budget_left(budget) < 45:
                 raise _BudgetSpent("engine_q6,sql_tier:budget")
@@ -512,6 +565,8 @@ def main():
             assert int(np.asarray(eout6.cols["revenue"][0])[0]) == ebase6
             extra["engine_q6_cold_rows_per_sec"] = round(e_rows / ecold6)
             extra["engine_q6_warm_rows_per_sec"] = round(e_rows / ewarm6)
+            extra["engine_q6_stage_seconds"] = dict(
+                shard.last_scan_stages)
 
             # ---- sql tier: parse -> plan -> execute over the store ----
             if _budget_left(budget) < 60:

@@ -96,7 +96,16 @@ class TableBlock:
         validity: Mapping[str, np.ndarray] | None = None,
         capacity: int | None = None,
     ) -> "TableBlock":
-        """Build a block from host numpy arrays (already physically encoded)."""
+        """Build a block from host numpy arrays (already physically encoded).
+
+        Low-copy staging: a capacity-aligned array passes straight to the
+        device transfer (on CPU backends ``jnp.asarray`` can even alias
+        aligned owning arrays — zero host copies); only a short tail is
+        ever padded, instead of zero-filling and re-copying a
+        full-capacity buffer per column. Callers must therefore not
+        mutate ``arrays``/``validity`` after handing them over — the
+        scan pipeline's payloads are single-owner by construction.
+        """
         names = schema.names
         n = len(next(iter(arrays.values()))) if arrays else 0
         cap = capacity if capacity is not None else _round_up(
@@ -111,11 +120,15 @@ class TableBlock:
             v = None if validity is None else validity.get(name)
             if v is None:
                 v = np.ones(n, dtype=np.bool_)
-            data = np.zeros(cap, dtype=f.type.physical)
-            data[:n] = a
-            valid = np.zeros(cap, dtype=np.bool_)
-            valid[:n] = v
-            cols[name] = Column(jnp.asarray(data), jnp.asarray(valid))
+            else:
+                v = np.asarray(v, dtype=np.bool_)
+            if cap != n:
+                # tail-only padding; padding validity stays False so it
+                # can never leak live rows
+                a = np.concatenate(
+                    [a, np.zeros(cap - n, dtype=f.type.physical)])
+                v = np.concatenate([v, np.zeros(cap - n, dtype=np.bool_)])
+            cols[name] = Column(jnp.asarray(a), jnp.asarray(v))
         return TableBlock(cols, jnp.asarray(n, dtype=jnp.int32), schema)
 
     # ---- views ----

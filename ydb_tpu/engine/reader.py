@@ -26,7 +26,10 @@ merge.cpp:10 NArrow::NMerger):
 
 from __future__ import annotations
 
-import concurrent.futures
+import contextlib
+import os
+import queue
+import threading
 from typing import Iterator
 
 import numpy as np
@@ -58,23 +61,43 @@ def _chunk_in_range(meta: dict, pk_range) -> bool:
 def rechunk(payloads, names, cap: int):
     """Re-cut a stream of (cols, valid) payloads into exactly-``cap``-row
     pieces (last piece partial). Shared by the block stream and
-    compaction output cutting."""
+    compaction output cutting.
+
+    Low-copy: a payload whose boundary already aligns with ``cap``
+    passes its arrays through untouched (the common case once portion
+    chunk sizes divide the block size), and a single buffered piece
+    flushes as its own slice views — ``np.concatenate`` only runs when
+    a block genuinely straddles payloads."""
     buf: list[tuple[dict, dict]] = []
     buf_n = 0
 
     def flush():
+        if len(buf) == 1:
+            return buf[0]
         return ({m: np.concatenate([b[0][m] for b in buf]) for m in names},
                 {m: np.concatenate([b[1][m] for b in buf]) for m in names})
 
     for cols, valid in payloads:
         n = len(next(iter(cols.values()))) if cols else 0
+        if not buf_n and n == cap:
+            # aligned payload: no buffering, no copy — pass through
+            yield ({m: cols[m] for m in names},
+                   {m: valid[m] for m in names})
+            continue
         off = 0
         while off < n:
             take = min(cap - buf_n, n - off)
-            buf.append((
-                {m: cols[m][off:off + take] for m in names},
-                {m: valid[m][off:off + take] for m in names},
-            ))
+            if take == n:
+                # whole payload in one piece: keep the original arrays
+                # (a [0:n] slice would demote them to views, costing the
+                # device-transfer aliasing fast path downstream)
+                buf.append(({m: cols[m] for m in names},
+                            {m: valid[m] for m in names}))
+            else:
+                buf.append((
+                    {m: cols[m][off:off + take] for m in names},
+                    {m: valid[m][off:off + take] for m in names},
+                ))
             buf_n += take
             off += take
             if buf_n == cap:
@@ -151,11 +174,15 @@ class _RunCursor:
         return int(self.pk_buf[-1])
 
     def _read_chunk(self, i: int) -> tuple[dict, dict]:
-        c, v = self.reader.read_chunk(i)
-        self.source.chunks_read += 1
-        shard = self.source.shard
-        return project_chunk(shard.schema, shard.column_added, self.meta,
-                             self.names, c, v)
+        t = self.source.timer
+        ctx = (t.stage("read") if t is not None
+               else contextlib.nullcontext())
+        with ctx:
+            c, v = self.reader.read_chunk(i)
+            self.source.chunks_read += 1
+            shard = self.source.shard
+            return project_chunk(shard.schema, shard.column_added,
+                                 self.meta, self.names, c, v)
 
     def fill_more(self) -> None:
         """Append the next chunk to the buffer (PK-pruned chunks skip)."""
@@ -217,12 +244,16 @@ class PortionStreamSource:
         dedup: bool | None = None,
         prefetch: bool = True,
         pk_range: tuple[int | None, int | None] | None = None,
+        timer=None,
     ):
         self.shard = shard
         self.metas = list(metas)
         # chunk-granular PK pruning window (coarse: callers still filter)
         self.pk_range = pk_range
         self.chunks_read = 0  # observability: chunk fetches actually done
+        # per-scan stage accounting (obs.probes.StageTimer): blob reads
+        # charge "read", K-way merging "merge"; None = untimed
+        self.timer = timer
         names = columns if columns is not None else shard.schema.names
         self.columns_read = tuple(names)
         self.schema = shard.schema.select(self.columns_read)
@@ -278,24 +309,42 @@ class PortionStreamSource:
                 for c in cursors:
                     while not c.done and c.last_pk <= bound:
                         c.fill_more()
-            takes = [c.take(bound) for c in cursors]
-            parts = []
-            runs = []
-            for c, k in zip(cursors, takes):
-                if k == 0:
-                    continue
-                parts.append(c.slices(k))
-                runs.append(c.pk_buf[:k])
-            run_idx, row_idx = native.kway_merge(runs, dedup=True)
-            offsets = np.cumsum([0] + [len(r) for r in runs])[:-1]
-            gidx = offsets[run_idx] + row_idx
-            cols = {n: np.concatenate([p[0][n] for p in parts])[gidx]
-                    for n in names}
-            valid = {n: np.concatenate([p[1][n] for p in parts])[gidx]
-                     for n in names}
-            for c, k in zip(cursors, takes):
-                if k:
-                    c.pop(k)
+            mctx = (self.timer.stage("merge") if self.timer is not None
+                    else contextlib.nullcontext())
+            with mctx:
+                takes = [c.take(bound) for c in cursors]
+                parts = []
+                runs = []
+                for c, k in zip(cursors, takes):
+                    if k == 0:
+                        continue
+                    parts.append(c.slices(k))
+                    runs.append(c.pk_buf[:k])
+                run_idx, row_idx = native.kway_merge(runs, dedup=True)
+                # gather per-run instead of concatenate-then-gather:
+                # with dedup the merged output is SMALLER than the
+                # buffered input, so materializing a concatenated copy
+                # of every run just to index it wastes the difference;
+                # per-run fancy gathers write each output row exactly
+                # once
+                out_n = len(run_idx)
+                sels = [np.flatnonzero(run_idx == r)
+                        for r in range(len(parts))]
+                rsels = [row_idx[s] for s in sels]
+                cols = {}
+                valid = {}
+                for n in names:
+                    first = parts[0][0][n]
+                    oc = np.empty(out_n, dtype=first.dtype)
+                    ov = np.empty(out_n, dtype=np.bool_)
+                    for p, s, rs in zip(parts, sels, rsels):
+                        oc[s] = p[0][n][rs]
+                        ov[s] = p[1][n][rs]
+                    cols[n] = oc
+                    valid[n] = ov
+                for c, k in zip(cursors, takes):
+                    if k:
+                        c.pop(k)
             yield cols, valid
 
     def _iter_plain(self, cluster: list[PortionMeta], names):
@@ -305,11 +354,16 @@ class PortionStreamSource:
             for i in range(rd.n_chunks):
                 if not _chunk_in_range(rd.chunk_meta(i), self.pk_range):
                     continue
-                c, v = rd.read_chunk(i)
-                self.chunks_read += 1
-                yield project_chunk(self.shard.schema,
-                                    self.shard.column_added,
-                                    m, names, c, v)
+                rctx = (self.timer.stage("read")
+                        if self.timer is not None
+                        else contextlib.nullcontext())
+                with rctx:
+                    c, v = rd.read_chunk(i)
+                    self.chunks_read += 1
+                    out = project_chunk(self.shard.schema,
+                                        self.shard.column_added,
+                                        m, names, c, v)
+                yield out
 
     def payload_stream(self, clusters, names):
         """All clusters as a stream of bounded (cols, valid) payloads."""
@@ -354,6 +408,7 @@ class PortionStreamSource:
         yield from stream_blocks(
             self.payload_stream(clusters, names), names, sch, cap,
             start_block=start_block, prefetch=self.prefetch,
+            timer=self.timer,
         )
 
     # NOTE deliberately no n_blocks(): with dedup the emitted block count
@@ -361,41 +416,129 @@ class PortionStreamSource:
     # (DQ checkpoint seek) must count actual emissions, not estimate.
 
 
+def _prefetch_depth() -> int:
+    """Staging lookahead (device blocks buffered ahead of the consumer).
+    Depth 2 keeps one block in transfer while one waits, without pinning
+    unbounded host/device memory."""
+    try:
+        return int(os.environ.get("YDB_TPU_PREFETCH_DEPTH", "2"))
+    except ValueError:
+        return 2
+
+
 def stream_blocks(payloads, names, sch, cap: int,
                   start_block: int = 0,
-                  prefetch: bool = True) -> Iterator[TableBlock]:
-    """(cols, valid) payload stream -> fixed-capacity TableBlocks, with a
-    1-deep thread prefetch so blob IO + host merge overlap the
-    device-side consumption. Always emits at least one (possibly empty)
-    block: consumers size their compiled programs off the stream."""
-    _SENTINEL = object()
+                  prefetch: bool = True,
+                  depth: int | None = None,
+                  timer=None) -> Iterator[TableBlock]:
+    """(cols, valid) payload stream -> fixed-capacity TableBlocks.
 
-    def gen_rows():
-        if not prefetch:
-            yield from payloads
-            return
-        it = iter(payloads)
-        with concurrent.futures.ThreadPoolExecutor(1) as pool:
-            fut = pool.submit(next, it, _SENTINEL)
-            while True:
-                cur = fut.result()
-                if cur is _SENTINEL:
-                    return
-                fut = pool.submit(next, it, _SENTINEL)
-                yield cur
+    The staging pipeline: a producer task on the SHARED conveyor pool
+    (runtime.conveyor.shared_conveyor — no per-scan executor churn)
+    drains the payload stream, re-cuts it (``rechunk``), builds device
+    blocks (``TableBlock.from_numpy`` issues the host->device transfer),
+    and parks them in a ``depth``-bounded queue. Blob IO, host merge AND
+    the next blocks' device transfers all overlap the consumer's device
+    compute; ``depth`` bounds how far the producer runs ahead.
 
-    emitted = 0
-    for cols, valid in rechunk(gen_rows(), names, cap):
-        emitted += 1
-        if emitted - 1 < start_block:
-            continue  # checkpoint-resume seek: skip cheaply
-        yield TableBlock.from_numpy(cols, sch, valid, capacity=cap)
-    if emitted == 0 and start_block == 0:
-        yield TableBlock.from_numpy(
+    ``timer`` (obs.probes.StageTimer) charges block building to the
+    "stage" stage. Always emits at least one (possibly empty) block:
+    consumers size their compiled programs off the stream. Abandoning
+    the generator (close/GC) stops the producer promptly — the bounded
+    put is stop-aware, so no task leaks on the shared pool.
+    """
+    depth = _prefetch_depth() if depth is None else depth
+
+    def build(cols, valid):
+        ctx = (timer.stage("stage") if timer is not None
+               else contextlib.nullcontext())
+        with ctx:
+            return TableBlock.from_numpy(cols, sch, valid, capacity=cap)
+
+    def empty_block():
+        return build(
             {m: np.empty(0, dtype=sch.field(m).type.physical)
              for m in names},
-            sch, {m: np.empty(0, dtype=bool) for m in names},
-            capacity=cap)
+            {m: np.empty(0, dtype=bool) for m in names})
+
+    pieces = rechunk(payloads, names, cap)
+
+    def sync_stream():
+        emitted = 0
+        for cols, valid in pieces:
+            emitted += 1
+            if emitted - 1 < start_block:
+                continue  # checkpoint-resume seek: skip cheaply
+            yield build(cols, valid)
+        if emitted == 0 and start_block == 0:
+            yield empty_block()
+
+    if not prefetch or depth <= 0:
+        yield from sync_stream()
+        return
+
+    from ydb_tpu.runtime.conveyor import shared_conveyor
+
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        """Stop-aware bounded put: an abandoned consumer sets ``stop``
+        and the producer exits instead of parking forever."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce():
+        emitted = 0
+        try:
+            for cols, valid in pieces:
+                if stop.is_set():
+                    return
+                emitted += 1
+                if emitted - 1 < start_block:
+                    continue  # seek skips BEFORE staging costs anything
+                if not put(("blk", build(cols, valid))):
+                    return
+            put(("end", emitted))
+        except BaseException as e:  # noqa: BLE001 - relayed to consumer
+            put(("err", e))
+
+    # atomic free-worker admission: a producer must never QUEUE behind
+    # other parked producers (its consumer would starve waiting on a
+    # task that cannot start) — with no idle worker, stage inline
+    handle = shared_conveyor().submit_if_free("scan_prefetch", produce)
+    if handle is None:
+        yield from sync_stream()
+        return
+    try:
+        while True:
+            try:
+                kind, payload = q.get(timeout=0.05)
+            except queue.Empty:
+                if handle.done.is_set() and q.empty():
+                    # producer finished without a terminal message:
+                    # cancelled during pool shutdown — surface that
+                    handle.wait(0)
+                    raise RuntimeError("block staging producer vanished")
+                continue
+            if kind == "blk":
+                yield payload
+            elif kind == "end":
+                if payload == 0 and start_block == 0:
+                    yield empty_block()
+                return
+            else:
+                raise payload
+    finally:
+        stop.set()
+        with contextlib.suppress(queue.Empty):
+            while True:
+                q.get_nowait()
 
 
 class MultiShardStreamSource:
@@ -407,15 +550,17 @@ class MultiShardStreamSource:
     shard scans (the KQP scan fan-out shape, kqp_scan_executer.cpp)."""
 
     def __init__(self, shards, schema, dicts, snap=None,
-                 columns: tuple[str, ...] | None = None):
+                 columns: tuple[str, ...] | None = None,
+                 timer=None):
         names = columns if columns is not None else schema.names
         self.columns_read = tuple(names)
         self._base_schema = schema
         self.schema = schema.select(self.columns_read)
         self.dicts = dicts
+        self.timer = timer
         self.subs = [
             PortionStreamSource(s, s.visible_portions(snap),
-                                columns=self.columns_read)
+                                columns=self.columns_read, timer=timer)
             for s in shards
         ]
 
@@ -457,4 +602,5 @@ class MultiShardStreamSource:
                 yield from sub.payload_stream(clusters, names)
 
         yield from stream_blocks(payloads(), names, sch, cap,
-                                 start_block=start_block)
+                                 start_block=start_block,
+                                 timer=self.timer)

@@ -265,13 +265,23 @@ class ScanExecutor:
             return partials[0]
         return self._finalize_jit(tuple(partials), self._final_aux)
 
-    def run_stream(self, blocks) -> TableBlock:
+    def run_stream(self, blocks, timer=None) -> TableBlock:
         """Drive a block stream with bounded in-flight work; returns the
-        result block (merged partials finalized, or concatenated rows)."""
+        result block (merged partials finalized, or concatenated rows).
+
+        ``timer`` (obs.probes.StageTimer) charges device dispatch +
+        backpressure waits to the "compute" stage; time spent PULLING
+        from ``blocks`` (the staging pipeline) is charged by the
+        producer side, so the two stages expose their overlap."""
         import collections
+        import contextlib
 
         window: collections.deque = collections.deque()
         partials: list[TableBlock] = []
+
+        def computing():
+            return (timer.stage("compute") if timer is not None
+                    else contextlib.nullcontext())
 
         def admit(out):
             partials.append(out)
@@ -280,23 +290,25 @@ class ScanExecutor:
                 jax.block_until_ready(window.popleft())
 
         for b in blocks:
-            admit(self.run_block(b))
-            if (
-                self._combine_jit is not None
-                and len(partials) >= self.combine_every
-            ):
-                merged = self._combine_jit(
-                    tuple(partials), self._combine_aux
-                )
-                partials = []
-                admit(merged)
-        if self.final is None:
-            # pure filter/project program: block outputs concatenate
-            out = (partials[0] if len(partials) == 1
-                   else concat_blocks(partials))
-        else:
-            out = self.finalize(partials)
-        return self._retype(out)
+            with computing():
+                admit(self.run_block(b))
+                if (
+                    self._combine_jit is not None
+                    and len(partials) >= self.combine_every
+                ):
+                    merged = self._combine_jit(
+                        tuple(partials), self._combine_aux
+                    )
+                    partials = []
+                    admit(merged)
+        with computing():
+            if self.final is None:
+                # pure filter/project program: block outputs concatenate
+                out = (partials[0] if len(partials) == 1
+                       else concat_blocks(partials))
+            else:
+                out = self.finalize(partials)
+            return self._retype(out)
 
     def _stamp_nullability(self, sch: dtypes.Schema) -> dtypes.Schema:
         """Original-program nullability over a rewritten-program schema

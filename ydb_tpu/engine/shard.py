@@ -37,6 +37,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import threading
+from collections import OrderedDict
 
 import numpy as np
 
@@ -57,6 +58,7 @@ from ydb_tpu.ssa.program import Program
 
 _P_COMMIT = probe("columnshard.commit")
 _P_SCAN = probe("columnshard.scan")
+_P_SCAN_STAGES = probe("columnshard.scan.stages")
 _P_COMPACT = probe("columnshard.compact")
 
 
@@ -84,6 +86,10 @@ class ShardConfig:
     # None = auto (on for tpu/gpu backends, off on CPU where "device"
     # memory is host RSS); 0 = off; >0 = byte budget.
     scan_cache_bytes: int | None = None
+    # compiled-executor cache cap (LRU entries): each entry pins a
+    # traced XLA executable per distinct (program, key_spaces); ad-hoc
+    # query workloads would otherwise grow it without bound
+    scan_cache_entries: int = 32
 
 
 class ColumnShard:
@@ -138,7 +144,14 @@ class ColumnShard:
         self._insert_buffer: dict[int, dict] = {}  # write_id -> batch
         self._next_write_id = 1
         # compiled-scan cache: (program, key_spaces) -> (executor, sizes)
-        self._scan_cache: dict = {}
+        # LRU-bounded at config.scan_cache_entries: compiled executors
+        # pin XLA executables, and ad-hoc workloads mint a fresh key per
+        # distinct program — unbounded, that's a leak
+        self._scan_cache: OrderedDict = OrderedDict()
+        self._scan_cache_lock = threading.Lock()
+        # stage snapshot of the most recent scan (read/merge/stage/
+        # compute seconds) — obs surface for bench + the viewer
+        self.last_scan_stages: dict = {}
         # HBM-resident decoded-block cache for warm scans, keyed by the
         # immutable (portion ids, read cols, block rows)
         self.block_cache = DeviceBlockCache(
@@ -389,23 +402,38 @@ class ColumnShard:
         into the compiled aux)."""
         from ydb_tpu.engine.reader import PortionStreamSource
         from ydb_tpu.engine.scan import ScanExecutor, required_columns
+        from ydb_tpu.obs.probes import StageTimer
 
+        timer = StageTimer()
         cols = required_columns(program, self.schema)
         src = PortionStreamSource(
-            self, self.visible_portions(snap), columns=cols
+            self, self.visible_portions(snap), columns=cols, timer=timer
         )
         key = (program, tuple(sorted((key_spaces or {}).items())))
         sizes = tuple(
             (c, len(self.dicts[c])) for c in sorted(self.dicts.columns())
         )
-        hit = self._scan_cache.get(key)
+        # the LRU bookkeeping (move_to_end / eviction) needs a lock:
+        # concurrent scans race a hit-path touch against another
+        # thread's eviction popitem; the expensive executor trace stays
+        # OUTSIDE it (duplicate compiles on a racing miss are wasteful
+        # but correct — last insert wins)
+        with self._scan_cache_lock:
+            hit = self._scan_cache.get(key)
+            if hit is not None and hit[1] == sizes:
+                self._scan_cache.move_to_end(key)
         if hit is not None and hit[1] == sizes:
             ex = hit[0]
         else:
             ex = ScanExecutor(
                 program, src, self.config.scan_block_rows, key_spaces
             ).detach()
-            self._scan_cache[key] = (ex, sizes)
+            with self._scan_cache_lock:
+                self._scan_cache[key] = (ex, sizes)
+                self._scan_cache.move_to_end(key)
+                while len(self._scan_cache) > max(
+                        1, self.config.scan_cache_entries):
+                    self._scan_cache.popitem(last=False)
         cache_key = None
         hit_before = self.block_cache.hits
         if self.block_cache.budget() > 0:
@@ -423,7 +451,14 @@ class ColumnShard:
             self.block_cache.stream(
                 cache_key,
                 lambda: src.blocks(self.config.scan_block_rows,
-                                   ex.read_cols))))
+                                   ex.read_cols)),
+            timer=timer))
+        # per-scan stage attribution (read/merge/stage/compute seconds);
+        # bench.py surfaces this as metric extras
+        self.last_scan_stages = timer.snapshot()
+        if _P_SCAN_STAGES:
+            _P_SCAN_STAGES.fire(shard=self.shard_id,
+                                **self.last_scan_stages)
         if _P_SCAN:
             _P_SCAN.fire(shard=self.shard_id,
                          portions=len(src.metas),

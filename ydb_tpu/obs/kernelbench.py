@@ -1,0 +1,187 @@
+"""Micro-benchmark CLI for the two PR-3 hot paths.
+
+``python -m ydb_tpu.obs.kernelbench`` measures, in-process:
+
+  * group-by — a synthetic multi-aggregate GROUP BY program compiled
+    twice (fused single-contraction vs per-aggregate reductions,
+    kernels.FUSED_FORCE) and cross-checked against the CPU oracle;
+  * staging — payload stream -> rechunk -> TableBlock.from_numpy ->
+    device block throughput (the low-copy block pipeline).
+
+Flags: ``--rows`` ``--groups`` ``--aggs`` ``--iters`` ``--block-rows``
+``--json`` (machine-readable report on stdout) and ``--smoke`` (tiny
+sizes, correctness-only; wired into tier-1 as a non-slow test).
+Run under JAX_PLATFORMS=cpu for a stable reference; on accelerators it
+measures whatever backend jax selects.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _build_case(rows: int, groups: int, aggs: int, seed: int = 7):
+    """Synthetic grouped-aggregation case: one bounded int key (dense
+    tier when `groups` is small), `aggs` decimal SUM columns plus AVG /
+    COUNT / MIN / MAX riders, ~6% NULLs."""
+    from ydb_tpu import dtypes
+    from ydb_tpu.ssa import (
+        Agg, AggSpec, Call, Col, FilterStep, GroupByStep, Op, Program,
+    )
+    from ydb_tpu.ssa.program import lit
+
+    rng = np.random.default_rng(seed)
+    cols = {"k": rng.integers(0, groups, rows).astype(np.int64)}
+    valid = {"k": np.ones(rows, dtype=bool)}
+    fields = [("k", dtypes.INT64)]
+    specs = [AggSpec(Agg.COUNT_ALL, None, "n")]
+    for i in range(aggs):
+        name = f"v{i}"
+        cols[name] = rng.integers(0, 10 ** 6, rows).astype(np.int64)
+        valid[name] = rng.random(rows) > 0.06
+        fields.append((name, dtypes.decimal(2)))
+        specs.append(AggSpec(Agg.SUM, name, f"sum_{name}"))
+    specs.append(AggSpec(Agg.AVG, "v0", "avg_v0"))
+    specs.append(AggSpec(Agg.COUNT, "v0", "cnt_v0"))
+    specs.append(AggSpec(Agg.MIN, "v0", "min_v0"))
+    specs.append(AggSpec(Agg.MAX, "v0", "max_v0"))
+    prog = Program((
+        FilterStep(Call(Op.GE, Col("v0"), lit(0))),
+        GroupByStep(("k",), tuple(specs)),
+    ))
+    schema = dtypes.schema(*fields)
+    return prog, schema, cols, valid
+
+
+def bench_group_by(rows: int, groups: int, aggs: int, iters: int,
+                   check: bool = True) -> dict:
+    import jax
+
+    from ydb_tpu.blocks.block import TableBlock
+    from ydb_tpu.engine.oracle import OracleTable, run_oracle
+    from ydb_tpu.ssa import kernels
+    from ydb_tpu.ssa.compiler import compile_program
+
+    prog, schema, cols, valid = _build_case(rows, groups, aggs)
+    blk = jax.device_put(TableBlock.from_numpy(cols, schema, valid))
+    out: dict = {"rows": rows, "groups": groups, "aggs": aggs}
+    results = {}
+    for label, force in (("fused", True), ("peragg", False)):
+        kernels.FUSED_FORCE = force
+        try:
+            cp = compile_program(prog, schema,
+                                 key_spaces={"k": groups})
+            run = jax.jit(cp.run)
+            aux = {k: jax.numpy.asarray(v) for k, v in cp.aux.items()}
+            res = jax.block_until_ready(run(blk, aux))
+            results[label] = res
+            best = float("inf")
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                jax.block_until_ready(run(blk, aux))
+                best = min(best, time.perf_counter() - t0)
+            out[f"{label}_rows_per_sec"] = round(rows / best)
+        finally:
+            kernels.FUSED_FORCE = None
+    if "fused_rows_per_sec" in out and "peragg_rows_per_sec" in out:
+        out["fused_speedup"] = round(
+            out["fused_rows_per_sec"] / out["peragg_rows_per_sec"], 2)
+    if check:
+        oracle = run_oracle(
+            prog, OracleTable(
+                {n: (cols[n], valid[n]) for n in cols}, schema))
+        for label, res in results.items():
+            got = OracleTable.from_block(res)
+            o_order = np.argsort(oracle.column("k"))
+            g_order = np.argsort(np.asarray(got.column("k")))
+            for name in got.cols:
+                g = np.asarray(got.column(name), dtype=np.float64)
+                o = np.asarray(oracle.column(name), dtype=np.float64)
+                np.testing.assert_allclose(
+                    g[g_order], o[o_order], rtol=1e-9,
+                    err_msg=f"{label} vs oracle on {name}")
+        out["oracle_check"] = "ok"
+    return out
+
+
+def bench_staging(rows: int, block_rows: int, iters: int) -> dict:
+    """Block staging throughput: payloads -> rechunk -> from_numpy ->
+    device blocks (the low-copy pipeline, prefetch on)."""
+    import jax
+
+    from ydb_tpu import dtypes
+    from ydb_tpu.engine.reader import stream_blocks
+
+    schema = dtypes.schema(("a", dtypes.INT64), ("b", dtypes.DOUBLE))
+    rng = np.random.default_rng(3)
+    chunk = 1 << 16
+    payloads = []
+    for off in range(0, rows, chunk):
+        n = min(chunk, rows - off)
+        payloads.append((
+            {"a": rng.integers(0, 10 ** 9, n).astype(np.int64),
+             "b": rng.random(n)},
+            {"a": np.ones(n, dtype=bool), "b": np.ones(n, dtype=bool)},
+        ))
+    best = float("inf")
+    n_blocks = 0
+    for _ in range(max(1, iters)):
+        t0 = time.perf_counter()
+        blocks = list(stream_blocks(iter(payloads), ("a", "b"), schema,
+                                    min(block_rows, rows)))
+        jax.block_until_ready([b.columns["a"].data for b in blocks])
+        best = min(best, time.perf_counter() - t0)
+        n_blocks = len(blocks)
+    return {"rows": rows, "block_rows": block_rows, "blocks": n_blocks,
+            "staging_rows_per_sec": round(rows / best)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ydb_tpu.obs.kernelbench",
+        description="group-by + block staging micro-benchmarks")
+    ap.add_argument("--rows", type=int, default=1 << 21)
+    ap.add_argument("--groups", type=int, default=16)
+    ap.add_argument("--aggs", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--block-rows", type=int, default=1 << 18)
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object on stdout")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny correctness-only run (tier-1 wiring)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.rows, args.groups, args.aggs, args.iters = 5000, 7, 2, 1
+        args.block_rows = 2048
+
+    import jax
+
+    report = {
+        "backend": jax.default_backend(),
+        "group_by": bench_group_by(args.rows, args.groups, args.aggs,
+                                   args.iters),
+        "staging": bench_staging(args.rows, args.block_rows, args.iters),
+    }
+    if args.json:
+        print(json.dumps(report))
+    else:
+        gb, st = report["group_by"], report["staging"]
+        print(f"backend={report['backend']}")
+        print(f"group-by rows={gb['rows']} groups={gb['groups']}: "
+              f"fused {gb.get('fused_rows_per_sec'):,} rows/s, "
+              f"per-agg {gb.get('peragg_rows_per_sec'):,} rows/s "
+              f"(x{gb.get('fused_speedup')}), "
+              f"oracle={gb.get('oracle_check', 'skipped')}")
+        print(f"staging rows={st['rows']} blocks={st['blocks']}: "
+              f"{st['staging_rows_per_sec']:,} rows/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,8 +13,10 @@ bounded ring of (name, params) events plus per-probe hit counts.
 from __future__ import annotations
 
 import collections
+import contextlib
 import fnmatch
 import threading
+import time
 
 _registry: dict[str, "Probe"] = {}
 _lock = threading.Lock()
@@ -92,6 +94,46 @@ class TraceSession:
 
     def __exit__(self, *exc) -> None:
         self.detach()
+
+
+class StageTimer:
+    """Per-scan stage accounting: accumulated wall seconds by stage name.
+
+    The scan pipeline spreads one logical query over threads — blob IO
+    and K-way merging on the prefetch producer, block staging
+    (pad + device transfer) beside it, device compute on the consumer —
+    so a single end-to-end duration says nothing about WHERE the time
+    went. Each pipeline site charges its own stage (``read`` / ``merge``
+    / ``stage`` / ``compute``); concurrent stages may sum past the
+    wall-clock total, which is exactly the overlap being measured.
+    Thread-safe; ``snapshot()`` is what bench.py surfaces as metric
+    extras and what the ``scan.stages`` probe fires.
+    """
+
+    #: canonical scan stages, always present in snapshots (zero if unhit)
+    STAGES = ("read", "merge", "stage", "compute")
+
+    def __init__(self):
+        self._t: collections.defaultdict = collections.defaultdict(float)
+        self._lock = threading.Lock()
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._t[name] += seconds
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {s: 0.0 for s in self.STAGES}
+            out.update(self._t)
+        return {k: round(v, 6) for k, v in out.items()}
 
 
 def memory_stats() -> dict:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import itertools
+import os
 import threading
 
 
@@ -146,6 +147,25 @@ class Conveyor:
             self._cv.notify()
         return h
 
+    def submit_if_free(self, queue: str, fn, *args,
+                       **kwargs) -> TaskHandle | None:
+        """Submit ONLY if an idle worker can take the task right now
+        (atomic check-and-push), else None. For long-lived pipeline
+        tasks (scan prefetch producers) that must never queue behind
+        each other: a parked producer whose consumer is itself waiting
+        on a queued producer would starve — callers degrade to a
+        synchronous path instead."""
+        with self._cv:
+            if (self._stopping or self._heap
+                    or self._active >= len(self._threads)):
+                return None
+            h = TaskHandle(queue, threading.Event())
+            heapq.heappush(
+                self._heap,
+                (10, next(self._seq), queue, fn, args, kwargs, h))
+            self._cv.notify()
+            return h
+
     def _worker(self) -> None:
         while True:
             with self._cv:
@@ -200,3 +220,32 @@ class Conveyor:
         if wait:
             for t in self._threads:
                 t.join(timeout=10)
+
+
+_shared: Conveyor | None = None
+_shared_lock = threading.Lock()
+
+
+def shared_conveyor() -> Conveyor:
+    """Process-wide conveyor for scan prefetch/staging work.
+
+    Before this pool every ``stream_blocks`` spun up (and tore down) its
+    own ``ThreadPoolExecutor(1)`` — thread churn per scan, and no global
+    bound on prefetch concurrency. The shared pool gives both: workers
+    are created ONCE (YDB_TPU_CONVEYOR_WORKERS, default 4) and every
+    scan's staging producer runs as a "scan_prefetch" task on them.
+
+    A scan's producer occupies one worker for the scan's lifetime (it
+    parks on a bounded queue between blocks), so the worker count bounds
+    how many block streams stage CONCURRENTLY; with every worker busy,
+    additional streams do NOT queue — ``submit_if_free`` turns them away
+    and ``stream_blocks`` degrades to synchronous (no-prefetch) staging,
+    which can never starve but loses the overlap. Never shut this
+    instance down — its threads are daemons and die with the process.
+    """
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            workers = int(os.environ.get("YDB_TPU_CONVEYOR_WORKERS", "4"))
+            _shared = Conveyor(workers=max(1, workers))
+        return _shared

@@ -149,6 +149,10 @@ def compile_program(
     plan: list = []  # (kind, payload) closures prepared statically
     cur_types = dict(ctx.types)
     cur_names = list(schema.names)
+    # static nullability at each step (the verifier's inference rules):
+    # the fused group-by collapses per-column valid counts and input
+    # masking for columns that provably carry no NULLs
+    cur_nullable = {f.name: f.nullable for f in schema.fields}
 
     def resolve_expr(expr: Expr):
         """Return (lower_fn(env, aux) -> Column, LogicalType)."""
@@ -203,6 +207,8 @@ def compile_program(
         if isinstance(step, AssignStep):
             fn, t = resolve_expr(step.expr)
             cur_types[step.name] = t
+            cur_nullable[step.name] = _verify.infer_nullable(
+                step.expr, cur_nullable)
             if step.name not in cur_names:
                 cur_names.append(step.name)
             plan.append(("assign", (step.name, fn)))
@@ -212,10 +218,14 @@ def compile_program(
                 raise TypeError(f"filter predicate must be bool, got {t}")
             plan.append(("filter", fn))
         elif isinstance(step, GroupByStep):
-            lowered = _resolve_group_by(ctx, step, cur_types)
+            lowered = _resolve_group_by(ctx, step, cur_types,
+                                        cur_nullable)
             plan.append(("group_by", lowered))
             cur_names = list(lowered.out_names)
             cur_types = dict(lowered.out_types)
+            # aggregate outputs may be NULL for empty/dead groups;
+            # conservative for any later step
+            cur_nullable = {n: True for n in cur_names}
         elif isinstance(step, ProjectStep):
             missing = [n for n in step.names if n not in cur_types]
             if missing:
@@ -938,7 +948,8 @@ class _GroupByLowered:
 _DENSE_GROUP_LIMIT = 65536
 
 
-def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
+def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types,
+                      cur_nullable: dict | None = None):
     keys = step.keys
     bounds = []
     dense = len(keys) > 0
@@ -1002,28 +1013,232 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
     else:
         ctx.group_layout = ("compact", None)
 
-    def lower(env, aux, live):
-        kcols = [env[k] for k in key_names]
-        capacity = next(iter(env.values())).data.shape[0]
-        if key_names:
-            if use_dense:
-                gid, ng = kernels.group_ids_dense(kcols, list(b_tuple), live)
-            else:
-                # a block of N rows has at most N groups: default the group
-                # capacity to the block capacity so nothing is ever
-                # silently dropped; an explicit max_groups caps it.
-                ng = (
-                    min(explicit_cap, capacity)
-                    if explicit_cap is not None
-                    else capacity
-                )
-                gid, ng_scalar = kernels.group_ids_sorted(kcols, live, ng)
-                ng_scalar = jnp.minimum(ng_scalar, jnp.int32(ng))
-        else:
-            # global aggregate: one group
-            gid = jnp.where(live, 0, 1).astype(jnp.int32)
-            ng = 1
+    src_types = {
+        s.column: cur_types[s.column] for s, _ in specs
+        if s.column is not None
+    }
+    # statically NULL-free aggregate inputs: their valid-count is the
+    # live count and their values need no validity masking — for the
+    # common all-NOT-NULL schema this collapses every per-column count
+    # slot and every input mask out of the fused pipeline
+    nonnull_cols = {
+        s.column for s, _ in specs
+        if s.column is not None
+        and not (cur_nullable or {}).get(s.column, True)
+    }
+    # integer SUM states double as AVG numerators (the fused reduction
+    # keeps integer sums exact, so the f64 cast afterwards is at least
+    # as precise as accumulating f64 per row)
+    int_sum_cols = {
+        s.column: jnp.dtype(t.physical) for s, t in specs
+        if s.func is Agg.SUM
+        and jnp.issubdtype(jnp.dtype(t.physical), jnp.integer)
+    }
 
+    def trace_fused(env, aux, live, gid, ng, kcols, capacity):
+        """Fused lowering: ONE shared hit expansion per GroupByStep.
+
+        All linear aggregates (COUNT/SUM/AVG/VAR/STDDEV states) stack
+        into per-accumulator-dtype banks and reduce with one
+        ``hits.T @ stacked`` contraction each
+        (kernels.fused_group_reduce); MIN/MAX and the key columns reuse
+        the same bool hit matrix — where the per-aggregate path expands
+        (rows x groups) once per aggregate AND once per key.
+        """
+        onehot = ng <= kernels.ONEHOT_GROUP_LIMIT
+        # counts ride the f64 GEMM bank in the one-hot tier (exact below
+        # 2^53, merges with the AVG/VAR sums into one matmul); the
+        # large-group tier keeps them int32 so they stay Pallas-eligible
+        count_dt = jnp.float64 if onehot else jnp.int32
+
+        bank_vecs: dict = {}   # accumulator dtype -> list of row vectors
+        slot_ix: dict = {}     # state key -> (dtype, slot index)
+
+        def slot(key, dtype, make_vec):
+            dtype = jnp.dtype(dtype)
+            if key not in slot_ix:
+                vecs = bank_vecs.setdefault(dtype, [])
+                slot_ix[key] = (dtype, len(vecs))
+                vecs.append(make_vec().astype(dtype))
+
+        def cnt_key(col):
+            # NULL-free column: its valid count IS the live count
+            return ("live",) if col in nonnull_cols else ("cnt", col)
+
+        def masked(c, col):
+            return (c.data if col in nonnull_cols
+                    else jnp.where(c.validity, c.data,
+                                   jnp.zeros_like(c.data)))
+
+        slot(("live",), count_dt,
+             lambda: jnp.ones((capacity,), dtype=jnp.int32))
+        for spec, t in specs:
+            if spec.func is Agg.COUNT_ALL:
+                continue
+            c = env[spec.column]
+            # per-column valid count: COUNT's value, everyone's validity
+            slot(cnt_key(spec.column), count_dt,
+                 lambda _c=c: _c.validity.astype(jnp.int32))
+            if spec.func is Agg.SUM:
+                acc = jnp.dtype(t.physical)
+                slot(("sum", spec.column, acc.name), acc,
+                     lambda _c=c, _col=spec.column: masked(_c, _col))
+            elif spec.func is Agg.AVG:
+                if spec.column in int_sum_cols:
+                    # share the exact integer SUM state
+                    slot(("sum", spec.column,
+                          int_sum_cols[spec.column].name),
+                         int_sum_cols[spec.column],
+                         lambda _c=c, _col=spec.column: masked(_c, _col))
+                else:
+                    slot(("sum", spec.column, "float64"), jnp.float64,
+                         lambda _c=c, _col=spec.column:
+                         masked(_c, _col).astype(jnp.float64))
+            elif spec.func in (Agg.VAR_SAMP, Agg.STDDEV_SAMP):
+                scale = (10.0 ** src_types[spec.column].scale
+                         if src_types[spec.column].is_decimal else 1.0)
+
+                def mk_vals(_c=c, _col=spec.column, _s=scale):
+                    v = masked(_c, _col).astype(jnp.float64)
+                    if _s != 1.0:
+                        v = v / _s
+                    return v
+
+                slot(("vsum", spec.column), jnp.float64, mk_vals)
+                slot(("vsq", spec.column), jnp.float64,
+                     lambda _mk=mk_vals: _mk() ** 2)
+
+        results = kernels.fused_group_reduce_banks(
+            {dtype: (vecs[0][:, None] if len(vecs) == 1
+                     else jnp.stack(vecs, axis=1))
+             for dtype, vecs in bank_vecs.items()},
+            gid, ng)
+
+        def state(key):
+            dtype, i = slot_ix[key]
+            return results[dtype][:, i]
+
+        def count_of(key):
+            return state(key).astype(jnp.int64)
+
+        live_count = count_of(("live",))
+        group_live = live_count > 0
+
+        hits = kernels.group_hits(gid, ng) if onehot else None
+        new_env: dict[str, Column] = {}
+        if key_names and use_dense:
+            # dense slot ids ARE the keys: decode each key value from
+            # the slot index arithmetically (enc = value + 1, 0 = NULL,
+            # group_ids_dense's mixed-radix encoding) — zero row passes
+            strides = []
+            acc = 1
+            for b in reversed(b_tuple):
+                strides.append(acc)
+                acc *= b + 1
+            strides.reverse()
+            slot_ids = jnp.arange(ng, dtype=jnp.int32)
+            for k, c, b, stride in zip(key_names, kcols, b_tuple,
+                                       strides):
+                enc = (slot_ids // stride) % (b + 1)
+                kd = jnp.maximum(enc - 1, 0).astype(c.data.dtype)
+                kv = (enc > 0) & group_live
+                new_env[k] = Column(kd, kv)
+        elif key_names and onehot:
+            # one first-row expansion shared by EVERY key column
+            first, found = kernels.first_live_index(hits)
+            for k, c in zip(key_names, kcols):
+                kd = jnp.where(found, c.data[first],
+                               jnp.zeros_like(c.data[first]))
+                kv = c.validity[first] & found
+                new_env[k] = Column(kd, kv & group_live)
+        else:
+            for k, c in zip(key_names, kcols):
+                kd = kernels.scatter_first(c.data, live, gid, ng)
+                kv = kernels.scatter_first(c.validity, live, gid, ng)
+                new_env[k] = Column(kd, kv & group_live)
+
+        for spec, t in specs:
+            if spec.func is Agg.COUNT_ALL:
+                data = live_count
+                valid = (jnp.ones_like(group_live) if not key_names
+                         else group_live)
+                new_env[spec.out_name] = Column(data, valid)
+                continue
+            c = env[spec.column]
+            nn = count_of(cnt_key(spec.column))
+            if spec.func is Agg.COUNT:
+                data = nn
+                valid = (jnp.ones_like(group_live) if not key_names
+                         else group_live)
+            elif spec.func is Agg.SUM:
+                data = state(("sum", spec.column,
+                              jnp.dtype(t.physical).name))
+                valid = nn > 0
+            elif spec.func in (Agg.MIN, Agg.MAX):
+                vals = c.data
+                packed = spec.column in str_rank_aux
+                if packed:
+                    rank = kernels.dict_gather(
+                        aux[str_rank_aux[spec.column]], c
+                    ).data
+                    vals = (
+                        rank.astype(jnp.int64) << 32
+                    ) | c.data.astype(jnp.int64)
+                if onehot:
+                    fill = kernels._extreme(
+                        vals.dtype, maximum=spec.func is Agg.MIN)
+                    hv = (hits if spec.column in nonnull_cols
+                          else hits & c.validity[:, None])
+                    expanded = jnp.where(
+                        hv, vals[:, None],
+                        jnp.asarray(fill, dtype=vals.dtype))
+                    reduce_fn = (jnp.min if spec.func is Agg.MIN
+                                 else jnp.max)
+                    data = reduce_fn(expanded, axis=0)
+                elif spec.func is Agg.MIN:
+                    data = kernels.scatter_min(
+                        vals, live & c.validity, gid, ng)
+                else:
+                    data = kernels.scatter_max(
+                        vals, live & c.validity, gid, ng)
+                if packed:
+                    data = (data & 0xFFFFFFFF).astype(jnp.int32)
+                valid = nn > 0
+            elif spec.func is Agg.AVG:
+                src_t = src_types[spec.column]
+                if spec.column in int_sum_cols:
+                    s = state(("sum", spec.column,
+                               int_sum_cols[spec.column].name)
+                              ).astype(jnp.float64)
+                else:
+                    s = state(("sum", spec.column, "float64"))
+                if src_t.is_decimal:
+                    s = s / (10.0 ** src_t.scale)
+                data = s / jnp.maximum(nn, 1)
+                valid = nn > 0
+            elif spec.func is Agg.SOME:
+                data = kernels.scatter_first(
+                    c.data, live & c.validity, gid, ng)
+                valid = nn > 0
+            elif spec.func in (Agg.VAR_SAMP, Agg.STDDEV_SAMP):
+                s = state(("vsum", spec.column))
+                q = state(("vsq", spec.column))
+                nf = nn.astype(jnp.float64)
+                var = (q - s * s / jnp.maximum(nf, 1.0)) \
+                    / jnp.maximum(nf - 1.0, 1.0)
+                var = jnp.maximum(var, 0.0)  # fp cancellation
+                data = (jnp.sqrt(var)
+                        if spec.func is Agg.STDDEV_SAMP else var)
+                valid = nn > 1
+            else:
+                raise NotImplementedError(spec.func)
+            new_env[spec.out_name] = Column(data, valid)
+        return new_env, group_live
+
+    def trace_peragg(env, aux, live, gid, ng, kcols):
+        """Reference lowering: one independent scatter/one-hot reduction
+        per aggregate (the pre-fusion path, kept as the A/B baseline —
+        kernels.fused_group_by_enabled() selects at trace time)."""
         # counts accumulate in int32 per block (a block holds < 2^31
         # rows) and widen after: int32 is what the Pallas one-hot
         # reduction supports, so COUNT/AVG-count ride the MXU-friendly
@@ -1114,6 +1329,37 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types):
                 else:
                     raise NotImplementedError(spec.func)
             new_env[spec.out_name] = Column(data, valid)
+        return new_env, group_live
+
+    def lower(env, aux, live):
+        kcols = [env[k] for k in key_names]
+        capacity = next(iter(env.values())).data.shape[0]
+        ng_scalar = None
+        if key_names:
+            if use_dense:
+                gid, ng = kernels.group_ids_dense(kcols, list(b_tuple), live)
+            else:
+                # a block of N rows has at most N groups: default the group
+                # capacity to the block capacity so nothing is ever
+                # silently dropped; an explicit max_groups caps it.
+                ng = (
+                    min(explicit_cap, capacity)
+                    if explicit_cap is not None
+                    else capacity
+                )
+                gid, ng_scalar = kernels.group_ids_sorted(kcols, live, ng)
+                ng_scalar = jnp.minimum(ng_scalar, jnp.int32(ng))
+        else:
+            # global aggregate: one group
+            gid = jnp.where(live, 0, 1).astype(jnp.int32)
+            ng = 1
+
+        if kernels.fused_group_by_enabled():
+            new_env, group_live = trace_fused(
+                env, aux, live, gid, ng, kcols, capacity)
+        else:
+            new_env, group_live = trace_peragg(
+                env, aux, live, gid, ng, kcols)
 
         if key_names and keep_slots:
             # mesh-mergeable layout: every slot stays in place; dead slots

@@ -26,6 +26,9 @@ import os
 
 import jax
 import jax.numpy as jnp
+# jax.enable_x64 was removed from the top-level namespace; the
+# experimental context manager is the stable spelling across versions
+from jax.experimental import enable_x64 as _enable_x64
 
 ROW_TILE = 1024
 MAX_GROUPS = 2048
@@ -48,6 +51,19 @@ def enabled() -> bool:
 def supported(dtype, num_groups: int) -> bool:
     return (jnp.dtype(dtype) in (jnp.float32, jnp.int32)
             and num_groups <= MAX_GROUPS)
+
+
+#: fused multi-column kernel slot cap: the out tile is (groups x slots)
+#: in VMEM next to the (ROW_TILE x groups) one-hot, so slots stay a
+#: single 128-lane tile. Real programs stack well under this (TPC-H Q1
+#: needs 5 int64 + 4 f64 + 6 count slots across all its banks).
+MAX_FUSED_SLOTS = 128
+
+
+def supported_fused(dtype, num_groups: int, n_slots: int) -> bool:
+    """Eligibility of the fused multi-column tile kernel
+    (kernels.fused_group_reduce's >ONEHOT tier)."""
+    return supported(dtype, num_groups) and n_slots <= MAX_FUSED_SLOTS
 
 
 def _pad_rows(a: jax.Array, n: int, fill):
@@ -94,7 +110,7 @@ def grouped_sum(values: jax.Array, gid: jax.Array, num_groups: int,
     # the engine runs with jax_enable_x64; Mosaic cannot legalize the
     # implicit i64 index/constant types that mode introduces, and
     # nothing in this kernel needs 64 bits — trace it in 32-bit mode
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         out = pl.pallas_call(
             kernel,
             grid=(tiles,),
@@ -116,3 +132,63 @@ def scatter_sum_pallas(values, valid_row, gid, num_groups: int,
     idx = jnp.where(valid_row, gid, num_groups)
     return grouped_sum(values.astype(dtype), idx, num_groups,
                        interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
+def grouped_sum_multi(values: jax.Array, gid: jax.Array, num_groups: int,
+                      interpret: bool = False) -> jax.Array:
+    """Fused multi-column grouped sum: (rows x slots) values ->
+    (num_groups x slots) per-group sums in ONE kernel.
+
+    The fused group-by's >ONEHOT_GROUP_LIMIT tier: each row tile expands
+    to a (ROW_TILE x groups) one-hot once and contracts against ALL slot
+    columns with a single MXU dot — where ``grouped_sum`` would run the
+    expansion once per aggregate. Rows with gid >= num_groups drop.
+    """
+    from jax.experimental import pallas as pl
+
+    k_pad = max(128, -(-num_groups // 128) * 128)
+    n_slots = values.shape[1]
+    s_pad = max(128, -(-n_slots // 128) * 128)
+    vals = _pad_rows(values, ROW_TILE, 0)
+    if s_pad != n_slots:
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((vals.shape[0], s_pad - n_slots),
+                             dtype=vals.dtype)], axis=1)
+    gids = _pad_rows(gid.astype(jnp.int32), ROW_TILE, k_pad)
+    tiles = vals.shape[0] // ROW_TILE
+    vals3 = vals.reshape(tiles, ROW_TILE, s_pad)
+    gids3 = gids.reshape(tiles, ROW_TILE, 1)
+
+    def kernel(gid_ref, val_ref, out_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            out_ref[:, :] = jnp.zeros_like(out_ref)
+
+        g = gid_ref[0, :, :]          # (ROW_TILE, 1)
+        v = val_ref[0, :, :]          # (ROW_TILE, s_pad)
+        groups = jax.lax.broadcasted_iota(
+            jnp.int32, (ROW_TILE, k_pad), 1)
+        onehot = (g == groups).astype(val_ref.dtype)
+        # (ROW_TILE, k_pad)^T contracted with (ROW_TILE, s_pad) on the
+        # row axis -> (k_pad, s_pad): one MXU pass covers every slot
+        out_ref[:, :] += jax.lax.dot_general(
+            onehot, v, (((0,), (0,)), ((), ())),
+            preferred_element_type=out_ref.dtype)
+
+    # 32-bit trace for the same Mosaic i64 reason as grouped_sum
+    with _enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(tiles,),
+            in_specs=[
+                pl.BlockSpec((1, ROW_TILE, 1), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, ROW_TILE, s_pad), lambda i: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((k_pad, s_pad), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((k_pad, s_pad), values.dtype),
+            interpret=interpret,
+        )(gids3, vals3)
+    return out[:num_groups, :n_slots]
