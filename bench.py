@@ -36,6 +36,15 @@ fused/peragg_q1_rows_per_sec + fused_speedup). Engine-tier runs also
 report per-stage scan seconds (engine_q{1,6}_stage_seconds:
 read/merge/stage/compute) from the streaming reader's StageTimer.
 Phase progress logs to stderr; stdout stays the one JSON line.
+
+Robustness: each tier's results checkpoint to disk as the tier
+completes (YDB_TPU_BENCH_CHECKPOINT, default BENCH_checkpoint.json;
+empty disables) so a wedged tunnel late in a run degrades to
+"completed tiers + fresh CPU" instead of losing everything. The CPU
+baseline is the MEDIAN of >= 5 runs with the coefficient of variation
+reported (cpu_q{1,6}_cv); cv > 0.3 marks the final
+``vs_baseline_untrusted`` flag — absolute rates stand, the ratio
+doesn't.
 """
 
 import json
@@ -97,6 +106,32 @@ def probe_backend() -> str | None:
 
 def _budget_left(budget: float) -> float:
     return budget - (time.perf_counter() - _T0)
+
+
+_CKPT_TIERS: list = []
+
+
+def _checkpoint(tier: str, extra: dict) -> None:
+    """Persist completed-tier results to disk as each tier finishes
+    (atomic tmp+rename). A wedged TPU tunnel at round end then degrades
+    to "completed tiers on disk + fresh CPU rerun" instead of losing
+    the whole run (VERDICT next-round #1). Path:
+    YDB_TPU_BENCH_CHECKPOINT (default BENCH_checkpoint.json; empty/0
+    disables). Best-effort: checkpoint IO must never kill the bench."""
+    path = os.environ.get("YDB_TPU_BENCH_CHECKPOINT",
+                          "BENCH_checkpoint.json")
+    if path in ("", "0", "off"):
+        return
+    _CKPT_TIERS.append(tier)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"completed_tiers": list(_CKPT_TIERS),
+                       "elapsed_s": round(time.perf_counter() - _T0, 1),
+                       "extra": extra}, f, indent=2, default=str)
+        os.replace(tmp, path)
+    except OSError as e:
+        _log(f"checkpoint write failed (ignored): {e}")
 
 
 class _SqlProbeTooSlow(Exception):
@@ -396,7 +431,10 @@ def main():
     if tpu_unavailable:
         extra["tpu_unavailable"] = True
 
-    # ---- CPU baseline: averaged over >= 5 runs (VERDICT r3 weak #3) ----
+    # ---- CPU baseline: median of >= 5 runs + dispersion (VERDICT r3
+    # weak #3, r5 weak #4): the median resists the one slow outlier a
+    # noisy host throws in, and the coefficient of variation is
+    # reported so a jittery baseline marks vs_baseline untrusted ----
     _log("CPU baselines")
     cutoff = tpch._days("1998-12-01") - 90
     d0, d1 = tpch._days("1994-01-01"), tpch._days("1995-01-01")
@@ -406,17 +444,20 @@ def main():
         t0 = time.perf_counter()
         base1, _, nls = cpu_q1(li, cutoff)
         ts.append(time.perf_counter() - t0)
-    cpu_q1_s = float(np.mean(ts))
+    cpu_q1_s = float(np.median(ts))
+    cpu_q1_cv = float(np.std(ts) / np.mean(ts))
     extra["cpu_q1_rows_per_sec"] = round(n_rows / cpu_q1_s)
     extra["cpu_q1_runs"] = n_base
-    extra["cpu_q1_cv"] = round(float(np.std(ts) / np.mean(ts)), 3)
+    extra["cpu_q1_cv"] = round(cpu_q1_cv, 3)
     ts = []
     for _ in range(n_base):
         t0 = time.perf_counter()
         base6 = cpu_q6(li, d0, d1)
         ts.append(time.perf_counter() - t0)
-    cpu_q6_s = float(np.mean(ts))
+    cpu_q6_s = float(np.median(ts))
     extra["cpu_q6_rows_per_sec"] = round(n_rows / cpu_q6_s)
+    extra["cpu_q6_cv"] = round(float(np.std(ts) / np.mean(ts)), 3)
+    _checkpoint("cpu_baseline", extra)
 
     # ---- kernel tier: HBM-resident blocks -> compiled program ----
     _log("kernel tier: ingest + compile")
@@ -453,6 +494,7 @@ def main():
                    for b in blocks for nm, c in b.columns.items()
                    if nm in ex1.read_cols)
     extra["kernel_hbm_gb_per_sec"] = round(q1_bytes / warm1 / 1e9, 1)
+    _checkpoint("kernel", extra)
 
     skipped = extra.setdefault("skipped", [])
 
@@ -465,6 +507,7 @@ def main():
         _log("fused group-by A/B")
         extra.update(fused_ab(src, blocks, n_rows, block_rows,
                               max(2, iters // 2)))
+        _checkpoint("fused_ab", extra)
     elif fused_enabled:
         skipped.append("fused_ab:budget")
 
@@ -477,6 +520,7 @@ def main():
         _log("pallas A/B")
         extra.update(pallas_ab(src, blocks, n_rows, block_rows,
                                max(2, iters // 2)))
+        _checkpoint("pallas_ab", extra)
     elif ab_enabled:
         skipped.append("pallas_ab:budget")
     del blocks
@@ -558,6 +602,7 @@ def main():
             extra["engine_q1_stage_seconds"] = dict(
                 shard.last_scan_stages)
             engine_warm_rps = round(e_rows / ewarm1)
+            _checkpoint("engine_q1", extra)
             if _budget_left(budget) < 45:
                 raise _BudgetSpent("engine_q6,sql_tier:budget")
             ecold6, ewarm6, eout6 = timed_cold_warm(
@@ -567,6 +612,7 @@ def main():
             extra["engine_q6_warm_rows_per_sec"] = round(e_rows / ewarm6)
             extra["engine_q6_stage_seconds"] = dict(
                 shard.last_scan_stages)
+            _checkpoint("engine_q6", extra)
 
             # ---- sql tier: parse -> plan -> execute over the store ----
             if _budget_left(budget) < 60:
@@ -648,6 +694,7 @@ def main():
                 run_sql(TPCH["q6"]), db_iters, deadline)
             assert int(np.asarray(sout6.cols["revenue"][0])[0]) == ebase6
             extra["sql_q6_warm_rows_per_sec"] = round(e_rows / swarm6)
+            _checkpoint("sql", extra)
     except _SqlProbeTooSlow as e:
         # the engine tier SUCCEEDED; only the SQL tier is skipped
         skipped.append(f"sql_tier:{e}")
@@ -659,25 +706,37 @@ def main():
         extra["engine_tier_error"] = repr(e)[-400:]
     try:
         run_ooc(extra, iters, block_rows)
+        if "ooc" in extra:
+            _checkpoint("ooc", extra)
     except Exception as e:  # noqa: BLE001 - OOC is additive evidence
         extra.setdefault("ooc", {})["error"] = repr(e)[-400:]
     _log("done")
 
     extra["baseline"] = ("vectorized numpy single-pass (mask+bincount), "
-                         f"same host, mean of {n_base} runs; rates are "
-                         "per-row so cross-SF comparable")
+                         f"same host, median of {n_base} runs; rates "
+                         "are per-row so cross-SF comparable")
     # label the metric with the SF it was actually measured at: the
     # engine tier runs at engine_sf; if it failed/was skipped the value
     # falls back to the kernel tier at sf
     metric_sf = engine_sf if "engine_q1_warm_rows_per_sec" in extra \
         else sf
-    print(json.dumps({
+    report = {
         "metric": f"tpch_q1_sf{metric_sf:g}_engine_rows_per_sec",
         "value": engine_warm_rps,
         "unit": "rows/s",
         "vs_baseline": round(engine_warm_rps / (n_rows / cpu_q1_s), 3),
         "extra": extra,
-    }))
+    }
+    if cpu_q1_cv > 0.3:
+        # the CPU baseline scattered too much for its median to anchor
+        # a ratio (shared/noisy host): the absolute rows/s numbers
+        # stand, the comparison does not (VERDICT r5 weak #4)
+        report["vs_baseline_untrusted"] = True
+        report["vs_baseline_untrusted_reason"] = (
+            f"cpu baseline cv={cpu_q1_cv:.3f} > 0.3 over "
+            f"{n_base} runs")
+    _checkpoint("final", extra)
+    print(json.dumps(report))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,545 @@
+"""Concurrency-discipline analyzer: rule unit tests + the tier-1
+enforcement that the whole ydb_tpu tree runs clean under C001-C008
+(mirrors test_lint_clean.py — a new lock-discipline violation fails CI
+until fixed or explicitly suppressed with a justification)."""
+
+import subprocess
+from pathlib import Path
+
+from ydb_tpu.analysis.concurrency import (
+    RULES,
+    check_paths,
+    check_source,
+    main,
+)
+from ydb_tpu.analysis.paths import collect_files
+
+PKG = Path(__file__).resolve().parents[1] / "ydb_tpu"
+
+
+def codes(src: str) -> list:
+    return [f.code for f in check_source(src, "t.py")]
+
+
+# ---------------- enforcement ----------------
+
+
+def test_repo_runs_clean():
+    findings = check_paths(collect_files([PKG]))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_code_clean_and_dirty(tmp_path, capsys):
+    assert main([str(PKG)]) == 0
+    bad = tmp_path / "bad.py"
+    bad.write_text("_cache = {}\n"
+                   "def put(k, v):\n"
+                   "    _cache[k] = v\n")
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "C005" in out
+
+
+def test_json_report(tmp_path, capsys):
+    import json
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("_cache = {}\n"
+                   "def put(k, v):\n"
+                   "    _cache[k] = v\n")
+    assert main([str(bad), "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep[0]["code"] == "C005"
+    assert rep[0]["line"] == 3
+
+
+# ---------------- C001 guard-inconsistency ----------------
+
+
+def test_c001_attr_written_under_and_outside_lock():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._cache = {}\n"
+           "    def put(self, k, v):\n"
+           "        with self._lock:\n"
+           "            self._cache[k] = v\n"
+           "    def evict(self, k):\n"
+           "        self._cache.pop(k, None)\n")
+    assert "C001" in codes(src)
+
+
+def test_c001_init_writes_exempt():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._cache = {}\n"
+           "        self._cache['seed'] = 1\n"
+           "    def put(self, k, v):\n"
+           "        with self._lock:\n"
+           "            self._cache[k] = v\n")
+    assert codes(src) == []
+
+
+def test_c001_interprocedural_guard_through_private_helper():
+    # a private helper called only under the lock inherits the guard
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._m = {}\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            self._put()\n"
+           "    def _put(self):\n"
+           "        self._m['a'] = 1\n"
+           "    def g(self):\n"
+           "        with self._lock:\n"
+           "            self._m.pop('a', None)\n")
+    assert codes(src) == []
+
+
+def test_c001_helper_also_called_unlocked_is_flagged():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._m = {}\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            self._put()\n"
+           "    def g(self):\n"
+           "        self._put()\n"
+           "    def _put(self):\n"
+           "        self._m['a'] = 1\n")
+    assert "C001" in codes(src)
+
+
+def test_c001_condition_aliases_its_wrapped_lock():
+    # Condition(self._lock): with either guards the same lock
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._freed = threading.Condition(self._lock)\n"
+           "        self._n = {}\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self._n['x'] = 1\n"
+           "    def b(self):\n"
+           "        with self._freed:\n"
+           "            self._n.pop('x', None)\n")
+    assert codes(src) == []
+
+
+# ---------------- C002 lock ordering ----------------
+
+
+def test_c002_two_lock_cycle():
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.l1 = threading.Lock()\n"
+           "        self.l2 = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self.l1:\n"
+           "            with self.l2:\n"
+           "                pass\n"
+           "    def g(self):\n"
+           "        with self.l2:\n"
+           "            with self.l1:\n"
+           "                pass\n")
+    assert "C002" in codes(src)
+
+
+def test_c002_consistent_order_clean():
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.l1 = threading.Lock()\n"
+           "        self.l2 = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self.l1:\n"
+           "            with self.l2:\n"
+           "                pass\n"
+           "    def g(self):\n"
+           "        with self.l1:\n"
+           "            with self.l2:\n"
+           "                pass\n")
+    assert codes(src) == []
+
+
+def test_c002_nonreentrant_self_deadlock():
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.l1 = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self.l1:\n"
+           "            with self.l1:\n"
+           "                pass\n")
+    assert "C002" in codes(src)
+
+
+def test_c002_rlock_reentry_ok():
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.l1 = threading.RLock()\n"
+           "    def f(self):\n"
+           "        with self.l1:\n"
+           "            with self.l1:\n"
+           "                pass\n")
+    assert codes(src) == []
+
+
+def test_c002_cross_class_cycle_via_typed_attrs():
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.lock = threading.Lock()\n"
+           "        self.b = B()\n"
+           "    def f(self):\n"
+           "        with self.lock:\n"
+           "            self.b.g()\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self.lock = threading.Lock()\n"
+           "        self.a = A()\n"
+           "    def g(self):\n"
+           "        with self.lock:\n"
+           "            pass\n"
+           "    def h(self):\n"
+           "        with self.lock:\n"
+           "            self.a.f()\n")
+    assert "C002" in codes(src)
+
+
+# ---------------- C003 blocking under lock ----------------
+
+
+def test_c003_sleep_under_lock():
+    src = ("import threading, time\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            time.sleep(1.0)\n")
+    assert "C003" in codes(src)
+
+
+def test_c003_queue_get_untimed_under_lock():
+    src = ("import threading, queue\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._q = queue.Queue()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            return self._q.get()\n")
+    assert "C003" in codes(src)
+
+
+def test_c003_timed_get_ok():
+    src = ("import threading, queue\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._q = queue.Queue()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            return self._q.get(timeout=0.1)\n")
+    assert codes(src) == []
+
+
+def test_c003_own_condition_wait_ok_foreign_lock_flagged():
+    # waiting on your own condition releases it — fine; holding a
+    # SECOND lock across the wait is the deadlock shape
+    ok = ("import threading\n"
+          "class C:\n"
+          "    def __init__(self):\n"
+          "        self._cv = threading.Condition()\n"
+          "    def f(self):\n"
+          "        with self._cv:\n"
+          "            self._cv.wait()\n")
+    assert codes(ok) == []
+    bad = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._cv = threading.Condition()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            with self._cv:\n"
+           "                self._cv.wait()\n")
+    assert "C003" in codes(bad)
+
+
+def test_c003_own_condition_wait_via_helper_ok():
+    # the helper waits on the condition the CALLER holds: wait()
+    # releases it, so the propagated finding would be a false positive
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._cv = threading.Condition()\n"
+           "    def f(self):\n"
+           "        with self._cv:\n"
+           "            self._park()\n"
+           "    def _park(self):\n"
+           "        self._cv.wait()\n")
+    assert codes(src) == []
+
+
+def test_c002_module_rlock_reentry_ok():
+    src = ("import threading\n"
+           "_L = threading.RLock()\n"
+           "class A:\n"
+           "    def f(self):\n"
+           "        with _L:\n"
+           "            with _L:\n"
+           "                pass\n")
+    assert codes(src) == []
+
+
+def test_c002_module_plain_lock_reentry_flagged():
+    src = ("import threading\n"
+           "_L = threading.Lock()\n"
+           "class A:\n"
+           "    def f(self):\n"
+           "        with _L:\n"
+           "            with _L:\n"
+           "                pass\n")
+    assert "C002" in codes(src)
+
+
+def test_c003_interprocedural_through_helper():
+    src = ("import threading, time\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            self._slow()\n"
+           "    def _slow(self):\n"
+           "        time.sleep(0.5)\n")
+    assert "C003" in codes(src)
+
+
+# ---------------- C004 orphan daemon threads ----------------
+
+
+def test_c004_daemon_without_stop_path():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._t = threading.Thread(target=self._run,\n"
+           "                                   daemon=True)\n"
+           "        self._t.start()\n"
+           "    def _run(self):\n"
+           "        pass\n")
+    assert "C004" in codes(src)
+
+
+def test_c004_stop_method_clears():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._stop = threading.Event()\n"
+           "        self._t = threading.Thread(target=self._run,\n"
+           "                                   daemon=True)\n"
+           "        self._t.start()\n"
+           "    def _run(self):\n"
+           "        pass\n"
+           "    def stop(self):\n"
+           "        self._stop.set()\n"
+           "        self._t.join(timeout=5)\n")
+    assert codes(src) == []
+
+
+def test_c004_fire_and_forget_spawn():
+    src = ("import threading\n"
+           "def go(fn):\n"
+           "    threading.Thread(target=fn, daemon=True).start()\n")
+    assert "C004" in codes(src)
+
+
+# ---------------- C005 module globals ----------------
+
+
+def test_c005_unlocked_module_container_write():
+    assert "C005" in codes("_cache = {}\n"
+                           "def put(k, v):\n"
+                           "    _cache[k] = v\n")
+
+
+def test_c005_locked_write_ok():
+    src = ("import threading\n"
+           "_cache = {}\n"
+           "_lock = threading.Lock()\n"
+           "def put(k, v):\n"
+           "    with _lock:\n"
+           "        _cache[k] = v\n")
+    assert codes(src) == []
+
+
+def test_c005_global_singleton_reassign():
+    src = ("_inst = None\n"
+           "def get():\n"
+           "    global _inst\n"
+           "    if _inst is None:\n"
+           "        _inst = object()\n"
+           "    return _inst\n")
+    assert "C005" in codes(src)
+
+
+# ---------------- C006 per-call locks ----------------
+
+
+def test_c006_lock_per_call():
+    src = ("import threading\n"
+           "def f():\n"
+           "    lock = threading.Lock()\n"
+           "    with lock:\n"
+           "        return 1\n")
+    assert "C006" in codes(src)
+
+
+def test_c006_factory_returning_lock_ok():
+    src = ("import threading\n"
+           "def make():\n"
+           "    lock = threading.Lock()\n"
+           "    return lock\n")
+    assert codes(src) == []
+
+
+def test_c006_lazy_self_lock_outside_init():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def ensure(self):\n"
+           "        self._lock = threading.Lock()\n")
+    assert "C006" in codes(src)
+
+
+# ---------------- C007 notify without lock ----------------
+
+
+def test_c007_notify_outside_with():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._cv = threading.Condition()\n"
+           "    def kick(self):\n"
+           "        self._cv.notify_all()\n")
+    assert "C007" in codes(src)
+
+
+def test_c007_notify_inside_with_ok():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._cv = threading.Condition()\n"
+           "    def kick(self):\n"
+           "        with self._cv:\n"
+           "            self._cv.notify_all()\n")
+    assert codes(src) == []
+
+
+# ---------------- C008 late-binding closures ----------------
+
+
+def test_c008_lambda_captures_loop_var():
+    src = ("def go(pool, items):\n"
+           "    for x in items:\n"
+           "        pool.submit(lambda: work(x))\n")
+    assert "C008" in codes(src)
+
+
+def test_c008_default_binding_ok():
+    src = ("def go(pool, items):\n"
+           "    for x in items:\n"
+           "        pool.submit(lambda x=x: work(x))\n")
+    assert codes(src) == []
+
+
+def test_c008_bound_method_eager_ok():
+    # conveyor.submit('compaction', s.maybe_compact): binds eagerly
+    src = ("def go(pool, shards):\n"
+           "    for s in shards:\n"
+           "        pool.submit('compaction', s.maybe_compact)\n")
+    assert codes(src) == []
+
+
+# ---------------- suppression ----------------
+
+
+def test_suppression_same_line_and_name_alias():
+    src = ("_cache = {}\n"
+           "def put(k, v):\n"
+           "    _cache[k] = v  # ydb-lint: disable=C005\n")
+    assert codes(src) == []
+    src = ("_cache = {}\n"
+           "def put(k, v):\n"
+           "    # ydb-lint: disable=unlocked-module-global\n"
+           "    _cache[k] = v\n")
+    assert codes(src) == []
+
+
+def test_suppression_is_per_rule():
+    src = ("_cache = {}\n"
+           "def put(k, v):\n"
+           "    _cache[k] = v  # ydb-lint: disable=C001\n")
+    assert "C005" in codes(src)
+
+
+def test_skip_file():
+    src = ("# ydb-lint: skip-file\n"
+           "_cache = {}\n"
+           "def put(k, v):\n"
+           "    _cache[k] = v\n")
+    assert codes(src) == []
+
+
+# ---------------- shared --changed path collection ----------------
+
+
+def _git(tmp, *args):
+    subprocess.run(
+        ("git", "-c", "user.email=t@t", "-c", "user.name=t") + args,
+        cwd=tmp, check=True, capture_output=True)
+
+
+def test_changed_scopes_to_touched_files(tmp_path):
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "a.py").write_text("def f(x=[]):\n    return x\n")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # clean tree: nothing in scope
+    assert collect_files([tmp_path], changed=True) == []
+    # an untracked file and a modified file both land in scope
+    (tmp_path / "b.py").write_text("_c = {}\ndef g(v):\n    _c[1] = v\n")
+    files = collect_files([tmp_path], changed=True)
+    assert [f.name for f in files] == ["b.py"]
+    # both CLIs honor the scope (lint shares the path collection)
+    from ydb_tpu.analysis.lint import main as lint_main
+
+    assert main([str(tmp_path), "--changed"]) == 1  # C005 in b.py
+    assert lint_main([str(tmp_path), "--changed"]) == 0  # b.py L-clean
+
+
+def test_changed_degrades_to_full_scan_outside_git(tmp_path):
+    sub = tmp_path / "not_a_repo"
+    sub.mkdir()
+    (sub / "a.py").write_text("x = 1\n")
+    files = collect_files([sub], changed=True)
+    assert [f.name for f in files] == ["a.py"]
+
+
+# ---------------- stability ----------------
+
+
+def test_rule_table_is_stable():
+    assert set(RULES) == {"C001", "C002", "C003", "C004", "C005",
+                          "C006", "C007", "C008"}

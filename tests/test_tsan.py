@@ -1,0 +1,322 @@
+"""Runtime thread-sanitizer: self-tests + seeded multi-thread stress.
+
+The stress suites hammer the DESIGNATED shared structures (scan cache,
+device block cache, conveyor heap, probe/counter registries) with the
+sanitizer active, so tier-1 runs double as a race detector: a dropped
+lock in any of those paths turns these tests red with a RaceError
+naming the structure. The self-tests prove the detector actually fires
+— including on the exact PR 3 scan-cache shape with its lock removed.
+"""
+
+import contextlib
+import threading
+
+import numpy as np
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.analysis import sanitizer
+from ydb_tpu.engine.blobs import MemBlobStore
+from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+from ydb_tpu.ssa import Agg, AggSpec, Call, Col, FilterStep, GroupByStep, Op
+from ydb_tpu.ssa.program import Program, lit
+
+SCHEMA = dtypes.schema(("a", dtypes.INT64, False), ("b", dtypes.INT64))
+
+
+def _run_threads(fns, timeout=30.0):
+    """Run thunks on threads; re-raise the first exception."""
+    errors: list = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(fn,)) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    if errors:
+        raise errors[0]
+
+
+# ---------------- detector self-tests ----------------
+
+
+def test_racy_toy_class_is_flagged():
+    """The injected unguarded-mutation race: two threads write a shared
+    dict with no lock — the sanitizer must raise, deterministically."""
+    with sanitizer.activate():
+        shared = sanitizer.share({}, "toy.racy")
+
+        def writer():
+            shared["w"] = 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join()
+        with pytest.raises(sanitizer.RaceError) as exc:
+            shared["main"] = 2
+        assert "toy.racy" in str(exc.value)
+
+
+def test_guarded_class_is_clean():
+    with sanitizer.activate():
+        lock = sanitizer.make_lock("toy.lock")
+        shared = sanitizer.share({}, "toy.guarded")
+
+        def writer():
+            for i in range(50):
+                with lock:
+                    shared[i] = i
+
+        _run_threads([writer] * 4)
+        with lock:
+            assert len(shared) == 50
+
+
+def test_single_thread_init_phase_never_flags():
+    # exclusive-phase accesses (construction) are unchecked by design
+    with sanitizer.activate():
+        shared = sanitizer.share({}, "toy.init")
+        for i in range(100):
+            shared[i] = i
+        assert len(shared) == 100
+
+
+def test_read_sharing_without_writes_is_clean():
+    with sanitizer.activate():
+        shared = sanitizer.share({"k": 1}, "toy.readshare")
+
+        def reader():
+            for _ in range(100):
+                assert shared.get("k") == 1
+
+        _run_threads([reader] * 4)
+
+
+def test_tracked_lock_held_set_and_condition_roundtrip():
+    with sanitizer.activate():
+        cv = sanitizer.make_condition("toy.cv")
+        assert sanitizer.held_locks() == frozenset()
+        with cv:
+            assert "toy.cv" in sanitizer.held_locks()
+        assert sanitizer.held_locks() == frozenset()
+
+        fired = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=10.0)
+                fired.append(sanitizer.held_locks())
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+
+        time.sleep(0.05)
+        with cv:
+            cv.notify_all()
+        t.join(timeout=10)
+        # after wait() returns the condition's lock is re-held
+        assert fired and "toy.cv" in fired[0]
+
+
+def test_tracked_condition_is_reentrant_like_plain_condition():
+    # threading.Condition() is RLock-backed; the sanitized variant must
+    # not deadlock on a re-entered ``with cv:`` only under TSAN
+    with sanitizer.activate():
+        cv = sanitizer.make_condition("toy.recv")
+        with cv:
+            with cv:
+                assert "toy.recv" in sanitizer.held_locks()
+        assert sanitizer.held_locks() == frozenset()
+
+
+def test_activate_epochs_reset_long_lived_proxy_state():
+    # a proxy created in epoch 1 and raced across threads must come
+    # back clean in epoch 2 (states reset in place, not orphaned)
+    with sanitizer.activate():
+        shared = sanitizer.share({}, "toy.epoch")
+
+        def writer():
+            with pytest.raises(sanitizer.RaceError):
+                for _ in range(2):
+                    shared["w"] = 1
+
+        shared["main"] = 0
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join()
+    with sanitizer.activate():
+        # fresh epoch: single-threaded writes on the SAME proxy are the
+        # exclusive init phase again — no stale lockset survives
+        for i in range(5):
+            shared[i] = i
+
+
+def test_tsan_off_is_zero_overhead_passthrough(monkeypatch):
+    monkeypatch.delenv("YDB_TPU_TSAN", raising=False)
+    raw = {}
+    assert sanitizer.share(raw, "toy.off") is raw
+    assert isinstance(sanitizer.make_lock("x"), type(threading.Lock()))
+    assert sanitizer.token("toy.off") is None
+    sanitizer.note(None, "nothing")  # no-op on a None token
+
+
+# ---------------- PR 3 scan-cache LRU race regression ----------------
+
+
+def _mk_shard(entries=2):
+    shard = ColumnShard(
+        "tsan", SCHEMA, MemBlobStore(),
+        config=ShardConfig(compact_portion_threshold=10 ** 9,
+                           scan_block_rows=64,
+                           scan_cache_entries=entries))
+    rng = np.random.default_rng(7)
+    shard.commit([shard.write({
+        "a": rng.integers(0, 8, 300).astype(np.int64),
+        "b": rng.integers(0, 100, 300).astype(np.int64)})])
+    return shard
+
+
+def _prog(threshold):
+    return Program((
+        FilterStep(Call(Op.GE, Col("a"), lit(threshold))),
+        GroupByStep(("a",), (AggSpec(Agg.COUNT_ALL, None, "n"),)),
+    ))
+
+
+def test_scan_cache_stress_under_sanitizer():
+    """Concurrent scans hammer ColumnShard._scan_cache with
+    scan_cache_entries=2 (constant touch/evict churn — the PR 3 race
+    surface) under the sanitizer proxies: the guarded implementation
+    must survive with zero findings and correct results."""
+    with sanitizer.activate():
+        shard = _mk_shard(entries=2)
+        expect = {t: int(shard.scan(_prog(t)).cols["n"][0].sum())
+                  for t in range(4)}
+
+        def scanner(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(12):
+                t = int(rng.integers(0, 4))
+                out = shard.scan(_prog(t))
+                assert int(out.cols["n"][0].sum()) == expect[t]
+
+        _run_threads([lambda s=s: scanner(s) for s in range(4)],
+                     timeout=120.0)
+        # even the assertion must respect the guard: an unlocked len()
+        # here is itself a cross-thread access the proxy flags
+        with shard._scan_cache_lock:
+            assert len(shard._scan_cache) <= 2
+
+
+def test_scan_cache_without_lock_is_caught():
+    """Remove the scan-cache lock (reintroducing the pre-PR 3 bug) and
+    the sanitizer must flag the unsynchronized LRU mutation."""
+    with sanitizer.activate():
+        shard = _mk_shard(entries=2)
+        # simulate the unguarded implementation: the with-statement
+        # still runs, but no lock is actually taken
+        shard._scan_cache_lock = contextlib.nullcontext()
+        shard.scan(_prog(0))  # populate from this thread
+
+        def other():
+            shard.scan(_prog(1))
+
+        with pytest.raises(sanitizer.RaceError) as exc:
+            _run_threads([other])
+        assert "_scan_cache" in str(exc.value)
+
+
+def test_concurrent_commits_mint_unique_snapshots():
+    """commit() allocates its snapshot inside _commit's critical
+    section: concurrent committers must never share a snapshot id
+    (the TOCTOU `self.snap + 1` read this PR closed)."""
+    with sanitizer.activate():
+        shard = _mk_shard()
+        snaps: list = []
+
+        def committer(base):
+            for i in range(5):
+                wid = shard.write({
+                    "a": np.asarray([base + i], dtype=np.int64),
+                    "b": np.asarray([i], dtype=np.int64)})
+                snaps.append(shard.commit([wid]))
+
+        _run_threads([lambda b=b: committer(b * 100) for b in range(4)])
+        assert len(snaps) == 20
+        assert len(set(snaps)) == 20, sorted(snaps)
+
+
+# ---------------- designated-structure stress ----------------
+
+
+def test_conveyor_stress_under_sanitizer():
+    from ydb_tpu.runtime.conveyor import Conveyor, ResourceBroker
+
+    with sanitizer.activate():
+        conveyor = Conveyor(
+            workers=3, broker=ResourceBroker(quotas={"q": 2}))
+        try:
+            handles = []
+
+            def submitter(base):
+                for i in range(20):
+                    handles.append(conveyor.submit(
+                        "q", lambda v=base * 100 + i: v * 2))
+
+            _run_threads([lambda b=b: submitter(b) for b in range(3)])
+            got = sorted(h.wait(30.0) for h in list(handles))
+            assert len(got) == 60
+        finally:
+            conveyor.shutdown()
+
+
+def test_blockcache_stress_under_sanitizer():
+    from ydb_tpu.engine.blockcache import DeviceBlockCache
+
+    class _Col:
+        data = np.zeros(16, dtype=np.int64)
+        validity = np.ones(16, dtype=bool)
+
+    class _Blk:
+        columns = {"c": _Col()}
+
+    with sanitizer.activate():
+        cache = DeviceBlockCache(budget=1 << 20)
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(40):
+                key = ("k", int(rng.integers(0, 6)))
+                got = cache.get(key)
+                if got is None:
+                    list(cache.stream(key, lambda: iter([_Blk()])))
+
+        _run_threads([lambda s=s: worker(s) for s in range(4)])
+        assert cache.hits + cache.misses > 0
+
+
+def test_probe_and_counter_registries_under_sanitizer():
+    from ydb_tpu.obs import probes
+    from ydb_tpu.obs.counters import CounterGroup
+
+    with sanitizer.activate():
+        root = CounterGroup()
+
+        def worker(seed):
+            for i in range(30):
+                probes.probe(f"tsan.stress.{seed}.{i % 5}")
+                g = root.group(worker=str(seed % 2))
+                g.counter(f"c{i % 3}").inc()
+                g.histogram("h").observe(0.001 * i)
+
+        _run_threads([lambda s=s: worker(s) for s in range(4)])
+        snap = root.snapshot()
+        assert sum(v for k, v in snap.items()
+                   if k.startswith("c")) == 4 * 30

@@ -1,6 +1,6 @@
-"""Static analysis: SSA program verification + trace-safety lint.
+"""Static analysis: SSA verification + trace-safety lint + concurrency.
 
-Two pillars (README.md in this directory):
+Three pillars (README.md in this directory):
   * ``verify`` — the typed SSA program checker every SQL→SSA lowering
     passes through before any JAX trace (the TProgramContainer::Init
     analog, ydb/core/tx/program/program.cpp:553).
@@ -8,6 +8,16 @@ Two pillars (README.md in this directory):
     patterns (host syncs, Python control flow on traced values,
     wall-clock/randomness inside traces, mutable defaults,
     nondeterministic set iteration). ``python -m ydb_tpu.analysis.lint``.
+  * ``concurrency`` + ``sanitizer`` — lock/guard discipline over the
+    threaded runtime (C001-C008: guard inconsistency, lock-order
+    cycles, blocking under locks, orphan daemon threads, ...) plus an
+    Eraser-style runtime race detector for the designated shared
+    structures (``YDB_TPU_TSAN=1``).
+    ``python -m ydb_tpu.analysis.concurrency``.
+
+``sanitizer`` keeps a bare dependency set (os + threading) so the
+low-level runtime modules (conveyor, probes, counters, blockcache)
+can import it safely: ``from ydb_tpu.analysis import sanitizer``.
 """
 
 from ydb_tpu.analysis.diagnostics import (  # noqa: F401

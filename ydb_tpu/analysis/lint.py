@@ -33,11 +33,15 @@ apply everywhere.
 Suppression: append ``# ydb-lint: disable=L001`` (or the rule name;
 comma-separate several; ``all`` kills every rule) to the offending
 line, or place it alone on the line above. ``# ydb-lint: skip-file``
-within the first ten lines skips the file.
+within the first ten lines skips the file. (Shared machinery:
+``analysis/suppress.py`` — the concurrency checker's C-rules use the
+same syntax.)
 
-Run: ``python -m ydb_tpu.analysis.lint [path ...] [--json]``
+Run: ``python -m ydb_tpu.analysis.lint [path ...] [--json] [--changed]``
 (default path: the ydb_tpu package). Exit code 1 on any unsuppressed
-finding; ``--json`` emits a machine-readable report.
+finding; ``--json`` emits a machine-readable report; ``--changed``
+scopes the scan to git-touched files (pre-commit fast path, shared
+with the concurrency CLI via ``analysis/paths.py``).
 """
 
 from __future__ import annotations
@@ -45,9 +49,10 @@ from __future__ import annotations
 import ast
 import dataclasses
 import json
-import re
 import sys
-from pathlib import Path
+
+from ydb_tpu.analysis.paths import collect_files, parse_cli
+from ydb_tpu.analysis.suppress import file_skipped, filter_suppressed
 
 RULES = {
     "L001": "host-sync-in-trace",
@@ -57,8 +62,6 @@ RULES = {
     "L005": "mutable-default-arg",
     "L006": "set-iteration-order",
 }
-_NAME_TO_CODE = {v: k for k, v in RULES.items()}
-
 _TRACE_ROOTS = ("jnp.", "jax.lax.", "jax.nn.", "jax.scipy.")
 _CLOCK_CALLS = {
     "time.time", "time.monotonic", "time.perf_counter",
@@ -79,10 +82,6 @@ _STATIC_JNP = {
     "jnp.issubdtype", "jnp.iinfo", "jnp.finfo", "jnp.result_type",
     "jnp.dtype", "jnp.shape", "jnp.ndim",
 }
-
-_SUPPRESS_RE = re.compile(r"#\s*ydb-lint:\s*disable=([\w\-,]+)")
-_SKIP_FILE_RE = re.compile(r"#\s*ydb-lint:\s*skip-file")
-
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -298,31 +297,12 @@ class _ModuleChecker(ast.NodeVisitor):
     visit_GeneratorExp = visit_ListComp
 
 
-def _suppressed_codes(line: str) -> set:
-    m = _SUPPRESS_RE.search(line)
-    if not m:
-        return set()
-    out = set()
-    for tok in m.group(1).split(","):
-        tok = tok.strip()
-        if not tok:
-            continue
-        if tok.lower() == "all":
-            out.update(RULES)
-        elif tok.upper() in RULES:
-            out.add(tok.upper())
-        elif tok.lower() in _NAME_TO_CODE:
-            out.add(_NAME_TO_CODE[tok.lower()])
-    return out
-
-
 def lint_source(src: str, filename: str = "<string>") -> list:
     """Lint one source text; returns unsuppressed findings sorted by
     position."""
     lines = src.splitlines()
-    for ln in lines[:10]:
-        if _SKIP_FILE_RE.search(ln):
-            return []
+    if file_skipped(lines):
+        return []
     try:
         tree = ast.parse(src, filename=filename)
     except SyntaxError as e:
@@ -330,37 +310,20 @@ def lint_source(src: str, filename: str = "<string>") -> list:
                         "syntax-error", str(e.msg))]
     checker = _ModuleChecker(filename)
     checker.visit(tree)
-    kept = []
-    for f in sorted(checker.out,
-                    key=lambda f: (f.line, f.col, f.code)):
-        here = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
-        above = lines[f.line - 2] if 1 < f.line <= len(lines) + 1 else ""
-        sup = _suppressed_codes(here)
-        if above.strip().startswith("#"):
-            sup |= _suppressed_codes(above)
-        if f.code not in sup:
-            kept.append(f)
-    return kept
+    return filter_suppressed(checker.out, lines, RULES)
 
 
-def lint_paths(paths) -> list:
+def lint_paths(paths, changed: bool = False) -> list:
     findings: list = []
-    for p in paths:
-        p = Path(p)
-        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
-        for f in files:
-            findings.extend(
-                lint_source(f.read_text(encoding="utf-8"), str(f)))
+    for f in collect_files(paths, changed=changed):
+        findings.extend(
+            lint_source(f.read_text(encoding="utf-8"), str(f)))
     return findings
 
 
 def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    as_json = "--json" in argv
-    paths = [a for a in argv if not a.startswith("--")]
-    if not paths:
-        paths = [str(Path(__file__).resolve().parents[1])]  # ydb_tpu/
-    findings = lint_paths(paths)
+    paths, as_json, changed = parse_cli(argv)
+    findings = lint_paths(paths, changed=changed)
     if as_json:
         print(json.dumps([f.to_dict() for f in findings], indent=2))
     else:

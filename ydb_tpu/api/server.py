@@ -417,8 +417,23 @@ class RequestProxy:
                 st["error"] = str(e)
             st["ready"] = True
 
-        threading.Thread(target=run, daemon=True).start()
+        # the handle rides in the op record so close() can join
+        # stragglers instead of abandoning them at process exit
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"op-{kind}")
+        st["thread"] = t
+        t.start()
         return op_id
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Join outstanding operation threads (orderly shutdown path:
+        serve() callers should close the proxy after stopping gRPC)."""
+        with self._op_lock:
+            threads = [st.get("thread") for st in
+                       self._operations.values()]
+        for t in threads:
+            if t is not None and t.is_alive():
+                t.join(timeout=timeout)
 
     def _op_status(self, st) -> "pb.OperationStatus":
         rows = 0

@@ -18,7 +18,8 @@ from __future__ import annotations
 
 import collections
 import os
-import threading
+
+from ydb_tpu.analysis import sanitizer
 
 
 def default_budget() -> int:
@@ -38,9 +39,12 @@ class DeviceBlockCache:
         # budget None = resolve default_budget() per use (it can change
         # with the environment in tests)
         self._budget = budget
-        self._entries: collections.OrderedDict = collections.OrderedDict()
+        # sanitizer-tracked under YDB_TPU_TSAN=1 (a per-instance name:
+        # distinct caches must not share lockset state)
+        self._entries = sanitizer.share(
+            collections.OrderedDict(), f"blockcache.{id(self):x}")
         self._nbytes = 0
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock(f"blockcache.{id(self):x}.lock")
         self.hits = 0
         self.misses = 0
 

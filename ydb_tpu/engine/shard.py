@@ -42,6 +42,7 @@ from collections import OrderedDict
 import numpy as np
 
 from ydb_tpu import dtypes
+from ydb_tpu.analysis import sanitizer
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.blobs import BlobStore
 from ydb_tpu.engine.blockcache import DeviceBlockCache
@@ -146,9 +147,16 @@ class ColumnShard:
         # compiled-scan cache: (program, key_spaces) -> (executor, sizes)
         # LRU-bounded at config.scan_cache_entries: compiled executors
         # pin XLA executables, and ad-hoc workloads mint a fresh key per
-        # distinct program — unbounded, that's a leak
-        self._scan_cache: OrderedDict = OrderedDict()
-        self._scan_cache_lock = threading.Lock()
+        # distinct program — unbounded, that's a leak. Under
+        # YDB_TPU_TSAN=1 the cache and its lock are sanitizer-tracked
+        # (the PR 3 touch/evict race regression runs against this).
+        # per-INSTANCE state names (shard_id alone would fuse lockset
+        # state across a reboot or two clusters reusing shard ids)
+        self._scan_cache = sanitizer.share(
+            OrderedDict(),
+            f"columnshard.{shard_id}.{id(self):x}._scan_cache")
+        self._scan_cache_lock = sanitizer.make_lock(
+            f"columnshard.{shard_id}.{id(self):x}._scan_cache_lock")
         # stage snapshot of the most recent scan (read/merge/stage/
         # compute seconds) — obs surface for bench + the viewer
         self.last_scan_stages: dict = {}
@@ -187,15 +195,20 @@ class ColumnShard:
         for name, arr in columns.items():
             if len(arr) != n:
                 raise ValueError("ragged batch")
-        wid = self._next_write_id
-        self._next_write_id += 1
-        self._insert_buffer[wid] = {
+        batch = {
             "columns": {
                 k: np.asarray(v, dtype=self.schema.field(k).type.physical)
                 for k, v in columns.items()
             },
             "validity": {k: np.asarray(v) for k, v in (validity or {}).items()},
         }
+        # id allocation + buffer insert share the metadata lock:
+        # concurrent API sessions writing one shard must never mint the
+        # same write id or interleave with a commit's buffer drain
+        with self._meta_lock:
+            wid = self._next_write_id
+            self._next_write_id += 1
+            self._insert_buffer[wid] = batch
         return wid
 
     def encode_strings(
@@ -224,28 +237,36 @@ class ColumnShard:
 
     def commit_at(self, write_ids: list[int], step: int) -> int:
         """Commit prepared writes at a coordinator-assigned plan step."""
-        if step <= self.snap:
-            raise ValueError(
-                f"plan step {step} not ahead of shard snapshot {self.snap}"
-            )
         return self._commit(write_ids, step)
 
     def abort(self, write_ids: list[int]) -> None:
-        for w in write_ids:
-            self._insert_buffer.pop(w, None)
+        with self._meta_lock:
+            for w in write_ids:
+                self._insert_buffer.pop(w, None)
 
     def commit(self, write_ids: list[int]) -> int:
         """Single-shard commit at the next local snapshot. Do not mix with
         coordinated commit_at on the same shard group — the coordinator
         owns global time there."""
-        return self._commit(write_ids, self.snap + 1)
+        return self._commit(write_ids, None)
 
-    def _commit(self, write_ids: list[int], snap: int) -> int:
-        batches = [self._insert_buffer.pop(w) for w in write_ids]
+    def _commit(self, write_ids: list[int], snap: "int | None") -> int:
+        # snapshot allocation, validation and advance happen in ONE
+        # critical section: two concurrent commits reading snap outside
+        # the lock would mint the same snapshot id, and background
+        # compaction/TTL bump the same counter under _meta_lock
+        with self._meta_lock:
+            if snap is None:
+                snap = self.snap + 1
+            elif snap <= self.snap:
+                raise ValueError(
+                    f"plan step {snap} not ahead of shard snapshot "
+                    f"{self.snap}")
+            batches = [self._insert_buffer.pop(w) for w in write_ids]
+            self.snap = snap
         if _P_COMMIT:
             _P_COMMIT.fire(shard=self.shard_id, snap=snap,
                            writes=len(write_ids))
-        self.snap = snap
         if not batches:
             self._log({"op": "noop", "snap": snap})
             return snap
@@ -764,6 +785,14 @@ class ColumnShard:
         return shard
 
     def _replay(self, rec: dict) -> None:
+        # boot-time replay is single-threaded, but the metadata it
+        # rewrites is the same state scans/compaction guard with
+        # _meta_lock — holding it keeps the guard discipline uniform
+        # (and replay-into-a-live-shard safe), at RLock cost only
+        with self._meta_lock:
+            self._replay_locked(rec)
+
+    def _replay_locked(self, rec: dict) -> None:
         op = rec["op"]
         self._wal_seq = max(self._wal_seq, rec["seq"])
         self.snap = max(self.snap, rec.get("snap", 0))

@@ -11,30 +11,38 @@ YDB_TPU_NO_NATIVE=1 to force the fallback (tests compare both).
 from __future__ import annotations
 
 import ctypes
+import threading
 
 import numpy as np
 
 from ydb_tpu.native.build import ensure_built
 
 _lib = None
+_load_lock = threading.Lock()
 
 
 def _load():
+    # first call can come from any conveyor worker (shuffle hashing,
+    # K-way merge in scan producers): double-checked so concurrent
+    # first uses build/dlopen once instead of racing ensure_built
     global _lib
     if _lib is not None:
         return _lib if _lib is not False else None
-    path = ensure_built()
-    if path is None:
-        _lib = False
-        return None
-    try:
-        lib = ctypes.CDLL(path)
-        lib.ydbtpu_kway_merge.restype = ctypes.c_int64
-        _lib = lib
-    except OSError:
-        _lib = False
-        return None
-    return _lib
+    with _load_lock:
+        if _lib is not None:
+            return _lib if _lib is not False else None
+        path = ensure_built()
+        if path is None:
+            _lib = False
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.ydbtpu_kway_merge.restype = ctypes.c_int64
+            _lib = lib
+        except OSError:
+            _lib = False
+            return None
+        return _lib
 
 
 def available() -> bool:

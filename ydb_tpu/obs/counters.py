@@ -12,6 +12,8 @@ from __future__ import annotations
 import bisect
 import threading
 
+from ydb_tpu.analysis import sanitizer
+
 
 class Counter:
     __slots__ = ("value", "_lock")
@@ -64,10 +66,16 @@ class Histogram:
 class CounterGroup:
     def __init__(self, labels: dict | None = None):
         self.labels = dict(labels or {})
-        self._children: dict[tuple, CounterGroup] = {}
-        self._counters: dict[str, Counter] = {}
-        self._histograms: dict[str, Histogram] = {}
-        self._lock = threading.Lock()
+        # registry dicts are sanitizer-tracked under YDB_TPU_TSAN=1
+        # (services register counters from conveyor workers + API
+        # threads concurrently)
+        self._children = sanitizer.share(
+            {}, f"counters.{id(self):x}.children")
+        self._counters = sanitizer.share(
+            {}, f"counters.{id(self):x}.counters")
+        self._histograms = sanitizer.share(
+            {}, f"counters.{id(self):x}.histograms")
+        self._lock = sanitizer.make_lock(f"counters.{id(self):x}.lock")
 
     def group(self, **labels) -> "CounterGroup":
         key = tuple(sorted(labels.items()))
@@ -108,9 +116,18 @@ class CounterGroup:
 
     def _encode(self, lines: list):
         ls = self._label_str()
-        for name, c in sorted(self._counters.items()):
+        # registry iteration must share the writers' lock: a service
+        # registering a counter mid-scrape would resize the dict under
+        # the encoder (dynamic race found by the TSAN stress suite).
+        # Child encoding happens OUTSIDE it — parent->child is the only
+        # acquisition order, and values render from a stable snapshot.
+        with self._lock:
+            counters = sorted(self._counters.items())
+            hists = sorted(self._histograms.items())
+            children = list(self._children.values())
+        for name, c in counters:
             lines.append(f"{name}{ls} {c.value}")
-        for name, h in sorted(self._histograms.items()):
+        for name, h in hists:
             lines.append(f"{name}_count{ls} {h.count}")
             lines.append(f"{name}_sum{ls} {h.total}")
             acc = 0
@@ -121,7 +138,7 @@ class CounterGroup:
                 inner = ",".join(
                     f'{k}="{v}"' for k, v in sorted(le.items()))
                 lines.append(f"{name}_bucket{{{inner}}} {acc}")
-        for child in self._children.values():
+        for child in children:
             child._encode(lines)
 
     def snapshot(self) -> dict:
@@ -133,11 +150,15 @@ class CounterGroup:
     def _snap(self, out: dict):
         prefix = ",".join(f"{k}={v}"
                           for k, v in sorted(self.labels.items()))
-        for name, c in self._counters.items():
+        with self._lock:
+            counters = list(self._counters.items())
+            hists = list(self._histograms.items())
+            children = list(self._children.values())
+        for name, c in counters:
             out[f"{name}|{prefix}"] = c.value
-        for name, h in self._histograms.items():
+        for name, h in hists:
             out[f"{name}_count|{prefix}"] = h.count
-        for child in self._children.values():
+        for child in children:
             child._snap(out)
 
 

@@ -18,8 +18,14 @@ import fnmatch
 import threading
 import time
 
-_registry: dict[str, "Probe"] = {}
-_lock = threading.Lock()
+from ydb_tpu.analysis import sanitizer
+
+# module-level registry: built at import, before any test could set
+# YDB_TPU_TSAN — so the proxy/lock are always-on variants whose
+# recording self-gates per access (idle cost: one flag check on the
+# probe() / attach() paths, never on fire())
+_registry = sanitizer.share_always({}, "probes._registry")
+_lock = sanitizer.TrackedLock("probes._lock")
 
 
 class Probe:

@@ -24,6 +24,8 @@ import itertools
 import os
 import threading
 
+from ydb_tpu.analysis import sanitizer
+
 
 class ConveyorController:
     """Test hook gating task execution (ICSController analog).
@@ -70,9 +72,12 @@ class ResourceBroker:
                  total: int | None = None):
         self.quotas = dict(quotas or {})
         self.total = total
-        self._running: dict[str, int] = {}
+        self._running = sanitizer.share(
+            {}, f"broker.{id(self):x}.running")
         self._all = 0
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock(f"broker.{id(self):x}.lock")
+        # a Condition over the tracked lock: wait/notify release and
+        # re-acquire through it, so the held-set stays exact under TSAN
         self._freed = threading.Condition(self._lock)
 
     def acquire(self, queue: str,
@@ -123,8 +128,11 @@ class Conveyor:
         self.broker = broker or ResourceBroker()
         self.controller = controller or ConveyorController()
         self._heap: list = []
+        # heapq mutates the list at the C level, bypassing any proxy:
+        # the push/pop sites carry explicit sanitizer notes instead
+        self._heap_tok = sanitizer.token(f"conveyor.{id(self):x}.heap")
         self._seq = itertools.count()
-        self._cv = threading.Condition()
+        self._cv = sanitizer.make_condition(f"conveyor.{id(self):x}.cv")
         self._stopping = False
         self._stop_event = threading.Event()
         self._active = 0
@@ -141,6 +149,7 @@ class Conveyor:
         with self._cv:
             if self._stopping:
                 raise RuntimeError("conveyor is shut down")
+            sanitizer.note(self._heap_tok, "heappush")
             heapq.heappush(
                 self._heap,
                 (priority, next(self._seq), queue, fn, args, kwargs, h))
@@ -160,6 +169,7 @@ class Conveyor:
                     or self._active >= len(self._threads)):
                 return None
             h = TaskHandle(queue, threading.Event())
+            sanitizer.note(self._heap_tok, "heappush")
             heapq.heappush(
                 self._heap,
                 (10, next(self._seq), queue, fn, args, kwargs, h))
@@ -173,6 +183,7 @@ class Conveyor:
                     self._cv.wait()
                 if self._stopping and not self._heap:
                     return
+                sanitizer.note(self._heap_tok, "heappop")
                 _, _, queue, fn, args, kwargs, h = heapq.heappop(
                     self._heap)
                 self._active += 1

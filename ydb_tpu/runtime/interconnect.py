@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Any
 
+from ydb_tpu.analysis import sanitizer
 from ydb_tpu.runtime.actors import ActorSystem, Envelope
 
 _HDR = struct.Struct("!I")
@@ -108,7 +109,8 @@ class _Session:
         self.addr = addr
         self.sock: socket.socket | None = None
         self.session_id = 0
-        self.lock = threading.Lock()
+        self.lock = sanitizer.make_lock(
+            f"interconnect.session.{peer_node}.{id(self):x}.lock")
         self._q: "queue.Queue" = queue.Queue()
         self._closed = threading.Event()
         self._thread = threading.Thread(target=self._sender_loop,
@@ -141,32 +143,50 @@ class _Session:
             self._deliver(env)
 
     def _deliver(self, env: Envelope) -> None:
-        with self.lock:
-            for attempt in range(self.ic.max_retries + 1):
-                if self._closed.is_set():
-                    break
-                try:
-                    if self.sock is None:
-                        self._connect()
-                    _send_frame(self.sock, ("env", env.target, env.sender,
-                                            env.message))
-                    return
-                except HandshakeRejected as e:
-                    # permanent: close the session so later envelopes
-                    # fail fast instead of re-dialing a refusing peer
-                    self._drop()
-                    self._closed.set()
+        # no lock across the attempt loop: the blocking work (connect,
+        # sendall, backoff sleeps) runs lock-free on this sender thread,
+        # so close() from another thread is never stalled behind a
+        # retry storm — self.lock guards only the self.sock field
+        for attempt in range(self.ic.max_retries + 1):
+            if self._closed.is_set():
+                break
+            try:
+                sock = self._ensure_sock()
+                _send_frame(sock, ("env", env.target, env.sender,
+                                   env.message))
+                return
+            except HandshakeRejected as e:
+                # permanent: close the session so later envelopes
+                # fail fast instead of re-dialing a refusing peer
+                self._drop()
+                self._closed.set()
+                self.ic._notify_undelivered(env, str(e))
+                return
+            except OSError as e:
+                self._drop()
+                if attempt >= self.ic.max_retries:
                     self.ic._notify_undelivered(env, str(e))
                     return
-                except OSError as e:
-                    self._drop()
-                    if attempt >= self.ic.max_retries:
-                        self.ic._notify_undelivered(env, str(e))
-                        return
-                    time.sleep(self.ic.retry_delay * (attempt + 1))
-            self.ic._notify_undelivered(env, "session closed")
+                time.sleep(self.ic.retry_delay * (attempt + 1))
+        self.ic._notify_undelivered(env, "session closed")
 
-    def _connect(self) -> None:
+    def _ensure_sock(self) -> socket.socket:
+        """The current socket, dialing a fresh session if none. Only
+        the sender thread calls this; the lock covers the handover of
+        the connected socket into self.sock against close()."""
+        with self.lock:
+            s = self.sock
+        if s is not None:
+            return s
+        s = self._connect()
+        with self.lock:
+            if self._closed.is_set():
+                s.close()
+                raise OSError("session closed")
+            self.sock = s
+        return s
+
+    def _connect(self) -> socket.socket:
         s = socket.create_connection(self.addr, timeout=self.ic.timeout)
         s.settimeout(self.ic.timeout)
         self.session_id += 1
@@ -191,14 +211,16 @@ class _Session:
             raise HandshakeRejected(
                 f"peer {self.addr} speaks protocol {resp_ver}, "
                 f"we speak {PROTOCOL_VERSION}")
-        self.sock = s
+        return s
 
     def _drop(self) -> None:
-        if self.sock is not None:
+        with self.lock:
+            s, self.sock = self.sock, None
+        if s is not None:
             try:
-                self.sock.close()
-            finally:
-                self.sock = None
+                s.close()
+            except OSError:
+                pass
 
     def close(self) -> None:
         self._closed.set()
@@ -225,8 +247,13 @@ class Interconnect:
         self.timeout = timeout
         self.max_retries = max_retries
         self.retry_delay = retry_delay
-        self._sessions: dict[int, _Session] = {}
-        self._slock = threading.Lock()
+        # session map is sanitizer-tracked under YDB_TPU_TSAN=1: the
+        # actor loop, reader threads (reverse-route add_peer) and
+        # close() all touch it
+        self._sessions = sanitizer.share(
+            {}, f"interconnect.{self.node}.{id(self):x}.sessions")
+        self._slock = sanitizer.make_lock(
+            f"interconnect.{self.node}.{id(self):x}.slock")
         self._listener: socket.socket | None = None
         self.port: int | None = None
         self._stop = threading.Event()

@@ -96,8 +96,10 @@ class TabletExecutor:
         self._since_snap = 0
         # one tablet = one writer: commit paths that bypass a global
         # commit lock (volatile readset exchange) still serialize
-        # per-tablet here, so version/log_index never collide
-        self._exec_lock = threading.Lock()
+        # per-tablet here, so version/log_index never collide. Reentrant
+        # because execute() checkpoints under it and checkpoint() is
+        # also a public entry point that must take it itself.
+        self._exec_lock = threading.RLock()
         # per-tablet counters (tablet_counters*.cpp analog), merged
         # cluster-wide by obs.tablet_counters.aggregate
         self.counters = {
@@ -158,6 +160,13 @@ class TabletExecutor:
         return False
 
     def checkpoint(self) -> None:
+        # serialized against execute(): an external checkpoint racing a
+        # commit could snapshot a half-applied version and truncate the
+        # redo records that covered it (reentrant from execute itself)
+        with self._exec_lock:
+            self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> None:
         # A stale leader must never snapshot: its snapshot would bake in
         # zombie writes past the successor's fence and boot would then
         # skip the successor's redo records (version <= snapshot

@@ -261,7 +261,10 @@ class SequenceShard:
         def fn(txc):
             txc.erase("sequences", (name,))
         self.executor.run(fn)
-        self._cache.pop(name, None)
+        # a nextval caching a fresh range concurrently with the drop
+        # must not resurrect the entry after this pop
+        with self._lock:
+            self._cache.pop(name, None)
 
     def next_val(self, name: str) -> int:
       with self._lock:
