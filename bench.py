@@ -32,7 +32,13 @@ YDB_TPU_BENCH_PALLAS_COMPARE=1 (force the in-process A/B of the Pallas
 one-hot group-by vs the XLA scatter path; default on for TPU backends),
 YDB_TPU_BENCH_FUSED_COMPARE=0 (skip the fused-vs-per-agg group-by A/B,
 which is on by default on every backend and reports
-fused/peragg_q1_rows_per_sec + fused_speedup). Engine-tier runs also
+fused/peragg_q1_rows_per_sec + fused_speedup),
+YDB_TPU_BENCH_STATS=0 (skip the column-statistics tier: zone-map
+pruning A/B on a selective non-PK filter — stats-on vs the
+YDB_TPU_STATS=0 path, bit-identical asserted — reported as
+extra.stats_pruning {chunks read/skipped, pruning_hit_rate,
+pruning_speedup} plus extra.stats_ndv per-column NDV relative error;
+YDB_TPU_BENCH_STATS_ROWS sizes it). Engine-tier runs also
 report per-stage scan seconds (engine_q{1,6}_stage_seconds:
 read/merge/stage/compute) from the streaming reader's StageTimer.
 Phase progress logs to stderr; stdout stays the one JSON line.
@@ -284,6 +290,52 @@ def pallas_ab(src, blocks, n_rows, block_rows, iters):
                        (("pallas", True), ("scatter", False)), set_flag)
 
 
+def run_stats_ab(extra: dict, iters: int) -> None:
+    """Column-statistics tier: zone-map scan pruning A/B (stats on vs
+    the YDB_TPU_STATS=0 path) on a selective non-PK filter over a
+    time-correlated table, plus aggregator NDV accuracy. Results are
+    asserted bit-identical between the two sides; reported extras:
+    pruning hit rate (chunks skipped / total), selective-scan speedup
+    and per-column NDV relative error."""
+    import numpy as np  # noqa: F811 - local alias for the helper
+
+    from ydb_tpu.obs.kernelbench import bench_pruning, \
+        build_pruning_shard
+    from ydb_tpu.stats.aggregator import StatisticsAggregator
+
+    rows = int(os.environ.get("YDB_TPU_BENCH_STATS_ROWS", str(1 << 20)))
+    shard, n = build_pruning_shard(rows, 1 << 14)
+    report = bench_pruning(rows, chunk_rows=1 << 14,
+                           iters=max(2, iters // 2), shard=(shard, n))
+    total = report["nostats_chunks_read"]
+    hit = 1.0 - report["stats_chunks_read"] / max(total, 1)
+    report["pruning_hit_rate"] = round(hit, 3)
+    extra["stats_pruning"] = report
+    # NDV accuracy on the SAME shard through the aggregator (no second
+    # build/serialize pass)
+    agg = StatisticsAggregator()
+    merged = agg.collect_shard(shard)
+    ndv = {}
+    from ydb_tpu.engine.portion import read_portion_blob
+
+    cols: dict = {}
+    for m in shard.visible_portions():
+        c, v = read_portion_blob(shard.store, m.blob_id)
+        for k, arr in c.items():
+            ok = v.get(k)
+            cols.setdefault(k, []).append(
+                arr if ok is None else arr[ok])
+    for k, parts in cols.items():
+        true = len(np.unique(np.concatenate(parts)))
+        est = merged[k].ndv
+        ndv[k] = {"true": true, "est": est,
+                  "rel_err": round(abs(est - true) / max(true, 1), 4)}
+    extra["stats_ndv"] = ndv
+    _log(f"stats tier: hit_rate={report['pruning_hit_rate']} "
+         f"speedup=x{report.get('pruning_speedup')} "
+         f"chunks_skipped={report['chunks_skipped']}")
+
+
 def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
     """Out-of-core engine-tier run at a LARGE scale factor (SURVEY
     §7.2 item 7): lineitem generates in bounded chunks (the full table
@@ -524,6 +576,19 @@ def main():
     elif ab_enabled:
         skipped.append("pallas_ab:budget")
     del blocks
+
+    # column-statistics tier: zone-map pruning A/B + NDV accuracy
+    # (YDB_TPU_BENCH_STATS=0 skips; fail-soft like the storage tiers)
+    if os.environ.get("YDB_TPU_BENCH_STATS", "1") not in ("0", "", "off"):
+        if _budget_left(budget) > 90:
+            _log("stats tier: pruning A/B + NDV")
+            try:
+                run_stats_ab(extra, iters)
+            except Exception as e:  # noqa: BLE001 - additive evidence
+                extra["stats_tier_error"] = repr(e)[-300:]
+            _checkpoint("stats", extra)
+        else:
+            skipped.append("stats_tier:budget")
 
     engine_warm_rps = extra["kernel_q1_warm_rows_per_sec"]
     db_iters = min(iters, 2)  # storage tiers stream the table per run

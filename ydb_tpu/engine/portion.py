@@ -43,6 +43,11 @@ class PortionMeta:
     # min/max of the TTL column, for eviction planning
     ttl_min: int | None = None
     ttl_max: int | None = None
+    # per-column zone map: {column: [vmin, vmax, null_count]} over the
+    # WHOLE portion (union of its chunk zones; ydb_tpu.stats.zonemap) —
+    # scan planning prunes portions against filter predicates without
+    # touching blob storage. None on pre-stats portions (v0 metadata).
+    zones: dict | None = None
     # table schema version this portion was written under: a column only
     # reads from portions at least as new as the version that (re)added
     # it — DROP then ADD of the same name must not resurrect old bytes
@@ -63,6 +68,10 @@ class PortionMeta:
 
 PORTION_MAGIC = b"YDBP0001"
 DEFAULT_CHUNK_ROWS = 1 << 16
+#: blob header format version: v1 adds per-chunk column zone maps
+#: ("zones" per chunk entry). v0 headers (no "version" key) read fine —
+#: they simply carry no zones, so scans fall back to unpruned reads.
+HEADER_VERSION = 1
 
 
 def _pack_chunk(columns, validity, lo, hi) -> bytes:
@@ -93,6 +102,7 @@ def write_portion_blob(
     validity: dict[str, np.ndarray] | None = None,
     chunk_rows: int = DEFAULT_CHUNK_ROWS,
     pk_column: str | None = None,
+    stats: bool = True,
 ) -> None:
     """Serialize columns as a chunk-indexed blob.
 
@@ -101,7 +111,17 @@ def write_portion_blob(
     rows are PK-sorted, which the shard guarantees) each chunk's header
     entry carries PK bounds so ranged scans can skip whole chunks
     (reader._chunk_in_range) without fetching them.
+
+    With ``stats`` (v1 headers, the default) each chunk entry also
+    carries per-column zone maps — ``{"zones": {col: [vmin, vmax,
+    null_count]}}``, dtype-aware (ints, floats, scaled decimals,
+    dict-encoded string ids) — computed vectorized at write time so
+    scans can skip chunks that no conjunctive filter predicate can
+    match (ydb_tpu.stats.zonemap). ``stats=False`` writes v0 headers
+    (the pre-stats format, still fully readable).
     """
+    from ydb_tpu.stats.zonemap import column_zones
+
     n = len(next(iter(columns.values()))) if columns else 0
     chunks = []
     payloads = []
@@ -117,12 +137,17 @@ def write_portion_blob(
             if np.issubdtype(pk.dtype, np.integer):
                 entry["pk_min"] = int(pk[lo])
                 entry["pk_max"] = int(pk[hi - 1])
+        if stats and hi > lo:
+            entry["zones"] = column_zones(columns, validity, lo, hi)
         chunks.append(entry)
         payloads.append(data)
         off += len(data)
         if n == 0:
             break
-    header = json.dumps({"chunks": chunks}).encode()
+    head: dict = {"chunks": chunks}
+    if stats:
+        head["version"] = HEADER_VERSION
+    header = json.dumps(head).encode()
     blob = b"".join([PORTION_MAGIC, struct.pack("<Q", len(header)),
                      header] + payloads)
     store.put(blob_id, blob)
@@ -140,11 +165,15 @@ class PortionChunkReader:
             self._legacy = store.get(blob_id)
             self.chunks = [None]
             self._base = 0
+            self.version = 0
             return
         self._legacy = None
         (hlen,) = struct.unpack("<Q", head[8:16])
         header = json.loads(store.get_range(blob_id, 16, hlen).decode())
         self.chunks = header["chunks"]
+        # v0 headers predate zone maps: absent "version" reads as 0 and
+        # chunk entries simply have no "zones" (scans stay unpruned)
+        self.version = header.get("version", 0)
         self._base = 16 + hlen
 
     @property
@@ -187,10 +216,21 @@ def read_portion_blob(
     return cols, valid
 
 
-def column_stats(arr: np.ndarray) -> tuple[int | None, int | None]:
-    if arr.size == 0 or not np.issubdtype(arr.dtype, np.integer):
-        return None, None
-    return int(arr.min()), int(arr.max())
+def column_stats(
+    arr: np.ndarray, validity: np.ndarray | None = None,
+) -> tuple:
+    """Typed (min, max) of a column, dtype-aware.
+
+    Ints (incl. dict ids, scaled decimals, dates) return ints; floats
+    return floats (no silent ``int()`` truncation); NULL rows are
+    excluded when ``validity`` is given. ``(None, None)`` for empty or
+    unstatable input. Zone maps reuse this for every scan column —
+    ydb_tpu.stats.zonemap.zone_of carries the shared implementation.
+    """
+    from ydb_tpu.stats.zonemap import zone_of
+
+    vmin, vmax, _nulls = zone_of(arr, validity)
+    return vmin, vmax
 
 
 def project_chunk(

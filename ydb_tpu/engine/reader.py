@@ -58,6 +58,23 @@ def _chunk_in_range(meta: dict, pk_range) -> bool:
     return True
 
 
+def _chunk_selected(meta: dict, pk_range, preds) -> bool:
+    """General chunk pruning: PK range plus conjunctive filter
+    predicates against the chunk's v1-header zone maps (the PK check is
+    just the oldest special case of the zone path). Conservative: a
+    chunk without zones (v0 header) is always read."""
+    if not _chunk_in_range(meta, pk_range):
+        return False
+    if preds:
+        from ydb_tpu.stats.zonemap import zones_decide
+
+        skip, _all = zones_decide(meta.get("zones") if meta else None,
+                                  preds)
+        if skip:
+            return False
+    return True
+
+
 def rechunk(payloads, names, cap: int):
     """Re-cut a stream of (cols, valid) payloads into exactly-``cap``-row
     pieces (last piece partial). Shared by the block stream and
@@ -185,11 +202,16 @@ class _RunCursor:
                                  self.meta, self.names, c, v)
 
     def fill_more(self) -> None:
-        """Append the next chunk to the buffer (PK-pruned chunks skip)."""
+        """Append the next chunk to the buffer (PK-pruned chunks skip).
+
+        Only the PK range prunes here: this cursor feeds the K-way
+        newest-wins merge, where value-predicate skips could resurrect
+        shadowed row versions (see PortionStreamSource.preds)."""
         i = self.next_chunk
         self.next_chunk += 1
         if not _chunk_in_range(self.reader.chunk_meta(i),
                                self.source.pk_range):
+            self.source.chunks_skipped += 1
             return
         cols, valid = self._read_chunk(i)
         for n in self.names:
@@ -245,12 +267,22 @@ class PortionStreamSource:
         prefetch: bool = True,
         pk_range: tuple[int | None, int | None] | None = None,
         timer=None,
+        preds=None,
     ):
         self.shard = shard
         self.metas = list(metas)
         # chunk-granular PK pruning window (coarse: callers still filter)
         self.pk_range = pk_range
+        # conjunctive filter predicates (stats.zonemap.Pred) for
+        # chunk-granular zone pruning. Only the NON-merging read path
+        # consults them: inside a K-way dedup merge a skipped newer
+        # chunk could resurrect the older row version it shadows, so
+        # merged clusters read every in-PK-range chunk. Single-portion
+        # clusters are always safe — portions hold unique PKs.
+        self.preds = list(preds or [])
         self.chunks_read = 0  # observability: chunk fetches actually done
+        self.chunks_skipped = 0  # chunks zone/PK-pruned without a fetch
+        self.portions_skipped = 0  # whole portions pruned by zone maps
         # per-scan stage accounting (obs.probes.StageTimer): blob reads
         # charge "read", K-way merging "merge"; None = untimed
         self.timer = timer
@@ -348,11 +380,15 @@ class PortionStreamSource:
             yield cols, valid
 
     def _iter_plain(self, cluster: list[PortionMeta], names):
-        """No-merge streaming: portion chunks emit in portion order."""
+        """No-merge streaming: portion chunks emit in portion order.
+        Chunk-granular pruning (PK range + zone-map predicates) happens
+        here — skipped chunks are never fetched from the store."""
         for m in cluster:
             rd = PortionChunkReader(self.shard.store, m.blob_id)
             for i in range(rd.n_chunks):
-                if not _chunk_in_range(rd.chunk_meta(i), self.pk_range):
+                if not _chunk_selected(rd.chunk_meta(i), self.pk_range,
+                                       self.preds):
+                    self.chunks_skipped += 1
                     continue
                 rctx = (self.timer.stage("read")
                         if self.timer is not None
@@ -558,11 +594,42 @@ class MultiShardStreamSource:
         self.schema = schema.select(self.columns_read)
         self.dicts = dicts
         self.timer = timer
+        self._shards = list(shards)
+        self._snap = snap
+        self.preds: tuple = ()
         self.subs = [
             PortionStreamSource(s, s.visible_portions(snap),
                                 columns=self.columns_read, timer=timer)
             for s in shards
         ]
+
+    def with_predicates(self, preds) -> "MultiShardStreamSource":
+        """A pruned VIEW of this source for one program's conjunctive
+        filter predicates (stats.zonemap.Pred): portion-level zone
+        pruning for shards whose rows never shadow (non-upsert), plus
+        chunk-granular pruning inside every sub-stream. The base source
+        stays untouched — other programs over the same snapshot keep
+        their unpruned streams — and ``device_cache_key`` carries the
+        predicate fingerprint so pruned block streams never collide
+        with unpruned ones in the device cache."""
+        from ydb_tpu.stats.zonemap import preds_fingerprint, zones_decide
+
+        view = MultiShardStreamSource(
+            self._shards, self._base_schema, self.dicts, self._snap,
+            columns=self.columns_read, timer=self.timer)
+        view.preds = preds_fingerprint(preds)
+        for sub in view.subs:
+            sub.preds = list(preds)
+            if not getattr(sub.shard, "upsert", False):
+                kept = []
+                for m in sub.metas:
+                    skip, _all = zones_decide(m.zones, sub.preds)
+                    if skip:
+                        sub.portions_skipped += 1
+                    else:
+                        kept.append(m)
+                sub.metas = kept
+        return view
 
     @property
     def num_rows(self) -> int:
@@ -572,19 +639,30 @@ class MultiShardStreamSource:
     def device_cache_key(self, read_cols, block_rows: int):
         """Identity of this source's block stream for the device block
         cache: per-shard (shard id, visible portion ids) plus the block
-        geometry. Portions are immutable, so equal keys produce equal
-        streams; any commit/compaction changes some shard's portion
-        set and with it the key."""
+        geometry AND the pruning-predicate fingerprint (a pruned stream
+        holds fewer rows than an unpruned one over the same portions —
+        serving one for the other would drop data). Portions are
+        immutable, so equal keys produce equal streams; any
+        commit/compaction changes some shard's portion set and with it
+        the key."""
         return (
             tuple((sub.shard.shard_id,
                    tuple(m.portion_id for m in sub.metas))
                   for sub in self.subs),
-            tuple(read_cols), block_rows,
+            tuple(read_cols), block_rows, self.preds,
         )
 
     @property
     def chunks_read(self) -> int:
         return sum(sub.chunks_read for sub in self.subs)
+
+    @property
+    def chunks_skipped(self) -> int:
+        return sum(sub.chunks_skipped for sub in self.subs)
+
+    @property
+    def portions_skipped(self) -> int:
+        return sum(sub.portions_skipped for sub in self.subs)
 
     def blocks(
         self,

@@ -175,11 +175,17 @@ class ScanExecutor:
         key_spaces: dict[str, int] | None = None,
         inflight_blocks: int = 4,
         combine_every: int = 8,
+        group_est: float | None = None,
     ):
         self.source = source
         self.block_rows = block_rows
         self.inflight_blocks = inflight_blocks
         self.combine_every = combine_every
+        # advisory NDV-based group-count estimate (stats.cost): steers
+        # the PARTIAL program's group-by tier choice; the final/combine
+        # programs run over small partial blocks and keep their own
+        # sizing
+        self.group_est = group_est
         self.read_cols = required_columns(program, source.schema)
         in_schema = source.schema.select(self.read_cols)
         # verify the ORIGINAL program before the two-phase rewrite:
@@ -194,7 +200,8 @@ class ScanExecutor:
         self._out_nullable = check_program(program, in_schema).out_nullable
         self.partial_prog, self.final_prog = twophase.split(program)
         self.partial = compile_program(
-            self.partial_prog, in_schema, source.dicts, key_spaces
+            self.partial_prog, in_schema, source.dicts, key_spaces,
+            group_est=group_est,
         )
         self._partial_jit = jax.jit(self.partial.run)
         self._partial_aux = {

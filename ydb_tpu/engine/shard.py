@@ -60,6 +60,7 @@ from ydb_tpu.ssa.program import Program
 _P_COMMIT = probe("columnshard.commit")
 _P_SCAN = probe("columnshard.scan")
 _P_SCAN_STAGES = probe("columnshard.scan.stages")
+_P_SCAN_PRUNING = probe("columnshard.scan.pruning")
 _P_COMPACT = probe("columnshard.compact")
 
 
@@ -160,6 +161,17 @@ class ColumnShard:
         # stage snapshot of the most recent scan (read/merge/stage/
         # compute seconds) — obs surface for bench + the viewer
         self.last_scan_stages: dict = {}
+        # pruning effectiveness of the most recent scan plus cumulative
+        # totals (obs: columnshard.scan.pruning probe, sys_scan_pruning
+        # view). Guarded by _stats_lock: concurrent scans update both.
+        self._stats_lock = sanitizer.make_lock(
+            f"columnshard.{shard_id}.{id(self):x}._stats_lock")
+        self.last_scan_pruning: dict = {}
+        self.pruning_totals: dict = sanitizer.share(
+            {"scans": 0, "portions_total": 0, "portions_skipped": 0,
+             "chunks_read": 0, "chunks_skipped": 0,
+             "chunks_fastpath": 0, "filters_dropped": 0},
+            f"columnshard.{shard_id}.{id(self):x}.pruning_totals")
         # HBM-resident decoded-block cache for warm scans, keyed by the
         # immutable (portion ids, read cols, block rows)
         self.block_cache = DeviceBlockCache(
@@ -320,6 +332,13 @@ class ColumnShard:
             commit_snap=snap,
             schema_version=self.schema_version,
         )
+        # portion-level zone maps for ALL columns (vectorized one-pass
+        # min/max/null-count per column): planning prunes portions and
+        # plans dense group tiers without touching blob storage
+        from ydb_tpu.stats.zonemap import column_zones
+
+        if cols:
+            meta.zones = column_zones(cols, validity)
         if self.pk_column and self.pk_column in cols:
             meta.pk_min, meta.pk_max = column_stats(cols[self.pk_column])
         if self.ttl_column and self.ttl_column in cols:
@@ -352,22 +371,60 @@ class ColumnShard:
     def visible_portions(
         self, snap: int | None = None,
         pk_range: tuple[int | None, int | None] | None = None,
+        preds=None,
     ) -> list[PortionMeta]:
+        """Portions visible at ``snap``, pruned by metadata statistics.
+
+        ``pk_range`` is the legacy spelling of the general path: it
+        lowers to ge/le predicates on the PK column and runs through
+        the same zone intersection as ``preds`` (stats.zonemap.Pred
+        conjuncts from a program's filters). Pre-stats portions carry
+        only pk_min/pk_max — those still serve the PK case; other
+        predicates read them unpruned (conservative)."""
         with self._meta_lock:
             snap = self.snap if snap is None else snap
             metas = list(self.portions.values())
+        all_preds = list(preds or [])
+        if pk_range and self.pk_column:
+            from ydb_tpu.stats.zonemap import Pred
+
+            lo, hi = pk_range
+            if lo is not None:
+                all_preds.append(Pred(self.pk_column, "ge", lo))
+            if hi is not None:
+                all_preds.append(Pred(self.pk_column, "le", hi))
         out = []
         for meta in metas:
             if not meta.visible_at(snap):
                 continue
-            if pk_range and meta.pk_min is not None:
-                lo, hi = pk_range
-                if lo is not None and meta.pk_max is not None and meta.pk_max < lo:
-                    continue
-                if hi is not None and meta.pk_min is not None and meta.pk_min > hi:
-                    continue
+            if all_preds and self._portion_pruned(meta, all_preds):
+                continue
             out.append(meta)
         return sorted(out, key=lambda m: m.portion_id)
+
+    def _meta_zones(self, meta: PortionMeta) -> dict | None:
+        """A portion's zone dict for predicate matching. v0 metadata
+        (pre-stats checkpoints) synthesizes the PK zone from
+        pk_min/pk_max so old portions keep PK pruning through the
+        general path."""
+        zones = dict(meta.zones) if meta.zones else {}
+        if self.pk_column and self.pk_column not in zones \
+                and meta.pk_min is not None:
+            # null count unknown on v0 metadata: claim "maybe all NULL"
+            # so skip decisions (which ignore nulls) still fire but the
+            # all-match fast path (which requires zero NULLs) never
+            # trusts a synthesized zone
+            zones[self.pk_column] = [meta.pk_min, meta.pk_max,
+                                     meta.num_rows]
+        return zones or None
+
+    def _portion_pruned(self, meta: PortionMeta, preds) -> bool:
+        """True when zone metadata proves no row of the portion can
+        satisfy every conjunct."""
+        from ydb_tpu.stats.zonemap import zones_decide
+
+        skip, _all = zones_decide(self._meta_zones(meta), preds)
+        return skip
 
     def _materialize(
         self, metas: list[PortionMeta], columns: tuple[str, ...] | None = None
@@ -411,26 +468,84 @@ class ColumnShard:
     def scan(
         self, program: Program, snap: int | None = None,
         key_spaces: dict[str, int] | None = None,
+        table_stats=None,
     ) -> OracleTable:
         """Streamed scan: portion-granular fetch -> (PK merge/dedup) ->
         fixed-capacity device blocks -> compiled program. Host memory is
         bounded by the largest PK-overlap cluster, not the table
         (fetching.h/scanner.h analog; ydb_tpu.engine.reader).
 
-        Compiled executors cache per (program, key_spaces) — the
+        Statistics consumption (YDB_TPU_STATS=0 disables, results stay
+        bit-identical either way):
+
+          * the program's conjunctive filter predicates evaluate against
+            portion zone maps BEFORE any blob is touched — non-matching
+            portions never stream, and chunk zones skip chunk fetches
+            inside surviving portions (ydb_tpu.stats.zonemap);
+          * a FilterStep every surviving portion provably all-matches
+            (zones inside the predicate, zero NULLs) is dropped from the
+            compiled program — the skip-the-filter-kernel fast path;
+          * integer group-by keys gain EXACT cardinality bounds from the
+            zone maps (key_spaces), enabling the dense group tier, and
+            ``table_stats`` (aggregator NDV) sizes the group capacity /
+            tier choice (ssa.compiler group_est).
+
+        Value-predicate portion pruning is skipped under upsert
+        semantics: a pruned newer portion could resurrect the older row
+        version it shadows. Chunk pruning stays safe there — it only
+        runs on single-portion clusters, whose PKs are unique.
+
+        Compiled executors cache per (program, key_spaces, hints) — the
         pattern-cache analog (mkql_computation_pattern_cache.h) — and
         invalidate when any dictionary grows (plan-time dict tables bake
         into the compiled aux)."""
+        from ydb_tpu import stats as stats_mod
         from ydb_tpu.engine.reader import PortionStreamSource
         from ydb_tpu.engine.scan import ScanExecutor, required_columns
         from ydb_tpu.obs.probes import StageTimer
+        from ydb_tpu.stats import zonemap
 
         timer = StageTimer()
-        cols = required_columns(program, self.schema)
+        use_stats = stats_mod.stats_enabled()
+        preds: list = []
+        full_steps: set = set()
+        if use_stats:
+            preds, full_steps = zonemap.extract_predicates(
+                program, self.schema, self.dicts)
+        visible = self.visible_portions(snap)
+        metas = visible
+        dropped: set = set()
+        if preds and not self.upsert:
+            metas = []
+            all_steps = set(full_steps)
+            for m in visible:
+                skip, alls = zonemap.zones_decide(
+                    self._meta_zones(m), preds)
+                if skip:
+                    continue
+                metas.append(m)
+                all_steps &= alls
+            # fast path: a filter every SURVIVING portion all-matches
+            # contributes nothing — drop it from the compiled program
+            # (bit-identical: all its rows pass, and 'all' required
+            # zero NULLs on the tested columns). Only for programs
+            # whose output a GroupByStep pins: a bare-filter program's
+            # implicit output IS its read set, and dropping the filter
+            # would narrow it.
+            dropped = all_steps if metas and \
+                program.group_by is not None else set()
+        eff_program = zonemap.drop_filter_steps(program, dropped)
+        cols = required_columns(eff_program, self.schema)
         src = PortionStreamSource(
-            self, self.visible_portions(snap), columns=cols, timer=timer
+            self, metas, columns=cols, timer=timer, preds=preds
         )
-        key = (program, tuple(sorted((key_spaces or {}).items())))
+        src.portions_skipped += len(visible) - len(metas)
+        key_spaces = dict(key_spaces or {})
+        group_est = None
+        if use_stats and eff_program.group_by is not None:
+            group_est = self._group_hints(
+                eff_program, metas, key_spaces, table_stats)
+        key = (eff_program, tuple(sorted(key_spaces.items())), group_est)
         sizes = tuple(
             (c, len(self.dicts[c])) for c in sorted(self.dicts.columns())
         )
@@ -447,7 +562,8 @@ class ColumnShard:
             ex = hit[0]
         else:
             ex = ScanExecutor(
-                program, src, self.config.scan_block_rows, key_spaces
+                eff_program, src, self.config.scan_block_rows,
+                key_spaces, group_est=group_est,
             ).detach()
             with self._scan_cache_lock:
                 self._scan_cache[key] = (ex, sizes)
@@ -465,9 +581,13 @@ class ColumnShard:
             with self._meta_lock:
                 live = set(self.portions)
             self.block_cache.prune(lambda k: set(k[0]) <= live)
+            # the predicate fingerprint is part of the identity: a
+            # pruned stream holds fewer rows than an unpruned one over
+            # the same portion set
             cache_key = (tuple(m.portion_id for m in src.metas),
                          tuple(ex.read_cols),
-                         self.config.scan_block_rows)
+                         self.config.scan_block_rows,
+                         zonemap.preds_fingerprint(preds))
         out = OracleTable.from_block(ex.run_stream(
             self.block_cache.stream(
                 cache_key,
@@ -477,6 +597,25 @@ class ColumnShard:
         # per-scan stage attribution (read/merge/stage/compute seconds);
         # bench.py surfaces this as metric extras
         self.last_scan_stages = timer.snapshot()
+        pruning = {
+            "portions_total": len(visible),
+            "portions_skipped": src.portions_skipped,
+            "chunks_read": src.chunks_read,
+            "chunks_skipped": src.chunks_skipped,
+            # with a zone-proven filter dropped, every chunk read took
+            # the skip-the-filter-kernel fast path
+            "chunks_fastpath": src.chunks_read if dropped else 0,
+            "filters_dropped": len(dropped),
+        }
+        with self._stats_lock:
+            self.last_scan_pruning = pruning
+            self.pruning_totals["scans"] += 1
+            for k, v in pruning.items():
+                if k != "portions_total":
+                    self.pruning_totals[k] += v
+            self.pruning_totals["portions_total"] += len(visible)
+        if _P_SCAN_PRUNING:
+            _P_SCAN_PRUNING.fire(shard=self.shard_id, **pruning)
         if _P_SCAN_STAGES:
             _P_SCAN_STAGES.fire(shard=self.shard_id,
                                 **self.last_scan_stages)
@@ -488,6 +627,50 @@ class ColumnShard:
                          block_cache_hit=self.block_cache.hits
                          > hit_before)
         return out
+
+    def _group_hints(self, program: Program, metas, key_spaces: dict,
+                     table_stats) -> float | None:
+        """Stats-derived group-by planning hints, mutating key_spaces.
+
+        Exact integer key bounds come from the zone maps of the
+        portions this scan will actually read (max value over their
+        union — a hard cardinality bound, so the dense tier stays
+        exact); the advisory group-count estimate comes from aggregator
+        NDV (table_stats) and only picks between equally-exact tiers.
+        """
+        from ydb_tpu.stats import cost
+
+        gb = program.group_by
+        for k in gb.keys:
+            if k in key_spaces or k not in self.schema:
+                continue
+            t = self.schema.field(k).type
+            if not t.is_integer:
+                continue  # strings bound via their dictionary already
+            bound = 0
+            ok = bool(metas)
+            for m in metas:
+                zone = (m.zones or {}).get(k)
+                if zone is None or zone[0] is None or zone[0] < 0:
+                    ok = False
+                    break
+                bound = max(bound, int(zone[1]))
+            # cap: a huge bound would explode the dense mixed-radix
+            # space; past it the sorted tier is the right plan anyway.
+            # key_spaces bounds are EXCLUSIVE (cardinality-style:
+            # values live in [0, b-1]), so the inclusive zone max
+            # shifts by one.
+            if ok and bound < (1 << 20):
+                key_spaces[k] = bound + 1
+        if table_stats is None:
+            return None
+        est = cost.estimate_group_count(gb.keys, table_stats)
+        if est is None:
+            return None
+        # 2-significant-figure bucket: the executor cache keys on the
+        # hint, and a raw NDV float would mint a fresh compile per
+        # aggregator refresh
+        return float(f"{est:.2g}")
 
     # ---------------- background: compaction / TTL ----------------
 

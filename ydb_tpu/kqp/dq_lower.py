@@ -65,9 +65,27 @@ def _split_at_sort(program):
     return partial, Program(steps[si:])
 
 
-def plan_to_stages(plan, n_tasks: int = 2) -> list[StageSpec]:
+def plan_to_stages(plan, n_tasks: int = 2, estimator=None,
+                   allow_swap: bool = False) -> list[StageSpec]:
     """Lower a logical plan tree to DQ stages (root must be a Transform,
-    which the SQL planner guarantees)."""
+    which the SQL planner guarantees).
+
+    ``estimator(node) -> float | None`` supplies statistics-based row
+    estimates (stats.cost.estimate_plan_rows bound to the aggregator's
+    TableStats). Two consumers:
+
+      * expand-join output capacity — ``fanout_hint`` is sized from the
+        estimated output/probe ratio instead of the fixed 4x guess, so
+        skew neither over-allocates HBM nor walks the overflow-retry
+        ladder (bit-identical: capacity only changes dead padding);
+      * build-side selection (``allow_swap=True``) — an inner expand
+        join whose "build" side is estimated much larger than its probe
+        side swaps the two (a grace join should build on the SMALL
+        side). Only taken when both payload column sets keep the exact
+        same output names (no suffix on either role), so the stage's
+        schema is unchanged; result ROW ORDER may differ, which is why
+        the swap is opt-in for callers that sort or aggregate above.
+    """
     stages: list[dict] = []  # mutable specs; frozen at the end
 
     def add(**kw) -> int:
@@ -81,26 +99,57 @@ def plan_to_stages(plan, n_tasks: int = 2) -> list[StageSpec]:
         raise ValueError(
             "stage feeds two consumers; duplicate the subtree instead")
 
+    def est(node) -> float | None:
+        if estimator is None:
+            return None
+        try:
+            return estimator(node)
+        except Exception:  # noqa: BLE001 - estimates must never fail a plan
+            return None
+
     def lower(node) -> int:
         if isinstance(node, TableScan):
             return add(program=node.program,
                        inputs=(SourceInput(node.table),),
                        output=None, tasks=n_tasks)
         if isinstance(node, (LookupJoin, ExpandJoin)):
-            pi = lower(node.probe)
-            bi = lower(node.build)
-            set_output(pi, HashPartition(tuple(node.probe_keys)))
-            set_output(bi, HashPartition(tuple(node.build_keys)))
+            probe, build = node.probe, node.build
+            probe_keys = tuple(node.probe_keys)
+            build_keys = tuple(node.build_keys)
+            swapped = False
+            p_rows, b_rows = est(probe), est(build)
+            if (allow_swap and isinstance(node, ExpandJoin)
+                    and node.kind == "inner" and not node.build_suffix
+                    and p_rows is not None and b_rows is not None
+                    and b_rows > 2 * p_rows):
+                probe, build = build, probe
+                probe_keys, build_keys = build_keys, probe_keys
+                swapped = True
+            pi = lower(probe)
+            bi = lower(build)
+            set_output(pi, HashPartition(probe_keys))
+            set_output(bi, HashPartition(build_keys))
             if isinstance(node, LookupJoin):
-                j = JoinSpec(node.probe_keys, node.build_keys,
+                j = JoinSpec(probe_keys, build_keys,
                              payload=node.payload, kind=node.kind,
                              suffix=node.suffix)
             else:
-                j = JoinSpec(node.probe_keys, node.build_keys,
-                             probe_payload=node.probe_payload,
-                             build_payload=node.build_payload,
+                fanout = node.fanout_hint
+                out_rows = est(node)
+                base = b_rows if swapped else p_rows
+                if out_rows is not None and base:
+                    # estimated per-probe-row expansion, padded 2x and
+                    # bounded: capacity sizing only, never semantics
+                    fanout = min(64.0, max(1.0,
+                                           2.0 * out_rows / base))
+                pp = node.probe_payload
+                bp = node.build_payload
+                if swapped:
+                    pp, bp = bp, pp
+                j = JoinSpec(probe_keys, build_keys,
+                             probe_payload=pp, build_payload=bp,
                              kind=node.kind, suffix=node.build_suffix,
-                             expand=True, fanout_hint=node.fanout_hint)
+                             expand=True, fanout_hint=fanout)
             return add(program=None,
                        inputs=(UnionAllInput(pi), UnionAllInput(bi)),
                        output=None, tasks=n_tasks, join=j)
@@ -145,13 +194,18 @@ def execute_plan_dq(
     dicts=None,
     key_spaces=None,
     n_tasks: int = 2,
+    estimator=None,
+    allow_swap: bool = False,
     **graph_kw,
 ) -> OracleTable:
     """Run a logical plan through the DQ stage graph on ``runtime``
     (SimRuntime or a single ActorSystem). ``sources`` maps each table to
-    its partition list (see partition_source)."""
+    its partition list (see partition_source); ``estimator`` /
+    ``allow_swap`` feed statistics into join sizing and build-side
+    selection (plan_to_stages)."""
     from ydb_tpu.dq.compute import run_stage_graph
 
-    stages = plan_to_stages(plan, n_tasks=n_tasks)
+    stages = plan_to_stages(plan, n_tasks=n_tasks, estimator=estimator,
+                            allow_swap=allow_swap)
     return run_stage_graph(stages, sources, runtime, dicts, key_spaces,
                            **graph_kw)

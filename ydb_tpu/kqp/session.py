@@ -182,6 +182,14 @@ class Cluster:
                           self.config.split_rows_per_shard,
                           0, 1 << 40)
         self.dicts = DictionarySet()  # cluster-wide, shared by all tables
+        # StatisticsAggregator service (ydb/core/statistics analog):
+        # merges per-shard column sketches into table-level NDV/null
+        # stats on the run_background cadence; snapshot/restore rides a
+        # tablet executor on the SAME blob store, so a rebooted node
+        # plans with persisted statistics while the first refresh runs
+        from ydb_tpu.stats.aggregator import StatisticsAggregator
+
+        self.stats = StatisticsAggregator(store=self.store)
         self._plan_cache: OrderedDict = OrderedDict()
         self._plan_cache_size = (
             plan_cache_size if plan_cache_size is not None
@@ -379,6 +387,10 @@ class Cluster:
             raise PlanError(str(e)) from e
         self.tables.pop(stmt.table, None)
         self._sweep_trash()
+        self.stats.forget(
+            stmt.table,
+            [sh.shard_id for sh in getattr(t, "shards", ())
+             if hasattr(sh, "shard_id")])
         self._plan_cache.clear()
         # a re-created same-name table reuses shard ids AND restarts
         # portion ids at 1, so stale entries would collide with the new
@@ -436,6 +448,17 @@ class Cluster:
             if hasattr(t, "run_background"):
                 s = t.run_background()
                 stats["compacted"] += s.get("compacted", 0)
+        # statistics refresh rides the maintenance cadence (and fires
+        # right after the compaction/commit churn above, so fresh
+        # portions are sketched while their chunks are page-cache-warm);
+        # incremental — only never-seen portions cost chunk reads. A
+        # failed refresh never blocks maintenance: scan paths simply
+        # degrade to unpruned reads until the next pass.
+        try:
+            self.stats.refresh_cluster(self)
+            stats["stats_tables"] = len(self.stats.all_stats())
+        except Exception:  # noqa: BLE001 - stats are advisory
+            pass
         self._auto_reshard(stats)
         # memory pressure: when the store is (or wraps) a shared page
         # cache, shrink its budget as process RSS approaches the soft
@@ -737,11 +760,17 @@ class Cluster:
             raise PlanError("n_shards must be >= 1")
         old_n = len(t.shards)
         old_gen = t.gen
+        old_ids = [sh.shard_id for sh in getattr(t, "shards", ())
+                   if hasattr(sh, "shard_id")]
         new_gen = t.reshard(n_shards)
         # durable cutover: after this journal entry a reboot sees the
         # new generation; before it, the new blobs are swept as orphans
         self.scheme.reshard_table("/" + name, n_shards, new_gen)
         t.drop_generation_storage(old_gen, old_n)
+        # the old generation's per-portion sketches can never be read
+        # again (generation-scoped shard ids); free them now and let
+        # the next refresh rebuild the table's stats from gen+1
+        self.stats.forget(name, old_ids)
         self._plan_cache.clear()
         return new_gen
 
@@ -764,6 +793,7 @@ class Cluster:
         }
         return Catalog(schemas=schemas, primary_keys=pks,
                        dicts=self.dicts, row_counts=counts,
+                       table_stats=self.stats.all_stats(),
                        udfs=dict(self.udfs))
 
     def _stmt_scalar_exec(self, stmt_db: list, snap: int | None = None,
@@ -879,6 +909,10 @@ class Cluster:
             sources = _SysLazySources(self, sources)
         db = Database(sources=sources, dicts=self.dicts)
         db.block_cache = self.scan_block_cache
+        # aggregator statistics ride into the executor for DQ join
+        # sizing (fanout estimates); cached dict, no refresh on the
+        # statement path
+        db.table_stats = self.stats.all_stats()
         if mesh and self._mesh_exec is not None:
             db.mesh_executor = self._mesh_snapshot(snap)
         return db

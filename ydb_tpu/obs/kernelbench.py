@@ -1,4 +1,4 @@
-"""Micro-benchmark CLI for the two PR-3 hot paths.
+"""Micro-benchmark CLI for the scan/aggregate hot paths.
 
 ``python -m ydb_tpu.obs.kernelbench`` measures, in-process:
 
@@ -6,13 +6,17 @@
     twice (fused single-contraction vs per-aggregate reductions,
     kernels.FUSED_FORCE) and cross-checked against the CPU oracle;
   * staging — payload stream -> rechunk -> TableBlock.from_numpy ->
-    device block throughput (the low-copy block pipeline).
+    device block throughput (the low-copy block pipeline);
+  * pruning (``--pruning``) — zone-map scan pruning on a selective
+    non-PK filter over a time-correlated table: chunks skipped/s and
+    the stats-on vs stats-off (YDB_TPU_STATS=0 analog) speedup, with
+    results asserted bit-identical between the two sides.
 
 Flags: ``--rows`` ``--groups`` ``--aggs`` ``--iters`` ``--block-rows``
-``--json`` (machine-readable report on stdout) and ``--smoke`` (tiny
-sizes, correctness-only; wired into tier-1 as a non-slow test).
-Run under JAX_PLATFORMS=cpu for a stable reference; on accelerators it
-measures whatever backend jax selects.
+``--pruning`` ``--json`` (machine-readable report on stdout) and
+``--smoke`` (tiny sizes, correctness-only; wired into tier-1 as a
+non-slow test). Run under JAX_PLATFORMS=cpu for a stable reference; on
+accelerators it measures whatever backend jax selects.
 """
 
 from __future__ import annotations
@@ -141,6 +145,110 @@ def bench_staging(rows: int, block_rows: int, iters: int) -> dict:
             "staging_rows_per_sec": round(rows / best)}
 
 
+def build_pruning_shard(rows: int, chunk_rows: int, commits: int = 4):
+    """A ColumnShard holding a time-correlated events table: ``ts``
+    increases with insertion order (the log/telemetry shape zone maps
+    thrive on) while NOT being the PK, ``user`` is low-cardinality and
+    ``val`` a decimal payload; ~3% NULL vals."""
+    from ydb_tpu import dtypes
+    from ydb_tpu.engine.blobs import MemBlobStore
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+
+    schema = dtypes.schema(
+        ("event_id", dtypes.INT64, False),
+        ("ts", dtypes.INT64, False),
+        ("user", dtypes.INT32, False),
+        ("val", dtypes.decimal(2)),
+    )
+    shard = ColumnShard(
+        "prune", schema, MemBlobStore(), pk_column="event_id",
+        config=ShardConfig(compact_portion_threshold=10 ** 9,
+                           portion_chunk_rows=chunk_rows))
+    rng = np.random.default_rng(11)
+    per = rows // commits
+    for c in range(commits):
+        n = per if c < commits - 1 else rows - per * (commits - 1)
+        base = c * per
+        cols = {
+            "event_id": (base + np.arange(n)).astype(np.int64),
+            "ts": (base + np.arange(n)).astype(np.int64),
+            "user": rng.integers(0, 64, n).astype(np.int32),
+            "val": rng.integers(0, 10 ** 6, n).astype(np.int64),
+        }
+        validity = {"val": rng.random(n) > 0.03}
+        shard.commit([shard.write(cols, validity)])
+    return shard, rows
+
+
+def bench_pruning(rows: int, chunk_rows: int, iters: int,
+                  selectivity: float = 0.05, shard=None) -> dict:
+    """Selective non-PK filter A/B: stats-on (zone pruning) vs
+    stats-off, bit-identical results required. ``shard`` reuses an
+    already-built events shard (bench.py's NDV pass shares one)."""
+    from ydb_tpu import stats as stats_mod
+    from ydb_tpu.ssa import Agg, AggSpec, Call, Col, FilterStep, \
+        GroupByStep, Op, Program
+    from ydb_tpu.ssa.program import lit
+
+    if shard is None:
+        shard, n = build_pruning_shard(rows, chunk_rows)
+    else:
+        shard, n = shard
+    lo = int(n * 0.5)
+    hi = int(n * (0.5 + selectivity))
+    prog = Program((
+        FilterStep(Call(Op.AND,
+                        Call(Op.GE, Col("ts"), lit(lo)),
+                        Call(Op.LT, Col("ts"), lit(hi)))),
+        GroupByStep(("user",), (
+            AggSpec(Agg.COUNT_ALL, None, "n"),
+            AggSpec(Agg.SUM, "val", "s"),
+        )),
+    ))
+    out: dict = {"rows": n, "chunk_rows": chunk_rows,
+                 "selectivity": selectivity}
+    results = {}
+    for label, force in (("stats", True), ("nostats", False)):
+        stats_mod.STATS_FORCE = force
+        try:
+            best = float("inf")
+            res = None
+            for _ in range(max(1, iters)):
+                t0 = time.perf_counter()
+                res = shard.scan(prog)
+                best = min(best, time.perf_counter() - t0)
+            results[label] = res
+            p = dict(shard.last_scan_pruning)
+            out[f"{label}_seconds"] = round(best, 4)
+            out[f"{label}_chunks_read"] = p.get("chunks_read", 0)
+            if force:
+                out["chunks_skipped"] = p.get("chunks_skipped", 0)
+                out["portions_skipped"] = p.get("portions_skipped", 0)
+                out["chunks_skipped_per_sec"] = round(
+                    p.get("chunks_skipped", 0) / max(best, 1e-9))
+        finally:
+            stats_mod.STATS_FORCE = None
+    if out.get("nostats_seconds"):
+        out["pruning_speedup"] = round(
+            out["nostats_seconds"] / max(out["stats_seconds"], 1e-9), 2)
+    ratio = out.get("nostats_chunks_read", 0) / max(
+        out.get("stats_chunks_read", 1), 1)
+    out["chunk_read_ratio"] = round(ratio, 2)
+    # bit-identity between the two sides (group keys sort-aligned;
+    # NULL slots compare by validity, not by their garbage payload)
+    a, b = results["stats"], results["nostats"]
+    oa = np.argsort(np.asarray(a.column("user")))
+    ob = np.argsort(np.asarray(b.column("user")))
+    for name in a.cols:
+        av, aok = (np.asarray(x) for x in a.cols[name])
+        bv, bok = (np.asarray(x) for x in b.cols[name])
+        if not np.array_equal(aok[oa], bok[ob]) or not np.array_equal(
+                np.where(aok, av, 0)[oa], np.where(bok, bv, 0)[ob]):
+            raise AssertionError(f"stats on/off mismatch on {name}")
+    out["identical"] = True
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m ydb_tpu.obs.kernelbench",
@@ -150,6 +258,10 @@ def main(argv=None) -> int:
     ap.add_argument("--aggs", type=int, default=4)
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--block-rows", type=int, default=1 << 18)
+    ap.add_argument("--pruning", action="store_true",
+                    help="zone-map scan-pruning A/B micro-bench")
+    ap.add_argument("--chunk-rows", type=int, default=1 << 14,
+                    help="portion chunk size for --pruning")
     ap.add_argument("--json", action="store_true",
                     help="one JSON object on stdout")
     ap.add_argument("--smoke", action="store_true",
@@ -159,6 +271,7 @@ def main(argv=None) -> int:
     if args.smoke:
         args.rows, args.groups, args.aggs, args.iters = 5000, 7, 2, 1
         args.block_rows = 2048
+        args.chunk_rows = 256
 
     import jax
 
@@ -168,6 +281,9 @@ def main(argv=None) -> int:
                                    args.iters),
         "staging": bench_staging(args.rows, args.block_rows, args.iters),
     }
+    if args.pruning or args.smoke:
+        report["pruning"] = bench_pruning(
+            args.rows, args.chunk_rows, args.iters)
     if args.json:
         print(json.dumps(report))
     else:
@@ -180,6 +296,14 @@ def main(argv=None) -> int:
               f"oracle={gb.get('oracle_check', 'skipped')}")
         print(f"staging rows={st['rows']} blocks={st['blocks']}: "
               f"{st['staging_rows_per_sec']:,} rows/s")
+        if "pruning" in report:
+            pr = report["pruning"]
+            print(f"pruning rows={pr['rows']}: chunks "
+                  f"{pr.get('stats_chunks_read')} read vs "
+                  f"{pr.get('nostats_chunks_read')} unpruned "
+                  f"({pr.get('chunks_skipped_per_sec'):,} skipped/s, "
+                  f"x{pr.get('pruning_speedup')} speedup, "
+                  f"identical={pr.get('identical')})")
     return 0
 
 

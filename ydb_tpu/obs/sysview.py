@@ -47,6 +47,23 @@ SYS_SCHEMAS = {
         ("generation", dtypes.INT32), ("tx_executed", dtypes.INT64),
         ("tx_committed", dtypes.INT64), ("redo_bytes", dtypes.INT64),
         ("checkpoints", dtypes.INT64)),
+    # column statistics (StatisticsAggregator feed, ydb/core/statistics
+    # analog): table-level NDV / null fractions / physical value bounds
+    # per column — what the planner's estimates are built from
+    "sys_statistics": dtypes.schema(
+        ("table_name", dtypes.STRING), ("column_name", dtypes.STRING),
+        ("ndv", dtypes.INT64), ("null_fraction", dtypes.DOUBLE),
+        ("rows", dtypes.INT64), ("vmin", dtypes.DOUBLE),
+        ("vmax", dtypes.DOUBLE)),
+    # per-shard scan-pruning effectiveness (cumulative since boot):
+    # pruning regressions show here without a bench run
+    "sys_scan_pruning": dtypes.schema(
+        ("table_name", dtypes.STRING), ("shard", dtypes.INT32),
+        ("scans", dtypes.INT64), ("portions_total", dtypes.INT64),
+        ("portions_skipped", dtypes.INT64),
+        ("chunks_read", dtypes.INT64), ("chunks_skipped", dtypes.INT64),
+        ("chunks_fastpath", dtypes.INT64),
+        ("filters_dropped", dtypes.INT64)),
 }
 
 
@@ -178,6 +195,57 @@ def _tablet_counters_rows(cluster):
             [r["checkpoints"] for r in rows]]
 
 
+def _statistics_rows(cluster):
+    """Aggregator column statistics; refreshes tables with no cached
+    stats yet (first read after boot) but serves cached snapshots
+    otherwise — the run_background cadence owns recomputation."""
+    stats = cluster.stats.all_stats()
+    missing = {
+        name: list(getattr(t, "shards", ()))
+        for name, t in cluster.tables.items()
+        if name not in stats and hasattr(t, "shards")
+        and any(hasattr(s, "portions") for s in t.shards)
+    }
+    if missing:
+        stats.update(cluster.stats.refresh_tables(missing))
+    tables, columns, ndv, nullf, rows, vmin, vmax = \
+        [], [], [], [], [], [], []
+    for tname in sorted(stats):
+        st = stats[tname]
+        for col in sorted(st.columns):
+            cs = st.columns[col]
+            tables.append(tname)
+            columns.append(col)
+            ndv.append(cs.ndv)
+            nullf.append(cs.null_fraction)
+            rows.append(cs.rows)
+            vmin.append(float(cs.vmin) if cs.vmin is not None else 0.0)
+            vmax.append(float(cs.vmax) if cs.vmax is not None else 0.0)
+    return [tables, columns, ndv, nullf, rows, vmin, vmax]
+
+
+def _scan_pruning_rows(cluster):
+    cols: list[list] = [[] for _ in range(9)]
+    for tname, t in cluster.tables.items():
+        for i, s in enumerate(getattr(t, "shards", ())):
+            totals = getattr(s, "pruning_totals", None)
+            if totals is None:
+                continue
+            lock = getattr(s, "_stats_lock", None)
+            if lock is not None:
+                with lock:
+                    snap = dict(totals)
+            else:
+                snap = dict(totals)
+            row = [tname, i, snap["scans"], snap["portions_total"],
+                   snap["portions_skipped"], snap["chunks_read"],
+                   snap["chunks_skipped"], snap["chunks_fastpath"],
+                   snap["filters_dropped"]]
+            for c, v in zip(cols, row):
+                c.append(v)
+    return cols
+
+
 _BUILDERS = {
     "sys_partition_stats": _partition_stats_rows,
     "sys_query_stats": _query_stats_rows,
@@ -186,6 +254,8 @@ _BUILDERS = {
     "sys_audit": _audit_rows,
     "sys_memory": _memory_rows,
     "sys_tablet_counters": _tablet_counters_rows,
+    "sys_statistics": _statistics_rows,
+    "sys_scan_pruning": _scan_pruning_rows,
 }
 
 

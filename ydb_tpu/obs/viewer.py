@@ -138,6 +138,7 @@ class Viewer:
             "/viewer/json/whiteboard": self._whiteboard,
             "/viewer/json/sysview": self._sysview,
             "/viewer/json/tablets": self._tablets,
+            "/viewer/json/statistics": self._statistics,
             "/counters": self._counters,
         }
         h = handlers.get(path)
@@ -216,6 +217,18 @@ class Viewer:
         if not names:
             return sorted(sysview.SYS_SCHEMAS)
         return sysview.sys_source(self.cluster, names[0])
+
+    def _statistics(self, query) -> dict:
+        """Column statistics + scan-pruning effectiveness (the stats
+        subsystem's monitoring face): table NDV/null fractions from the
+        aggregator and per-shard pruning counters, so a pruning
+        regression is visible without a bench run."""
+        return {
+            "columns": _source_rows(
+                sysview.sys_source(self.cluster, "sys_statistics")),
+            "pruning": _source_rows(
+                sysview.sys_source(self.cluster, "sys_scan_pruning")),
+        }
 
     def _tablets(self, query) -> dict:
         """Per-tablet counters + per-type aggregates (the counters-

@@ -49,7 +49,8 @@ PAGE = """<!doctype html>
 <main id="main">loading…</main>
 <script>
 "use strict";
-const TABS = ["overview", "tablets", "sysviews", "topics", "counters"];
+const TABS = ["overview", "tablets", "statistics", "sysviews", "topics",
+              "counters"];
 const tabOf = h => TABS.includes(h) ? h : "overview";
 let tab = tabOf(location.hash.slice(1));
 let sysviewName = "";
@@ -99,6 +100,13 @@ const VIEWS = {
       + "<h3>aggregates by type</h3>"
       + renderTable(Object.entries(t.aggregates || {}).map(
           ([k, v]) => Object.assign({type: k}, v)));
+  },
+  async statistics() {
+    const s = await get("/viewer/json/statistics");
+    return "<h3>column statistics (NDV / null fractions)</h3>"
+      + renderTable(s.columns || [])
+      + "<h3>scan pruning (cumulative per shard)</h3>"
+      + renderTable(s.pruning || []);
   },
   async sysviews() {
     const names = await get("/viewer/json/sysview");

@@ -65,6 +65,9 @@ class Database:
     # SQL path's share of the shared-page-cache analog). Databases are
     # per-statement; the cache outlives them.
     block_cache: object = None
+    # aggregator table statistics (stats.cost.TableStats by table name):
+    # feeds DQ join sizing estimates; advisory only
+    table_stats: dict | None = None
 
     def invalidate_compile_cache(self):
         self._compile_cache.clear()
@@ -74,6 +77,24 @@ def _materialize(source: ColumnSource, columns) -> TableBlock:
     names = columns if columns is not None else source.schema.names
     blocks = list(source.blocks(block_rows=1 << 40, columns=names))
     return blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
+
+
+def _pruned_source(src, program, db: Database):
+    """Predicate-pruned view of a scan source, when statistics are on
+    and the source supports it (MultiShardStreamSource.with_predicates).
+    Falls through to the original source otherwise — host-resident
+    ColumnSources have no chunk plane to prune."""
+    from ydb_tpu import stats as stats_mod
+
+    with_preds = getattr(src, "with_predicates", None)
+    if with_preds is None or not stats_mod.stats_enabled():
+        return src
+    from ydb_tpu.stats.zonemap import extract_predicates
+
+    preds, _full = extract_predicates(program, src.schema, db.dicts)
+    if not preds:
+        return src
+    return with_preds(preds)
 
 
 # DQ is the default executor for join-bearing plans (VERDICT r4 item 2);
@@ -153,9 +174,26 @@ def _execute_plan_dq(plan: PlanNode, db: Database) -> TableBlock | None:
             if src is None:
                 return None
             parts[node.table] = _partition_for_dq(src)
+    estimator = None
+    if db.table_stats:
+        from ydb_tpu import stats as stats_mod
+        from ydb_tpu.stats import cost
+
+        if stats_mod.stats_enabled():
+            table_stats = db.table_stats
+            # real schemas type predicate literals (decimal scaling)
+            schemas = {
+                name: db.sources[name].schema for name in parts
+                if hasattr(db.sources.get(name), "schema")
+            }
+
+            def estimator(node):
+                return cost.estimate_plan_rows(node, table_stats,
+                                               schemas)
     rt = ActorSystem(node=1)
     try:
-        stages = plan_to_stages(plan, n_tasks=_DQ_TASKS)
+        stages = plan_to_stages(plan, n_tasks=_DQ_TASKS,
+                                estimator=estimator)
         handle = build_stage_graph(
             stages, parts, rt, db.dicts, db.key_spaces,
             block_rows=_DQ_BLOCK_ROWS, compile_cache=db._compile_cache)
@@ -211,6 +249,11 @@ def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
                 key_spaces=db.key_spaces,
             ).detach()  # cache compiled state, not the source arrays
             db._compile_cache[key] = ex
+        # zone-map scan pruning (stats.zonemap): the pushdown program's
+        # conjunctive filters skip portions/chunks before any blob read.
+        # The pruned view carries its predicate fingerprint into the
+        # device cache key, so pruned streams never alias unpruned ones.
+        src = _pruned_source(src, plan.program, db)
         stream = src.blocks(1 << 22, ex.read_cols)
         bc = db.block_cache
         key_of = getattr(src, "device_cache_key", None)

@@ -69,6 +69,10 @@ class Catalog:
     # obs/sysview.table_stats): drives CBO-lite join ordering — among
     # connectable candidates, smaller estimated sides join first
     row_counts: dict[str, int] = dataclasses.field(default_factory=dict)
+    # table -> stats.cost.TableStats from the StatisticsAggregator:
+    # per-column NDV / null fractions / value bounds. Fills row-count
+    # gaps for join ordering and feeds downstream estimators.
+    table_stats: dict = dataclasses.field(default_factory=dict)
     # registered scalar UDFs: name -> (vectorized fn, result LogicalType)
     udfs: dict[str, tuple] = dataclasses.field(default_factory=dict)
 
@@ -1522,13 +1526,19 @@ class _SelectPlanner:
             t = scopes[i].table
             if t is not None and t in self.catalog.row_counts:
                 return float(self.catalog.row_counts[t])
+            if t is not None and t in self.catalog.table_stats:
+                # aggregator statistics fill row-count gaps (a table
+                # whose cheap metadata count is unknown may still have
+                # a sketched row count)
+                return float(self.catalog.table_stats[t].rows)
             return float("inf")
 
         # CBO-lite: with table statistics available (and no LEFT JOINs,
         # which do not commute freely), prefer the SMALLEST connectable
         # side next — dimension tables join before fact expansions
         # (ydb/library/yql/core/cbo greedy ordering shape)
-        use_stats = bool(self.catalog.row_counts) and not any(
+        use_stats = bool(self.catalog.row_counts
+                         or self.catalog.table_stats) and not any(
             kind == "left" for _, _, kind in join_specs)
 
         join_order: list[int] = []

@@ -89,6 +89,8 @@ class _Lowering:
         # psum/pmin/pmax merging over the mesh
         self.partial_slots = partial_slots
         self.group_layout: tuple = (None, None)
+        # advisory NDV-based distinct-group estimate (compile_program)
+        self.group_est: float | None = None
         self.types: dict[str, dtypes.LogicalType] = {
             f.name: f.type for f in schema.fields
         }
@@ -128,6 +130,7 @@ def compile_program(
     key_spaces: dict[str, int] | None = None,
     partial_slots: bool = False,
     dict_aliases: dict[str, str] | None = None,
+    group_est: float | None = None,
 ) -> CompiledProgram:
     # mandatory precondition: no program reaches the trace unverified.
     # Malformed programs raise VerificationError (a PlanError) with
@@ -144,6 +147,9 @@ def compile_program(
         out_nullable = {n: True for n in out_nullable}
 
     ctx = _Lowering(schema, dicts, key_spaces, partial_slots, dict_aliases)
+    # advisory distinct-group estimate (stats.cost NDV product): picks
+    # between equally-exact group-by tiers; never a correctness bound
+    ctx.group_est = group_est
 
     # ---- static pass: resolve plan, types, aux tables, output schema ----
     plan: list = []  # (kind, payload) closures prepared statically
@@ -952,22 +958,30 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types,
                       cur_nullable: dict | None = None):
     keys = step.keys
     bounds = []
-    dense = len(keys) > 0
     for k in keys:
         if k not in cur_types:
             raise KeyError(f"group-by key {k} not in scope")
-        b = ctx.key_bound(k, cur_types[k])
-        if b is None:
-            dense = False
-            break
-        bounds.append(b)
-    num_groups = 0
-    if dense:
-        num_groups = 1
+        bounds.append(ctx.key_bound(k, cur_types[k]))
+    # exact distinct-combination bound: the product of per-key
+    # cardinality bounds (+1 for the NULL slot each), when every key
+    # has one (dictionary sizes, stats zone maps, caller key_spaces)
+    bound_product: int | None = None
+    if keys and all(b is not None for b in bounds):
+        bound_product = 1
         for b in bounds:
-            num_groups *= b + 1
-        if num_groups > _DENSE_GROUP_LIMIT:
-            dense = False
+            bound_product *= b + 1
+    num_groups = bound_product or 0
+    dense = bound_product is not None and \
+        bound_product <= _DENSE_GROUP_LIMIT
+    if dense and ctx.group_est is not None and not ctx.partial_slots \
+            and num_groups > 64 and num_groups > 8 * ctx.group_est:
+        # NDV says the mixed-radix slot space is mostly dead (e.g. two
+        # 100-ary keys with 50 real combinations): the sorted tier at
+        # bound_product capacity beats scattering into dead slots. Both
+        # tiers are exact — this is purely a cost choice. partial_slots
+        # callers need the slot layout for mesh psum merging, so they
+        # keep dense.
+        dense = False
 
     out_types: dict[str, dtypes.LogicalType] = {}
     for k in keys:
@@ -1000,6 +1014,7 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types,
     use_dense = dense
     b_tuple = tuple(bounds) if dense else ()
     explicit_cap = step.max_groups
+    group_bound = bound_product  # exact cap for the sorted tier
     keep_slots = ctx.partial_slots and (dense or not keys)
     if not keys:
         ctx.group_layout = ("keyless", 1)
@@ -1341,12 +1356,16 @@ def _resolve_group_by(ctx: _Lowering, step: GroupByStep, cur_types,
             else:
                 # a block of N rows has at most N groups: default the group
                 # capacity to the block capacity so nothing is ever
-                # silently dropped; an explicit max_groups caps it.
-                ng = (
-                    min(explicit_cap, capacity)
-                    if explicit_cap is not None
-                    else capacity
-                )
+                # silently dropped; an explicit max_groups caps it, and
+                # a statistics-derived bound product (exact — distinct
+                # combinations cannot exceed it) sizes the capacity
+                # instead of the block-capacity worst case.
+                caps = [capacity]
+                if explicit_cap is not None:
+                    caps.append(explicit_cap)
+                if group_bound is not None:
+                    caps.append(group_bound)
+                ng = max(1, min(caps))
                 gid, ng_scalar = kernels.group_ids_sorted(kcols, live, ng)
                 ng_scalar = jnp.minimum(ng_scalar, jnp.int32(ng))
         else:
