@@ -40,7 +40,11 @@ extra.stats_pruning {chunks read/skipped, pruning_hit_rate,
 pruning_speedup} plus extra.stats_ndv per-column NDV relative error;
 YDB_TPU_BENCH_STATS_ROWS sizes it). Engine-tier runs also
 report per-stage scan seconds (engine_q{1,6}_stage_seconds:
-read/merge/stage/compute) from the streaming reader's StageTimer.
+read/merge/stage/compute) from the streaming reader's StageTimer,
+warm-repeat p50/p99 latency from obs.counters histograms
+(engine_q{1,6}_latency, sql_q1_latency) and one profiled run's
+QueryProfile (engine_q{1,6}_profile, sql_q1_profile: stage seconds,
+compile-vs-execute split, pruning counts — obs.profile).
 Phase progress logs to stderr; stdout stays the one JSON line.
 
 Robustness: each tier's results checkpoint to disk as the tier
@@ -206,14 +210,15 @@ def check_q1(out1, li, nls, base1):
             f"engine/baseline mismatch on {eng_col}")
 
 
-def timed_cold_warm(fn, iters, deadline=None):
+def timed_cold_warm(fn, iters, deadline=None, hist=None):
     """(cold_seconds, warm_best_seconds, last_result).
 
     ``deadline`` (seconds since bench start) bounds the WARM repeats:
     the budget must hold mid-tier, not just between tiers — an overrun
     here is what gets the whole bench killed externally (and a killed
     TPU claim wedges the tunnel for hours). With no warm repeat left,
-    warm reports the cold time."""
+    warm reports the cold time. ``hist`` (obs.counters.Histogram)
+    observes every WARM repeat — per-tier p50/p99 in the report."""
     t0 = time.perf_counter()
     out = fn()
     cold = time.perf_counter() - t0
@@ -224,8 +229,21 @@ def timed_cold_warm(fn, iters, deadline=None):
             break
         t0 = time.perf_counter()
         out = fn()
-        warm = min(warm, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        if hist is not None:
+            hist.observe(dt)
+        warm = min(warm, dt)
     return cold, (cold if warm == float("inf") else warm), out
+
+
+def _latency_summary(hist) -> dict | None:
+    """p50/p99 (ms) off a per-tier histogram; None when it saw < 2
+    repeats (a single sample's percentiles are just that sample)."""
+    if hist.count < 2:
+        return None
+    return {"p50_ms": round(hist.percentile(0.5) * 1e3, 3),
+            "p99_ms": round(hist.percentile(0.99) * 1e3, 3),
+            "samples": hist.count}
 
 
 def _q1_flag_ab(src, blocks, n_rows, block_rows, iters, sides, set_flag):
@@ -645,9 +663,14 @@ def main():
                 return go
 
             _log("engine tier: scans")
+            from ydb_tpu.obs import profile as profile_mod
+            from ydb_tpu.obs.counters import Histogram
+
             deadline = budget - 45
+            ehist1 = Histogram()
             ecold1, ewarm1, eout1 = timed_cold_warm(
-                run_engine(tpch.q1_program()), db_iters, deadline)
+                run_engine(tpch.q1_program()), db_iters, deadline,
+                hist=ehist1)
             # verify + record q1 BEFORE anything else can run out of
             # budget: measured numbers survive a mid-tier _BudgetSpent
             eres = {n: np.asarray(v[0]) for n, v in eout1.cols.items()}
@@ -666,17 +689,39 @@ def main():
             # concurrent stages overlap, so they may sum past wall time
             extra["engine_q1_stage_seconds"] = dict(
                 shard.last_scan_stages)
+            lat = _latency_summary(ehist1)
+            if lat:
+                extra["engine_q1_latency"] = lat
+            # one profiled warm run: the QueryProfile (stage seconds,
+            # compile-vs-execute split, pruning) rides the bench JSON.
+            # Budget-guarded like every other run — an extra scan past
+            # the external kill threshold wedges the TPU claim.
+            if _budget_left(budget) > 30:
+                with profile_mod.profiled("q1",
+                                          query_class="engine") as ph:
+                    shard.scan(tpch.q1_program())
+                extra["engine_q1_profile"] = ph.profile.to_dict()
             engine_warm_rps = round(e_rows / ewarm1)
             _checkpoint("engine_q1", extra)
             if _budget_left(budget) < 45:
                 raise _BudgetSpent("engine_q6,sql_tier:budget")
+            ehist6 = Histogram()
             ecold6, ewarm6, eout6 = timed_cold_warm(
-                run_engine(tpch.q6_program()), db_iters, deadline)
+                run_engine(tpch.q6_program()), db_iters, deadline,
+                hist=ehist6)
             assert int(np.asarray(eout6.cols["revenue"][0])[0]) == ebase6
             extra["engine_q6_cold_rows_per_sec"] = round(e_rows / ecold6)
             extra["engine_q6_warm_rows_per_sec"] = round(e_rows / ewarm6)
             extra["engine_q6_stage_seconds"] = dict(
                 shard.last_scan_stages)
+            lat = _latency_summary(ehist6)
+            if lat:
+                extra["engine_q6_latency"] = lat
+            if _budget_left(budget) > 30:
+                with profile_mod.profiled("q6",
+                                          query_class="engine") as ph:
+                    shard.scan(tpch.q6_program())
+                extra["engine_q6_profile"] = ph.profile.to_dict()
             _checkpoint("engine_q6", extra)
 
             # ---- sql tier: parse -> plan -> execute over the store ----
@@ -746,13 +791,22 @@ def main():
                     return to_host(execute_plan(plan, sql_db))
                 return go
 
+            shist1 = Histogram()
             scold1, swarm1, sout1 = timed_cold_warm(
-                run_sql(TPCH["q1"]), db_iters, deadline)
+                run_sql(TPCH["q1"]), db_iters, deadline, hist=shist1)
             assert np.allclose(
                 np.sort(np.asarray(sout1.cols["count_order"][0])),
                 np.sort(ebase1["count"]))
             extra["sql_q1_cold_rows_per_sec"] = round(e_rows / scold1)
             extra["sql_q1_warm_rows_per_sec"] = round(e_rows / swarm1)
+            lat = _latency_summary(shist1)
+            if lat:
+                extra["sql_q1_latency"] = lat
+            if _budget_left(budget) > 30:
+                with profile_mod.profiled(TPCH["q1"],
+                                          query_class="sql") as ph:
+                    run_sql(TPCH["q1"])()
+                extra["sql_q1_profile"] = ph.profile.to_dict()
             if _budget_left(budget) < 45:
                 raise _BudgetSpent("sql_q6:budget")
             scold6, swarm6, sout6 = timed_cold_warm(

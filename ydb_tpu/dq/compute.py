@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import threading
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -248,6 +250,11 @@ class ComputeActor(Actor):
         self.spiller = spiller or Spiller()
         self.abort_target: ActorId | None = None
         self._aborted = False
+        # profile span for this task (opened at StartTask when a query
+        # trace is active on the executer thread; finished with the
+        # task's accumulated device-compute seconds)
+        self._span = None
+        self._compute_s = 0.0
 
         self._in_finished: set[int] = set()
         # agg stages accumulate partial states THROUGH the spiller
@@ -307,6 +314,13 @@ class ComputeActor(Actor):
         from ydb_tpu.runtime.interconnect import Undelivered
 
         if isinstance(message, StartTask):
+            from ydb_tpu.obs import tracing
+
+            parent = tracing.current_span()
+            if parent is not None and self._span is None:
+                self._span = parent.child("dq.task").set(
+                    stage=self.task.stage, task=self.task.task_id,
+                    thread=threading.get_ident())
             self._start_source()
         elif isinstance(message, _PumpSource):
             if not self._aborted:
@@ -475,15 +489,26 @@ class ComputeActor(Actor):
         self._ingest(blk)
         self.send(self.self_id, _PumpSource())
 
+    def _timed(self, fn, *args):
+        """Charge a stage-program dispatch to the task's profile span
+        (pass-through when no trace is active)."""
+        if self._span is None:
+            return fn(*args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        self._compute_s += time.perf_counter() - t0
+        return out
+
     def _ingest(self, block: TableBlock):
         spec = self.task.stage_spec
         if spec.final_program is not None:
             # aggregate stage: per-block partial, accumulated via the
             # spiller (blocks beyond the quota go to blobs)
             self._acc_ids.append(self.spiller.put(
-                block_to_payload(self.compiled.run_block(block))))
+                block_to_payload(
+                    self._timed(self.compiled.run_block, block))))
         else:
-            out = self.compiled.run_block(block)
+            out = self._timed(self.compiled.run_block, block)
             self._emit(out)
 
     def _finish_input(self):
@@ -494,7 +519,7 @@ class ComputeActor(Actor):
             build = _assemble(self._join_acc[1],
                               self.compiled.in_schemas[1])
             self._join_acc = {0: [], 1: []}
-            self._emit(self.compiled.run_join(probe, build))
+            self._emit(self._timed(self.compiled.run_join, probe, build))
             self._finish_output()
             return
         if spec.final_program is not None:
@@ -504,11 +529,11 @@ class ComputeActor(Actor):
                                      self.compiled.mid_schema)
                     for sid in self._acc_ids
                 ]
-                self._emit(self.compiled.run_final(blocks))
+                self._emit(self._timed(self.compiled.run_final, blocks))
             else:
                 # empty input still finalizes (COUNT over nothing etc.)
                 empty = _empty_block(self.compiled.mid_schema)
-                self._emit(self.compiled.run_final([empty]))
+                self._emit(self._timed(self.compiled.run_final, [empty]))
             self._acc_ids = []
         self._finish_output()
 
@@ -555,6 +580,10 @@ class ComputeActor(Actor):
 
     def _finish_output(self):
         self._done = True
+        if self._span is not None:
+            self._span.set(compute_seconds=round(self._compute_s, 6))
+            self._span.finish()
+            self._span = None
         if isinstance(self.task.stage_spec.output, ResultOutput):
             self.send(self.result_target, ResultData(None, True))
             return
@@ -687,8 +716,10 @@ def compile_stages(
     whole compiled chain from the shipped stage specs (the task-start
     path, kqp_node_service.cpp:121)."""
     from ydb_tpu.engine.scan import required_columns
+    from ydb_tpu.obs import tracing
 
     compiled: list[_CompiledStage] = []
+    cache_hits = cache_misses = 0
     for si, spec in enumerate(stages):
         in_schemas = []
         for inp in spec.inputs:
@@ -725,12 +756,18 @@ def compile_stages(
                   if key_spaces else None)
             hit = compile_cache.get(ck)
             if hit is not None:
+                cache_hits += 1
                 compiled.append(hit)
                 continue
+        cache_misses += 1
         stage = _CompiledStage(spec, in_schemas, dicts, key_spaces)
         if ck is not None:
             compile_cache[ck] = stage
         compiled.append(stage)
+    # stage-compile cache effectiveness rides the query trace (the DQ
+    # half of the compile-vs-execute attribution)
+    tracing.annotate(dq_compile_hits=cache_hits,
+                     dq_compile_misses=cache_misses)
     return compiled
 
 

@@ -530,16 +530,24 @@ def stream_blocks(payloads, names, sch, cap: int,
         return False
 
     def produce():
+        # the conveyor re-activated the consumer's span on this worker
+        # thread; the producer span proves (and tests assert) the
+        # trace id crossed the pool
+        from ydb_tpu.obs import tracing
+
         emitted = 0
         try:
-            for cols, valid in pieces:
-                if stop.is_set():
-                    return
-                emitted += 1
-                if emitted - 1 < start_block:
-                    continue  # seek skips BEFORE staging costs anything
-                if not put(("blk", build(cols, valid))):
-                    return
+            with tracing.span("scan.producer") as psp:
+                psp.set(thread=threading.get_ident())
+                for cols, valid in pieces:
+                    if stop.is_set():
+                        return
+                    emitted += 1
+                    if emitted - 1 < start_block:
+                        continue  # seek skips BEFORE staging costs
+                    if not put(("blk", build(cols, valid))):
+                        return
+                psp.set(blocks=emitted)
             put(("end", emitted))
         except BaseException as e:  # noqa: BLE001 - relayed to consumer
             put(("err", e))
@@ -602,6 +610,15 @@ class MultiShardStreamSource:
                                 columns=self.columns_read, timer=timer)
             for s in shards
         ]
+
+    def attach_timer(self, timer) -> "MultiShardStreamSource":
+        """Late-bind a StageTimer (the SQL scan path creates the source
+        at snapshot time, before any program — and with it any profile
+        span — exists)."""
+        self.timer = timer
+        for sub in self.subs:
+            sub.timer = timer
+        return self
 
     def with_predicates(self, preds) -> "MultiShardStreamSource":
         """A pruned VIEW of this source for one program's conjunctive

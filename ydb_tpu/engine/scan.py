@@ -17,6 +17,7 @@ prefetch and credit windows attach here (ydb_tpu.dq channels reuse it).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Iterator
 
 import jax
@@ -186,6 +187,16 @@ class ScanExecutor:
         # programs run over small partial blocks and keep their own
         # sizing
         self.group_est = group_est
+        # first dispatch of each jitted program (partial / combine /
+        # final) = jit trace + XLA compile; measured once per program
+        # and summed into first_trace_seconds so scan sites can
+        # attribute the compile-vs-execute split that separates cold
+        # from warm runs (finalize compiles too — attributing only the
+        # partial would leak its compile into "execute")
+        self.first_trace_seconds: float | None = None
+        self._partial_traced = False
+        self._combine_traced = False
+        self._finalize_traced = False
         self.read_cols = required_columns(program, source.schema)
         in_schema = source.schema.select(self.read_cols)
         # verify the ORIGINAL program before the two-phase rewrite:
@@ -262,15 +273,32 @@ class ScanExecutor:
         self.source = None
         return self
 
+    def _timed_first(self, flag: str, fn, *args):
+        """A program's first dispatch runs jit trace + XLA compile:
+        time it synchronously, once (one-off sync; warm stays async),
+        accumulating into ``first_trace_seconds``."""
+        if getattr(self, flag):
+            return fn(*args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        setattr(self, flag, True)
+        self.first_trace_seconds = (
+            (self.first_trace_seconds or 0.0)
+            + time.perf_counter() - t0)
+        return out
+
     def run_block(self, block: TableBlock) -> TableBlock:
-        return self._partial_jit(block, self._partial_aux)
+        return self._timed_first("_partial_traced", self._partial_jit,
+                                 block, self._partial_aux)
 
     def finalize(self, partials: list[TableBlock]) -> TableBlock:
         """Merge per-block partial results and run the final program —
         one jitted device computation end to end."""
         if self.final is None and len(partials) == 1:
             return partials[0]
-        return self._finalize_jit(tuple(partials), self._final_aux)
+        return self._timed_first("_finalize_traced", self._finalize_jit,
+                                 tuple(partials), self._final_aux)
 
     def run_stream(self, blocks, timer=None) -> TableBlock:
         """Drive a block stream with bounded in-flight work; returns the
@@ -303,9 +331,9 @@ class ScanExecutor:
                     self._combine_jit is not None
                     and len(partials) >= self.combine_every
                 ):
-                    merged = self._combine_jit(
-                        tuple(partials), self._combine_aux
-                    )
+                    merged = self._timed_first(
+                        "_combine_traced", self._combine_jit,
+                        tuple(partials), self._combine_aux)
                     partials = []
                     admit(merged)
         with computing():

@@ -470,6 +470,19 @@ class ColumnShard:
         key_spaces: dict[str, int] | None = None,
         table_stats=None,
     ) -> OracleTable:
+        from ydb_tpu.obs import tracing
+
+        # profile surface: when a query trace is active the scan's
+        # stage seconds / pruning counters / compile-cache status ride
+        # a "shard.scan" span (the same numbers the probes fire)
+        with tracing.span("shard.scan") as sp:
+            return self._scan_profiled(program, snap, key_spaces,
+                                       table_stats, sp)
+
+    def _scan_profiled(
+        self, program: Program, snap: int | None,
+        key_spaces: dict[str, int] | None, table_stats, sp,
+    ) -> OracleTable:
         """Streamed scan: portion-granular fetch -> (PK merge/dedup) ->
         fixed-capacity device blocks -> compiled program. Host memory is
         bounded by the largest PK-overlap cluster, not the table
@@ -558,7 +571,8 @@ class ColumnShard:
             hit = self._scan_cache.get(key)
             if hit is not None and hit[1] == sizes:
                 self._scan_cache.move_to_end(key)
-        if hit is not None and hit[1] == sizes:
+        fresh = not (hit is not None and hit[1] == sizes)
+        if not fresh:
             ex = hit[0]
         else:
             ex = ScanExecutor(
@@ -623,9 +637,18 @@ class ColumnShard:
             _P_SCAN.fire(shard=self.shard_id,
                          portions=len(src.metas),
                          chunks_read=src.chunks_read,
-                         compiled_fresh=hit is None,
+                         compiled_fresh=fresh,
                          block_cache_hit=self.block_cache.hits
                          > hit_before)
+        if sp.recording:
+            sp.set(shard=self.shard_id, rows=int(out.num_rows),
+                   compile_cache=("miss" if fresh else "hit"),
+                   **{f"stage_{k}": v
+                      for k, v in self.last_scan_stages.items()},
+                   **pruning)
+            if fresh and ex.first_trace_seconds:
+                sp.set(first_trace_seconds=round(
+                    ex.first_trace_seconds, 6))
         return out
 
     def _group_hints(self, program: Program, metas, key_spaces: dict,

@@ -164,14 +164,19 @@ def plan_to_stages(plan, n_tasks: int = 2, estimator=None,
                        dict_aliases=node.dict_aliases)
         raise NotImplementedError(node)
 
-    root = lower(plan)
-    set_output(root, ResultOutput())
-    out = []
-    for kw in stages:
-        kw.setdefault("join", None)
-        kw.setdefault("final_program", None)
-        kw.setdefault("dict_aliases", ())
-        out.append(StageSpec(**kw))
+    from ydb_tpu.obs import tracing
+
+    with tracing.span("dq.lower") as sp:
+        root = lower(plan)
+        set_output(root, ResultOutput())
+        out = []
+        for kw in stages:
+            kw.setdefault("join", None)
+            kw.setdefault("final_program", None)
+            kw.setdefault("dict_aliases", ())
+            out.append(StageSpec(**kw))
+        sp.set(stages=len(out),
+               joins=sum(1 for s in out if s.join is not None))
     return out
 
 

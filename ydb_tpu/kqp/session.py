@@ -102,6 +102,46 @@ def _process_rss() -> int:
         return 0
 
 
+class _BoundedCompileCache(dict):
+    """LRU-bounded, lock-guarded dict for the cluster compile cache.
+
+    Compiled entries pin XLA executables + device-resident aux arrays,
+    and ad-hoc workloads mint a fresh key per distinct statement — an
+    unbounded dict is a leak (same reasoning as the shard scan cache
+    and the plan cache beside this one). Dict-compatible ``get`` /
+    ``[]=`` so the plan executor and DQ stage compiler use it
+    unchanged; the lock serializes the LRU bookkeeping against
+    concurrent sessions (touch vs evict is the PR 3 race shape)."""
+
+    def __init__(self, capacity: int = 256):
+        super().__init__()
+        self.capacity = max(1, capacity)
+        import threading
+
+        self._lock = threading.Lock()
+        self._order: OrderedDict = OrderedDict()
+
+    def get(self, key, default=None):
+        with self._lock:
+            if key in self._order:
+                self._order.move_to_end(key)
+            return dict.get(self, key, default)
+
+    def __setitem__(self, key, value):
+        with self._lock:
+            dict.__setitem__(self, key, value)
+            self._order[key] = None
+            self._order.move_to_end(key)
+            while len(self._order) > self.capacity:
+                old, _ = self._order.popitem(last=False)
+                dict.pop(self, old, None)
+
+    def clear(self):
+        with self._lock:
+            dict.clear(self)
+            self._order.clear()
+
+
 class Cluster:
     """Storage + schema tablet + plan cache: one in-process database.
 
@@ -194,6 +234,19 @@ class Cluster:
         self._plan_cache_size = (
             plan_cache_size if plan_cache_size is not None
             else self.config.plan_cache_size)
+        # node-scoped compiled-program cache shared by every statement's
+        # Database (the computation-pattern cache across sessions): a
+        # second run of the same SELECT reuses its jitted executors
+        # instead of retracing, which is what makes warm-vs-cold
+        # (compile-cache hit/miss) a measurable per-query attribute.
+        # Invalidated with the plan cache (dict growth bakes into aux);
+        # LRU-bounded — compiled entries pin XLA executables.
+        self._compile_cache: dict = _BoundedCompileCache()
+        # bounded ring of recent query profiles feeding last_profile,
+        # sys_top_queries / sys_query_log and /viewer/json/query_profile
+        from ydb_tpu.obs.profile import ProfileRing
+
+        self.profiles = ProfileRing()
         self._dict_seq = 0
         self._dict_durable: dict[str, int] = {}
         self._replay_dict_journal()
@@ -231,6 +284,12 @@ class Cluster:
         for t in self.tables.values():
             if hasattr(t, "sweep_stale_generations"):
                 t.sweep_stale_generations()
+
+    def _invalidate_plans(self) -> None:
+        """Drop cached plans AND compiled executors together: both bake
+        dictionary contents / schema shape into plan-time state."""
+        self._plan_cache.clear()
+        self._compile_cache.clear()
 
     # ---- dict durability (cluster-wide journal) ----
 
@@ -368,7 +427,7 @@ class Cluster:
         except SchemeError as e:
             raise PlanError(str(e)) from e
         self._instantiate(desc)
-        self._plan_cache.clear()
+        self._invalidate_plans()
 
     def drop_table(self, stmt: ast.DropTable) -> None:
         from ydb_tpu.scheme.shard import SchemeError
@@ -391,7 +450,7 @@ class Cluster:
             stmt.table,
             [sh.shard_id for sh in getattr(t, "shards", ())
              if hasattr(sh, "shard_id")])
-        self._plan_cache.clear()
+        self._invalidate_plans()
         # a re-created same-name table reuses shard ids AND restarts
         # portion ids at 1, so stale entries would collide with the new
         # table's keys and serve the dropped table's rows
@@ -428,7 +487,7 @@ class Cluster:
         t.alter_schema(desc.schema, desc.schema_version, desc.column_added)
         if row_strip:
             self.scheme.clear_strip("/" + stmt.table)
-        self._plan_cache.clear()
+        self._invalidate_plans()
 
     def run_background(self) -> dict:
         """One maintenance pass: table compaction/TTL + CDC drains (the
@@ -693,7 +752,7 @@ class Cluster:
         t, arrays, val = self._insert_arrays(stmt)
         res = t.insert(arrays, val)  # journals dict growth via pre_commit
         # new dictionary entries may invalidate cached plan aux tables
-        self._plan_cache.clear()
+        self._invalidate_plans()
         return res
 
     def insert_ops(self, stmt: ast.Insert):
@@ -704,7 +763,7 @@ class Cluster:
             raise PlanError(
                 f"interactive transactions support row tables; "
                 f"{stmt.table} is a column table")
-        self._plan_cache.clear()
+        self._invalidate_plans()
         return t, t.insert_ops(arrays, val)
 
     def _insert_arrays(self, stmt: ast.Insert):
@@ -771,7 +830,7 @@ class Cluster:
         # again (generation-scoped shard ids); free them now and let
         # the next refresh rebuild the table's stats from gen+1
         self.stats.forget(name, old_ids)
-        self._plan_cache.clear()
+        self._invalidate_plans()
         return new_gen
 
     # ---- query path ----
@@ -835,7 +894,7 @@ class Cluster:
 
         self._mesh_exec = MeshPlanExecutor(
             MeshDatabase({}, dicts=self.dicts), mesh)
-        self._plan_cache.clear()
+        self._invalidate_plans()
 
     def disable_mesh(self) -> None:
         self._mesh_exec = None
@@ -886,7 +945,7 @@ class Cluster:
         """Register a scalar UDF: ``fn`` takes numpy arrays (one per SQL
         argument) and returns an array; usable in any expression."""
         self.udfs[name.lower()] = (fn, out_type)
-        self._plan_cache.clear()
+        self._invalidate_plans()
 
     def snapshot_db(self, snap: int | None = None,
                     include_sys: bool = False,
@@ -909,6 +968,10 @@ class Cluster:
             sources = _SysLazySources(self, sources)
         db = Database(sources=sources, dicts=self.dicts)
         db.block_cache = self.scan_block_cache
+        # compiled programs persist across statements (the node-scoped
+        # pattern cache): the second run of a SELECT is a compile-cache
+        # hit — warm execute only, no retrace
+        db._compile_cache = self._compile_cache
         # aggregator statistics ride into the executor for DQ join
         # sizing (fanout estimates); cached dict, no refresh on the
         # statement path
@@ -977,24 +1040,33 @@ class Cluster:
         against it, and such plans never enter the cache.
         ``access_check(plan_node)`` gates plan-time subquery execution
         (ACL enforcement happens BEFORE any table is read)."""
+        from ydb_tpu.obs import tracing
+
         if snap is None and access_check is None:
             hit = self._plan_cache.get(sql)
             if hit is not None:
                 if _P_PLAN_CACHE:
                     _P_PLAN_CACHE.fire(hit=True)
+                tracing.annotate(plan_cache="hit")
                 self._plan_cache.move_to_end(sql)
                 return hit
             if _P_PLAN_CACHE:
                 _P_PLAN_CACHE.fire(hit=False)
-        stmt = parse(sql)
+            tracing.annotate(plan_cache="miss")
+        with tracing.span("parse"):
+            stmt = parse(sql)
         if isinstance(stmt, ast.Explain):
             # EXPLAIN precomputes scalar subqueries exactly like
             # execution would (same guards, same single snapshot), so
-            # the rendered plan is the plan the engine would run
+            # the rendered plan is the plan the engine would run.
+            # ANALYZE additionally executes it, so the statement db and
+            # dict aliases ride along for the dispatch path.
+            stmt_db: list = [None]
             pq = plan_select_full(
                 stmt.select, self.catalog(),
-                self._stmt_scalar_exec([None], snap, access_check))
-            return ("explain", pq.plan)
+                self._stmt_scalar_exec(stmt_db, snap, access_check))
+            return ("explain", pq.plan, dict(pq.dict_aliases),
+                    stmt_db[0], stmt.analyze)
         if not isinstance(stmt, (ast.Select, ast.UnionAll)):
             return stmt
 
@@ -1128,6 +1200,9 @@ class Session:
     # authenticated principal (the auth token); None = internal
     # session, exempt from ACL checks
     principal: str | None = None
+    # QueryProfile of the most recent statement (None with profiling
+    # disabled — YDB_TPU_PROFILE=0)
+    last_profile: object = None
 
     def execute(self, sql: str, trace_id: int | None = None):
         """Returns OracleTable for SELECT, TxResult for INSERT, None DDL."""
@@ -1182,31 +1257,53 @@ class Session:
 
     def _execute_admitted(self, sql: str, trace_id: int | None = None,
                           t0: float | None = None):
+        import contextlib
         import time as _time
+
+        from ydb_tpu.obs import tracing
 
         c = self.cluster
         if t0 is None:
             t0 = _time.monotonic()
+        # profiling on (default): the root span is ACTIVATED so every
+        # layer below — planner, executor, scans, DQ tasks, conveyor
+        # prefetch producers — threads children under this trace id.
+        # YDB_TPU_PROFILE=0 keeps the root/plan/execute spans (the
+        # pre-profile surface) but skips activation: no child spans, no
+        # attribute computation anywhere below, no profile assembly.
+        prof = tracing.profiling_enabled()
+
+        def act(sp):
+            return tracing.activate(sp) if prof \
+                else contextlib.nullcontext()
+
         with c.tracer.trace("query", trace_id) as span:
-            with span.child("plan") as plan_span:
-                planned = c.plan(
-                    sql,
-                    snap=self._tx["snap"] if self._tx else None,
-                    access_check=(self._plan_access_check
-                                  if self.principal is not None
-                                  else None))
-                if not isinstance(planned, tuple):
-                    kind = type(planned).__name__.lower()
-                elif planned[0] == "explain":
-                    kind = "explain"
-                else:
-                    kind = "select"
-                plan_span.set(kind=kind)
-            span.set(kind=kind)
-            with span.child("execute"):
-                out = self._dispatch(planned)
-        seconds = _time.monotonic() - t0
-        rows = out.num_rows if isinstance(out, OracleTable) else 0
+            with act(span):
+                with span.child("plan") as plan_span:
+                    with act(plan_span):
+                        planned = c.plan(
+                            sql,
+                            snap=self._tx["snap"] if self._tx else None,
+                            access_check=(self._plan_access_check
+                                          if self.principal is not None
+                                          else None))
+                    if not isinstance(planned, tuple):
+                        kind = type(planned).__name__.lower()
+                    elif planned[0] == "explain":
+                        kind = "explain"
+                    else:
+                        kind = "select"
+                    plan_span.set(kind=kind)
+                span.set(kind=kind)
+                with span.child("execute") as exec_span:
+                    with act(exec_span):
+                        out = self._dispatch(planned)
+            # totals attach BEFORE the root span finishes: a finished
+            # span is visible to exporter threads, whose attrs
+            # iteration must never race a late set()
+            seconds = _time.monotonic() - t0
+            rows = out.num_rows if isinstance(out, OracleTable) else 0
+            span.set(seconds=round(seconds, 6), rows=rows)
         c.query_log.append({"sql": sql, "kind": kind,
                             "seconds": seconds, "rows": rows})
         if kind != "select":
@@ -1219,12 +1316,52 @@ class Session:
         g = c.counters.group(kind=kind)
         g.counter("queries").inc()
         g.histogram("latency_seconds").observe(seconds)
+        if prof:
+            self._finish_profile(planned, sql, kind, span, seconds,
+                                 rows)
         if c.metering is not None:
             from ydb_tpu.obs.metering import request_units
 
             c.metering.record(f"kqp.{kind}",
                               request_units(kind, rows))
         return out
+
+    def _finish_profile(self, planned, sql: str, kind: str, span,
+                        seconds: float, rows: int) -> None:
+        """Assemble the statement's QueryProfile from its finished span
+        tree; feed last_profile, the profile ring and the per-query-
+        class latency histogram (with p50/p99 gauges beside it, the
+        numbers the serving-tier bench reads off /counters)."""
+        from ydb_tpu.obs.profile import build_profile, classify_plan, \
+            subtree
+
+        c = self.cluster
+        qc = kind
+        if isinstance(planned, tuple):
+            if planned[0] == "explain":
+                qc = "explain"
+            else:
+                qc = classify_plan(planned[0])
+        # scope to THIS statement's span subtree: a client-propagated
+        # trace_id is shared across statements, and folding the whole
+        # trace would re-sum earlier statements' spans into this one
+        trace = c.tracer.spans_for(span.trace_id)
+        scoped = [span] + subtree(trace, span.span_id)
+        profile = build_profile(
+            scoped, sql=sql, kind=kind,
+            query_class=qc, seconds=seconds, rows=rows)
+        self.last_profile = profile
+        c.profiles.add(profile)
+        if profile.compile_cache:
+            c.counters.group(kind="compile_cache").counter(
+                profile.compile_cache).inc()
+        g = c.counters.group(query_class=qc)
+        h = g.histogram("query_latency_seconds")
+        h.observe(seconds)
+        # percentile GAUGES beside the raw histogram: scrapers without
+        # histogram_quantile support (and the bench) read these directly
+        g.counter("query_latency_p50").set(round(h.percentile(0.5), 9))
+        g.counter("query_latency_p99").set(round(h.percentile(0.99), 9))
 
     def _check_access(self, perm: str, *paths: str) -> None:
         """ACL gate (scheme ACEs with subtree inheritance): enforced
@@ -1340,28 +1477,66 @@ class Session:
             # EXPLAIN reveals schema/plan shape: same read gate as
             # executing the query would have
             self._plan_access_check(planned[1])
+            if len(planned) > 4 and planned[4]:
+                return self._explain_analyze(planned)
             return format_plan(planned[1])
         p, alias_map, plan_db = planned
         self._check_access(
             "read", *("/" + t for t in self._plan_tables(p)))
-        # reuse the plan-time snapshot when scalar subqueries precomputed
-        # against it (statement-level read consistency)
-        if plan_db is not None:
-            # scalar subqueries precomputed against this db (pinned to
-            # the tx snapshot when one is open): reuse it
-            db = plan_db
-        elif self._tx is not None:
-            # repeatable read: every statement in the transaction sees
-            # the BEGIN snapshot
-            db = self.cluster.snapshot_db(
-                self._tx["snap"],
-                include_sys=self.cluster.flags.enable_sys_views)
-        else:
-            db = self.cluster.snapshot_db(
-                include_sys=self.cluster.flags.enable_sys_views)
-        out = to_host(execute_plan(p, db))
+        db = self._statement_db(plan_db)
+        from ydb_tpu.obs import tracing
+
+        blk = execute_plan(p, db)
+        with tracing.span("fetch"):
+            # device -> host result transfer is its own phase: on a
+            # tunneled accelerator it can dominate small results
+            out = to_host(blk)
         out.dicts = self.cluster.result_dicts(out.schema, alias_map)
         return out
+
+    def _statement_db(self, plan_db) -> Database:
+        """The Database a statement executes against — ONE set of
+        snapshot rules shared by SELECT and EXPLAIN ANALYZE (which must
+        measure under exactly the semantics the query would run with):
+        reuse the plan-time snapshot when scalar subqueries precomputed
+        against it (statement-level read consistency), else the BEGIN
+        snapshot inside a transaction (repeatable read), else fresh."""
+        if plan_db is not None:
+            return plan_db
+        if self._tx is not None:
+            return self.cluster.snapshot_db(
+                self._tx["snap"],
+                include_sys=self.cluster.flags.enable_sys_views)
+        return self.cluster.snapshot_db(
+            include_sys=self.cluster.flags.enable_sys_views)
+
+    def _explain_analyze(self, planned) -> str:
+        """EXPLAIN ANALYZE: run the query for real (same snapshot rules
+        as a SELECT), then render the plan annotated with the measured
+        actuals — per-stage seconds, pruning/row counts and the
+        compile-vs-execute split. Two consecutive runs separate the
+        compile-cache miss (first) from warm execute (second)."""
+        import time as _time
+
+        from ydb_tpu.obs import tracing
+        from ydb_tpu.obs.profile import build_profile, classify_plan, \
+            format_plan_analyzed, subtree
+
+        _, p, _aliases, plan_db, _an = planned
+        db = self._statement_db(plan_db)
+        t0 = _time.monotonic()
+        with tracing.span("analyze") as asp:
+            out = to_host(execute_plan(p, db))
+        seconds = _time.monotonic() - t0
+        spans = []
+        if asp.recording:
+            spans = subtree(
+                self.cluster.tracer.spans_for(asp.trace_id),
+                asp.span_id)
+        profile = build_profile(
+            spans, kind="explain", query_class=classify_plan(p),
+            seconds=seconds, rows=out.num_rows)
+        return format_plan_analyzed(p, profile)
 
     # -- interactive transaction plumbing --
 

@@ -32,11 +32,17 @@ class Counter:
 
 
 class Histogram:
-    """Fixed-bucket histogram (exponential bounds by default)."""
+    """Fixed-bucket histogram (exponential bounds by default).
+
+    Default bounds reach DOWN to one microsecond: warm device ops run
+    well under a millisecond, and the old 1ms floor quantized every
+    sub-ms p50 up to it. ``percentile`` interpolates linearly WITHIN
+    the winning bucket (the Prometheus ``histogram_quantile``
+    convention) instead of answering with the bucket edge."""
 
     def __init__(self, bounds: tuple = ()):
         self.bounds = tuple(bounds) or tuple(
-            0.001 * (4 ** i) for i in range(12))  # 1ms .. ~4200s
+            1e-6 * (4 ** i) for i in range(16))  # 1us .. ~1074s
         self.buckets = [0] * (len(self.bounds) + 1)
         self.count = 0
         self.total = 0.0
@@ -56,11 +62,19 @@ class Histogram:
             target = q * self.count
             acc = 0
             for i, n in enumerate(self.buckets):
+                if not n:
+                    continue
                 acc += n
                 if acc >= target:
-                    return (self.bounds[i] if i < len(self.bounds)
-                            else float("inf"))
-            return float("inf")
+                    if i >= len(self.bounds):
+                        # overflow bucket: no finite upper edge to
+                        # interpolate toward — report its lower edge
+                        return self.bounds[-1] if self.bounds else 0.0
+                    lo = self.bounds[i - 1] if i else 0.0
+                    hi = self.bounds[i]
+                    frac = (target - (acc - n)) / n
+                    return lo + (hi - lo) * frac
+            return self.bounds[-1] if self.bounds else 0.0
 
 
 class CounterGroup:

@@ -10,10 +10,16 @@
   * pruning (``--pruning``) — zone-map scan pruning on a selective
     non-PK filter over a time-correlated table: chunks skipped/s and
     the stats-on vs stats-off (YDB_TPU_STATS=0 analog) speedup, with
-    results asserted bit-identical between the two sides.
+    results asserted bit-identical between the two sides;
+  * profile overhead (``--profile-overhead``) — warm TPC-H Q1 through
+    ``ColumnShard.scan`` with query profiling active (a traced root
+    span, the session's default-on state) vs inactive (the
+    ``YDB_TPU_PROFILE=0`` path): profiling must be within noise of off,
+    or it cannot stay default-on.
 
 Flags: ``--rows`` ``--groups`` ``--aggs`` ``--iters`` ``--block-rows``
-``--pruning`` ``--json`` (machine-readable report on stdout) and
+``--pruning`` ``--profile-overhead`` ``--sf`` (scale factor for the
+overhead bench) ``--json`` (machine-readable report on stdout) and
 ``--smoke`` (tiny sizes, correctness-only; wired into tier-1 as a
 non-slow test). Run under JAX_PLATFORMS=cpu for a stable reference; on
 accelerators it measures whatever backend jax selects.
@@ -249,6 +255,65 @@ def bench_pruning(rows: int, chunk_rows: int, iters: int,
     return out
 
 
+def bench_profile_overhead(sf: float, iters: int, block_rows: int,
+                           assert_within: float | None = None) -> dict:
+    """Warm TPC-H Q1 with query profiling ON (traced root span — the
+    session's default-on state: spans, stage timers, probe attrs,
+    profile assembly) vs OFF (no active trace, the YDB_TPU_PROFILE=0
+    path). ``assert_within`` fails the bench when the ON side exceeds
+    OFF by more than that fraction (the default-on budget)."""
+    from ydb_tpu.engine.blobs import MemBlobStore
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+    from ydb_tpu.obs import profile as profile_mod
+    from ydb_tpu.workload import tpch
+
+    data = tpch.TpchData(sf=sf, seed=5)
+    li = data.tables["lineitem"]
+    n = len(li["l_orderkey"])
+    shard = ColumnShard(
+        "profov", tpch.LINEITEM_SCHEMA, MemBlobStore(),
+        dicts=data.dicts,
+        config=ShardConfig(compact_portion_threshold=10 ** 9,
+                           scan_block_rows=block_rows,
+                           portion_chunk_rows=1 << 16))
+    shard.commit([shard.write(dict(li))])
+    prog = tpch.q1_program()
+
+    def run_off():
+        return shard.scan(prog)
+
+    def run_on():
+        with profile_mod.profiled("q1") as h:
+            shard.scan(prog)
+        return h
+
+    run_off()  # warm: compile + scan-cache fill, shared by both sides
+    run_on()
+    best = {"off": float("inf"), "on": float("inf")}
+    # interleave the sides so host drift hits both equally
+    for _ in range(max(1, iters)):
+        for label, fn in (("off", run_off), ("on", run_on)):
+            t0 = time.perf_counter()
+            fn()
+            best[label] = min(best[label], time.perf_counter() - t0)
+    out = {
+        "rows": n, "sf": sf,
+        "profile_off_seconds": round(best["off"], 6),
+        "profile_on_seconds": round(best["on"], 6),
+        "profile_off_rows_per_sec": round(n / best["off"]),
+        "profile_on_rows_per_sec": round(n / best["on"]),
+        "overhead_pct": round(100 * (best["on"] / best["off"] - 1), 2),
+    }
+    if assert_within is not None:
+        # only claim a budget verdict when one was actually checked
+        if best["on"] > best["off"] * (1 + assert_within):
+            raise AssertionError(
+                f"profiling overhead {out['overhead_pct']}% exceeds "
+                f"the {assert_within * 100:g}% budget")
+        out["within_budget"] = True
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m ydb_tpu.obs.kernelbench",
@@ -262,6 +327,10 @@ def main(argv=None) -> int:
                     help="zone-map scan-pruning A/B micro-bench")
     ap.add_argument("--chunk-rows", type=int, default=1 << 14,
                     help="portion chunk size for --pruning")
+    ap.add_argument("--profile-overhead", action="store_true",
+                    help="profiling on-vs-off warm Q1 A/B micro-bench")
+    ap.add_argument("--sf", type=float, default=0.05,
+                    help="TPC-H scale factor for --profile-overhead")
     ap.add_argument("--json", action="store_true",
                     help="one JSON object on stdout")
     ap.add_argument("--smoke", action="store_true",
@@ -272,6 +341,7 @@ def main(argv=None) -> int:
         args.rows, args.groups, args.aggs, args.iters = 5000, 7, 2, 1
         args.block_rows = 2048
         args.chunk_rows = 256
+        args.sf = 0.01
 
     import jax
 
@@ -284,6 +354,12 @@ def main(argv=None) -> int:
     if args.pruning or args.smoke:
         report["pruning"] = bench_pruning(
             args.rows, args.chunk_rows, args.iters)
+    if args.profile_overhead or args.smoke:
+        # smoke: tiny run, lax bound (machinery + no-catastrophe
+        # guard); real sizes measure the 2% default-on budget
+        report["profile_overhead"] = bench_profile_overhead(
+            args.sf, max(3, args.iters), args.block_rows,
+            assert_within=(0.5 if args.smoke else None))
     if args.json:
         print(json.dumps(report))
     else:
@@ -304,6 +380,12 @@ def main(argv=None) -> int:
                   f"({pr.get('chunks_skipped_per_sec'):,} skipped/s, "
                   f"x{pr.get('pruning_speedup')} speedup, "
                   f"identical={pr.get('identical')})")
+        if "profile_overhead" in report:
+            po = report["profile_overhead"]
+            print(f"profile overhead rows={po['rows']}: "
+                  f"on {po['profile_on_rows_per_sec']:,} rows/s vs "
+                  f"off {po['profile_off_rows_per_sec']:,} rows/s "
+                  f"({po['overhead_pct']:+.2f}%)")
     return 0
 
 

@@ -64,6 +64,29 @@ SYS_SCHEMAS = {
         ("chunks_read", dtypes.INT64), ("chunks_skipped", dtypes.INT64),
         ("chunks_fastpath", dtypes.INT64),
         ("filters_dropped", dtypes.INT64)),
+    # the N most expensive recent queries with their profiles (the
+    # reference's .sys/top_queries): backed by the bounded profile ring
+    "sys_top_queries": dtypes.schema(
+        ("rank", dtypes.INT32), ("query_text", dtypes.STRING),
+        ("kind", dtypes.STRING), ("query_class", dtypes.STRING),
+        ("seconds", dtypes.DOUBLE), ("rows", dtypes.INT64),
+        ("compile_seconds", dtypes.DOUBLE),
+        ("execute_seconds", dtypes.DOUBLE),
+        ("plan_cache", dtypes.STRING), ("compile_cache", dtypes.STRING),
+        ("read_seconds", dtypes.DOUBLE),
+        ("merge_seconds", dtypes.DOUBLE),
+        ("stage_seconds", dtypes.DOUBLE),
+        ("compute_seconds", dtypes.DOUBLE),
+        ("portions_skipped", dtypes.INT64),
+        ("chunks_read", dtypes.INT64),
+        ("chunks_skipped", dtypes.INT64)),
+    # recent queries in arrival order with profile summaries (the
+    # profile-ring twin of sys_query_stats, which stays text-only)
+    "sys_query_log": dtypes.schema(
+        ("seq", dtypes.INT64), ("query_text", dtypes.STRING),
+        ("kind", dtypes.STRING), ("query_class", dtypes.STRING),
+        ("seconds", dtypes.DOUBLE), ("rows", dtypes.INT64),
+        ("trace_id", dtypes.INT64), ("spans", dtypes.INT64)),
 }
 
 
@@ -246,6 +269,33 @@ def _scan_pruning_rows(cluster):
     return cols
 
 
+def _top_queries_rows(cluster):
+    cols: list[list] = [[] for _ in range(17)]
+    for rank, p in enumerate(cluster.profiles.top(16), start=1):
+        st = p.stages
+        pr = p.pruning
+        row = [rank, p.sql[:256], p.kind, p.query_class,
+               p.seconds, p.rows, p.compile_seconds, p.execute_seconds,
+               p.plan_cache or "", p.compile_cache or "",
+               st.get("read", 0.0), st.get("merge", 0.0),
+               st.get("stage", 0.0), st.get("compute", 0.0),
+               pr.get("portions_skipped", 0), pr.get("chunks_read", 0),
+               pr.get("chunks_skipped", 0)]
+        for c, v in zip(cols, row):
+            c.append(v)
+    return cols
+
+
+def _query_log_rows(cluster):
+    cols: list[list] = [[] for _ in range(8)]
+    for p in cluster.profiles.recent():
+        row = [p.seq, p.sql[:256], p.kind, p.query_class, p.seconds,
+               p.rows, p.trace_id, len(p.spans)]
+        for c, v in zip(cols, row):
+            c.append(v)
+    return cols
+
+
 _BUILDERS = {
     "sys_partition_stats": _partition_stats_rows,
     "sys_query_stats": _query_stats_rows,
@@ -256,6 +306,8 @@ _BUILDERS = {
     "sys_tablet_counters": _tablet_counters_rows,
     "sys_statistics": _statistics_rows,
     "sys_scan_pruning": _scan_pruning_rows,
+    "sys_top_queries": _top_queries_rows,
+    "sys_query_log": _query_log_rows,
 }
 
 

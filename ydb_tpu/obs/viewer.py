@@ -139,6 +139,7 @@ class Viewer:
             "/viewer/json/sysview": self._sysview,
             "/viewer/json/tablets": self._tablets,
             "/viewer/json/statistics": self._statistics,
+            "/viewer/json/query_profile": self._query_profile,
             "/counters": self._counters,
         }
         h = handlers.get(path)
@@ -228,6 +229,32 @@ class Viewer:
                 sysview.sys_source(self.cluster, "sys_statistics")),
             "pruning": _source_rows(
                 sysview.sys_source(self.cluster, "sys_scan_pruning")),
+        }
+
+    def _query_profile(self, query) -> dict:
+        """Per-query profiles from the bounded ring (the top-queries /
+        EXPLAIN-ANALYZE data over HTTP): the N most expensive recent
+        queries plus the latest profile with its full span tree.
+        ``?seq=N`` selects one profile by ring sequence number."""
+        ring = self.cluster.profiles
+        seqs = query.get("seq")
+        if seqs:
+            want = int(seqs[0])
+            for p in ring.recent():
+                if p.seq == want:
+                    return dict(p.to_dict(), span_tree=p.span_tree())
+            raise KeyError(f"no profile seq={want}")
+        recent = ring.recent()
+        last = recent[-1] if recent else None
+        return {
+            "top": [p.to_dict() for p in ring.top(16)],
+            "recent": [
+                {"seq": p.seq, "query_text": p.sql[:120],
+                 "kind": p.kind, "query_class": p.query_class,
+                 "seconds": round(p.seconds, 6), "rows": p.rows}
+                for p in recent],
+            "last": (dict(last.to_dict(), span_tree=last.span_tree())
+                     if last is not None else None),
         }
 
     def _tablets(self, query) -> dict:

@@ -49,8 +49,8 @@ PAGE = """<!doctype html>
 <main id="main">loading…</main>
 <script>
 "use strict";
-const TABS = ["overview", "tablets", "statistics", "sysviews", "topics",
-              "counters"];
+const TABS = ["overview", "profiles", "tablets", "statistics",
+              "sysviews", "topics", "counters"];
 const tabOf = h => TABS.includes(h) ? h : "overview";
 let tab = tabOf(location.hash.slice(1));
 let sysviewName = "";
@@ -93,6 +93,32 @@ const VIEWS = {
       + "<h3>recent queries</h3>"
       + renderTable(wb.recent_queries || [])
       + "<h3>memory</h3>" + kv(wb.memory || {});
+  },
+  async profiles() {
+    const p = await get("/viewer/json/query_profile");
+    const top = (p.top || []).map(q => ({
+      query: q.sql, class: q.query_class, seconds: q.seconds,
+      rows: q.rows, compile_s: q.compile_seconds,
+      execute_s: q.execute_seconds, plan_cache: q.plan_cache,
+      compile_cache: q.compile_cache,
+      compute_s: (q.stages || {}).compute, read_s: (q.stages || {}).read,
+    }));
+    let spanHtml = "<p class=muted>(no profiled query yet)</p>";
+    if (p.last) {
+      const rows = [];
+      (function walk(nodes, depth) {
+        for (const s of nodes || []) {
+          rows.push({span: "\\u00a0".repeat(depth * 2) + s.name,
+                     seconds: s.seconds,
+                     attrs: JSON.stringify(s.attrs)});
+          walk(s.children, depth + 1);
+        }
+      })(p.last.span_tree, 0);
+      spanHtml = renderTable(rows, ["span", "seconds", "attrs"]);
+    }
+    return "<h3>top queries (most expensive retained)</h3>"
+      + renderTable(top)
+      + "<h3>last query span tree</h3>" + spanHtml;
   },
   async tablets() {
     const t = await get("/viewer/json/tablets");

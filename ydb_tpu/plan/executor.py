@@ -30,6 +30,8 @@ from ydb_tpu.blocks.block import TableBlock, concat_blocks
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.oracle import OracleTable
 from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
+from ydb_tpu.obs import tracing
+from ydb_tpu.obs.probes import probe as _probe
 from ydb_tpu.ssa import join as join_kernels
 from ydb_tpu.ssa import kernels
 from ydb_tpu.ssa.compiler import compile_program
@@ -41,6 +43,13 @@ from ydb_tpu.plan.nodes import (
     TableScan,
     Transform,
 )
+
+# the SQL scan path fires the SAME probe points the direct
+# ColumnShard.scan fires (shard=-1 marks the statement-level aggregate
+# over all shards), so EXPLAIN ANALYZE actuals and probe sessions see
+# one consistent accounting
+_P_SCAN_STAGES = _probe("columnshard.scan.stages")
+_P_SCAN_PRUNING = _probe("columnshard.scan.pruning")
 
 
 @dataclasses.dataclass
@@ -201,8 +210,10 @@ def _execute_plan_dq(plan: PlanNode, db: Database) -> TableBlock | None:
         # plan shapes that do not lower (e.g. a join-rooted plan with no
         # result Transform) keep working through the recursive walk
         return None
-    handle.start()
-    rt.run()
+    with tracing.span("dq") as sp:
+        sp.set(stages=len(stages), tasks=_DQ_TASKS)
+        handle.start()
+        rt.run()
     if not handle.collector.done:
         raise RuntimeError("DQ stage graph did not complete")
     return handle.collector.result_block()
@@ -236,31 +247,98 @@ def execute_plan(plan: PlanNode, db: Database,
     return out
 
 
-def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
-    if isinstance(plan, TableScan):
-        src = db.sources[plan.table]
-        if plan.program is None:
-            return _materialize(src, plan.columns)
-        key = (plan.table, plan.program)
-        ex = db._compile_cache.get(key)
-        if ex is None:
-            ex = ScanExecutor(
-                plan.program, src, block_rows=1 << 22,
-                key_spaces=db.key_spaces,
-            ).detach()  # cache compiled state, not the source arrays
-            db._compile_cache[key] = ex
+def _scan_node(plan: TableScan, db: Database, sp) -> TableBlock:
+    from ydb_tpu.obs.probes import StageTimer
+
+    src = db.sources[plan.table]
+    key = (plan.table, plan.program)
+    ex = db._compile_cache.get(key)
+    fresh = ex is None
+    if fresh:
+        ex = ScanExecutor(
+            plan.program, src, block_rows=1 << 22,
+            key_spaces=db.key_spaces,
+        ).detach()  # cache compiled state, not the source arrays
+        db._compile_cache[key] = ex
+    # stage accounting while a query trace records OR a probe session
+    # listens (probe observability must not degrade when profiling is
+    # off — the shard-level probes fire unconditionally too). The timer
+    # itself is cheap, but attaching it threads per-chunk charging
+    # through the whole staging pipeline; attached to the base source
+    # for this run only — a Database reused across statements (bench)
+    # shares its sources, and a stale timer would keep charging later
+    # unprofiled scans — so it detaches after the stream drains.
+    want_stats = (sp.recording or bool(_P_SCAN_STAGES)
+                  or bool(_P_SCAN_PRUNING))
+    timer = None
+    base_src = src
+    if want_stats:
+        timer = StageTimer()
+        if hasattr(base_src, "attach_timer"):
+            base_src.attach_timer(timer)
+    try:
         # zone-map scan pruning (stats.zonemap): the pushdown program's
-        # conjunctive filters skip portions/chunks before any blob read.
-        # The pruned view carries its predicate fingerprint into the
-        # device cache key, so pruned streams never alias unpruned ones.
+        # conjunctive filters skip portions/chunks before any blob
+        # read. The pruned view carries its predicate fingerprint into
+        # the device cache key, so pruned streams never alias unpruned
+        # ones.
         src = _pruned_source(src, plan.program, db)
+        # chunk counters are cumulative on the source object; shared
+        # unpruned sources accumulate across statements, so the span
+        # reports this run's DELTA (pruned views are fresh per run)
+        chunks0 = {k: int(getattr(src, k, 0))
+                   for k in ("chunks_read", "chunks_skipped")}
         stream = src.blocks(1 << 22, ex.read_cols)
         bc = db.block_cache
         key_of = getattr(src, "device_cache_key", None)
         if bc is not None and key_of is not None and bc.budget() > 0:
             stream = bc.stream(
                 key_of(ex.read_cols, 1 << 22), lambda: stream)
-        return ex.run_stream(stream)
+        out = ex.run_stream(stream, timer=timer)
+    finally:
+        if timer is not None and hasattr(base_src, "attach_timer"):
+            base_src.attach_timer(None)
+    if want_stats:
+        stages = timer.snapshot()
+        pruning = {k: int(getattr(src, k, 0)) - v0
+                   for k, v0 in chunks0.items()}
+        pruning["portions_skipped"] = int(
+            getattr(src, "portions_skipped", 0))
+        pruning["portions_total"] = pruning["portions_skipped"] + sum(
+            len(s.metas) for s in getattr(src, "subs", ()))
+        if sp.recording:
+            sp.set(table=plan.table, rows=int(out.length),
+                   compile_cache=("miss" if fresh else "hit"),
+                   **{f"stage_{k}": v for k, v in stages.items()},
+                   **pruning)
+            if fresh and ex.first_trace_seconds:
+                sp.set(first_trace_seconds=round(
+                    ex.first_trace_seconds, 6))
+        if _P_SCAN_STAGES:
+            _P_SCAN_STAGES.fire(shard=-1, **stages)
+        if _P_SCAN_PRUNING:
+            _P_SCAN_PRUNING.fire(shard=-1, **pruning)
+    return out
+
+
+def _compiled_transform(plan: Transform, schema, db: Database):
+    """Compile a Transform program (jit + device aux); split out so the
+    executor walk stays free of trace-time constructs."""
+    cp = compile_program(
+        plan.program, schema, db.dicts, db.key_spaces,
+        dict_aliases=dict(plan.dict_aliases),
+    )
+    return (jax.jit(cp.run),
+            {k: jnp.asarray(v) for k, v in cp.aux.items()})
+
+
+def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
+    if isinstance(plan, TableScan):
+        src = db.sources[plan.table]
+        if plan.program is None:
+            return _materialize(src, plan.columns)
+        with tracing.span("scan") as sp:
+            return _scan_node(plan, db, sp)
     if isinstance(plan, LookupJoin):
         probe = execute_plan(plan.probe, db, _memo)
         build = execute_plan(plan.build, db, _memo)
@@ -282,21 +360,21 @@ def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
         block = execute_plan(plan.input, db, _memo)
         key = (plan.program, plan.dict_aliases, block.schema)
         hit = db._compile_cache.get(key)
-        if hit is None:
-            # mandatory precondition (ydb_tpu.analysis): surface
-            # step-indexed diagnostics for malformed programs before
-            # any trace work; compile_program re-checks, but this keeps
-            # the executor the choke point even if lowering changes
-            check_program(plan.program, block.schema)
-            cp = compile_program(
-                plan.program, block.schema, db.dicts, db.key_spaces,
-                dict_aliases=dict(plan.dict_aliases),
-            )
-            hit = (jax.jit(cp.run),
-                   {k: jnp.asarray(v) for k, v in cp.aux.items()})
-            db._compile_cache[key] = hit
-        run, aux = hit
-        return run(block, aux)
+        with tracing.span("transform") as sp:
+            if hit is None:
+                sp.set(compile_cache="miss")
+                # mandatory precondition (ydb_tpu.analysis): surface
+                # step-indexed diagnostics for malformed programs
+                # before any trace work; compile_program re-checks, but
+                # this keeps the executor the choke point even if
+                # lowering changes
+                check_program(plan.program, block.schema)
+                hit = _compiled_transform(plan, block.schema, db)
+                db._compile_cache[key] = hit
+            else:
+                sp.set(compile_cache="hit")
+            run, aux = hit
+            return run(block, aux)
     if isinstance(plan, Concat):
         # branches execute independently (planner guarantees identical
         # column names/types); live rows append in branch order

@@ -25,6 +25,7 @@ import os
 import threading
 
 from ydb_tpu.analysis import sanitizer
+from ydb_tpu.obs import tracing
 
 
 class ConveyorController:
@@ -145,6 +146,10 @@ class Conveyor:
 
     def submit(self, queue: str, fn, *args, priority: int = 10,
                **kwargs) -> TaskHandle:
+        # the submitter's active trace span follows the task onto the
+        # worker thread (scan prefetch producers record under the
+        # query's trace id); no-op when no trace is active
+        fn = tracing.wrap_current(fn)
         h = TaskHandle(queue, threading.Event())
         with self._cv:
             if self._stopping:
@@ -164,6 +169,7 @@ class Conveyor:
         each other: a parked producer whose consumer is itself waiting
         on a queued producer would starve — callers degrade to a
         synchronous path instead."""
+        fn = tracing.wrap_current(fn)  # trace follows the producer
         with self._cv:
             if (self._stopping or self._heap
                     or self._active >= len(self._threads)):

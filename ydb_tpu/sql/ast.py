@@ -237,9 +237,12 @@ class AlterTable:
 
 @dataclasses.dataclass(frozen=True)
 class Explain:
-    """EXPLAIN <select>: return the physical plan, not the rows."""
+    """EXPLAIN [ANALYZE] <select>: return the physical plan. With
+    ANALYZE the query actually runs and the plan is annotated with
+    measured actuals (per-stage seconds, rows, cache hits)."""
 
     select: Select
+    analyze: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

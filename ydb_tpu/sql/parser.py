@@ -119,7 +119,14 @@ class Parser:
     def parse_statement(self) -> ast.Statement:
         if self.peek().value == "explain":
             self.next()
-            stmt = ast.Explain(self.parse_select_or_union())
+            # ANALYZE is a soft keyword (stays usable as a column name)
+            analyze = False
+            t = self.peek()
+            if t.kind == "name" and t.value.lower() == "analyze":
+                self.next()
+                analyze = True
+            stmt = ast.Explain(self.parse_select_or_union(),
+                               analyze=analyze)
         elif self.peek().value in ("select", "with"):
             stmt = self.parse_select_or_union()
         elif self.peek().value in ("insert", "upsert"):

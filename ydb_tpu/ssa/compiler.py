@@ -132,6 +132,27 @@ def compile_program(
     dict_aliases: dict[str, str] | None = None,
     group_est: float | None = None,
 ) -> CompiledProgram:
+    # program lowering is attributed to the active query trace (the
+    # "ssa.compile" spans are one half of the compile-vs-execute split;
+    # the other half — the first jitted dispatch's XLA compile — is
+    # timed at the call sites). NULL span when no trace is active.
+    from ydb_tpu.obs import tracing
+
+    with tracing.span("ssa.compile") as _sp:
+        _sp.set(steps=len(program.steps), cols=len(schema.names))
+        return _compile_program(program, schema, dicts, key_spaces,
+                                partial_slots, dict_aliases, group_est)
+
+
+def _compile_program(
+    program: Program,
+    schema: dtypes.Schema,
+    dicts: DictionarySet | None = None,
+    key_spaces: dict[str, int] | None = None,
+    partial_slots: bool = False,
+    dict_aliases: dict[str, str] | None = None,
+    group_est: float | None = None,
+) -> CompiledProgram:
     # mandatory precondition: no program reaches the trace unverified.
     # Malformed programs raise VerificationError (a PlanError) with
     # step-indexed diagnostics instead of an opaque trace-time failure.
