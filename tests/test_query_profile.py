@@ -454,7 +454,8 @@ def test_build_profile_aggregates_scan_spans():
     assert p.stages["read"] == pytest.approx(0.4)
     assert p.stages["compute"] == pytest.approx(0.3)
     assert p.pruning == {"portions_total": 6, "portions_skipped": 1,
-                         "chunks_read": 4, "chunks_skipped": 2}
+                         "chunks_read": 4, "chunks_skipped": 2,
+                         "resident_portions": 0, "resident_rows": 0}
     assert p.device_seconds == pytest.approx(0.3)
     tree = p.span_tree()
     assert tree[0]["name"] == "query"

@@ -21,6 +21,10 @@ import os
 
 from ydb_tpu.analysis import sanitizer
 
+#: single-flight wait bound: a filler stuck past this (wedged blob
+#: store, debugger) stops blocking waiters — they fill uncached instead
+FLIGHT_WAIT_SECONDS = 30.0
+
 
 def default_budget() -> int:
     """Auto budget: on for accelerator backends, off on CPU (there the
@@ -45,8 +49,14 @@ class DeviceBlockCache:
             collections.OrderedDict(), f"blockcache.{id(self):x}")
         self._nbytes = 0
         self._lock = sanitizer.make_lock(f"blockcache.{id(self):x}.lock")
+        # key -> threading.Event: per-key in-flight fills (single-flight
+        # dedup — concurrent scans missing the same key must not both
+        # decode and both tee)
+        self._flights = sanitizer.share(
+            {}, f"blockcache.{id(self):x}.flights")
         self.hits = 0
         self.misses = 0
+        self.flight_waits = 0
 
     def budget(self) -> int:
         """YDB_TPU_SCAN_CACHE_BYTES overrides EVERYTHING (including an
@@ -131,11 +141,59 @@ class DeviceBlockCache:
 
     def stream(self, key, make_blocks):
         """Cached stream for ``key``: the cached blocks when present,
-        else ``make_blocks()`` teed into the cache. When the budget is
-        off, the raw stream passes through untouched."""
+        else ``make_blocks()`` teed into the cache with per-key
+        single-flight dedup — the first scan to miss fills; concurrent
+        scans on the same key wait for its entry instead of each
+        decoding and teeing their own copy. When the budget is off, the
+        raw stream passes through untouched."""
         if self.budget() <= 0 or key is None:
             return make_blocks()
-        cached = self.get(key)
-        if cached is not None:
-            return iter(cached)
-        return self.tee(make_blocks(), key)
+        return self._stream_gen(key, make_blocks)
+
+    def _stream_gen(self, key, make_blocks):
+        """Flight registration happens INSIDE the generator body (on
+        first next()): a generator handed back but never iterated runs
+        no ``finally``, so registering before returning it could strand
+        the flight and wedge every waiter."""
+        import threading
+
+        while True:
+            with self._lock:
+                ent = self._entries.get(key)
+                if ent is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    blocks = ent[0]
+                    ev = None
+                elif key not in self._flights:
+                    # we are the filler
+                    self._flights[key] = threading.Event()
+                    blocks = None
+                    ev = None
+                else:
+                    ev = self._flights[key]
+                    self.flight_waits += 1
+            if ev is not None:
+                if not ev.wait(FLIGHT_WAIT_SECONDS):
+                    # wedged filler: serve uncached rather than stall
+                    with self._lock:
+                        self.misses += 1
+                    yield from make_blocks()
+                    return
+                continue  # filler done — re-check the entry
+            if blocks is not None:
+                yield from blocks
+                return
+            try:
+                with self._lock:
+                    self.misses += 1
+                yield from self.tee(make_blocks(), key)
+            finally:
+                # wake waiters whether the fill landed, overflowed the
+                # budget, or the consumer abandoned the stream early —
+                # they re-check and fill (or wait) themselves
+                with self._lock:
+                    ev = self._flights.pop(key, None)
+                if ev is not None:
+                    ev.set()
+            return

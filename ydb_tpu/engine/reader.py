@@ -295,6 +295,11 @@ class PortionStreamSource:
             else bool(shard.upsert and shard.pk_column)
         )
         self.prefetch = prefetch
+        # HBM-resident tier attribution: portions/rows served from
+        # decoded device arrays instead of the staged host path
+        # (engine.resident; sys_resident_store + shard.scan spans)
+        self.resident_hits = 0
+        self.resident_rows = 0
 
     @property
     def num_rows(self) -> int:
@@ -441,6 +446,20 @@ class PortionStreamSource:
         sch = self.shard.schema.select(names)
         cap = min(block_rows, max(self.num_rows, 1))
         clusters = plan_clusters(self.metas, self.dedup)
+        res = getattr(self.shard, "resident", None)
+        if start_block == 0 and res is not None and res.enabled():
+            # HBM-resident fast path: portions with pinned decoded
+            # columns assemble blocks device-side; the rest stage
+            # through the host path mid-stream. Count-based resume
+            # (start_block) stays on the host path — its block
+            # boundaries must not depend on what happens to be
+            # resident at resume time.
+            from ydb_tpu.engine import resident as resident_mod
+
+            yield from resident_mod.stream_resident(
+                self, clusters, names, sch, cap,
+                timer=self.timer, prefetch=self.prefetch)
+            return
         yield from stream_blocks(
             self.payload_stream(clusters, names), names, sch, cap,
             start_block=start_block, prefetch=self.prefetch,
@@ -483,34 +502,39 @@ def stream_blocks(payloads, names, sch, cap: int,
     the generator (close/GC) stops the producer promptly — the bounded
     put is stop-aware, so no task leaks on the shared pool.
     """
-    depth = _prefetch_depth() if depth is None else depth
-
     def build(cols, valid):
         ctx = (timer.stage("stage") if timer is not None
                else contextlib.nullcontext())
         with ctx:
             return TableBlock.from_numpy(cols, sch, valid, capacity=cap)
 
-    def empty_block():
-        return build(
-            {m: np.empty(0, dtype=sch.field(m).type.physical)
-             for m in names},
-            {m: np.empty(0, dtype=bool) for m in names})
-
     pieces = rechunk(payloads, names, cap)
 
-    def sync_stream():
+    def gen():
         emitted = 0
         for cols, valid in pieces:
             emitted += 1
             if emitted - 1 < start_block:
-                continue  # checkpoint-resume seek: skip cheaply
+                continue  # checkpoint-resume seek: skips BEFORE staging
             yield build(cols, valid)
         if emitted == 0 and start_block == 0:
-            yield empty_block()
+            yield build(
+                {m: np.empty(0, dtype=sch.field(m).type.physical)
+                 for m in names},
+                {m: np.empty(0, dtype=bool) for m in names})
 
+    return pump_blocks(gen(), prefetch=prefetch, depth=depth)
+
+
+def pump_blocks(blocks, prefetch: bool = True,
+                depth: int | None = None) -> Iterator[TableBlock]:
+    """Drain a block generator on the SHARED conveyor pool ahead of the
+    consumer (the staging producer shape shared by the host payload
+    path and the resident tier's mixed stream). With no idle worker —
+    or prefetch off — the generator runs inline on the consumer."""
+    depth = _prefetch_depth() if depth is None else depth
     if not prefetch or depth <= 0:
-        yield from sync_stream()
+        yield from blocks
         return
 
     from ydb_tpu.runtime.conveyor import shared_conveyor
@@ -539,13 +563,11 @@ def stream_blocks(payloads, names, sch, cap: int,
         try:
             with tracing.span("scan.producer") as psp:
                 psp.set(thread=threading.get_ident())
-                for cols, valid in pieces:
+                for blk in blocks:
                     if stop.is_set():
                         return
                     emitted += 1
-                    if emitted - 1 < start_block:
-                        continue  # seek skips BEFORE staging costs
-                    if not put(("blk", build(cols, valid))):
+                    if not put(("blk", blk)):
                         return
                 psp.set(blocks=emitted)
             put(("end", emitted))
@@ -557,7 +579,7 @@ def stream_blocks(payloads, names, sch, cap: int,
     # task that cannot start) — with no idle worker, stage inline
     handle = shared_conveyor().submit_if_free("scan_prefetch", produce)
     if handle is None:
-        yield from sync_stream()
+        yield from blocks
         return
     try:
         while True:
@@ -573,8 +595,6 @@ def stream_blocks(payloads, names, sch, cap: int,
             if kind == "blk":
                 yield payload
             elif kind == "end":
-                if payload == 0 and start_block == 0:
-                    yield empty_block()
                 return
             else:
                 raise payload
@@ -639,10 +659,15 @@ class MultiShardStreamSource:
             sub.preds = list(preds)
             if not getattr(sub.shard, "upsert", False):
                 kept = []
+                res = getattr(sub.shard, "resident", None)
                 for m in sub.metas:
                     skip, _all = zones_decide(m.zones, sub.preds)
                     if skip:
                         sub.portions_skipped += 1
+                        if res is not None:
+                            # zone-pruned portions have no resident
+                            # value: feed the eviction policy
+                            res.note_pruned(m.portion_id)
                     else:
                         kept.append(m)
                 sub.metas = kept
@@ -681,6 +706,14 @@ class MultiShardStreamSource:
     def portions_skipped(self) -> int:
         return sum(sub.portions_skipped for sub in self.subs)
 
+    @property
+    def resident_hits(self) -> int:
+        return sum(sub.resident_hits for sub in self.subs)
+
+    @property
+    def resident_rows(self) -> int:
+        return sum(sub.resident_rows for sub in self.subs)
+
     def blocks(
         self,
         block_rows: int,
@@ -690,6 +723,24 @@ class MultiShardStreamSource:
         names = columns if columns is not None else self.columns_read
         sch = self._base_schema.select(names)
         cap = min(block_rows, max(self.num_rows, 1))
+        if start_block == 0 and any(
+                getattr(sub.shard, "resident", None) is not None
+                and sub.shard.resident.enabled() for sub in self.subs):
+            # resident-aware SQL path: one mixed item stream across all
+            # shards keeps a single block capacity (one compiled
+            # program), while each shard's portions serve from its own
+            # resident store or stage through the host path
+            from ydb_tpu.engine import resident as resident_mod
+
+            def items():
+                for sub in self.subs:
+                    clusters = plan_clusters(sub.metas, sub.dedup)
+                    yield from resident_mod.scan_items(sub, clusters,
+                                                       names)
+
+            yield from pump_blocks(resident_mod.mixed_blocks(
+                items(), names, sch, cap, timer=self.timer))
+            return
 
         def payloads():
             for sub in self.subs:

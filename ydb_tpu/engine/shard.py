@@ -92,6 +92,11 @@ class ShardConfig:
     # traced XLA executable per distinct (program, key_spaces); ad-hoc
     # query workloads would otherwise grow it without bound
     scan_cache_entries: int = 32
+    # HBM-resident column tier budget (engine.resident): per-(portion,
+    # column) decoded device arrays shared across every scan shape.
+    # None = auto (YDB_TPU_RESIDENT_BYTES env valve, else on for
+    # accelerator backends); 0 = off; >0 = byte budget.
+    resident_bytes: int | None = None
 
 
 class ColumnShard:
@@ -176,6 +181,22 @@ class ColumnShard:
         # immutable (portion ids, read cols, block rows)
         self.block_cache = DeviceBlockCache(
             budget=self.config.scan_cache_bytes)
+        # HBM-resident column tier (engine.resident): per-(portion,
+        # column) decoded device arrays serving every scan shape —
+        # where the block cache above keys whole streams on (portion
+        # set, read cols, geometry, predicates) and rebuilds from host
+        # bytes for any new combination. Per-shard so ROADMAP item 3
+        # can slice it per-device.
+        from ydb_tpu.engine.resident import ResidentStore
+
+        self.resident = ResidentStore(
+            f"{shard_id}.{id(self):x}",
+            budget=self.config.resident_bytes)
+        # meta_gen stamp of the last cache prune (the Cluster
+        # snapshot_db pattern): entries only die when a portion id
+        # vanishes from the map, so steady-state scans skip the
+        # every-entry prune walk entirely. Guarded by _meta_lock.
+        self._prune_gen: "int | None" = None
         # serializes metadata mutations (portion map, WAL seq, snapshot)
         # so conveyor-driven background work (compaction/TTL/GC) can run
         # concurrently with foreground scans: critical sections cover
@@ -351,6 +372,17 @@ class ColumnShard:
             if staged:
                 rec["staged"] = True
             self._log(rec)
+        # eager resident promotion (write path AND compaction output):
+        # the decoded columns are already in memory — pin them on the
+        # device asynchronously so the FIRST scan is already warm.
+        # Budget pressure evicts cold portions; a full valve spills.
+        if self.resident.enabled() and meta.num_rows:
+            pcols, pvalid = cols, validity
+
+            def from_memory():
+                return pcols, pvalid
+
+            self.resident.promote_async(pid, meta.num_rows, from_memory)
         return meta
 
     def _dict_delta(self) -> dict:
@@ -535,6 +567,10 @@ class ColumnShard:
                 skip, alls = zonemap.zones_decide(
                     self._meta_zones(m), preds)
                 if skip:
+                    # zone-skipped portions are poor HBM citizens: a
+                    # resident copy would have served zero rows. Feed
+                    # the eviction policy so they go first.
+                    self.resident.note_pruned(m.portion_id)
                     continue
                 metas.append(m)
                 all_steps &= alls
@@ -587,14 +623,31 @@ class ColumnShard:
                     self._scan_cache.popitem(last=False)
         cache_key = None
         hit_before = self.block_cache.hits
-        if self.block_cache.budget() > 0:
+        # the resident tier subsumes the whole-stream device cache:
+        # caching the assembled stream AND pinning its source columns
+        # would hold the same bytes twice against two budgets
+        use_block_cache = (self.block_cache.budget() > 0
+                           and not self.resident.enabled())
+        if use_block_cache or self.resident.enabled():
             # entries referencing a portion that no longer exists
             # (compacted/TTL'd away and dropped from the portion map)
             # can never be keyed again by any snapshot: free their
-            # device memory now instead of waiting for LRU
+            # device memory now instead of waiting for LRU. meta_gen
+            # only moves when gc_blobs drops portions (the
+            # Cluster.snapshot_db stamp pattern), so the steady state
+            # is one int compare per scan instead of a full cache walk.
             with self._meta_lock:
-                live = set(self.portions)
-            self.block_cache.prune(lambda k: set(k[0]) <= live)
+                gen = self.meta_gen
+                stale = gen != self._prune_gen
+                live = set(self.portions) if stale else None
+            if stale:
+                self.block_cache.prune(lambda k: set(k[0]) <= live)
+                self.resident.prune(live)
+                # stamp with the gen read BEFORE pruning: a gc racing
+                # us just forces one extra (harmless) re-prune
+                with self._meta_lock:
+                    self._prune_gen = gen
+        if use_block_cache:
             # the predicate fingerprint is part of the identity: a
             # pruned stream holds fewer rows than an unpruned one over
             # the same portion set
@@ -639,10 +692,14 @@ class ColumnShard:
                          chunks_read=src.chunks_read,
                          compiled_fresh=fresh,
                          block_cache_hit=self.block_cache.hits
-                         > hit_before)
+                         > hit_before,
+                         resident_portions=src.resident_hits,
+                         resident_rows=src.resident_rows)
         if sp.recording:
             sp.set(shard=self.shard_id, rows=int(out.num_rows),
                    compile_cache=("miss" if fresh else "hit"),
+                   resident_portions=src.resident_hits,
+                   resident_rows=src.resident_rows,
                    **{f"stage_{k}": v
                       for k, v in self.last_scan_stages.items()},
                    **pruning)
@@ -891,6 +948,10 @@ class ColumnShard:
             self.meta_gen += 1
         for bid in blob_ids:
             self.store.delete(bid)
+        # GC'd portion ids can never be named by any snapshot again:
+        # free their resident device arrays now (outside _meta_lock —
+        # the stores keep no lock-order edge between them)
+        self.resident.invalidate(dead)
         return len(dead)
 
     # ---------------- durability: WAL + checkpoint + boot ----------------

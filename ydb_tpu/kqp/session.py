@@ -455,6 +455,13 @@ class Cluster:
         # portion ids at 1, so stale entries would collide with the new
         # table's keys and serve the dropped table's rows
         self.scan_block_cache.clear()
+        # same portion-id-reuse hazard for the HBM-resident tier; the
+        # dropped shards are unreachable, but free their device arrays
+        # now rather than at GC
+        for sh in getattr(t, "shards", ()):
+            store = getattr(sh, "resident", None)
+            if store is not None:
+                store.clear()
 
     def _sweep_trash(self) -> None:
         for op_id, prefixes in self.scheme.trash():
@@ -519,6 +526,26 @@ class Cluster:
         except Exception:  # noqa: BLE001 - stats are advisory
             pass
         self._auto_reshard(stats)
+        # resident-tier aggregate counters ride the maintenance cadence
+        # (the /counters surface; per-shard detail stays in
+        # sys_resident_store)
+        res = {"bytes": 0, "portions": 0, "promotions": 0,
+               "evictions": 0, "spills": 0, "hits": 0}
+        have_res = False
+        for t in self.tables.values():
+            for s in t.shards:
+                store = getattr(s, "resident", None)
+                if store is None:
+                    continue
+                have_res = True
+                snap = store.snapshot()
+                for k in res:
+                    res[k] += snap[k]
+        if have_res:
+            g = self.counters.group(component="resident")
+            for k, v in res.items():
+                g.counter(k).set(v)
+            stats["resident_bytes"] = res["bytes"]
         # memory pressure: when the store is (or wraps) a shared page
         # cache, shrink its budget as process RSS approaches the soft
         # limit and restore it when pressure clears

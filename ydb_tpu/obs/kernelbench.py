@@ -255,6 +255,70 @@ def bench_pruning(rows: int, chunk_rows: int, iters: int,
     return out
 
 
+def bench_resident(rows: int, chunk_rows: int, iters: int,
+                   shard=None) -> dict:
+    """HBM-resident tier A/B (equality-asserted): the same shard
+    scanned warm with the resident tier forced on (heat-promoted, then
+    drained, so blocks assemble from pinned device arrays) vs forced
+    off (every scan re-stages from host bytes). The gap is ROADMAP
+    item 1's engine-vs-kernel distance at micro scale."""
+    from ydb_tpu.engine import resident as resident_mod
+    from ydb_tpu.ssa import Agg, AggSpec, GroupByStep, Program
+
+    if shard is None:
+        shard, n = build_pruning_shard(rows, chunk_rows)
+    else:
+        shard, n = shard
+    prog = Program((
+        GroupByStep(("user",), (
+            AggSpec(Agg.COUNT_ALL, None, "n"),
+            AggSpec(Agg.SUM, "val", "s"),
+        )),
+    ))
+    out: dict = {"rows": n}
+    results = {}
+    for label, force in (("resident", True), ("staged", False)):
+        resident_mod.RESIDENT_FORCE = force
+        try:
+            if force:
+                # heat-driven promotion: two host-path scans cross the
+                # threshold, drain pins every portion before timing
+                for _ in range(2):
+                    shard.scan(prog)
+                shard.resident.drain()
+            best = float("inf")
+            res = None
+            for _ in range(max(1, iters)):
+                t0 = time.perf_counter()
+                res = shard.scan(prog)
+                best = min(best, time.perf_counter() - t0)
+            results[label] = res
+            out[f"{label}_seconds"] = round(best, 5)
+            out[f"{label}_rows_per_sec"] = round(n / max(best, 1e-9))
+            if force:
+                snap = shard.resident.snapshot()
+                out["resident_portions"] = snap["portions"]
+                out["resident_bytes"] = snap["bytes"]
+        finally:
+            resident_mod.RESIDENT_FORCE = None
+    out["resident_speedup"] = round(
+        out["staged_seconds"] / max(out["resident_seconds"], 1e-9), 2)
+    # bit-identity between the two sides (group keys sort-aligned)
+    a, b = results["resident"], results["staged"]
+    oa = np.argsort(np.asarray(a.column("user")))
+    ob = np.argsort(np.asarray(b.column("user")))
+    for name in a.cols:
+        av, aok = (np.asarray(x) for x in a.cols[name])
+        bv, bok = (np.asarray(x) for x in b.cols[name])
+        if not np.array_equal(aok[oa], bok[ob]) or not np.array_equal(
+                np.where(aok, av, 0)[oa], np.where(bok, bv, 0)[ob]):
+            raise AssertionError(
+                f"resident on/off mismatch on {name}")
+    out["identical"] = True
+    shard.resident.clear()
+    return out
+
+
 def bench_profile_overhead(sf: float, iters: int, block_rows: int,
                            assert_within: float | None = None) -> dict:
     """Warm TPC-H Q1 with query profiling ON (traced root span — the
@@ -327,6 +391,8 @@ def main(argv=None) -> int:
                     help="zone-map scan-pruning A/B micro-bench")
     ap.add_argument("--chunk-rows", type=int, default=1 << 14,
                     help="portion chunk size for --pruning")
+    ap.add_argument("--resident", action="store_true",
+                    help="HBM-resident vs staged warm scan A/B")
     ap.add_argument("--profile-overhead", action="store_true",
                     help="profiling on-vs-off warm Q1 A/B micro-bench")
     ap.add_argument("--sf", type=float, default=0.05,
@@ -354,6 +420,9 @@ def main(argv=None) -> int:
     if args.pruning or args.smoke:
         report["pruning"] = bench_pruning(
             args.rows, args.chunk_rows, args.iters)
+    if args.resident or args.smoke:
+        report["resident"] = bench_resident(
+            args.rows, args.chunk_rows, args.iters)
     if args.profile_overhead or args.smoke:
         # smoke: tiny run, lax bound (machinery + no-catastrophe
         # guard); real sizes measure the 2% default-on budget
@@ -380,6 +449,15 @@ def main(argv=None) -> int:
                   f"({pr.get('chunks_skipped_per_sec'):,} skipped/s, "
                   f"x{pr.get('pruning_speedup')} speedup, "
                   f"identical={pr.get('identical')})")
+        if "resident" in report:
+            rr = report["resident"]
+            print(f"resident rows={rr['rows']}: "
+                  f"{rr['resident_rows_per_sec']:,} rows/s vs staged "
+                  f"{rr['staged_rows_per_sec']:,} rows/s "
+                  f"(x{rr['resident_speedup']}, "
+                  f"{rr['resident_portions']} portions / "
+                  f"{rr['resident_bytes']:,} B pinned, "
+                  f"identical={rr['identical']})")
         if "profile_overhead" in report:
             po = report["profile_overhead"]
             print(f"profile overhead rows={po['rows']}: "

@@ -28,7 +28,7 @@ import time
 STAGE_KEYS = ("read", "merge", "stage", "compute")
 #: span attrs summed into the per-query pruning/row accounting
 PRUNING_KEYS = ("portions_total", "portions_skipped", "chunks_read",
-                "chunks_skipped")
+                "chunks_skipped", "resident_portions", "resident_rows")
 #: span names that carry scan-level stage/pruning/compile attrs
 SCAN_SPANS = ("scan", "shard.scan")
 

@@ -80,6 +80,18 @@ SYS_SCHEMAS = {
         ("portions_skipped", dtypes.INT64),
         ("chunks_read", dtypes.INT64),
         ("chunks_skipped", dtypes.INT64)),
+    # HBM-resident column tier (engine/resident.py): per-shard pinned
+    # bytes vs budget plus promotion/eviction/spill lifecycle counters
+    # — the "is the hot set actually resident" dashboard
+    "sys_resident_store": dtypes.schema(
+        ("table_name", dtypes.STRING), ("shard", dtypes.INT32),
+        ("enabled", dtypes.INT32), ("portions", dtypes.INT64),
+        ("columns", dtypes.INT64), ("bytes", dtypes.INT64),
+        ("budget", dtypes.INT64), ("hits", dtypes.INT64),
+        ("misses", dtypes.INT64), ("promotions", dtypes.INT64),
+        ("evictions", dtypes.INT64), ("spills", dtypes.INT64),
+        ("invalidations", dtypes.INT64), ("errors", dtypes.INT64),
+        ("inflight", dtypes.INT64)),
     # recent queries in arrival order with profile summaries (the
     # profile-ring twin of sys_query_stats, which stays text-only)
     "sys_query_log": dtypes.schema(
@@ -286,6 +298,25 @@ def _top_queries_rows(cluster):
     return cols
 
 
+def _resident_store_rows(cluster):
+    cols: list[list] = [[] for _ in range(15)]
+    for tname, t in cluster.tables.items():
+        for i, s in enumerate(t.shards):
+            store = getattr(s, "resident", None)
+            if store is None:  # DataShard
+                continue
+            snap = store.snapshot()
+            row = [tname, i, int(store.enabled()), snap["portions"],
+                   snap["columns"], snap["bytes"], snap["budget"],
+                   snap["hits"], snap["misses"], snap["promotions"],
+                   snap["evictions"], snap["spills"],
+                   snap["invalidations"], snap["errors"],
+                   snap["inflight"]]
+            for c, v in zip(cols, row):
+                c.append(v)
+    return cols
+
+
 def _query_log_rows(cluster):
     cols: list[list] = [[] for _ in range(8)]
     for p in cluster.profiles.recent():
@@ -306,6 +337,7 @@ _BUILDERS = {
     "sys_tablet_counters": _tablet_counters_rows,
     "sys_statistics": _statistics_rows,
     "sys_scan_pruning": _scan_pruning_rows,
+    "sys_resident_store": _resident_store_rows,
     "sys_top_queries": _top_queries_rows,
     "sys_query_log": _query_log_rows,
 }

@@ -139,6 +139,7 @@ class Viewer:
             "/viewer/json/sysview": self._sysview,
             "/viewer/json/tablets": self._tablets,
             "/viewer/json/statistics": self._statistics,
+            "/viewer/json/resident": self._resident,
             "/viewer/json/query_profile": self._query_profile,
             "/counters": self._counters,
         }
@@ -218,6 +219,21 @@ class Viewer:
         if not names:
             return sorted(sysview.SYS_SCHEMAS)
         return sysview.sys_source(self.cluster, names[0])
+
+    def _resident(self, query) -> dict:
+        """HBM-resident column tier (engine/resident.py): per-shard
+        pinned bytes vs budget plus the promotion/eviction lifecycle —
+        whether the hot set is actually resident, and what pressure is
+        doing to it."""
+        rows = _source_rows(
+            sysview.sys_source(self.cluster, "sys_resident_store"))
+        total = {"bytes": 0, "budget": 0, "portions": 0,
+                 "promotions": 0, "evictions": 0, "spills": 0,
+                 "hits": 0, "misses": 0}
+        for r in rows:
+            for k in total:
+                total[k] += r.get(k, 0)
+        return {"shards": rows, "total": total}
 
     def _statistics(self, query) -> dict:
         """Column statistics + scan-pruning effectiveness (the stats

@@ -50,7 +50,7 @@ PAGE = """<!doctype html>
 <script>
 "use strict";
 const TABS = ["overview", "profiles", "tablets", "statistics",
-              "sysviews", "topics", "counters"];
+              "resident", "sysviews", "topics", "counters"];
 const tabOf = h => TABS.includes(h) ? h : "overview";
 let tab = tabOf(location.hash.slice(1));
 let sysviewName = "";
@@ -133,6 +133,12 @@ const VIEWS = {
       + renderTable(s.columns || [])
       + "<h3>scan pruning (cumulative per shard)</h3>"
       + renderTable(s.pruning || []);
+  },
+  async resident() {
+    const r = await get("/viewer/json/resident");
+    return "<h3>HBM-resident column tier (totals)</h3>"
+      + kv(r.total || {})
+      + "<h3>per shard</h3>" + renderTable(r.shards || []);
   },
   async sysviews() {
     const names = await get("/viewer/json/sysview");

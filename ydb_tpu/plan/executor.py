@@ -287,13 +287,27 @@ def _scan_node(plan: TableScan, db: Database, sp) -> TableBlock:
         # unpruned sources accumulate across statements, so the span
         # reports this run's DELTA (pruned views are fresh per run)
         chunks0 = {k: int(getattr(src, k, 0))
-                   for k in ("chunks_read", "chunks_skipped")}
-        stream = src.blocks(1 << 22, ex.read_cols)
+                   for k in ("chunks_read", "chunks_skipped",
+                             "resident_hits", "resident_rows")}
+        raw_stream = src.blocks(1 << 22, ex.read_cols)
+        stream = raw_stream
         bc = db.block_cache
         key_of = getattr(src, "device_cache_key", None)
-        if bc is not None and key_of is not None and bc.budget() > 0:
+        # the resident tier subsumes the whole-stream device cache (see
+        # ColumnShard scan: double-caching holds the bytes twice)
+        res_on = any(
+            getattr(s.shard, "resident", None) is not None
+            and s.shard.resident.enabled()
+            for s in getattr(src, "subs", ()))
+        if bc is not None and key_of is not None and bc.budget() > 0 \
+                and not res_on:
+            # bind the RAW source stream, not `stream` itself: the
+            # single-flight cache calls make_blocks lazily (on first
+            # next()), after `stream` has been rebound to the cache
+            # generator — a late-bound `stream` would hand the
+            # generator back to itself
             stream = bc.stream(
-                key_of(ex.read_cols, 1 << 22), lambda: stream)
+                key_of(ex.read_cols, 1 << 22), lambda: raw_stream)
         out = ex.run_stream(stream, timer=timer)
     finally:
         if timer is not None and hasattr(base_src, "attach_timer"):
@@ -302,6 +316,9 @@ def _scan_node(plan: TableScan, db: Database, sp) -> TableBlock:
         stages = timer.snapshot()
         pruning = {k: int(getattr(src, k, 0)) - v0
                    for k, v0 in chunks0.items()}
+        # resident-hit attribution: EXPLAIN ANALYZE shows how much of
+        # the scan the HBM tier served without touching host bytes
+        pruning["resident_portions"] = pruning.pop("resident_hits")
         pruning["portions_skipped"] = int(
             getattr(src, "portions_skipped", 0))
         pruning["portions_total"] = pruning["portions_skipped"] + sum(

@@ -182,6 +182,16 @@ class Conveyor:
             self._cv.notify()
             return h
 
+    def pending(self, queue: str | None = None) -> int:
+        """Queued (not yet running) task count, optionally for one
+        queue — promotion-backlog observability for the resident tier
+        (a deep "resident_promote" backlog means HBM promotion is
+        falling behind ingest)."""
+        with self._cv:
+            if queue is None:
+                return len(self._heap)
+            return sum(1 for item in self._heap if item[2] == queue)
+
     def _worker(self) -> None:
         while True:
             with self._cv:
