@@ -38,7 +38,13 @@ pruning A/B on a selective non-PK filter — stats-on vs the
 YDB_TPU_STATS=0 path, bit-identical asserted — reported as
 extra.stats_pruning {chunks read/skipped, pruning_hit_rate,
 pruning_speedup} plus extra.stats_ndv per-column NDV relative error;
-YDB_TPU_BENCH_STATS_ROWS sizes it). Engine-tier runs also
+YDB_TPU_BENCH_STATS_ROWS sizes it),
+YDB_TPU_BENCH_FUSION=0 (skip the whole-plan fusion tier: warm TPC-H
+Q3 executed as ONE fused donated-buffer dispatch — ssa.plan_fuse — vs
+the per-node fragment walk at the short-query scale fusion targets,
+bit-identity asserted; reported as extra.fusion_* rows/s, speedup and
+per-query dispatch counts; YDB_TPU_BENCH_FUSION_SF sizes it,
+default 0.001). Engine-tier runs also
 report per-stage scan seconds (engine_q{1,6}_stage_seconds:
 read/merge/stage/compute) from the streaming reader's StageTimer,
 warm-repeat p50/p99 latency from obs.counters histograms
@@ -354,6 +360,27 @@ def run_stats_ab(extra: dict, iters: int) -> None:
          f"chunks_skipped={report['chunks_skipped']}")
 
 
+def run_fusion_ab(extra: dict, iters: int) -> None:
+    """Whole-plan fusion tier: warm TPC-H Q3 (joins + grouped top-k)
+    executed as ONE fused donated-buffer dispatch (ssa.plan_fuse) vs
+    the per-node fragment walk, same Database both sides, bit-identity
+    asserted inside the bench. Runs at the short-query scale fusion
+    targets (PR 9 acceptance: fused warm >= 1.5x per-fragment on CPU
+    with a single dispatch per shape class)."""
+    from ydb_tpu.obs.kernelbench import bench_fusion
+
+    sf = float(os.environ.get("YDB_TPU_BENCH_FUSION_SF", "0.001"))
+    r = bench_fusion(sf, max(3, iters))
+    for k in ("rows", "fused_rows_per_sec", "walk_rows_per_sec",
+              "fused_speedup", "fused_dispatches",
+              "fragment_dispatches", "fragments_elided", "identical"):
+        extra[f"fusion_{k}"] = r[k]
+    _log(f"fusion tier: x{r['fused_speedup']} fused over walk "
+         f"({r['fused_dispatches']} dispatch vs "
+         f"{r['fragment_dispatches']} fragments, "
+         f"identical={r['identical']})")
+
+
 def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
     """Out-of-core engine-tier run at a LARGE scale factor (SURVEY
     §7.2 item 7): lineitem generates in bounded chunks (the full table
@@ -607,6 +634,19 @@ def main():
             _checkpoint("stats", extra)
         else:
             skipped.append("stats_tier:budget")
+
+    # whole-plan fusion tier: fused single-dispatch vs per-fragment walk
+    # (YDB_TPU_BENCH_FUSION=0 skips; fail-soft like the storage tiers)
+    if os.environ.get("YDB_TPU_BENCH_FUSION", "1") not in ("0", "", "off"):
+        if _budget_left(budget) > 90:
+            _log("fusion tier: whole-plan A/B")
+            try:
+                run_fusion_ab(extra, iters)
+            except Exception as e:  # noqa: BLE001 - additive evidence
+                extra["fusion_tier_error"] = repr(e)[-300:]
+            _checkpoint("fusion", extra)
+        else:
+            skipped.append("fusion_tier:budget")
 
     engine_warm_rps = extra["kernel_q1_warm_rows_per_sec"]
     db_iters = min(iters, 2)  # storage tiers stream the table per run

@@ -128,6 +128,29 @@ def test_mutable_default_arg():
     assert codes("def f(x=None):\n    return x\n") == []
 
 
+def test_block_until_ready_in_traced_fn():
+    src = ("import jax\n"
+           "import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    y = jnp.sum(x)\n"
+           "    jax.block_until_ready(y)\n"
+           "    return y\n")
+    assert "L007" in codes(src)
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    return jnp.sum(x).block_until_ready()\n")
+    assert "L007" in codes(src)
+
+
+def test_block_until_ready_in_host_fn_ok():
+    # benchmark harnesses sync eagerly outside any traced function
+    src = ("import jax\n"
+           "def f(out):\n"
+           "    jax.block_until_ready(out)\n"
+           "    return out\n")
+    assert codes(src) == []
+
+
 def test_set_iteration_order():
     assert "L006" in codes(
         "def f(v):\n    return [x for x in set(v)]\n")
@@ -170,4 +193,5 @@ def test_skip_file():
 
 
 def test_rule_table_is_stable():
-    assert set(RULES) == {"L001", "L002", "L003", "L004", "L005", "L006"}
+    assert set(RULES) == {"L001", "L002", "L003", "L004", "L005", "L006",
+                          "L007"}

@@ -1,0 +1,372 @@
+"""Whole-plan single-trace fusion (ssa.plan_fuse): fused vs per-node
+walk bit-identity across TPC-H shapes and NULL patterns, shape-class
+compile-cache reuse, expand-join overflow growth, unfusible fallback,
+EXPLAIN ANALYZE surface, and the YDB_TPU_FUSE_PLAN escape hatch."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.plan.executor import Database, execute_plan
+from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, \
+    Transform
+from ydb_tpu.ssa import (
+    Agg, AggSpec, Call, Col, FilterStep, GroupByStep, Op, Program,
+    plan_fuse,
+)
+from ydb_tpu.ssa.program import AssignStep, ProjectStep, SortStep, \
+    UdfCall, lit
+from ydb_tpu.obs import profile as profile_mod
+from ydb_tpu.workload import tpch
+
+
+def make_db(data: "tpch.TpchData") -> Database:
+    return Database(
+        sources={t: ColumnSource(cols, data.schema(t), data.dicts)
+                 for t, cols in data.tables.items()},
+        dicts=data.dicts)
+
+
+@pytest.fixture(scope="module")
+def tpch_db():
+    data = tpch.TpchData(sf=0.002, seed=5)
+    return make_db(data), data
+
+
+def run_ab(plan, db):
+    """Execute fused then per-node; returns (fused, walk) blocks."""
+    old = plan_fuse.FUSE_FORCE
+    try:
+        plan_fuse.FUSE_FORCE = True
+        fused = execute_plan(plan, db, use_dq=False)
+        plan_fuse.FUSE_FORCE = False
+        walk = execute_plan(plan, db, use_dq=False)
+    finally:
+        plan_fuse.FUSE_FORCE = old
+    return fused, walk
+
+
+def assert_identical(a, b):
+    """Bit-identity: same schema, same live rows, same values AND the
+    same validity — positionally (every tested plan orders its output
+    deterministically)."""
+    assert a.schema.names == b.schema.names
+    assert int(a.length) == int(b.length)
+    av, aok = a.to_numpy(), a.validity_numpy()
+    bv, bok = b.to_numpy(), b.validity_numpy()
+    for name in a.schema.names:
+        np.testing.assert_array_equal(aok[name], bok[name],
+                                      err_msg=f"validity({name})")
+        np.testing.assert_array_equal(
+            np.where(aok[name], av[name], 0),
+            np.where(bok[name], bv[name], 0), err_msg=name)
+
+
+# ---------------- bit-identity across TPC-H shapes ----------------
+
+
+def test_q3_joins_topk_bit_identity(tpch_db):
+    """Semi + inner join feeding a grouped top-10: the acceptance
+    shape."""
+    db, _ = tpch_db
+    plan = tpch.q3_plan()
+    assert plan_fuse.plan_signature(plan, db) is not None
+    fused, walk = run_ab(plan, db)
+    assert int(fused.length) == 10
+    assert_identical(fused, walk)
+
+
+def test_q1_agg_avg_bit_identity(tpch_db):
+    """Q1's SUM/AVG/COUNT battery (the AVG final fixup) + sort."""
+    db, _ = tpch_db
+    plan = Transform(TableScan("lineitem"), tpch.q1_program())
+    fused, walk = run_ab(plan, db)
+    assert int(fused.length) > 0
+    assert_identical(fused, walk)
+
+
+def test_q6_global_agg_bit_identity(tpch_db):
+    db, _ = tpch_db
+    plan = Transform(TableScan("lineitem"), tpch.q6_program())
+    fused, walk = run_ab(plan, db)
+    assert int(fused.length) == 1
+    assert_identical(fused, walk)
+
+
+def null_db(n=3000, seed=11):
+    """Synthetic pair of tables with NULLs in group keys, agg inputs
+    and join keys (a NULL key matches nothing)."""
+    rng = np.random.default_rng(seed)
+    t_schema = dtypes.schema(("k", dtypes.INT64), ("j", dtypes.INT64),
+                             ("v", dtypes.INT64))
+    d_schema = dtypes.schema(("dk", dtypes.INT64), ("w", dtypes.INT64))
+    t_cols = {
+        "k": rng.integers(0, 7, n).astype(np.int64),
+        "j": rng.integers(0, 50, n).astype(np.int64),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+    }
+    t_valid = {
+        "k": rng.random(n) > 0.1,
+        "j": rng.random(n) > 0.15,
+        "v": rng.random(n) > 0.2,
+    }
+    d_cols = {
+        "dk": np.arange(50, dtype=np.int64),
+        "w": rng.integers(0, 10, 50).astype(np.int64),
+    }
+    d_valid = {"dk": np.ones(50, bool), "w": rng.random(50) > 0.3}
+    return Database(sources={
+        "t": ColumnSource(t_cols, t_schema, validity=t_valid),
+        "d": ColumnSource(d_cols, d_schema, validity=d_valid),
+    })
+
+
+def test_null_patterns_join_agg_bit_identity():
+    db = null_db()
+    plan = Transform(
+        LookupJoin(
+            probe=TableScan("t"), build=TableScan("d"),
+            probe_keys=("j",), build_keys=("dk",),
+            payload=("w",), kind="left",
+        ),
+        Program((
+            AssignStep("vw", Call(Op.ADD, Col("v"), Col("w"))),
+            GroupByStep(
+                keys=("k",),
+                aggs=(AggSpec(Agg.SUM, "vw", "s"),
+                      AggSpec(Agg.AVG, "v", "a"),
+                      AggSpec(Agg.COUNT, "w", "c"),
+                      AggSpec(Agg.COUNT_ALL, None, "n")),
+            ),
+            SortStep(keys=("k",)),
+        )))
+    fused, walk = run_ab(plan, db)
+    # NULL group key forms its own group; NULL-fed aggs stay NULL-aware
+    assert int(fused.length) == 8
+    assert_identical(fused, walk)
+
+
+def test_expand_join_overflow_grows_and_matches():
+    """An expand join whose true fanout exceeds fanout_hint: the fused
+    dispatch overflows its static capacity, grows it, re-stages and
+    re-dispatches — results still bit-identical to the walk."""
+    rng = np.random.default_rng(3)
+    n_probe, n_build = 500, 4000
+    p_schema = dtypes.schema(("pk", dtypes.INT64), ("pv", dtypes.INT64))
+    b_schema = dtypes.schema(("bk", dtypes.INT64), ("bv", dtypes.INT64))
+    db = Database(sources={
+        "p": ColumnSource({
+            "pk": rng.integers(0, 40, n_probe).astype(np.int64),
+            "pv": rng.integers(0, 100, n_probe).astype(np.int64),
+        }, p_schema),
+        "b": ColumnSource({
+            "bk": rng.integers(0, 40, n_build).astype(np.int64),
+            "bv": rng.integers(0, 100, n_build).astype(np.int64),
+        }, b_schema),
+    })
+    plan = Transform(
+        ExpandJoin(
+            probe=TableScan("p"), build=TableScan("b"),
+            probe_keys=("pk",), build_keys=("bk",),
+            probe_payload=("pk", "pv"), build_payload=("bv",),
+            fanout_hint=1.0,  # true fanout ~100: forces overflow growth
+        ),
+        Program((
+            GroupByStep(keys=("pk",),
+                        aggs=(AggSpec(Agg.SUM, "bv", "s"),
+                              AggSpec(Agg.COUNT_ALL, None, "n"))),
+            SortStep(keys=("pk",)),
+        )))
+    sig = plan_fuse.plan_signature(plan, db)
+    assert sig is not None
+    fused, walk = run_ab(plan, db)
+    assert_identical(fused, walk)
+    # the grown capacity is kept on the cached plan for later statements
+    key = sig.cache_key(db)
+    cached = db._compile_cache[key]
+    assert cached.expand_caps[0] > plan_fuse.DEFAULT_CAPACITY_QUANTUM
+
+
+# ---------------- shape-class compile cache ----------------
+
+
+def test_shape_class_sizes():
+    q = plan_fuse.DEFAULT_CAPACITY_QUANTUM
+    for n in (1, 1000, 1024, 8192, 8193, 60000, 600858):
+        c = plan_fuse.shape_class(n)
+        assert c >= n and c % q == 0
+        if n > 8 * q:
+            assert c <= n * 1.25 + q  # bounded dead padding
+    assert plan_fuse.shape_class(1) == q
+    # growing within a class must not change the class
+    assert plan_fuse.shape_class(8193) == plan_fuse.shape_class(10000)
+
+
+def test_shape_class_cache_hit_on_same_class_data():
+    """Different data with the same shape-class vector reuses the
+    compiled FusedPlan: no rebuild, compile_cache=hit, zero compile
+    seconds."""
+    data = tpch.TpchData(sf=0.002, seed=5)
+    db = make_db(data)
+    plan = tpch.q3_plan()
+
+    def fuse_keys():
+        return [k for k in db._compile_cache if k[0] == "plan_fuse"]
+
+    old = plan_fuse.FUSE_FORCE
+    try:
+        plan_fuse.FUSE_FORCE = True
+        first = execute_plan(plan, db, use_dq=False)
+        assert len(fuse_keys()) == 1
+
+        # same shape class, different rows AND different values: slice
+        # a few hundred rows off lineitem and shuffle the remainder
+        li = data.tables["lineitem"]
+        n = len(li["l_orderkey"])
+        keep = plan_fuse.shape_class(n) - plan_fuse.shape_class(n - 300)
+        assert keep == 0  # sliced table stays in the class
+        perm = np.random.default_rng(9).permutation(n - 300)
+        db.sources["lineitem"] = ColumnSource(
+            {k: v[:n - 300][perm] for k, v in li.items()},
+            data.schema("lineitem"), data.dicts)
+
+        with profile_mod.profiled("q3") as h:
+            second = execute_plan(plan, db, use_dq=False)
+        assert len(fuse_keys()) == 1  # reused, not rebuilt
+        p = h.profile
+        assert p.compile_cache == "hit"
+        assert p.compile_seconds == 0.0
+        assert p.fused_stages == 6 and p.fragments_elided == 5
+        assert not any(s["name"] == "ssa.compile" for s in p.spans)
+
+        plan_fuse.FUSE_FORCE = False
+        walk = execute_plan(plan, db, use_dq=False)
+    finally:
+        plan_fuse.FUSE_FORCE = old
+    assert_identical(second, walk)
+    assert int(first.length) == 10
+
+
+def test_different_class_recompiles():
+    """A table in a different shape class gets its own FusedPlan."""
+    data = tpch.TpchData(sf=0.002, seed=5)
+    db = make_db(data)
+    plan = tpch.q3_plan()
+    old = plan_fuse.FUSE_FORCE
+    try:
+        plan_fuse.FUSE_FORCE = True
+        execute_plan(plan, db, use_dq=False)
+        li = data.tables["lineitem"]
+        n = len(li["l_orderkey"])
+        half = n // 2
+        assert plan_fuse.shape_class(half) != plan_fuse.shape_class(n)
+        db.sources["lineitem"] = ColumnSource(
+            {k: v[:half] for k, v in li.items()},
+            data.schema("lineitem"), data.dicts)
+        execute_plan(plan, db, use_dq=False)
+    finally:
+        plan_fuse.FUSE_FORCE = old
+    keys = [k for k in db._compile_cache if k[0] == "plan_fuse"]
+    assert len(keys) == 2
+
+
+# ---------------- fallback rules ----------------
+
+
+def test_udf_subtree_not_fusible_falls_back(tpch_db):
+    db, _ = tpch_db
+    plan = Transform(
+        TableScan("lineitem", Program((
+            ProjectStep(("l_orderkey", "l_quantity")),
+        ))),
+        Program((
+            AssignStep("q2", UdfCall(
+                "double", (Col("l_quantity"),), dtypes.INT64,
+                lambda a: a * 2)),
+            GroupByStep(keys=("l_orderkey",),
+                        aggs=(AggSpec(Agg.SUM, "q2", "s"),)),
+            SortStep(keys=("l_orderkey",), limit=20),
+        )))
+    assert plan_fuse.plan_signature(plan, db) is None
+    # forcing fusion on still executes (per-node walk fallback), and
+    # matches the forced-off side
+    fused, walk = run_ab(plan, db)
+    assert_identical(fused, walk)
+
+
+def test_oversized_table_not_fusible(tpch_db, monkeypatch):
+    db, _ = tpch_db
+    monkeypatch.setattr(plan_fuse, "FUSE_MAX_ROWS", 100)
+    assert plan_fuse.plan_signature(tpch.q3_plan(), db) is None
+
+
+def test_missing_table_not_fusible(tpch_db):
+    db, _ = tpch_db
+    plan = Transform(TableScan("no_such_table"),
+                     Program((ProjectStep(("x",)),)))
+    assert plan_fuse.plan_signature(plan, db) is None
+
+
+# ---------------- EXPLAIN ANALYZE / session surface ----------------
+
+
+def _ev_cluster():
+    from ydb_tpu.kqp.session import Cluster
+
+    c = Cluster()
+    s = c.session()
+    s.execute("CREATE TABLE ev (id int64, ts int64, v int64, "
+              "PRIMARY KEY (id)) WITH (shards = 2)")
+    for base in (0, 100, 200):
+        vals = ", ".join(f"({base + i}, {base + i}, {(base + i) * 3})"
+                         for i in range(8))
+        s.execute(f"INSERT INTO ev VALUES {vals}")
+    return c
+
+
+def test_explain_analyze_reports_fusion():
+    c = _ev_cluster()
+    s = c.session()
+    sql = ("EXPLAIN ANALYZE SELECT ts, sum(v) AS sv FROM ev "
+           "WHERE ts >= 100 GROUP BY ts")
+    txt = s.execute(sql)
+    assert "fusion: fused_stages=2 fragments_elided=1" in txt
+    p = s.last_profile
+    assert p.fused_stages == 2 and p.fragments_elided == 1
+    # the whole build is ONE compile span; the dispatch is ONE fused
+    # computation under ONE plan.fuse span
+    assert sum(1 for sp in p.spans if sp["name"] == "ssa.compile") == 1
+    fuse = [sp for sp in p.spans if sp["name"] == "plan.fuse"]
+    assert len(fuse) == 1
+    assert fuse[0]["attrs"]["fused_stages"] == 2
+    assert fuse[0]["attrs"]["compile_cache"] == "miss"
+    # warm rerun: cached FusedPlan, no compile
+    txt2 = s.execute(sql)
+    assert "compile_cache=hit" in txt2
+    assert "compile_seconds=0.000000" in txt2
+    assert "fusion: fused_stages=2" in txt2
+
+
+# ---------------- env gates ----------------
+
+
+def test_fuse_plan_env_gate(monkeypatch):
+    monkeypatch.setattr(plan_fuse, "FUSE_FORCE", None)
+    monkeypatch.setenv("YDB_TPU_FUSE_PLAN", "0")
+    assert not plan_fuse.fusion_enabled()
+    data = tpch.TpchData(sf=0.002, seed=5)
+    db = make_db(data)
+    plan = tpch.q3_plan()
+    with profile_mod.profiled("q3") as h:
+        gated = execute_plan(plan, db, use_dq=False)
+    assert not any(s["name"] == "plan.fuse" for s in h.profile.spans)
+    assert h.profile.fused_stages == 0
+
+    monkeypatch.setenv("YDB_TPU_FUSE_PLAN", "1")
+    assert plan_fuse.fusion_enabled()
+    with profile_mod.profiled("q3") as h:
+        fused = execute_plan(plan, db, use_dq=False)
+    assert any(s["name"] == "plan.fuse" for s in h.profile.spans)
+    assert h.profile.fused_stages == 6
+    assert_identical(fused, gated)

@@ -24,6 +24,13 @@ function. The classic hazards (each is a rule below):
   L006 set-iteration-order     iterating a set literal / ``set(...)``
                                feeds hash order into trace order; two
                                processes compile different programs
+  L007 block-in-trace          ``jax.block_until_ready(...)`` / the
+                               ``.block_until_ready()`` method inside a
+                               trace-suspect function: under jit it is
+                               a no-op on tracers at best, and in the
+                               fused plan-lowering paths it would split
+                               the single-dispatch computation back
+                               into synchronized fragments
 
 "Trace-suspect" means the function's own body calls into ``jnp.*`` /
 ``jax.lax.*`` / ``jax.nn.*`` — the practical signature of code that
@@ -61,6 +68,7 @@ RULES = {
     "L004": "unseeded-randomness",
     "L005": "mutable-default-arg",
     "L006": "set-iteration-order",
+    "L007": "block-in-trace",
 }
 _TRACE_ROOTS = ("jnp.", "jax.lax.", "jax.nn.", "jax.scipy.")
 _CLOCK_CALLS = {
@@ -170,6 +178,14 @@ class _FunctionChecker(ast.NodeVisitor):
                        " (and fails under jit); keep values on device or"
                        " materialize once outside the trace")
         root = _dotted(fn)
+        if root == "jax.block_until_ready" or (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "block_until_ready"):
+            self._emit(node, "L007",
+                       "block_until_ready inside traced code is a no-op"
+                       " on tracers and a fusion barrier in plan-lowering"
+                       " paths; sync once outside the trace (after the"
+                       " fused dispatch) instead")
         if (isinstance(fn, ast.Name) and fn.id in ("int", "float", "bool")
                 or root in _MATERIALIZER_ROOTS):
             if any(_has_trace_call(a, through_materializers=True)

@@ -213,6 +213,17 @@ class TableBlock:
         return {k: v[:n] for k, v in got.items()}
 
 
+def device_aux(aux: Mapping[str, object]) -> dict:
+    """Stage a compiled program's aux tables (dict masks, gather tables)
+    on the device, skipping values that already live there — the aux
+    dict crosses every fragment boundary, and re-staging device-resident
+    arrays on each hop costs a transfer for nothing."""
+    return {
+        k: v if isinstance(v, jax.Array) else jnp.asarray(v)
+        for k, v in aux.items()
+    }
+
+
 def concat_blocks(blocks: list[TableBlock], capacity: int | None = None) -> TableBlock:
     """Host-side concat of live rows into one block (used by readers/tests)."""
     if not blocks:

@@ -18,11 +18,12 @@ import dataclasses
 import threading
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu import dtypes
-from ydb_tpu.blocks.block import TableBlock, concat_blocks
+from ydb_tpu.blocks.block import TableBlock, concat_blocks, device_aux
 from ydb_tpu.dq.graph import (
     Broadcast,
     ChannelSpec,
@@ -36,7 +37,7 @@ from ydb_tpu.dq.graph import (
 )
 from ydb_tpu.dq.spilling import Spiller
 from ydb_tpu.engine.oracle import OracleTable
-from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.engine.scan import ColumnSource, merge_blocks_device
 from ydb_tpu.runtime.actors import Actor, ActorId
 from ydb_tpu.ssa.compiler import compile_program
 
@@ -169,9 +170,8 @@ class _CompiledStage:
                 dict_aliases=dict(spec.dict_aliases),
             )
             mid = self.per_block.out_schema
-            self._pb_aux = {
-                k: jnp.asarray(v) for k, v in self.per_block.aux.items()
-            }
+            self._pb_jit = jax.jit(self.per_block.run)
+            self._pb_aux = device_aux(self.per_block.aux)
         else:
             self.per_block = None
             mid = in_schema
@@ -186,18 +186,30 @@ class _CompiledStage:
                 spec.final_program, mid, dicts, key_spaces,
                 dict_aliases=aliases,
             )
-            self._f_aux = {
-                k: jnp.asarray(v) for k, v in self.final.aux.items()
-            }
+            self._f_aux = device_aux(self.final.aux)
             self.out_schema = self.final.out_schema
+            final_run = self.final.run
+
+            # the stage's whole final phase — merge accumulated partials
+            # + final program — is ONE traced computation (the fused
+            # finalize the single-chip ScanExecutor uses): partials never
+            # round-trip through the host between merge and final
+            @jax.jit
+            def _finalize(parts, aux):
+                return final_run(merge_blocks_device(list(parts)), aux)
+
+            self._finalize_jit = _finalize
         else:
             self.final = None
             self.out_schema = mid
+            self._f_aux = {}
+            self._finalize_jit = jax.jit(
+                lambda parts, aux: merge_blocks_device(list(parts)))
 
     def run_block(self, block: TableBlock) -> TableBlock:
         if self.per_block is None:
             return block
-        return self.per_block.run(block, self._pb_aux)
+        return self._pb_jit(block, self._pb_aux)
 
     def run_join(self, probe: TableBlock, build: TableBlock) -> TableBlock:
         """Device-local join of this task's hash bucket (grace bucket
@@ -214,10 +226,9 @@ class _CompiledStage:
         )
 
     def run_final(self, blocks: list[TableBlock]) -> TableBlock:
-        merged = blocks[0] if len(blocks) == 1 else concat_blocks(blocks)
-        if self.final is None:
-            return merged
-        return self.final.run(merged, self._f_aux)
+        if self.final is None and len(blocks) == 1:
+            return blocks[0]
+        return self._finalize_jit(tuple(blocks), self._f_aux)
 
 
 class ComputeActor(Actor):

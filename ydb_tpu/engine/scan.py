@@ -25,7 +25,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu import dtypes
-from ydb_tpu.blocks.block import Column, TableBlock, concat_blocks
+from ydb_tpu.blocks.block import (
+    Column,
+    TableBlock,
+    concat_blocks,
+    device_aux,
+)
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.oracle import OracleTable
 from ydb_tpu.ssa import kernels, twophase
@@ -215,9 +220,7 @@ class ScanExecutor:
             group_est=group_est,
         )
         self._partial_jit = jax.jit(self.partial.run)
-        self._partial_aux = {
-            k: jnp.asarray(v) for k, v in self.partial.aux.items()
-        }
+        self._partial_aux = device_aux(self.partial.aux)
         self._combine_jit = None
         self._combine_aux = {}
         if self.final_prog is not None and self.partial.group_layout[0] in (
@@ -236,9 +239,7 @@ class ScanExecutor:
                 return comb_run(merge_blocks_device(list(parts)), aux)
 
             self._combine_jit = _combine
-            self._combine_aux = {
-                k: jnp.asarray(v) for k, v in comb.aux.items()
-            }
+            self._combine_aux = device_aux(comb.aux)
         if self.final_prog is not None:
             self.final = compile_program(
                 self.final_prog, self.partial.out_schema, source.dicts,
@@ -246,9 +247,7 @@ class ScanExecutor:
                 dict_aliases=twophase.dict_aliases(self.partial_prog),
             )
             self._final_jit = jax.jit(self.final.run)
-            self._final_aux = {
-                k: jnp.asarray(v) for k, v in self.final.aux.items()
-            }
+            self._final_aux = device_aux(self.final.aux)
             self.out_schema = self._stamp_nullability(
                 self.final.out_schema)
             final_run = self.final.run

@@ -18,6 +18,12 @@ Compared to the in-process recursive executor, joins never materialize a
 whole table in one place: each join task holds 1/N of each side (the
 GraceJoin memory shape), streamed in through credit-flow channels with
 spill-beyond-quota.
+
+Device-side, each lowered stage runs as a single fused trace: the task
+runner (dq/compute.py) jits the whole per-task program — scan pushdown,
+grace-bucket join, partial aggregate — as one XLA computation, and the
+in-process executor's whole-plan analogue (ssa/plan_fuse.py) does the
+same for plans small enough to skip DQ entirely.
 """
 
 from __future__ import annotations

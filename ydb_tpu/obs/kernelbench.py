@@ -15,11 +15,15 @@
     ``ColumnShard.scan`` with query profiling active (a traced root
     span, the session's default-on state) vs inactive (the
     ``YDB_TPU_PROFILE=0`` path): profiling must be within noise of off,
-    or it cannot stay default-on.
+    or it cannot stay default-on;
+  * fusion (``--fusion``) — warm TPC-H Q3 (joins + grouped top-k)
+    executed as ONE whole-plan fused dispatch (ssa.plan_fuse) vs the
+    per-node fragment walk, bit-identity asserted, with per-query
+    dispatch counts.
 
 Flags: ``--rows`` ``--groups`` ``--aggs`` ``--iters`` ``--block-rows``
-``--pruning`` ``--profile-overhead`` ``--sf`` (scale factor for the
-overhead bench) ``--json`` (machine-readable report on stdout) and
+``--pruning`` ``--profile-overhead`` ``--fusion`` ``--sf`` (scale
+factor for the overhead/fusion benches) ``--json`` (report on stdout) and
 ``--smoke`` (tiny sizes, correctness-only; wired into tier-1 as a
 non-slow test). Run under JAX_PLATFORMS=cpu for a stable reference; on
 accelerators it measures whatever backend jax selects.
@@ -72,7 +76,7 @@ def bench_group_by(rows: int, groups: int, aggs: int, iters: int,
                    check: bool = True) -> dict:
     import jax
 
-    from ydb_tpu.blocks.block import TableBlock
+    from ydb_tpu.blocks.block import TableBlock, device_aux
     from ydb_tpu.engine.oracle import OracleTable, run_oracle
     from ydb_tpu.ssa import kernels
     from ydb_tpu.ssa.compiler import compile_program
@@ -87,7 +91,7 @@ def bench_group_by(rows: int, groups: int, aggs: int, iters: int,
             cp = compile_program(prog, schema,
                                  key_spaces={"k": groups})
             run = jax.jit(cp.run)
-            aux = {k: jax.numpy.asarray(v) for k, v in cp.aux.items()}
+            aux = device_aux(cp.aux)
             res = jax.block_until_ready(run(blk, aux))
             results[label] = res
             best = float("inf")
@@ -378,6 +382,75 @@ def bench_profile_overhead(sf: float, iters: int, block_rows: int,
     return out
 
 
+def bench_fusion(sf: float, iters: int) -> dict:
+    """Whole-plan fusion A/B: TPC-H Q3 (semi + inner join feeding a
+    grouped two-phase-aggregate top-k) executed fused — one
+    donated-buffer dispatch per shape class (ssa.plan_fuse) — vs the
+    per-node memo walk, same Database both sides, results asserted
+    bit-identical (Q3's sort is fully tie-broken, so rows compare
+    positionally)."""
+    import jax
+
+    from ydb_tpu.engine.scan import ColumnSource
+    from ydb_tpu.plan.executor import Database, execute_plan
+    from ydb_tpu.ssa import plan_fuse
+    from ydb_tpu.workload import tpch
+
+    data = tpch.TpchData(sf=sf, seed=5)
+    db = Database(
+        sources={t: ColumnSource(cols, data.schema(t), data.dicts)
+                 for t, cols in data.tables.items()},
+        dicts=data.dicts)
+    plan = tpch.q3_plan()
+    sig = plan_fuse.plan_signature(plan, db)
+    if sig is None:
+        raise AssertionError("q3 plan did not fuse")
+    n = len(data.tables["lineitem"]["l_orderkey"])
+
+    def run(force):
+        old = plan_fuse.FUSE_FORCE
+        plan_fuse.FUSE_FORCE = force
+        try:
+            return jax.block_until_ready(
+                execute_plan(plan, db, use_dq=False))
+        finally:
+            plan_fuse.FUSE_FORCE = old
+
+    out: dict = {
+        "rows": n, "sf": sf,
+        # the walk dispatches (at least) one compiled fragment per plan
+        # node; the fused path replaces all of them with one dispatch
+        "fragment_dispatches": sig.fused_stages,
+        "fused_dispatches": 1,
+        "fragments_elided": sig.fused_stages - 1,
+    }
+    results = {}
+    best = {"fused": float("inf"), "walk": float("inf")}
+    for label, force in (("fused", True), ("walk", False)):
+        results[label] = run(force)  # warm: trace + compile caches
+    # interleave the sides so host drift hits both equally
+    for _ in range(max(1, iters)):
+        for label, force in (("fused", True), ("walk", False)):
+            t0 = time.perf_counter()
+            run(force)
+            best[label] = min(best[label], time.perf_counter() - t0)
+    for label in ("fused", "walk"):
+        out[f"{label}_seconds"] = round(best[label], 6)
+        out[f"{label}_rows_per_sec"] = round(n / best[label])
+    out["fused_speedup"] = round(best["walk"] / best["fused"], 2)
+    a, b = results["fused"], results["walk"]
+    assert a.schema.names == b.schema.names
+    av, aok = a.to_numpy(), a.validity_numpy()
+    bv, bok = b.to_numpy(), b.validity_numpy()
+    for name in a.schema.names:
+        if not np.array_equal(aok[name], bok[name]) or not np.array_equal(
+                np.where(aok[name], av[name], 0),
+                np.where(bok[name], bv[name], 0)):
+            raise AssertionError(f"fused/walk mismatch on {name}")
+    out["identical"] = True
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m ydb_tpu.obs.kernelbench",
@@ -395,8 +468,11 @@ def main(argv=None) -> int:
                     help="HBM-resident vs staged warm scan A/B")
     ap.add_argument("--profile-overhead", action="store_true",
                     help="profiling on-vs-off warm Q1 A/B micro-bench")
+    ap.add_argument("--fusion", action="store_true",
+                    help="whole-plan fused vs per-fragment warm Q3 A/B")
     ap.add_argument("--sf", type=float, default=0.05,
-                    help="TPC-H scale factor for --profile-overhead")
+                    help="TPC-H scale factor for --profile-overhead"
+                         " and --fusion")
     ap.add_argument("--json", action="store_true",
                     help="one JSON object on stdout")
     ap.add_argument("--smoke", action="store_true",
@@ -429,6 +505,8 @@ def main(argv=None) -> int:
         report["profile_overhead"] = bench_profile_overhead(
             args.sf, max(3, args.iters), args.block_rows,
             assert_within=(0.5 if args.smoke else None))
+    if args.fusion or args.smoke:
+        report["fusion"] = bench_fusion(args.sf, max(3, args.iters))
     if args.json:
         print(json.dumps(report))
     else:
@@ -464,6 +542,15 @@ def main(argv=None) -> int:
                   f"on {po['profile_on_rows_per_sec']:,} rows/s vs "
                   f"off {po['profile_off_rows_per_sec']:,} rows/s "
                   f"({po['overhead_pct']:+.2f}%)")
+        if "fusion" in report:
+            fu = report["fusion"]
+            print(f"fusion rows={fu['rows']}: fused "
+                  f"{fu['fused_rows_per_sec']:,} rows/s vs walk "
+                  f"{fu['walk_rows_per_sec']:,} rows/s "
+                  f"(x{fu['fused_speedup']}, "
+                  f"{fu['fused_dispatches']} dispatch vs "
+                  f"{fu['fragment_dispatches']} fragments, "
+                  f"identical={fu['identical']})")
     return 0
 
 

@@ -48,6 +48,8 @@ class QueryProfile:
     compile_cache: str = ""   # miss if ANY scan/transform compiled fresh
     compile_seconds: float = 0.0   # lowering + first-trace (XLA) time
     execute_seconds: float = 0.0   # seconds - compile_seconds
+    fused_stages: int = 0     # plan nodes folded into one traced dispatch
+    fragments_elided: int = 0  # dispatch boundaries removed by fusion
     stages: dict = dataclasses.field(default_factory=dict)
     pruning: dict = dataclasses.field(default_factory=dict)
     device_seconds: float = 0.0
@@ -129,6 +131,20 @@ def build_profile(spans, sql: str = "", kind: str = "",
             p.plan_cache = str(a["plan_cache"])
         if s.name == "ssa.compile":
             p.compile_seconds += s.seconds
+        if s.name == "plan.fuse":
+            # whole-plan single-trace execution (ssa.plan_fuse): one
+            # span per fused dispatch carrying the fusion accounting
+            p.fused_stages = max(p.fused_stages,
+                                 int(a.get("fused_stages", 0)))
+            p.fragments_elided += int(a.get("fragments_elided", 0))
+            if a.get("compile_cache") == "miss":
+                p.compile_cache = "miss"
+            elif (a.get("compile_cache") == "hit"
+                  and not p.compile_cache):
+                p.compile_cache = "hit"
+            p.compile_seconds += float(
+                a.get("first_trace_seconds", 0.0))
+            continue
         if s.name == "dq.task":
             # DQ queries run their device dispatches inside compute
             # actors (no scan/transform spans on that path): the tasks'
@@ -241,6 +257,10 @@ def format_plan_analyzed(plan, profile: QueryProfile) -> str:
         "compile: compile_cache=" + (profile.compile_cache or "none")
         + f" compile_seconds={profile.compile_seconds:.6f}"
         + f" execute_seconds={profile.execute_seconds:.6f}")
+    if profile.fused_stages:
+        lines.append(
+            f"fusion: fused_stages={profile.fused_stages}"
+            f" fragments_elided={profile.fragments_elided}")
     st = profile.stages
     lines.append("stages: " + " ".join(
         f"{k}={st.get(k, 0.0):.6f}" for k in STAGE_KEYS))

@@ -21,12 +21,11 @@ import dataclasses
 import os
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu import dtypes
 from ydb_tpu.analysis.verify import check_program
-from ydb_tpu.blocks.block import TableBlock, concat_blocks
+from ydb_tpu.blocks.block import TableBlock, concat_blocks, device_aux
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.oracle import OracleTable
 from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
@@ -238,6 +237,16 @@ def execute_plan(plan: PlanNode, db: Database,
             out = _execute_plan_dq(plan, db)
             if out is not None:
                 return out
+        # whole-plan fusion (ssa.plan_fuse): replace the per-node memo
+        # walk with ONE jitted dispatch when the whole tree is fusible.
+        # A bare TableScan is already a single fragment — _scan_node's
+        # streaming path stays.
+        from ydb_tpu.ssa import plan_fuse
+
+        if plan_fuse.fusion_enabled() and not isinstance(plan, TableScan):
+            out = _execute_plan_fused(plan, db)
+            if out is not None:
+                return out
         _memo = {}
     hit = _memo.get(id(plan))
     if hit is not None:
@@ -338,6 +347,187 @@ def _scan_node(plan: TableScan, db: Database, sp) -> TableBlock:
     return out
 
 
+def _stage_fused_site(site, db: Database, timer, donate: bool):
+    """Stage one fused scan site to its shape-class capacity.
+
+    Mirrors _scan_node's staging side exactly — pruned view, chunk-delta
+    pruning accounting, block cache / resident tier routing, StageTimer
+    attachment — but ends at a single padded device block instead of a
+    streamed program run (the program runs inside the fused trace).
+    Returns (block, pruning dict). The staged block's buffers are always
+    fresh (from_numpy copies / a jitted merge), so the fused dispatch
+    may donate them."""
+    import contextlib
+
+    from ydb_tpu.ssa import plan_fuse
+
+    src = db.sources[site.table]
+    base_src = src
+    if timer is not None and hasattr(base_src, "attach_timer"):
+        base_src.attach_timer(timer)
+    try:
+        if site.node.program is not None:
+            src = _pruned_source(src, site.node.program, db)
+        chunks0 = {k: int(getattr(src, k, 0))
+                   for k in ("chunks_read", "chunks_skipped",
+                             "resident_hits", "resident_rows")}
+        staging = (timer.stage("stage") if timer is not None
+                   else contextlib.nullcontext())
+        if isinstance(src, ColumnSource):
+            n = src.num_rows
+            arrays = {m: src.columns[m] for m in site.read_cols}
+            validity = None
+            if src.validity:
+                validity = {m: src.validity[m]
+                            for m in site.read_cols
+                            if m in src.validity}
+            if donate and site.capacity == n:
+                # exact-fit capacity: from_numpy pads nothing, and
+                # jnp.asarray may alias an aligned host array on CPU —
+                # donating the alias would let XLA scribble over the
+                # source table. Copy this (power-of-two row count) case;
+                # every other path stages through fresh buffers already.
+                arrays = {k: np.array(v) for k, v in arrays.items()}
+                if validity:
+                    validity = {k: np.array(v)
+                                for k, v in validity.items()}
+            with staging:
+                blk = TableBlock.from_numpy(
+                    arrays, site.in_schema, validity,
+                    capacity=site.capacity)
+        else:
+            raw_stream = src.blocks(1 << 22, site.read_cols)
+            stream = raw_stream
+            bc = db.block_cache
+            key_of = getattr(src, "device_cache_key", None)
+            res_on = any(
+                getattr(s.shard, "resident", None) is not None
+                and s.shard.resident.enabled()
+                for s in getattr(src, "subs", ()))
+            if bc is not None and key_of is not None \
+                    and bc.budget() > 0 and not res_on:
+                stream = bc.stream(
+                    key_of(site.read_cols, 1 << 22), lambda: raw_stream)
+            blocks = tuple(stream)
+            with staging:
+                blk = plan_fuse.fit_blocks(blocks, site.capacity)
+    finally:
+        if timer is not None and hasattr(base_src, "attach_timer"):
+            base_src.attach_timer(None)
+    pruning = {k: int(getattr(src, k, 0)) - v0
+               for k, v0 in chunks0.items()}
+    pruning["resident_portions"] = pruning.pop("resident_hits")
+    pruning["portions_skipped"] = int(
+        getattr(src, "portions_skipped", 0))
+    pruning["portions_total"] = pruning["portions_skipped"] + sum(
+        len(s.metas) for s in getattr(src, "subs", ()))
+    return blk, pruning
+
+
+def _run_fused(fused, db: Database, fsp) -> TableBlock:
+    """Stage every scan site, dispatch the fused computation once, and
+    handle expand-join overflow retries.
+
+    Observability mirrors the walk: each staged table gets a "scan" span
+    with stage/pruning attrs firing the shard=-1 probes; the PRIMARY
+    (largest) table's span stays open around the fused dispatch so
+    device time lands in its "compute" stage — EXPLAIN ANALYZE actuals
+    and probe sessions stay consistent whichever executor ran."""
+    import contextlib
+
+    from ydb_tpu.obs.probes import StageTimer
+
+    want_stats = (fsp.recording or bool(_P_SCAN_STAGES)
+                  or bool(_P_SCAN_PRUNING))
+    sites = fused.sites
+    primary = max(range(len(sites)), key=lambda i: sites[i].capacity)
+    inputs: dict = {}
+
+    def emit_obs(sp, site, timer, rows, pruning):
+        stages = timer.snapshot()
+        if sp.recording:
+            sp.set(table=site.table, rows=rows,
+                   **{f"stage_{k}": v for k, v in stages.items()},
+                   **pruning)
+        if _P_SCAN_STAGES:
+            _P_SCAN_STAGES.fire(shard=-1, **stages)
+        if _P_SCAN_PRUNING:
+            _P_SCAN_PRUNING.fire(shard=-1, **pruning)
+
+    for i, other in enumerate(sites):
+        if i == primary:
+            continue
+        with tracing.span("scan") as sp:
+            timer = StageTimer() if want_stats else None
+            blk, pruning = _stage_fused_site(other, db, timer,
+                                             fused.donate)
+            inputs[other.key] = blk
+            if want_stats:
+                emit_obs(sp, other, timer, int(blk.length), pruning)
+
+    site = sites[primary]
+    with tracing.span("scan") as sp:
+        timer = StageTimer() if want_stats else None
+        blk, pruning = _stage_fused_site(site, db, timer, fused.donate)
+        inputs[site.key] = blk
+        # rows read before the dispatch: donated inputs are dead after
+        rows = int(blk.length) if want_stats else 0
+        while True:
+            computing = (timer.stage("compute") if timer is not None
+                         else contextlib.nullcontext())
+            with computing:
+                out, totals = fused.run(inputs)
+            over = fused.overflowed(totals)
+            if not over:
+                break
+            # an expand join outgrew its static capacity: widen it (the
+            # cached plan keeps the exact size for later statements),
+            # re-stage — donation consumed the inputs — and re-dispatch
+            for j in over:
+                fused.grow(j, totals[j])
+            inputs = {
+                s.key: _stage_fused_site(s, db, None, fused.donate)[0]
+                for s in sites
+            }
+        if want_stats:
+            emit_obs(sp, site, timer, rows, pruning)
+    return out
+
+
+def _execute_plan_fused(plan: PlanNode, db: Database) -> TableBlock | None:
+    """Whole-plan fused fast path (ssa.plan_fuse): one donated-buffer
+    jitted dispatch per (plan fingerprint, shape class), cached in the
+    cluster compile cache. Returns None when the plan is not fusible
+    (the caller falls back to the per-node walk)."""
+    from ydb_tpu.ssa import plan_fuse
+
+    sig = plan_fuse.plan_signature(plan, db)
+    if sig is None or not sig.sites:
+        return None
+    key = sig.cache_key(db)
+    fused = db._compile_cache.get(key)
+    fresh = fused is None
+    with tracing.span("plan.fuse") as fsp:
+        if fresh:
+            try:
+                fused = plan_fuse.build(sig, db)
+            except plan_fuse.Unfusible:
+                return None
+            db._compile_cache[key] = fused
+        ft0 = fused.first_trace_seconds or 0.0
+        out = _run_fused(fused, db, fsp)
+        if fsp.recording:
+            fsp.set(fused_stages=fused.fused_stages,
+                    fragments_elided=fused.fused_stages - 1,
+                    compile_cache=("miss" if fresh else "hit"))
+            # growth retraces on a cached plan count too: report THIS
+            # run's trace time, not the lifetime accumulation
+            ft = (fused.first_trace_seconds or 0.0) - ft0
+            if ft:
+                fsp.set(first_trace_seconds=round(ft, 6))
+    return out
+
+
 def _compiled_transform(plan: Transform, schema, db: Database):
     """Compile a Transform program (jit + device aux); split out so the
     executor walk stays free of trace-time constructs."""
@@ -345,8 +535,7 @@ def _compiled_transform(plan: Transform, schema, db: Database):
         plan.program, schema, db.dicts, db.key_spaces,
         dict_aliases=dict(plan.dict_aliases),
     )
-    return (jax.jit(cp.run),
-            {k: jnp.asarray(v) for k, v in cp.aux.items()})
+    return jax.jit(cp.run), device_aux(cp.aux)
 
 
 def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
