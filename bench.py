@@ -44,7 +44,14 @@ Q3 executed as ONE fused donated-buffer dispatch — ssa.plan_fuse — vs
 the per-node fragment walk at the short-query scale fusion targets,
 bit-identity asserted; reported as extra.fusion_* rows/s, speedup and
 per-query dispatch counts; YDB_TPU_BENCH_FUSION_SF sizes it,
-default 0.001). Engine-tier runs also
+default 0.001),
+YDB_TPU_BENCH_MESH=0 (skip the mesh scale-out tier: Q1/Q6 sharded
+scan scaling and Q3 repartition-join throughput through ONE
+shard_map'd whole-plan dispatch — parallel.mesh_fuse — vs the
+single-chip executor on the same data, bit-identity asserted;
+auto-skips under 2 visible devices; YDB_TPU_BENCH_MESH_SF sizes it,
+reported as extra.mesh_q{1,6,3}_{rows_per_sec,scaling}).
+Engine-tier runs also
 report per-stage scan seconds (engine_q{1,6}_stage_seconds:
 read/merge/stage/compute) from the streaming reader's StageTimer,
 warm-repeat p50/p99 latency from obs.counters histograms
@@ -381,6 +388,82 @@ def run_fusion_ab(extra: dict, iters: int) -> None:
          f"identical={r['identical']})")
 
 
+def run_mesh_tier(extra: dict, iters: int) -> None:
+    """Mesh scale-out tier: whole-plan SPMD execution over the device
+    mesh (parallel.mesh_fuse — one sharded donated-buffer dispatch with
+    all_to_all repartition for the joins) vs the single-chip executor on
+    the SAME data. Q1/Q6 measure sharded scan+aggregate scaling, Q3 the
+    repartition-join throughput; every mesh result is asserted
+    bit-identical to the single-chip side. Skips (recorded) when fewer
+    than 2 devices are visible; YDB_TPU_BENCH_MESH_SF sizes it."""
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        extra["mesh_tier_skipped"] = f"needs >=2 devices, have {n_dev}"
+        return
+
+    from ydb_tpu.engine.scan import ColumnSource
+    from ydb_tpu.parallel.mesh import make_mesh
+    from ydb_tpu.parallel.mesh_exec import MeshDatabase, MeshPlanExecutor
+    from ydb_tpu.plan import (
+        Database, TableScan, Transform, execute_plan, to_host,
+    )
+    from ydb_tpu.workload import tpch
+
+    sf = float(os.environ.get("YDB_TPU_BENCH_MESH_SF", "0.05"))
+    data = tpch.TpchData(sf=sf, seed=29)
+    single_db = Database(
+        sources={t: ColumnSource(cols, data.schema(t), data.dicts)
+                 for t, cols in data.tables.items()},
+        dicts=data.dicts)
+    mesh_db = MeshDatabase(
+        sources={
+            t: [ColumnSource({k: v[s::n_dev] for k, v in cols.items()},
+                             data.schema(t), data.dicts)
+                for s in range(n_dev)]
+            for t, cols in data.tables.items()
+        },
+        dicts=data.dicts)
+    mex = MeshPlanExecutor(mesh_db, make_mesh(n_dev))
+    n_rows = len(data.tables["lineitem"]["l_orderkey"])
+    extra["mesh_devices"] = n_dev
+    extra["mesh_sf"] = sf
+    extra["mesh_rows"] = n_rows
+
+    plans = {
+        "q1": Transform(TableScan("lineitem"), tpch.q1_program()),
+        "q6": Transform(TableScan("lineitem"), tpch.q6_program()),
+        "q3": tpch.q3_plan(),
+    }
+    for name, plan in plans.items():
+        def run_mesh(plan=plan):
+            out = mex.execute_fused(plan)
+            assert out is not None, f"mesh path declined {name}"
+            return out
+
+        def run_single(plan=plan):
+            return to_host(execute_plan(plan, single_db, use_dq=False))
+
+        _, mwarm, mres = timed_cold_warm(run_mesh, iters)
+        _, swarm, sres = timed_cold_warm(run_single, iters)
+        assert mres.num_rows == sres.num_rows, name
+        for col in mres.cols:
+            np.testing.assert_array_equal(
+                np.asarray(mres.cols[col][0]),
+                np.asarray(sres.cols[col][0]),
+                err_msg=f"mesh/single mismatch: {name}.{col}")
+        extra[f"mesh_{name}_rows_per_sec"] = round(n_rows / mwarm)
+        extra[f"single_{name}_rows_per_sec"] = round(n_rows / swarm)
+        # > 1 means the sharded dispatch beats one chip end-to-end at
+        # this scale; the per-device row count is what actually shrinks
+        extra[f"mesh_{name}_scaling"] = round(swarm / mwarm, 2)
+        extra[f"mesh_{name}_identical"] = True
+    _log(f"mesh tier: {n_dev} devices, q1 x"
+         f"{extra['mesh_q1_scaling']} q6 x{extra['mesh_q6_scaling']} "
+         f"q3 x{extra['mesh_q3_scaling']} vs single chip")
+
+
 def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
     """Out-of-core engine-tier run at a LARGE scale factor (SURVEY
     §7.2 item 7): lineitem generates in bounded chunks (the full table
@@ -647,6 +730,19 @@ def main():
             _checkpoint("fusion", extra)
         else:
             skipped.append("fusion_tier:budget")
+
+    # mesh scale-out tier: sharded whole-plan dispatch vs single chip
+    # (YDB_TPU_BENCH_MESH=0 skips; auto-skips under 2 devices)
+    if os.environ.get("YDB_TPU_BENCH_MESH", "1") not in ("0", "", "off"):
+        if _budget_left(budget) > 90:
+            _log("mesh tier: sharded fused plans")
+            try:
+                run_mesh_tier(extra, max(2, iters // 2))
+            except Exception as e:  # noqa: BLE001 - additive evidence
+                extra["mesh_tier_error"] = repr(e)[-300:]
+            _checkpoint("mesh", extra)
+        else:
+            skipped.append("mesh_tier:budget")
 
     engine_warm_rps = extra["kernel_q1_warm_rows_per_sec"]
     db_iters = min(iters, 2)  # storage tiers stream the table per run

@@ -77,6 +77,7 @@ def _match(mesh_res, ref_res, int_cols, float_cols=()):
             rtol=1e-9, err_msg=c)
 
 
+@pytest.mark.slow  # per-stage 8-dev traces dominate single-core CI
 def test_q3_mesh_join_matches_single_chip(data, catalog, mesh_db,
                                           single_db):
     plan = plan_select_full(parse(TPCH["q3"]), catalog).plan
@@ -88,6 +89,7 @@ def test_q3_mesh_join_matches_single_chip(data, catalog, mesh_db,
                       "o_shippriority"))
 
 
+@pytest.mark.slow  # per-stage 8-dev traces dominate single-core CI
 def test_q5_mesh_join_matches_single_chip(data, catalog, mesh_db,
                                           single_db):
     plan = plan_select_full(parse(TPCH["q5"]), catalog).plan

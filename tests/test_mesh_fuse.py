@@ -1,0 +1,331 @@
+"""Sharded whole-plan fusion (parallel.mesh_fuse): one shard_map'd
+donated-buffer dispatch per plan on the virtual 8-device CPU mesh, with
+results asserted BIT-identical to the single-chip executor — scans
+(Q1), repartition joins (Q3/Q5), NULL join keys, the degenerate
+1-device mesh, stats-sized shuffle buckets with the overflow->grow
+protocol, and the SQL front door through Cluster.enable_mesh."""
+
+import numpy as np
+import pytest
+
+from ydb_tpu import dtypes
+from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.engine.scan import ColumnSource
+from ydb_tpu.parallel import mesh_fuse, shuffle
+from ydb_tpu.parallel.mesh import make_mesh
+from ydb_tpu.parallel.mesh_exec import MeshDatabase, MeshPlanExecutor
+from ydb_tpu.plan import (
+    Database,
+    LookupJoin,
+    TableScan,
+    Transform,
+    execute_plan,
+    to_host,
+)
+from ydb_tpu.sql.parser import parse
+from ydb_tpu.sql.planner import Catalog, plan_select_full
+from ydb_tpu.ssa import Agg, AggSpec, GroupByStep, Program, SortStep
+from ydb_tpu.workload import tpch
+from ydb_tpu.workload.queries import TPCH
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch.TpchData(sf=0.005, seed=31)
+
+
+@pytest.fixture(scope="module")
+def catalog(data):
+    return Catalog(
+        schemas={t: data.schema(t) for t in data.tables},
+        primary_keys=dict(tpch.PRIMARY_KEYS),
+        dicts=data.dicts,
+    )
+
+
+def _mesh_db(data, n_dev=N_DEV):
+    return MeshDatabase(
+        sources={
+            t: [ColumnSource({k: v[s::n_dev] for k, v in cols.items()},
+                             data.schema(t), data.dicts)
+                for s in range(n_dev)]
+            for t, cols in data.tables.items()
+        },
+        dicts=data.dicts,
+    )
+
+
+@pytest.fixture(scope="module")
+def single_db(data):
+    return Database(
+        sources={t: ColumnSource(cols, data.schema(t), data.dicts)
+                 for t, cols in data.tables.items()},
+        dicts=data.dicts,
+    )
+
+
+def _identical(got, ref):
+    """Every column bit-identical — values AND validity, floats
+    included (the fused lowering must reproduce the single-chip result
+    exactly, not approximately)."""
+    assert got.num_rows == ref.num_rows
+    assert set(got.cols) == set(ref.cols)
+    for c in got.cols:
+        np.testing.assert_array_equal(
+            np.asarray(got.cols[c][0]), np.asarray(ref.cols[c][0]),
+            err_msg=c)
+        np.testing.assert_array_equal(
+            np.asarray(got.cols[c][1]), np.asarray(ref.cols[c][1]),
+            err_msg=f"{c}:validity")
+
+
+def _fused_plans(ex):
+    return [v for v in ex._jit_cache.values()
+            if isinstance(v, mesh_fuse.MeshFusedPlan)]
+
+
+def test_q1_scan_aggregate_fused_bit_identical(data, single_db):
+    plan = Transform(TableScan("lineitem"), tpch.q1_program())
+    ex = MeshPlanExecutor(_mesh_db(data), make_mesh(N_DEV))
+    res = ex.execute_fused(plan)
+    assert res is not None, "q1 did not mesh-fuse"
+    ref = to_host(execute_plan(plan, single_db, use_dq=False))
+    _identical(res, ref)
+    # second statement hits the compiled-plan cache, same bits out
+    assert len(_fused_plans(ex)) == 1
+    _identical(ex.execute_fused(plan), ref)
+    assert len(_fused_plans(ex)) == 1
+
+
+@pytest.mark.slow  # full q3 mesh build; join fusion is covered tier-1 by
+# the NULL-key LookupJoin cases and the SQL-session test below
+def test_q3_join_fused_bit_identical(data, catalog, single_db):
+    plan = plan_select_full(parse(TPCH["q3"]), catalog).plan
+    ex = MeshPlanExecutor(_mesh_db(data), make_mesh(N_DEV))
+    res = ex.execute_fused(plan)
+    assert res is not None, "q3 did not mesh-fuse"
+    _identical(res, to_host(execute_plan(plan, single_db, use_dq=False)))
+    # the equi-joins repartitioned through stats-sized buckets
+    (fused,) = _fused_plans(ex)
+    assert fused.shuffle_capacity() > 0
+    assert "shuffle" in fused.cap_kinds
+
+
+@pytest.mark.slow  # deepest join chain = the longest 8-dev CPU trace
+def test_q5_multi_join_fused_bit_identical(data, catalog, single_db):
+    plan = plan_select_full(parse(TPCH["q5"]), catalog).plan
+    ex = MeshPlanExecutor(_mesh_db(data), make_mesh(N_DEV))
+    res = ex.execute_fused(plan)
+    assert res is not None, "q5 did not mesh-fuse"
+    _identical(res, to_host(execute_plan(plan, single_db, use_dq=False)))
+
+
+def _null_key_case(rows=512, null_every=5):
+    """Probe table with NULL join keys (canonical zeroed slots, as the
+    kernels emit) against a unique-key build side."""
+    rng = np.random.default_rng(17)
+    lsch = dtypes.schema(("k", dtypes.INT64), ("g", dtypes.INT64),
+                         ("v", dtypes.INT64))
+    rsch = dtypes.schema(("rk", dtypes.INT64), ("w", dtypes.INT64))
+    k = rng.integers(0, 32, rows)
+    kv = np.ones(rows, dtype=bool)
+    kv[::null_every] = False
+    k[~kv] = 0  # canonical NULL slot
+    lcols = {"k": k, "g": rng.integers(0, 3, rows),
+             "v": rng.integers(0, 100, rows)}
+    lval = {"k": kv, "g": np.ones(rows, dtype=bool),
+            "v": np.ones(rows, dtype=bool)}
+    rcols = {"rk": np.arange(0, 32, 2), "w": np.arange(0, 32, 2) * 10}
+    return lsch, rsch, lcols, lval, rcols
+
+
+def _null_key_dbs(n_dev=N_DEV):
+    lsch, rsch, lcols, lval, rcols = _null_key_case()
+    dicts = DictionarySet()
+    single = Database(
+        sources={"L": ColumnSource(lcols, lsch, dicts, validity=lval),
+                 "R": ColumnSource(rcols, rsch, dicts)},
+        dicts=dicts)
+    mesh = MeshDatabase(
+        sources={
+            "L": [ColumnSource(
+                {k: v[s::n_dev] for k, v in lcols.items()}, lsch, dicts,
+                validity={k: v[s::n_dev] for k, v in lval.items()})
+                for s in range(n_dev)],
+            "R": [ColumnSource(
+                {k: v[s::n_dev] for k, v in rcols.items()}, rsch, dicts)
+                for s in range(n_dev)],
+        },
+        dicts=dicts)
+    return single, mesh
+
+
+@pytest.mark.parametrize("kind", [
+    "inner", "left",
+    pytest.param("semi", marks=pytest.mark.slow),
+    pytest.param("anti", marks=pytest.mark.slow),
+])
+def test_null_join_keys_fused_bit_identical(kind):
+    """NULL probe keys never match (inner/semi drop, left pads, anti
+    keeps) — the sharded repartition join must agree with the
+    single-chip kernels bit-for-bit."""
+    single, mesh_db = _null_key_dbs()
+    payload = ("w",) if kind in ("inner", "left") else ()
+    aggs = (AggSpec(Agg.SUM, "v", "sv"),
+            AggSpec(Agg.COUNT_ALL, None, "n"))
+    if payload:
+        aggs += (AggSpec(Agg.SUM, "w", "sw"),
+                 AggSpec(Agg.COUNT, "w", "nw"))
+    plan = Transform(
+        LookupJoin(probe=TableScan("L"), build=TableScan("R"),
+                   probe_keys=("k",), build_keys=("rk",),
+                   payload=payload, kind=kind),
+        Program((GroupByStep(keys=("g",), aggs=aggs),
+                 SortStep(keys=("g",)))))
+    ex = MeshPlanExecutor(mesh_db, make_mesh(N_DEV))
+    res = ex.execute_fused(plan)
+    assert res is not None, f"{kind} join did not mesh-fuse"
+    _identical(res, to_host(execute_plan(plan, single, use_dq=False)))
+
+
+def test_degenerate_single_device_mesh(data, single_db):
+    """A 1-device mesh is the single-chip lowering verbatim: no
+    collectives, same bits."""
+    plan = Transform(TableScan("lineitem"), tpch.q1_program())
+    db1 = MeshDatabase(
+        sources={t: [ColumnSource(cols, data.schema(t), data.dicts)]
+                 for t, cols in data.tables.items()},
+        dicts=data.dicts)
+    ex = MeshPlanExecutor(db1, make_mesh(1))
+    res = ex.execute_fused(plan)
+    assert res is not None
+    _identical(res, to_host(execute_plan(plan, single_db, use_dq=False)))
+
+
+def test_skew_overflow_grows_and_stays_identical():
+    """100% key skew: every probe row routes to ONE destination, so the
+    stats-sized bucket (no stats -> mean x margin) must overflow; the
+    host grows it to the observed worst count, re-stages (donation
+    consumed the inputs) and the final result is still bit-identical."""
+    rows = 2048 * N_DEV
+    lsch = dtypes.schema(("k", dtypes.INT64), ("v", dtypes.INT64))
+    rsch = dtypes.schema(("rk", dtypes.INT64), ("w", dtypes.INT64))
+    lcols = {"k": np.full(rows, 7, dtype=np.int64),
+             "v": np.arange(rows, dtype=np.int64)}
+    rcols = {"rk": np.array([7], dtype=np.int64),
+             "w": np.array([100], dtype=np.int64)}
+    dicts = DictionarySet()
+    single = Database(
+        sources={"L": ColumnSource(lcols, lsch, dicts),
+                 "R": ColumnSource(rcols, rsch, dicts)},
+        dicts=dicts)
+    mesh_db = MeshDatabase(
+        sources={
+            "L": [ColumnSource(
+                {k: v[s::N_DEV] for k, v in lcols.items()}, lsch, dicts)
+                for s in range(N_DEV)],
+            "R": [ColumnSource(
+                {k: v[s::N_DEV] for k, v in rcols.items()}, rsch, dicts)
+                for s in range(N_DEV)],
+        },
+        dicts=dicts)
+    plan = Transform(
+        LookupJoin(probe=TableScan("L"), build=TableScan("R"),
+                   probe_keys=("k",), build_keys=("rk",),
+                   payload=("w",), kind="inner"),
+        Program((GroupByStep(keys=("k",), aggs=(
+            AggSpec(Agg.SUM, "v", "sv"),
+            AggSpec(Agg.SUM, "w", "sw"),
+            AggSpec(Agg.COUNT_ALL, None, "n"))),)))
+    ex = MeshPlanExecutor(mesh_db, make_mesh(N_DEV))
+    res = ex.execute_fused(plan)
+    assert res is not None
+    (fused,) = _fused_plans(ex)
+    assert fused.shuffle_grows >= 1, "skew never tripped the grow path"
+    _identical(res, to_host(execute_plan(plan, single, use_dq=False)))
+    # the grown capacity is cached: a re-run must not grow again
+    grows = fused.shuffle_grows
+    _identical(ex.execute_fused(plan),
+               to_host(execute_plan(plan, single, use_dq=False)))
+    assert fused.shuffle_grows == grows
+
+
+@pytest.mark.slow  # two full q3 mesh builds; the sizing gate itself is
+# covered tier-1 by tests/test_shuffle.py::test_size_buckets_uniform_and_gates
+def test_shuffle_stats_gate_full_capacity_when_off(data, catalog,
+                                                   single_db):
+    """YDB_TPU_SHUFFLE_STATS=0 (via the in-process force) restores
+    full-capacity buckets; results match either way."""
+    plan = plan_select_full(parse(TPCH["q3"]), catalog).plan
+    ref = to_host(execute_plan(plan, single_db, use_dq=False))
+    caps = {}
+    old = shuffle.SHUFFLE_STATS_FORCE
+    for force in (False, True):
+        shuffle.SHUFFLE_STATS_FORCE = force
+        try:
+            ex = MeshPlanExecutor(_mesh_db(data), make_mesh(N_DEV))
+            _identical(ex.execute_fused(plan), ref)
+            (fused,) = _fused_plans(ex)
+            caps[force] = fused.shuffle_capacity()
+        finally:
+            shuffle.SHUFFLE_STATS_FORCE = old
+    # stats sizing must actually shrink the exchange on this shape
+    assert caps[True] < caps[False], caps
+
+
+def test_mesh_fuse_gate_falls_back_to_walk(data):
+    """YDB_TPU_MESH_FUSE=0 (via the force) disables the fused path so
+    the executor answers through the per-node walk (whose bit-identity
+    test_mesh_exec already asserts)."""
+    plan = Transform(TableScan("lineitem"), tpch.q1_program())
+    ex = MeshPlanExecutor(_mesh_db(data), make_mesh(N_DEV))
+    old = mesh_fuse.MESH_FUSE_FORCE
+    mesh_fuse.MESH_FUSE_FORCE = False
+    try:
+        assert ex.execute_fused(plan) is None
+    finally:
+        mesh_fuse.MESH_FUSE_FORCE = old
+
+
+def test_mesh_fused_from_sql_session(monkeypatch):
+    """Cluster.enable_mesh routes SQL statements through the sharded
+    fused dispatch (execute_fused returns a result, not a fallback) and
+    the rows match the pre-mesh reference."""
+    from ydb_tpu.kqp.session import Cluster
+    from ydb_tpu.parallel import mesh_exec as mex_mod
+
+    c = Cluster()
+    s = c.session()
+    s.execute("CREATE TABLE fusers (id int64, grp int64, "
+              "PRIMARY KEY (id)) WITH (shards = 3)")
+    s.execute("CREATE TABLE forders (oid int64, uid int64, amount int64,"
+              " PRIMARY KEY (oid)) WITH (shards = 5)")
+    for i in range(0, 120, 30):
+        s.execute("INSERT INTO fusers VALUES " + ", ".join(
+            f"({j}, {j % 4})" for j in range(i, i + 30)))
+    for i in range(0, 600, 100):
+        s.execute("INSERT INTO forders VALUES " + ", ".join(
+            f"({j}, {j % 120}, {j % 13})" for j in range(i, i + 100)))
+    q = ("SELECT u.grp AS g, SUM(o.amount) AS total, COUNT(*) AS n "
+         "FROM forders o JOIN fusers u ON o.uid = u.id "
+         "GROUP BY u.grp ORDER BY g")
+    ref = s.execute(q)
+    c.enable_mesh()
+    calls = []
+    orig = mex_mod.MeshPlanExecutor.execute_fused
+
+    def spy(self, plan):
+        r = orig(self, plan)
+        calls.append(r)
+        return r
+
+    monkeypatch.setattr(mex_mod.MeshPlanExecutor, "execute_fused", spy)
+    res = s.execute(q)
+    assert calls and calls[-1] is not None, (
+        "session statement fell back off the fused mesh path")
+    for col in ("g", "total", "n"):
+        np.testing.assert_array_equal(
+            np.asarray(res.cols[col][0]), np.asarray(ref.cols[col][0]),
+            err_msg=col)

@@ -127,6 +127,14 @@ class ResidentStore:
         self._miss_heat: dict = {}
         self._inflight: set = set()
         self._pending: list = []  # conveyor TaskHandles (drain support)
+        # mesh device slice (set_device_slice): when the cluster mesh is
+        # on, each shard's store binds to ONE mesh device — promotions
+        # place arrays there and the budget narrows to the device share,
+        # so mesh scans read columns already resident on the device that
+        # computes them (no cross-device pull at dispatch)
+        self._slice_slot: "int | None" = None
+        self._slice_device = None
+        self._slice_budget: "int | None" = None
         self._nbytes = 0
         self._tick = 0
         # counters (the sys_resident_store / viewer surface)
@@ -152,11 +160,38 @@ class ResidentStore:
                 return int(env)
             except ValueError:
                 return 0
+        if self._slice_budget is not None:
+            return self._slice_budget
+        return self._base_budget()
+
+    def _base_budget(self) -> int:
+        """Budget ignoring any mesh device slice: what this store may
+        hold when it owns its device alone (assign_device_slices divides
+        this across the shards sharing one mesh device)."""
         if self._budget is not None:
             return self._budget
         if _gate() is True:
             return AUTO_BYTES
         return default_budget()
+
+    # ---- mesh device slices ----
+
+    def set_device_slice(self, slot: int, device, budget: int) -> None:
+        """Bind this store to one mesh device: promotions land on
+        ``device`` and the budget narrows to the per-device share (the
+        ledger evicts down immediately — a store that grew under the
+        full budget must not keep over-occupying its device)."""
+        with self._lock:
+            self._slice_slot = slot
+            self._slice_device = device
+            self._slice_budget = int(budget)
+            self._evict_to_budget_locked(self._slice_budget)
+
+    def clear_device_slice(self) -> None:
+        with self._lock:
+            self._slice_slot = None
+            self._slice_device = None
+            self._slice_budget = None
 
     def enabled(self) -> bool:
         g = _gate()
@@ -222,6 +257,7 @@ class ResidentStore:
         import jax.numpy as jnp
 
         budget = self.budget()
+        dev = self._slice_device
         entries = {}
         total = 0
         valid = valid or {}
@@ -229,7 +265,15 @@ class ResidentStore:
             v = valid.get(n)
             if v is None:
                 v = np.ones(len(a), dtype=np.bool_)
-            e = _Entry(jnp.asarray(a), jnp.asarray(v, dtype=jnp.bool_))
+            if dev is not None:
+                import jax
+
+                e = _Entry(jax.device_put(np.asarray(a), dev),
+                           jax.device_put(
+                               np.asarray(v, dtype=np.bool_), dev))
+            else:
+                e = _Entry(jnp.asarray(a),
+                           jnp.asarray(v, dtype=jnp.bool_))
             entries[n] = e
             total += e.nbytes
         if total > budget:
@@ -406,7 +450,34 @@ class ResidentStore:
                 "invalidations": self.invalidations,
                 "errors": self.errors,
                 "inflight": len(self._inflight),
+                "device_slot": self._slice_slot,
             }
+
+
+def assign_device_slices(stores, n_devices: int, devices=None,
+                         per_device_budget: "int | None" = None) -> None:
+    """Bind a table's per-shard ResidentStores onto mesh devices.
+
+    Stores group round-robin (``stores[d::n_devices]``) — the SAME
+    grouping mesh_exec.device_partitions uses for scan sources, so a
+    shard's resident columns live exactly where its rows are scanned.
+    Shards sharing one device split the device budget evenly; the base
+    is ``per_device_budget`` when given, else each store's own un-sliced
+    budget standing in for the device's HBM share."""
+    for d in range(n_devices):
+        group = stores[d::n_devices]
+        if not group:
+            continue
+        dev = devices[d] if devices is not None else None
+        for st in group:
+            base = (per_device_budget if per_device_budget is not None
+                    else st._base_budget())
+            st.set_device_slice(d, dev, max(base // len(group), 0))
+
+
+def clear_device_slices(stores) -> None:
+    for st in stores:
+        st.clear_device_slice()
 
 
 # ---------------- scan-side block assembly ----------------

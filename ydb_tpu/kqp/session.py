@@ -284,6 +284,14 @@ class Cluster:
         for t in self.tables.values():
             if hasattr(t, "sweep_stale_generations"):
                 t.sweep_stale_generations()
+        # mesh-by-default: YDB_TPU_MESH=1 routes eligible SELECTs SPMD
+        # over the device mesh from boot (the same executor enable_mesh
+        # installs). Last in __init__ — enable_mesh invalidates the plan
+        # cache, which must exist by now.
+        import os as _os
+
+        if _os.environ.get("YDB_TPU_MESH", "0") not in ("0", "", "off"):
+            self.enable_mesh()
 
     def _invalidate_plans(self) -> None:
         """Drop cached plans AND compiled executors together: both bake
@@ -921,10 +929,36 @@ class Cluster:
 
         self._mesh_exec = MeshPlanExecutor(
             MeshDatabase({}, dicts=self.dicts), mesh)
+        # per-device resident slices: each columnshard's HBM tier binds
+        # to the mesh device that scans it, so mesh dispatches read
+        # device-resident columns without a cross-device pull
+        self._assign_resident_slices()
         self._invalidate_plans()
 
     def disable_mesh(self) -> None:
+        if self._mesh_exec is not None:
+            from ydb_tpu.engine import resident as resident_mod
+
+            for t in self.tables.values():
+                stores = [s.resident for s in getattr(t, "shards", ())
+                          if getattr(s, "resident", None) is not None]
+                resident_mod.clear_device_slices(stores)
         self._mesh_exec = None
+
+    def _assign_resident_slices(self) -> None:
+        """Round-robin each table's shard ResidentStores onto the mesh
+        devices — the SAME grouping device_partitions applies to scan
+        sources, so resident columns live where their rows compute."""
+        from ydb_tpu.engine import resident as resident_mod
+
+        mex = self._mesh_exec
+        devices = [d[0] for d in mex.mesh.devices]  # (shard, pipe) grid
+        for t in self.tables.values():
+            stores = [s.resident for s in getattr(t, "shards", ())
+                      if getattr(s, "resident", None) is not None]
+            if stores:
+                resident_mod.assign_device_slices(stores, mex.n,
+                                                  devices=devices)
 
     def _mesh_snapshot(self, snap: int):
         """A PER-SNAPSHOT MeshPlanExecutor: fresh source bindings (so
@@ -939,6 +973,9 @@ class Cluster:
 
         base = self._mesh_exec
         cluster = self
+        # tables created since enable_mesh get their resident slices
+        # here (idempotent re-binding for the rest)
+        self._assign_resident_slices()
 
         class _Lazy(dict):
             def __missing__(self, key):
@@ -963,8 +1000,12 @@ class Cluster:
                 return (dict.__contains__(self, key)
                         or key in cluster.tables)
 
-        ex = MeshPlanExecutor(MeshDatabase(_Lazy(), dicts=self.dicts),
-                              base.mesh)
+        ex = MeshPlanExecutor(
+            MeshDatabase(_Lazy(), dicts=self.dicts,
+                         # aggregator stats size the stats-sized shuffle
+                         # buckets (count-min heavy-hitter bound)
+                         table_stats=self.stats.all_stats()),
+            base.mesh)
         ex._jit_cache = base._jit_cache
         return ex
 

@@ -19,10 +19,18 @@
   * fusion (``--fusion``) — warm TPC-H Q3 (joins + grouped top-k)
     executed as ONE whole-plan fused dispatch (ssa.plan_fuse) vs the
     per-node fragment walk, bit-identity asserted, with per-query
-    dispatch counts.
+    dispatch counts;
+  * shuffle (``--shuffle``) — all_to_all repartition on a virtual
+    8-device mesh with stats-sized send buckets (count-min heavy-hitter
+    bound, parallel.shuffle.size_buckets) vs always-sufficient
+    full-capacity buckets: rows/s, analytic bytes exchanged, the
+    >=4x capacity reduction on uniform keys, and a 100%-skew
+    overflow -> grow -> lossless re-exchange round, row multisets
+    asserted equal throughout.
 
 Flags: ``--rows`` ``--groups`` ``--aggs`` ``--iters`` ``--block-rows``
-``--pruning`` ``--profile-overhead`` ``--fusion`` ``--sf`` (scale
+``--pruning`` ``--profile-overhead`` ``--fusion`` ``--shuffle``
+``--shuffle-rows`` ``--sf`` (scale
 factor for the overhead/fusion benches) ``--json`` (report on stdout) and
 ``--smoke`` (tiny sizes, correctness-only; wired into tier-1 as a
 non-slow test). Run under JAX_PLATFORMS=cpu for a stable reference; on
@@ -451,6 +459,166 @@ def bench_fusion(sf: float, iters: int) -> dict:
     return out
 
 
+def bench_shuffle(rows_per_dev: int, iters: int,
+                  with_skew: bool = True) -> dict:
+    """Stats-sized vs full-capacity shuffle A/B on a virtual mesh.
+
+    Uniform random keys repartitioned over the ``shard`` axis with the
+    send bucket sized two ways: full local capacity (always sufficient,
+    ships ndev x capacity rows) vs ``shuffle.size_buckets`` (mean load x
+    safety margin + the count-min heavy-hitter bound from a real sketch
+    over the keys). Row multisets asserted equal between the sides and
+    key colocation checked; on a uniform distribution the stats bucket
+    must be >=4x smaller. A 100%-skew case (every key identical, no
+    stats) then exercises the overflow protocol: the undersized exchange
+    reports its worst per-destination count, the bucket grows to that
+    shape class, and the re-exchange is asserted lossless."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ydb_tpu import dtypes
+    from ydb_tpu.blocks.block import TableBlock
+    from ydb_tpu.parallel import shuffle
+    from ydb_tpu.parallel.dist import _local, _relocal, stack_blocks
+    from ydb_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_map
+    from ydb_tpu.ssa.plan_fuse import shape_class
+    from ydb_tpu.stats.sketch import CountMinSketch
+
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        # bucket sizing is meaningful relative to the fan-out; under 8
+        # destinations the mean-load bucket cannot hit the 4x target
+        return {"skipped": f"needs >=8 devices, have {n_dev}"}
+    n_dev = 8
+    mesh = make_mesh(n_dev)
+    sch = dtypes.schema(("k", dtypes.INT64), ("v", dtypes.INT64))
+    bytes_per_row = sum(
+        np.dtype(f.type.physical).itemsize + 1 for f in sch.fields)
+
+    def stage(key_arrays):
+        blocks = [
+            TableBlock.from_numpy(
+                {"k": key_arrays[d],
+                 "v": np.arange(len(key_arrays[d]), dtype=np.int64)
+                 + d * rows_per_dev},
+                sch, capacity=rows_per_dev)
+            for d in range(n_dev)
+        ]
+        return jax.device_put(
+            stack_blocks(blocks), NamedSharding(mesh, P(SHARD_AXIS)))
+
+    def exchange(B):
+        def go(st):
+            blk, worst = shuffle.repartition(
+                _local(st), ["k"], n_dev, bucket_rows=B, with_counts=True)
+            return _relocal(blk), worst
+        return jax.jit(shard_map(
+            go, mesh=mesh, in_specs=P(SHARD_AXIS),
+            out_specs=(P(SHARD_AXIS), P()), check_vma=False))
+
+    def collect(out):
+        lens = np.asarray(out.length)
+        ks = np.asarray(out.columns["k"].data)
+        vs = np.asarray(out.columns["v"].data)
+        rows, per_dev = [], []
+        for d in range(n_dev):
+            k, v = ks[d][: lens[d]], vs[d][: lens[d]]
+            rows.extend(zip(k.tolist(), v.tolist()))
+            per_dev.append(set(k.tolist()))
+        return rows, per_dev
+
+    rng = np.random.default_rng(11)
+    uniform = [rng.integers(0, 1 << 30, rows_per_dev).astype(np.int64)
+               for _ in range(n_dev)]
+    want = sorted(
+        (int(k), int(d * rows_per_dev + i))
+        for d in range(n_dev) for i, k in enumerate(uniform[d]))
+
+    sk = CountMinSketch()
+    for arr in uniform:
+        sk.add_many(arr)
+    old = shuffle.SHUFFLE_STATS_FORCE
+    shuffle.SHUFFLE_STATS_FORCE = True
+    try:
+        stats_B = shuffle.size_buckets(
+            rows_per_dev, n_dev, heavy=sk.max_freq())
+    finally:
+        shuffle.SHUFFLE_STATS_FORCE = old
+    full_B = rows_per_dev
+
+    total = n_dev * rows_per_dev
+    out: dict = {
+        "rows": total, "devices": n_dev,
+        "full_bucket_rows": full_B, "stats_bucket_rows": stats_B,
+        "heavy_bound": sk.max_freq(),
+        "capacity_ratio": round(full_B / stats_B, 2),
+        # every device sends ndev buckets of B rows each exchange
+        "full_bytes_exchanged": n_dev * n_dev * full_B * bytes_per_row,
+        "stats_bytes_exchanged": n_dev * n_dev * stats_B * bytes_per_row,
+    }
+    assert out["capacity_ratio"] >= 4, (
+        f"uniform keys sized {stats_B} vs full {full_B}: "
+        f"ratio {out['capacity_ratio']} < 4")
+
+    best = {}
+    results = {}
+    for label, B in (("stats", stats_B), ("full", full_B)):
+        fn = exchange(B)
+        st = stage(uniform)
+        blk, worst = jax.block_until_ready(fn(st))
+        assert int(np.asarray(worst)) <= B, (
+            f"{label} bucket {B} overflowed on uniform keys")
+        results[label] = blk
+        best[label] = float("inf")
+        for _ in range(max(1, iters)):
+            st = stage(uniform)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(st))
+            best[label] = min(best[label], time.perf_counter() - t0)
+        out[f"{label}_rows_per_sec"] = round(total / best[label])
+    out["shuffle_speedup"] = round(best["full"] / best["stats"], 2)
+
+    for label, blk in results.items():
+        rows, per_dev = collect(blk)
+        assert sorted(rows) == want, f"{label} exchange lost rows"
+        for i in range(n_dev):
+            for j in range(i + 1, n_dev):
+                assert not (per_dev[i] & per_dev[j]), (
+                    f"{label}: key on two shards")
+    out["identical"] = True
+
+    # 100% skew, no stats: every row routes to one destination, so the
+    # mean-sized bucket must overflow, report its worst count, grow to
+    # that shape class, and re-exchange losslessly
+    if not with_skew:  # smoke keeps tier-1 cheap; --shuffle runs it
+        return out
+    skew = [np.full(rows_per_dev, 42, dtype=np.int64)
+            for _ in range(n_dev)]
+    shuffle.SHUFFLE_STATS_FORCE = True
+    try:
+        B = shuffle.size_buckets(rows_per_dev, n_dev, heavy=0)
+    finally:
+        shuffle.SHUFFLE_STATS_FORCE = old
+    skew_out: dict = {"initial_bucket_rows": B, "grows": 0}
+    while True:
+        blk, worst = jax.block_until_ready(exchange(B)(stage(skew)))
+        w = int(np.asarray(worst))
+        if w <= B:
+            break
+        B = shape_class(w)
+        skew_out["grows"] += 1
+    skew_out["grown_bucket_rows"] = B
+    assert skew_out["grows"] >= 1, "skew case never overflowed"
+    rows, _ = collect(blk)
+    skew_want = sorted(
+        (42, int(d * rows_per_dev + i))
+        for d in range(n_dev) for i in range(rows_per_dev))
+    assert sorted(rows) == skew_want, "skew grow lost rows"
+    skew_out["identical"] = True
+    out["skew"] = skew_out
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m ydb_tpu.obs.kernelbench",
@@ -470,6 +638,10 @@ def main(argv=None) -> int:
                     help="profiling on-vs-off warm Q1 A/B micro-bench")
     ap.add_argument("--fusion", action="store_true",
                     help="whole-plan fused vs per-fragment warm Q3 A/B")
+    ap.add_argument("--shuffle", action="store_true",
+                    help="stats-sized vs full-capacity shuffle A/B")
+    ap.add_argument("--shuffle-rows", type=int, default=1 << 15,
+                    help="rows per device for --shuffle")
     ap.add_argument("--sf", type=float, default=0.05,
                     help="TPC-H scale factor for --profile-overhead"
                          " and --fusion")
@@ -484,6 +656,7 @@ def main(argv=None) -> int:
         args.block_rows = 2048
         args.chunk_rows = 256
         args.sf = 0.01
+        args.shuffle_rows = 8192
 
     import jax
 
@@ -507,6 +680,9 @@ def main(argv=None) -> int:
             assert_within=(0.5 if args.smoke else None))
     if args.fusion or args.smoke:
         report["fusion"] = bench_fusion(args.sf, max(3, args.iters))
+    if args.shuffle or args.smoke:
+        report["shuffle"] = bench_shuffle(
+            args.shuffle_rows, args.iters, with_skew=args.shuffle)
     if args.json:
         print(json.dumps(report))
     else:
@@ -551,6 +727,20 @@ def main(argv=None) -> int:
                   f"{fu['fused_dispatches']} dispatch vs "
                   f"{fu['fragment_dispatches']} fragments, "
                   f"identical={fu['identical']})")
+        if "shuffle" in report:
+            sh = report["shuffle"]
+            if "skipped" in sh:
+                print(f"shuffle: skipped ({sh['skipped']})")
+            else:
+                print(f"shuffle rows={sh['rows']} dev={sh['devices']}: "
+                      f"stats {sh['stats_rows_per_sec']:,} rows/s vs "
+                      f"full {sh['full_rows_per_sec']:,} rows/s "
+                      f"(x{sh['shuffle_speedup']}, bucket "
+                      f"{sh['stats_bucket_rows']} vs "
+                      f"{sh['full_bucket_rows']} = "
+                      f"x{sh['capacity_ratio']} capacity, "
+                      f"{sh.get('skew', {}).get('grows', 'n/a')} "
+                      f"skew grows, identical={sh['identical']})")
     return 0
 
 

@@ -30,7 +30,7 @@ from ydb_tpu.blocks.block import Column, TableBlock
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.oracle import OracleTable
 from ydb_tpu.engine.scan import ColumnSource, required_columns
-from ydb_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from ydb_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_map
 from ydb_tpu.ssa import twophase
 from ydb_tpu.ssa.compiler import compile_program
 from ydb_tpu.ssa.ops import Agg
@@ -182,6 +182,31 @@ def _neutral(dtype, maximum: bool):
     return jnp.array(info.max if maximum else info.min, dtype)
 
 
+def merge_spec(partial_prog: Program, partial_out_schema, dicts):
+    """(merge_kinds, rank_tables) for cross-shard partial-state merges:
+    per-column reduction kind from the partial program's group-by, plus
+    lexicographic rank tables for string MIN/MAX (dictionary ids do not
+    order like the strings they intern). Shared by MeshScan and the
+    fused mesh lowering (parallel/mesh_fuse)."""
+    merge_kinds: dict[str, Agg | str] = {}
+    rank_tables: dict[str, jax.Array] = {}
+    gb = partial_prog.group_by
+    if gb is not None:
+        for k in gb.keys:
+            merge_kinds[k] = "key"
+        for spec in gb.aggs:
+            merge_kinds[spec.out_name] = spec.func
+            if (
+                spec.func in (Agg.MIN, Agg.MAX)
+                and spec.column is not None
+                and partial_out_schema.field(spec.out_name).type.is_string
+            ):
+                rank_tables[spec.out_name] = jnp.asarray(
+                    dicts[spec.column].sort_rank()
+                )
+    return merge_kinds, rank_tables
+
+
 def _gather_rows(block: TableBlock) -> TableBlock:
     """all_gather compacted partial rows from every shard into one block."""
     cap = block.capacity
@@ -239,24 +264,8 @@ class MeshScan:
         layout = self.partial.group_layout[0]
         self._use_slots = layout in ("dense_slots", "keyless")
 
-        merge_kinds: dict[str, Agg | str] = {}
-        rank_tables: dict[str, jax.Array] = {}
-        gb = partial_prog.group_by
-        if gb is not None:
-            for k in gb.keys:
-                merge_kinds[k] = "key"
-            for spec in gb.aggs:
-                merge_kinds[spec.out_name] = spec.func
-                if (
-                    spec.func in (Agg.MIN, Agg.MAX)
-                    and spec.column is not None
-                    and self.partial.out_schema.field(
-                        spec.out_name
-                    ).type.is_string
-                ):
-                    rank_tables[spec.out_name] = jnp.asarray(
-                        dicts[spec.column].sort_rank()
-                    )
+        merge_kinds, rank_tables = merge_spec(
+            partial_prog, self.partial.out_schema, dicts)
         self._merge_kinds = merge_kinds
         self._rank_tables = rank_tables
 
@@ -294,7 +303,7 @@ class MeshScan:
             return merge_final(part)
 
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 step,
                 mesh=self.mesh,
                 in_specs=P(SHARD_AXIS),
@@ -305,7 +314,7 @@ class MeshScan:
         # merge+final over PRE-COMPUTED per-shard partial states (the
         # streaming driver computes states shard-locally block by block)
         self._merge_final_step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda st: merge_final(_local(st)),
                 mesh=self.mesh,
                 in_specs=P(SHARD_AXIS),

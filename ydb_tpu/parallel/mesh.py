@@ -28,6 +28,23 @@ SHARD_AXIS = "shard"
 PIPE_AXIS = "pipe"
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=...)``; older
+    releases only have ``jax.experimental.shard_map.shard_map`` whose
+    replication check is spelled ``check_rep``. Every engine call site
+    routes through this wrapper so the mesh runs on either."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
+
 def make_mesh(
     n_shards: int | None = None,
     n_pipe: int = 1,

@@ -42,16 +42,22 @@ from ydb_tpu.parallel.dist import (
     _relocal,
     stack_blocks,
 )
-from ydb_tpu.parallel.mesh import SHARD_AXIS, make_mesh
-from ydb_tpu.parallel.shuffle import repartition
+from ydb_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_map
+from ydb_tpu.parallel.shuffle import heavy_bound, repartition, size_buckets
 from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, Transform
 from ydb_tpu.ssa import join as join_kernels
 from ydb_tpu.ssa import kernels
+from ydb_tpu.ssa.plan_fuse import shape_class
 from ydb_tpu.ssa.program import SortStep, WindowStep
 
 
-def _round_up(n: int, q: int = 64) -> int:
-    return max(q, (n + q - 1) // q * q)
+def _round_up(n: int) -> int:
+    """Intermediate staging capacity: plan_fuse's shape classes (1024
+    quantum, quarter-of-power-of-two steps), replacing the walk's old
+    ad-hoc 64-row quantum so the per-node mesh walk and the fused mesh
+    path land on the SAME block capacities — one compile-cache entry per
+    class serves both executors instead of two near-identical traces."""
+    return shape_class(n)
 
 
 class MeshDatabase:
@@ -64,10 +70,14 @@ class MeshDatabase:
     """
 
     def __init__(self, sources: dict[str, list], dicts=None,
-                 key_spaces=None):
+                 key_spaces=None, table_stats=None):
         self.sources = sources
         self.dicts = dicts if dicts is not None else DictionarySet()
         self.key_spaces = key_spaces
+        # aggregator TableStats by name: sizes shuffle buckets (the
+        # count-min heavy-hitter bound); advisory — missing stats only
+        # cost a grow-retrace under skew, never correctness
+        self.table_stats = table_stats
 
 
 class _ChainSource:
@@ -135,6 +145,94 @@ class MeshPlanExecutor:
     def execute(self, plan) -> OracleTable:
         out = self._exec(plan, {}, root=True)
         return OracleTable.from_block(out)
+
+    # ---- whole-plan sharded fusion (parallel/mesh_fuse) ----
+
+    def execute_fused(self, plan) -> OracleTable | None:
+        """One sharded jitted dispatch for the whole plan, or None when
+        the plan does not mesh-fuse (the caller falls through to the
+        per-node walk above). Compiled MeshFusedPlans — and the negative
+        doesn't-fuse verdicts — cache per (plan fingerprint, shape-class
+        vector, mesh size) in the executor's jit cache."""
+        from ydb_tpu.obs import tracing
+        from ydb_tpu.parallel import mesh_fuse
+
+        if not mesh_fuse.mesh_fusion_enabled():
+            return None
+        sig = mesh_fuse.mesh_signature(plan, self.db, self.n)
+        if sig is None or not sig.sites:
+            return None
+        key = ("mesh_fuse", self.n, sig.cache_key(self.db))
+        fused = self._jit_cache.get(key)
+        if fused == "unfusible":
+            return None
+        fresh = fused is None
+        with tracing.span("plan.fuse") as fsp:
+            if fresh:
+                try:
+                    fused = mesh_fuse.build(sig, self.db, self.mesh,
+                                            stats=self.db.table_stats)
+                except (mesh_fuse.Unfusible, NotImplementedError):
+                    # negative verdicts cache too: plan_signature is
+                    # cheap but build walks every program
+                    self._jit_cache[key] = "unfusible"
+                    return None
+                self._jit_cache[key] = fused
+            ft0 = fused.first_trace_seconds or 0.0
+            grows0 = fused.shuffle_grows
+            inputs = self._stage_fused(fused)
+            while True:
+                out, totals = fused.run(inputs)
+                over = fused.overflowed(totals)
+                if not over:
+                    break
+                # a shuffle bucket or expand join outgrew its static
+                # capacity: widen to the observed size (the cached plan
+                # keeps it for later statements) and re-stage — donation
+                # consumed the inputs
+                for j in over:
+                    fused.grow(j, totals[j])
+                inputs = self._stage_fused(fused)
+            if fsp.recording:
+                fsp.set(fused_stages=fused.fused_stages,
+                        fragments_elided=fused.fused_stages - 1,
+                        compile_cache=("miss" if fresh else "hit"),
+                        mesh_devices=self.n,
+                        shuffle_capacity=fused.shuffle_capacity(),
+                        shuffle_grows=fused.shuffle_grows - grows0)
+                ft = (fused.first_trace_seconds or 0.0) - ft0
+                if ft:
+                    fsp.set(first_trace_seconds=round(ft, 6))
+        return OracleTable.from_block(out)
+
+    def _stage_fused(self, fused) -> dict:
+        """Stage every scan site as a mesh-sharded stacked block: each
+        device's partition streams, fits to the per-device shape-class
+        capacity (plan_fuse.fit_blocks — fresh buffers, safe to donate),
+        and the per-device blocks stack under NamedSharding(P(shard))."""
+        from ydb_tpu.ssa.plan_fuse import fit_blocks
+
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        inputs: dict = {}
+        for site in fused.sites:
+            subs = self.db.sources[site.table]
+            if len(subs) != self.n:
+                raise ValueError(
+                    f"table {site.table} has {len(subs)} shards for a"
+                    f" {self.n}-device mesh (need exactly one per device)")
+            devs = []
+            for sub in subs:
+                blocks = tuple(sub.blocks(1 << 22, site.read_cols))
+                if not blocks:
+                    # portion streams yield nothing for an empty shard
+                    blocks = (TableBlock.from_numpy(
+                        {f.name: np.empty(0, dtype=f.type.physical)
+                         for f in site.in_schema.fields},
+                        site.in_schema),)
+                devs.append(fit_blocks(blocks, site.capacity))
+            inputs[site.key] = jax.device_put(
+                stack_blocks(devs), sharding)
+        return inputs
 
     def _exec(self, plan, memo: dict, root: bool = False):
         hit = memo.get(id(plan))
@@ -207,29 +305,35 @@ class MeshPlanExecutor:
 
     def _repartition(self, stacked: TableBlock, keys: list[str]):
         cap = stacked.capacity
-        B = _round_up(2 * cap // self.n + 1)
+        # stats-sized first attempt (mean load × margin + the count-min
+        # heavy-hitter bound) instead of the old blind 2/n-of-capacity;
+        # overflow grows to the shape class of the OBSERVED worst count
+        # — one exact retry, not a doubling ladder
+        B = size_buckets(cap, self.n,
+                         heavy=heavy_bound(self.db.table_stats, keys))
         while True:
             key = ("repart", stacked.schema, tuple(keys), cap, B)
             step = self._jit_cache.get(key)
             if step is None:
                 n = self.n
 
-                def go(st):
-                    blk, over = repartition(
-                        _local(st), keys, n, bucket_rows=B,
-                        with_overflow=True)
-                    return _relocal(blk), over[None]
+                def go(st, _B=B):
+                    blk, worst = repartition(
+                        _local(st), keys, n, bucket_rows=_B,
+                        with_counts=True)
+                    return _relocal(blk), worst
 
-                step = jax.jit(jax.shard_map(
+                step = jax.jit(shard_map(
                     go, mesh=self.mesh, in_specs=P(SHARD_AXIS),
-                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                    out_specs=(P(SHARD_AXIS), P()),
                     check_vma=False,
                 ))
                 self._jit_cache[key] = step
-            out, over = step(stacked)
-            if not bool(np.any(np.asarray(over))):
+            out, worst = step(stacked)
+            w = int(np.asarray(worst))
+            if w <= B:
                 return self._tighten(out)
-            B *= 2  # grace respill: double the bucket and re-exchange
+            B = shape_class(w)  # grace respill, sized by the observation
 
     def _tighten(self, stacked: TableBlock) -> TableBlock:
         """Slice a front-packed stacked block down to a tight capacity so
@@ -261,7 +365,7 @@ class MeshPlanExecutor:
                     payload=plan.payload)
                 return _relocal(out)
 
-            step = jax.jit(jax.shard_map(
+            step = jax.jit(shard_map(
                 go, mesh=self.mesh,
                 in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
                 out_specs=P(SHARD_AXIS), check_vma=False,
@@ -287,7 +391,7 @@ class MeshPlanExecutor:
                         kind=plan.kind)
                     return _relocal(out), total[None]
 
-                step = jax.jit(jax.shard_map(
+                step = jax.jit(shard_map(
                     go, mesh=self.mesh,
                     in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
                     out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
@@ -328,7 +432,7 @@ class MeshPlanExecutor:
                 def go(st):
                     return _relocal(cp.run(_local(st), aux))
 
-                step = jax.jit(jax.shard_map(
+                step = jax.jit(shard_map(
                     go, mesh=self.mesh, in_specs=P(SHARD_AXIS),
                     out_specs=P(SHARD_AXIS), check_vma=False,
                 ))

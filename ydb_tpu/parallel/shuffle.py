@@ -7,21 +7,90 @@ shard and the buckets exchange via ``jax.lax.all_to_all`` over ICI — the
 same collective shape as MoE expert dispatch (SURVEY.md §2.11).
 
 XLA needs static shapes, so each device sends a fixed-capacity bucket to
-every peer (default: the full local capacity, which is always enough —
-worst case all local rows hash to one shard). Memory cost is
-ndev × bucket_rows per column; keep scan blocks modest and let the engine
-stream. After the exchange each device owns exactly the rows whose key
-hash maps to it — the precondition for partitioned (grace-style) joins and
-re-keyed aggregation.
+every peer. Full local capacity is always enough — worst case all local
+rows hash to one shard — but ships ndev × capacity rows per exchange;
+``size_buckets`` instead sizes the bucket from column statistics (mean
+destination load × safety margin + the count-min heaviest-hitter bound,
+rounded to a plan_fuse shape class so same-class re-runs never retrace).
+Undersized buckets cannot corrupt results: ``repartition`` returns the
+traced worst per-destination count, the host compares it against the
+static capacity and grows-and-retraces on overflow (the grace-join
+respill protocol with ICI as the spill fabric). ``YDB_TPU_SHUFFLE_STATS=0``
+restores full-capacity buckets. After the exchange each device owns
+exactly the rows whose key hash maps to it — the precondition for
+partitioned (grace-style) joins and re-keyed aggregation.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
 
 from ydb_tpu.blocks.block import Column, TableBlock
 from ydb_tpu.parallel.mesh import SHARD_AXIS
+
+#: in-process override for stats-sized buckets (bench A/B seam); None
+#: defers to the YDB_TPU_SHUFFLE_STATS environment gate
+SHUFFLE_STATS_FORCE: "bool | None" = None
+
+#: headroom over the mean per-destination load: absorbs ordinary hash
+#: imbalance without a grow-retrace; measured skew beyond it still
+#: corrects itself through the overflow protocol
+SAFETY_MARGIN = 1.5
+
+
+def shuffle_stats_enabled() -> bool:
+    if SHUFFLE_STATS_FORCE is not None:
+        return SHUFFLE_STATS_FORCE
+    return os.environ.get("YDB_TPU_SHUFFLE_STATS", "1") not in (
+        "0", "", "off")
+
+
+def size_buckets(local_rows: int, n_shards: int, heavy: int = 0,
+                 margin: float = SAFETY_MARGIN) -> int:
+    """Stats-sized per-destination send bucket for ``repartition``.
+
+    Uniform keys spread ``local_rows`` evenly over ``n_shards``
+    destinations, so the bucket holds mean × margin; a heavy hitter can
+    pile its whole frequency onto one destination, so the estimate adds
+    ``heavy`` (the table-wide count-min bound — every local occurrence
+    routes to the same shard in the worst case). Rounded UP to a
+    plan_fuse shape class (same-class re-runs reuse the compiled
+    exchange) and clamped to the always-sufficient full capacity.
+    Stats off (or a degenerate 1-shard mesh) keeps full capacity."""
+    from ydb_tpu.ssa.plan_fuse import shape_class
+
+    full = max(int(local_rows), 1)
+    if n_shards <= 1 or not shuffle_stats_enabled():
+        return full
+    mean = -(-full // n_shards)
+    est = int(mean * margin) + max(int(heavy), 0)
+    return min(full, shape_class(est))
+
+
+def heavy_bound(stats, keys) -> int:
+    """Heaviest joint-key frequency bound from aggregator statistics.
+
+    Each key column's bound is the max matching ``ColumnStats.heavy``
+    across tables (join keys may appear under the same name on both
+    sides; the max stays conservative). A composite key occurs at most
+    as often as its rarest component, so the joint bound is the min
+    over per-key bounds — any single known component already bounds the
+    pair. Unknown columns contribute nothing (0 = no bound)."""
+    if not stats:
+        return 0
+    per_key = []
+    for k in keys:
+        best = 0
+        for ts in stats.values():
+            cs = getattr(ts, "columns", {}).get(k)
+            if cs is not None:
+                best = max(best, int(getattr(cs, "heavy", 0)))
+        if best:
+            per_key.append(best)
+    return min(per_key) if per_key else 0
 
 # splitmix64-style avalanche constants
 _C1 = jnp.uint64(0xBF58476D1CE4E5B9)
@@ -47,16 +116,18 @@ def repartition(
     key_names: list[str],
     n_shards: int,
     bucket_rows: int | None = None,
-    with_overflow: bool = False,
+    with_counts: bool = False,
 ) -> "TableBlock | tuple[TableBlock, jax.Array]":
     """Exchange rows so each shard owns hash(keys) % n_shards == its index.
 
     Must run inside shard_map over the ``shard`` axis. Returns a local
-    block of capacity n_shards * bucket_rows. With ``with_overflow``,
-    returns (block, overflowed: bool scalar) — True when any send bucket
-    exceeded ``bucket_rows`` and rows were dropped; callers retry with a
-    bigger bucket (the grace-join respill protocol,
-    mkql_grace_join_imp.cpp bucket overflow)."""
+    block of capacity n_shards * bucket_rows. With ``with_counts``,
+    returns (block, worst: int32 scalar) — the mesh-wide max rows any
+    device wanted to send to one destination. worst > bucket_rows means
+    rows were dropped somewhere; callers re-exchange with bucket_rows
+    grown to hold ``worst`` exactly (the grace-join respill protocol,
+    mkql_grace_join_imp.cpp bucket overflow, sized by the observed count
+    instead of blind doubling)."""
     cap = block.capacity
     B = bucket_rows if bucket_rows is not None else cap
     live = block.row_mask()
@@ -113,9 +184,10 @@ def repartition(
     from ydb_tpu.ssa import kernels
 
     out = kernels.compact(big, mask)
-    if not with_overflow:
+    if not with_counts:
         return out
-    overflowed = jnp.any(counts[:n_shards] > B)
+    worst = jnp.max(counts[:n_shards])
     # a drop anywhere poisons every shard's result: reduce over the mesh
-    overflowed = jax.lax.pmax(overflowed, SHARD_AXIS)
-    return out, overflowed
+    # so every device (and the host, once) sees the same grow target
+    worst = jax.lax.pmax(worst, SHARD_AXIS)
+    return out, worst

@@ -153,6 +153,14 @@ def _execute_plan_mesh(plan: PlanNode, db: Database):
         if isinstance(node, TableScan) and \
                 node.table not in mex.db.sources:
             return None
+    # sharded whole-plan fusion first (parallel/mesh_fuse): one jitted
+    # donated-buffer dispatch over the mesh; the per-node walk remains
+    # the fallback for shapes that don't mesh-fuse
+    fused = getattr(mex, "execute_fused", None)
+    if fused is not None:
+        out = fused(plan)
+        if out is not None:
+            return out
     try:
         return mex.execute(plan)
     except NotImplementedError:

@@ -293,6 +293,32 @@ def _union_nullability(schemas: list[dtypes.Schema]) -> dtypes.Schema:
         for f in base.fields))
 
 
+def lookup_schema(node: LookupJoin, p_sch: dtypes.Schema,
+                  b_sch: dtypes.Schema) -> dtypes.Schema:
+    """run_equi_join's output schema for a lookup join node."""
+    if node.kind in ("semi", "anti"):
+        return p_sch
+    fields = list(p_sch.fields)
+    for n in node.payload:
+        f = b_sch.field(n)
+        fields.append(dtypes.Field(
+            n + node.suffix, f.type,
+            f.nullable or node.kind == "left"))
+    return dtypes.Schema(tuple(fields))
+
+
+def expand_schema(node: ExpandJoin, p_sch: dtypes.Schema,
+                  b_sch: dtypes.Schema) -> dtypes.Schema:
+    """expand_join's output schema for an expand join node."""
+    fields = [p_sch.field(n) for n in node.probe_payload]
+    for n in node.build_payload:
+        f = b_sch.field(n)
+        fields.append(dtypes.Field(
+            n + node.build_suffix, f.type,
+            f.nullable or node.kind == "left"))
+    return dtypes.Schema(tuple(fields))
+
+
 class FusedPlan:
     """A compiled whole-plan computation + its staging contract.
 
@@ -388,34 +414,54 @@ def build(sig: PlanSignature, db) -> FusedPlan:
     return fused
 
 
-def _build(sig: PlanSignature, db) -> FusedPlan:
-    site_by_node = {id(s.node): s for s in sig.sites}
-    aux_np: dict = {}
-    expand_caps: list[int] = []
-    lowered: dict[int, tuple] = {}  # id(node) -> (emit, schema, cap)
-    n_nodes = 0
+class PlanLowering:
+    """Overridable whole-plan lowering: one walk over the plan tree
+    emitting trace-time closures per node.
 
-    def compiled(program, schema, dicts, dict_aliases=None):
+    The single-chip lowering below is the base; the mesh lowering
+    (parallel/mesh_fuse.MeshLowering) subclasses the join/transform
+    hooks to insert all_to_all repartitions and two-phase partial→final
+    merges while inheriting the scan/concat/shared-subtree machinery —
+    the seam that keeps single-chip the degenerate 1-device case
+    instead of a third executor."""
+
+    def __init__(self, sig: PlanSignature, db):
+        self.sig = sig
+        self.db = db
+        self.site_by_node = {id(s.node): s for s in sig.sites}
+        self.aux_np: dict = {}
+        # grow-protocol capacity slots (FusedPlan.grow): parallel lists
+        # of static capacity + slot kind ("expand" here; subclasses add
+        # their own kinds, e.g. the mesh lowering's "shuffle")
+        self.caps: list[int] = []
+        self.cap_kinds: list[str] = []
+        self._lowered: dict[int, tuple] = {}  # id -> (emit, schema, cap)
+        self._n_nodes = 0
+
+    def compiled(self, program, schema, dicts, dict_aliases=None,
+                 partial_slots: bool = False):
         """Lower one fragment's program; its aux tables merge into the
-        plan-wide dict under a per-fragment prefix."""
-        nonlocal n_nodes
-        cp = _compile_program(program, schema, dicts, db.key_spaces,
+        plan-wide dict under a per-fragment prefix. Returns (run, cp) —
+        the prefixed runner plus the CompiledProgram (out_schema,
+        group_layout) for callers that dispatch on layout."""
+        cp = _compile_program(program, schema, dicts, self.db.key_spaces,
+                              partial_slots=partial_slots,
                               dict_aliases=dict_aliases)
-        pfx = f"n{n_nodes}."
-        n_nodes += 1
-        aux_np.update({pfx + k: v for k, v in cp.aux.items()})
+        pfx = f"n{self._n_nodes}."
+        self._n_nodes += 1
+        self.aux_np.update({pfx + k: v for k, v in cp.aux.items()})
         keys = tuple(cp.aux.keys())
 
         def run(block, aux):
             return cp.run(block, {k: aux[pfx + k] for k in keys})
 
-        return run, cp.out_schema
+        return run, cp
 
-    def lower(node) -> tuple[Callable, dtypes.Schema, int]:
-        hit = lowered.get(id(node))
+    def lower(self, node) -> tuple[Callable, dtypes.Schema, int]:
+        hit = self._lowered.get(id(node))
         if hit is not None:
             return hit
-        emit, sch, cap = _lower(node)
+        emit, sch, cap = self._lower(node)
         nid = id(node)
 
         # trace-time memo: a shared subtree (CTE referenced twice)
@@ -429,126 +475,138 @@ def _build(sig: PlanSignature, db) -> FusedPlan:
             return h
 
         out = (memo_emit, sch, cap)
-        lowered[nid] = out
+        self._lowered[nid] = out
         return out
 
-    def _lower(node):
+    def _lower(self, node):
         if isinstance(node, TableScan):
-            site = site_by_node[id(node)]
-            src = db.sources[node.table]
-            if node.program is None:
-                sch = site.in_schema
-
-                def emit(inputs, aux, memo, totals, _k=site.key,
-                         _cols=site.read_cols):
-                    return inputs[_k].select(_cols)
-
-                return emit, sch, site.capacity
-            run, sch = compiled(node.program, site.in_schema,
-                                getattr(src, "dicts", None) or db.dicts)
-
-            def emit(inputs, aux, memo, totals, _k=site.key,
-                     _cols=site.read_cols, _run=run):
-                return _run(inputs[_k].select(_cols), aux)
-
-            return emit, sch, site.capacity
-
+            return self.lower_scan(node)
         if isinstance(node, LookupJoin):
-            p_emit, p_sch, p_cap = lower(node.probe)
-            b_emit, b_sch, _ = lower(node.build)
-            if node.kind in ("semi", "anti"):
-                sch = p_sch
-            else:
-                fields = list(p_sch.fields)
-                for n in node.payload:
-                    f = b_sch.field(n)
-                    fields.append(dtypes.Field(
-                        n + node.suffix, f.type,
-                        f.nullable or node.kind == "left"))
-                sch = dtypes.Schema(tuple(fields))
-
-            def emit(inputs, aux, memo, totals, _n=node, _pe=p_emit,
-                     _be=b_emit):
-                return join_kernels.run_equi_join(
-                    _pe(inputs, aux, memo, totals),
-                    _be(inputs, aux, memo, totals),
-                    _n.probe_keys, _n.build_keys, kind=_n.kind,
-                    suffix=_n.suffix, payload=_n.payload)
-
-            return emit, sch, p_cap
-
+            return self.lower_lookup(node)
         if isinstance(node, ExpandJoin):
-            p_emit, p_sch, p_cap = lower(node.probe)
-            b_emit, b_sch, _ = lower(node.build)
-            fields = [p_sch.field(n) for n in node.probe_payload]
-            for n in node.build_payload:
-                f = b_sch.field(n)
-                fields.append(dtypes.Field(
-                    n + node.build_suffix, f.type,
-                    f.nullable or node.kind == "left"))
-            sch = dtypes.Schema(tuple(fields))
-            ei = len(expand_caps)
-            # p_cap is an upper bound on the probe subtree's live rows
-            # (group-bys only shrink), sized like run_equi_join's first
-            # round; overflow grows it exactly (FusedPlan.grow)
-            expand_caps.append(max(
-                int(p_cap * node.fanout_hint), DEFAULT_CAPACITY_QUANTUM))
-
-            def emit(inputs, aux, memo, totals, _n=node, _pe=p_emit,
-                     _be=b_emit, _ei=ei):
-                out, total = join_kernels.expand_join(
-                    _pe(inputs, aux, memo, totals),
-                    _be(inputs, aux, memo, totals),
-                    list(_n.probe_keys), list(_n.build_keys),
-                    list(_n.probe_payload), list(_n.build_payload),
-                    out_capacity=expand_caps[_ei],
-                    build_suffix=_n.build_suffix, kind=_n.kind)
-                totals[_ei] = total
-                return out
-
-            # report the initial bound so parents (nested expands) can
-            # size their own caps; if this cap later grows on overflow
-            # the parent under-sizes at worst, and its own overflow
-            # check grows it the same way
-            return emit, sch, expand_caps[ei]
-
+            return self.lower_expand(node)
         if isinstance(node, Transform):
-            i_emit, i_sch, i_cap = lower(node.input)
-            run, sch = compiled(node.program, i_sch, db.dicts,
-                                dict_aliases=dict(node.dict_aliases))
-
-            def emit(inputs, aux, memo, totals, _ie=i_emit, _run=run):
-                return _run(_ie(inputs, aux, memo, totals), aux)
-
-            return emit, sch, i_cap
-
+            return self.lower_transform(node)
         if isinstance(node, Concat):
-            parts = [lower(i) for i in node.inputs]
-            sch = _union_nullability([p[1] for p in parts])
-            caps = [p[2] for p in parts]
-            cap = (sum(caps) if all(c is not None for c in caps)
-                   else None)
-
-            def emit(inputs, aux, memo, totals, _parts=parts, _sch=sch):
-                blocks = [
-                    # restamp to the union schema so the merged block
-                    # types like concat_blocks' output
-                    TableBlock(b.columns, b.length, _sch)
-                    for b in (p[0](inputs, aux, memo, totals)
-                              for p in _parts)
-                ]
-                return merge_blocks_device(blocks)
-
-            return emit, sch, cap
-
+            return self.lower_concat(node)
         raise Unfusible(f"node does not lower: {node!r}")
 
-    root, out_schema, _ = lower(sig.plan)
+    def lower_scan(self, node: TableScan):
+        site = self.site_by_node[id(node)]
+        src = self.db.sources[node.table]
+        if node.program is None:
+            sch = site.in_schema
+
+            def emit(inputs, aux, memo, totals, _k=site.key,
+                     _cols=site.read_cols):
+                return inputs[_k].select(_cols)
+
+            return emit, sch, site.capacity
+        run, cp = self.compiled(
+            node.program, site.in_schema,
+            getattr(src, "dicts", None) or self.db.dicts)
+
+        def emit(inputs, aux, memo, totals, _k=site.key,
+                 _cols=site.read_cols, _run=run):
+            return _run(inputs[_k].select(_cols), aux)
+
+        return emit, cp.out_schema, site.capacity
+
+    def lower_lookup(self, node: LookupJoin):
+        p_emit, p_sch, p_cap = self.lower(node.probe)
+        b_emit, b_sch, _ = self.lower(node.build)
+        sch = lookup_schema(node, p_sch, b_sch)
+
+        def emit(inputs, aux, memo, totals, _n=node, _pe=p_emit,
+                 _be=b_emit):
+            return join_kernels.run_equi_join(
+                _pe(inputs, aux, memo, totals),
+                _be(inputs, aux, memo, totals),
+                _n.probe_keys, _n.build_keys, kind=_n.kind,
+                suffix=_n.suffix, payload=_n.payload)
+
+        return emit, sch, p_cap
+
+    def expand_slot(self, probe_cap: int, fanout_hint: float) -> int:
+        """Register one expand join's static output capacity; returns
+        the slot index (totals[i] carries the traced match count)."""
+        # probe_cap is an upper bound on the probe subtree's live rows
+        # (group-bys only shrink), sized like run_equi_join's first
+        # round; overflow grows it exactly (FusedPlan.grow)
+        self.caps.append(max(
+            int(probe_cap * fanout_hint), DEFAULT_CAPACITY_QUANTUM))
+        self.cap_kinds.append("expand")
+        return len(self.caps) - 1
+
+    def expand_total(self, total):
+        """Hook: how an expand join's traced match count reaches the
+        host (the mesh lowering pmax-reduces it over the shard axis)."""
+        return total
+
+    def lower_expand(self, node: ExpandJoin):
+        p_emit, p_sch, p_cap = self.lower(node.probe)
+        b_emit, b_sch, _ = self.lower(node.build)
+        sch = expand_schema(node, p_sch, b_sch)
+        ei = self.expand_slot(p_cap, node.fanout_hint)
+        caps = self.caps
+
+        def emit(inputs, aux, memo, totals, _n=node, _pe=p_emit,
+                 _be=b_emit, _ei=ei):
+            out, total = join_kernels.expand_join(
+                _pe(inputs, aux, memo, totals),
+                _be(inputs, aux, memo, totals),
+                list(_n.probe_keys), list(_n.build_keys),
+                list(_n.probe_payload), list(_n.build_payload),
+                out_capacity=caps[_ei],
+                build_suffix=_n.build_suffix, kind=_n.kind)
+            totals[_ei] = self.expand_total(total)
+            return out
+
+        # report the initial bound so parents (nested expands) can
+        # size their own caps; if this cap later grows on overflow
+        # the parent under-sizes at worst, and its own overflow
+        # check grows it the same way
+        return emit, sch, self.caps[ei]
+
+    def lower_transform(self, node: Transform):
+        i_emit, i_sch, i_cap = self.lower(node.input)
+        run, cp = self.compiled(node.program, i_sch, self.db.dicts,
+                                dict_aliases=dict(node.dict_aliases))
+
+        def emit(inputs, aux, memo, totals, _ie=i_emit, _run=run):
+            return _run(_ie(inputs, aux, memo, totals), aux)
+
+        return emit, cp.out_schema, i_cap
+
+    def lower_concat(self, node: Concat):
+        parts = [self.lower(i) for i in node.inputs]
+        sch = _union_nullability([p[1] for p in parts])
+        caps = [p[2] for p in parts]
+        cap = (sum(caps) if all(c is not None for c in caps)
+               else None)
+
+        def emit(inputs, aux, memo, totals, _parts=parts, _sch=sch):
+            blocks = [
+                # restamp to the union schema so the merged block
+                # types like concat_blocks' output
+                TableBlock(b.columns, b.length, _sch)
+                for b in (p[0](inputs, aux, memo, totals)
+                          for p in _parts)
+            ]
+            return merge_blocks_device(blocks)
+
+        return emit, sch, cap
+
+
+def _build(sig: PlanSignature, db) -> FusedPlan:
+    lo = PlanLowering(sig, db)
+    root, out_schema, _ = lo.lower(sig.plan)
+    caps = lo.caps
 
     def run_all(inputs, aux):
-        totals: list = [jnp.int64(0)] * len(expand_caps)
+        totals: list = [jnp.int64(0)] * len(caps)
         out = root(inputs, aux, {}, totals)
         return out, tuple(totals)
 
-    return FusedPlan(sig.sites, out_schema, device_aux(aux_np),
-                     run_all, expand_caps, sig.fused_stages, _DONATE)
+    return FusedPlan(sig.sites, out_schema, device_aux(lo.aux_np),
+                     run_all, caps, sig.fused_stages, _DONATE)

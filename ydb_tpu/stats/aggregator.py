@@ -142,7 +142,8 @@ class StatisticsAggregator:
                     else merged[col].merge(sk)
         stats = TableStats(rows=rows, columns={
             col: ColumnStats(ndv=sk.ndv, nulls=sk.nulls, rows=sk.rows,
-                             vmin=sk.vmin, vmax=sk.vmax)
+                             vmin=sk.vmin, vmax=sk.vmax,
+                             heavy=sk.max_freq)
             for col, sk in merged.items()
         })
         with self._lock:

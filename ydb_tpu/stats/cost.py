@@ -30,6 +30,10 @@ class ColumnStats:
     rows: int = 0
     vmin: object = None
     vmax: object = None
+    # heaviest-hitter frequency bound (CountMinSketch.max_freq): the
+    # most common value occurs at most this many times. Sizes shuffle
+    # buckets under skew (parallel/shuffle.size_buckets); 0 = unknown.
+    heavy: int = 0
 
     @property
     def null_fraction(self) -> float:

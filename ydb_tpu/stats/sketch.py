@@ -82,6 +82,18 @@ class CountMinSketch:
         return int(min(self.table[d][idx[d][0]]
                        for d in range(self.depth)))
 
+    def max_freq(self) -> int:
+        """Upper bound on the most frequent value's count, without
+        knowing the value: freq(v) <= table[d, h_d(v)] <= max of row d,
+        for every depth d — so min over depths of the per-row max cell
+        bounds the heaviest hitter. Tight under adversarial skew (the
+        hot key dominates its cells); loose but small (~collision load)
+        on uniform data. Feeds shuffle bucket sizing
+        (parallel/shuffle.size_buckets)."""
+        if self.total == 0:
+            return 0
+        return int(self.table.max(axis=1).min())
+
     def merge(self, other: "CountMinSketch") -> "CountMinSketch":
         """Associative/commutative fold (elementwise counter addition).
         Returns a NEW sketch; operands stay untouched."""
@@ -198,6 +210,11 @@ class ColumnSketch:
     @property
     def null_fraction(self) -> float:
         return self.nulls / self.rows if self.rows else 0.0
+
+    @property
+    def max_freq(self) -> int:
+        """Heaviest-hitter bound (CountMinSketch.max_freq)."""
+        return self.cms.max_freq()
 
     def merge(self, other: "ColumnSketch") -> "ColumnSketch":
         out = ColumnSketch()
