@@ -259,6 +259,40 @@ def _latency_summary(hist) -> dict | None:
             "samples": hist.count}
 
 
+def _profiled_with_movement(label, fn, extra, key, query_class):
+    """One profiled run with the data-movement timeline forced on:
+    embeds the run's stage-occupancy fractions/overlaps plus the
+    movement byte DELTAS (blob read / decoded / staged / resident /
+    shuffle) as rates in ``extra[key + "_occupancy"/"_movement"]``.
+    Returns the profile handle (ph.profile carries the full dict)."""
+    from ydb_tpu.obs import profile as profile_mod
+    from ydb_tpu.obs import timeline
+
+    before = timeline.movement_snapshot()
+    prev = timeline.TIMELINE_FORCE
+    timeline.TIMELINE_FORCE = True
+    try:
+        with profile_mod.profiled(label, query_class=query_class) as ph:
+            fn()
+    finally:
+        timeline.TIMELINE_FORCE = prev
+    after = timeline.movement_snapshot()
+    secs = getattr(ph.profile, "seconds", 0.0) or 0.0
+    mv = {}
+    for k, v in sorted(after.items()):
+        d = v - before.get(k, 0)
+        if d:
+            mv[k] = d
+            if secs:
+                mv[k + "_per_sec"] = round(d / secs)
+    if mv:
+        extra[key + "_movement"] = mv
+    occ = getattr(ph.profile, "stage_occupancy", None)
+    if occ:
+        extra[key + "_occupancy"] = occ
+    return ph
+
+
 def _q1_flag_ab(src, blocks, n_rows, block_rows, iters, sides, set_flag):
     """In-process q1 A/B over a trace-time force flag: fresh executors
     per side — the flag is consulted at trace time, and separate
@@ -833,9 +867,9 @@ def main():
             # Budget-guarded like every other run — an extra scan past
             # the external kill threshold wedges the TPU claim.
             if _budget_left(budget) > 30:
-                with profile_mod.profiled("q1",
-                                          query_class="engine") as ph:
-                    shard.scan(tpch.q1_program())
+                ph = _profiled_with_movement(
+                    "q1", lambda: shard.scan(tpch.q1_program()),
+                    extra, "engine_q1", "engine")
                 extra["engine_q1_profile"] = ph.profile.to_dict()
             engine_warm_rps = round(e_rows / ewarm1)
             _checkpoint("engine_q1", extra)
@@ -854,9 +888,9 @@ def main():
             if lat:
                 extra["engine_q6_latency"] = lat
             if _budget_left(budget) > 30:
-                with profile_mod.profiled("q6",
-                                          query_class="engine") as ph:
-                    shard.scan(tpch.q6_program())
+                ph = _profiled_with_movement(
+                    "q6", lambda: shard.scan(tpch.q6_program()),
+                    extra, "engine_q6", "engine")
                 extra["engine_q6_profile"] = ph.profile.to_dict()
             _checkpoint("engine_q6", extra)
 
@@ -1002,9 +1036,9 @@ def main():
             if lat:
                 extra["sql_q1_latency"] = lat
             if _budget_left(budget) > 30:
-                with profile_mod.profiled(TPCH["q1"],
-                                          query_class="sql") as ph:
-                    run_sql(TPCH["q1"])()
+                ph = _profiled_with_movement(
+                    TPCH["q1"], run_sql(TPCH["q1"]),
+                    extra, "sql_q1", "sql")
                 extra["sql_q1_profile"] = ph.profile.to_dict()
             if _budget_left(budget) < 45:
                 raise _BudgetSpent("sql_q6:budget")

@@ -22,6 +22,7 @@ import dataclasses
 import io
 import json
 import struct
+import time
 
 import numpy as np
 
@@ -186,12 +187,26 @@ class PortionChunkReader:
             if c is None else c
 
     def read_chunk(self, i: int) -> tuple[dict, dict]:
+        from ydb_tpu.obs import timeline
+
         if self._legacy is not None:
-            return _unpack_chunk(self._legacy)
-        c = self.chunks[i]
-        data = self.store.get_range(
-            self.blob_id, self._base + c["off"], c["len"])
-        return _unpack_chunk(data)
+            data = self._legacy
+        else:
+            c = self.chunks[i]
+            with timeline.event("blob.read", "blob.read",
+                                timeline.current_trace_id(),
+                                bytes=c["len"]):
+                data = self.store.get_range(
+                    self.blob_id, self._base + c["off"], c["len"])
+        timeline.add_bytes("blob_read_bytes", len(data))
+        t0 = time.perf_counter()
+        cols, valid = _unpack_chunk(data)
+        decoded = sum(a.nbytes for a in cols.values()) + sum(
+            v.nbytes for v in valid.values())
+        timeline.add_bytes("decoded_bytes", decoded)
+        timeline.record("decode", "decode", t0, time.perf_counter(),
+                        timeline.current_trace_id(), bytes=decoded)
+        return cols, valid
 
 
 def read_portion_blob(

@@ -503,10 +503,17 @@ def stream_blocks(payloads, names, sch, cap: int,
     put is stop-aware, so no task leaks on the shared pool.
     """
     def build(cols, valid):
+        from ydb_tpu.obs import timeline
+
         ctx = (timer.stage("stage") if timer is not None
                else contextlib.nullcontext())
         with ctx:
-            return TableBlock.from_numpy(cols, sch, valid, capacity=cap)
+            blk = TableBlock.from_numpy(cols, sch, valid, capacity=cap)
+        # staged/H2D movement: padded device bytes this block shipped
+        timeline.add_bytes("staged_bytes", sum(
+            c.data.nbytes + c.validity.nbytes
+            for c in blk.columns.values()))
+        return blk
 
     pieces = rechunk(payloads, names, cap)
 

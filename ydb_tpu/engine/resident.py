@@ -47,6 +47,7 @@ import numpy as np
 
 from ydb_tpu.analysis import sanitizer
 from ydb_tpu.blocks.block import Column, TableBlock
+from ydb_tpu.obs import timeline
 from ydb_tpu.obs.probes import probe
 
 _P_PROMOTE = probe("resident.promote")
@@ -524,6 +525,10 @@ def scan_items(source, clusters, names):
                 if ent is not None:
                     source.resident_hits += 1
                     source.resident_rows += m.num_rows
+                    # bytes served straight from HBM — the movement the
+                    # resident tier SAVED the staged pipeline
+                    timeline.add_bytes("resident_bytes", sum(
+                        e.nbytes for e in ent.values()))
                     yield ("dev", ent, m.num_rows)
                     continue
                 if store.record_miss(m.portion_id):
@@ -606,7 +611,11 @@ def mixed_blocks(items, names, sch, cap, timer=None):
         ctx = (timer.stage("stage") if timer is not None
                else contextlib.nullcontext())
         with ctx:
-            return TableBlock.from_numpy(cols, sch, valid, capacity=cap)
+            blk = TableBlock.from_numpy(cols, sch, valid, capacity=cap)
+        timeline.add_bytes("staged_bytes", sum(
+            c.data.nbytes + c.validity.nbytes
+            for c in blk.columns.values()))
+        return blk
 
     it = iter(items)
     emitted = 0

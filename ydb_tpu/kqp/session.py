@@ -41,7 +41,13 @@ from ydb_tpu.obs.probes import probe as _probe
 from ydb_tpu.tx import Coordinator, ShardedTable
 from ydb_tpu.tx.coordinator import TxResult
 
+import time as _time
+
 _P_PLAN_CACHE = _probe("kqp.plan_cache")
+_P_SLOW = _probe("query.slow")
+
+# conveyor queue-depth histogram buckets (task counts, not seconds)
+_DEPTH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 _TYPE_MAP = {
     "int8": dtypes.INT8, "int16": dtypes.INT16, "int32": dtypes.INT32,
@@ -247,6 +253,16 @@ class Cluster:
         from ydb_tpu.obs.profile import ProfileRing
 
         self.profiles = ProfileRing()
+        # in-flight statement registry (sys_active_queries + the
+        # query.slow watchdog): token -> {sql, start, stage, ...};
+        # sessions register before admission and unregister in a
+        # finally, so a failed statement always clears
+        from ydb_tpu.analysis import sanitizer as _san
+
+        self._active_lock = _san.make_lock(f"kqp.{id(self):x}.active")
+        self.active_queries = _san.share(
+            {}, f"kqp.{id(self):x}.active_queries")
+        self._active_seq = 0
         self._dict_seq = 0
         self._dict_durable: dict[str, int] = {}
         self._replay_dict_journal()
@@ -563,7 +579,108 @@ class Cluster:
         if cache is not None and limit and rss:
             stats["cache_pressure"] = cache.react_to_pressure(
                 rss / limit)
+        # conveyor queue telemetry: lifetime totals plus the depth
+        # high-water mark and per-queue wait samples accumulated since
+        # the previous pass (queue_stats drains/resets those)
+        from ydb_tpu.runtime.conveyor import shared_conveyor
+
+        qs = shared_conveyor().queue_stats()
+        g = self.counters.group(component="conveyor")
+        for k in ("submitted", "completed", "rejected", "depth",
+                  "active", "workers", "max_depth"):
+            g.counter(k).set(qs[k])
+        g.histogram("queue_depth",
+                    bounds=_DEPTH_BOUNDS).observe(float(qs["max_depth"]))
+        for q, waits in qs["waits"].items():
+            h = self.counters.group(
+                component="conveyor", queue=q).histogram(
+                    "queue_wait_seconds")
+            for w in waits:
+                h.observe(w)
+        stats["conveyor_depth"] = qs["depth"]
+        # data-movement byte counters (always-on, obs.timeline): bytes
+        # read from blobs, decoded, staged to device, served resident,
+        # and shuffled per device — the /counters movement surface
+        from ydb_tpu.obs import timeline as _tl
+
+        mv = _tl.movement_snapshot()
+        if mv:
+            g = self.counters.group(component="movement")
+            for k, v in mv.items():
+                if k.startswith("shuffle_bytes_dev"):
+                    self.counters.group(
+                        component="movement",
+                        device=k[len("shuffle_bytes_dev"):],
+                    ).counter("shuffle_bytes").set(v)
+                else:
+                    g.counter(k).set(v)
+        # slow-query watchdog over the in-flight registry
+        stats["slow_queries"] = self.check_slow_queries()
         return stats
+
+    # ---- live query introspection ----
+
+    def _register_active(self, sql: str, t0: float) -> int:
+        """Enter a statement into the in-flight registry (before
+        admission, so queued statements are visible). Returns the token
+        the caller must hand to _unregister_active in a finally."""
+        with self._active_lock:
+            self._active_seq += 1
+            tok = self._active_seq
+            pos = sum(1 for e in self.active_queries.values()
+                      if e["stage"] == "queued")
+            self.active_queries[tok] = {
+                "sql": sql, "start": t0, "stage": "queued",
+                "queue_position": pos, "trace_id": 0, "kind": "",
+                "rows": 0, "slow_fired": False,
+            }
+        return tok
+
+    def _update_active(self, tok: int, **fields) -> None:
+        with self._active_lock:
+            e = self.active_queries.get(tok)
+            if e is not None:
+                e.update(fields)
+
+    def _unregister_active(self, tok: int) -> None:
+        with self._active_lock:
+            self.active_queries.pop(tok, None)
+
+    def active_query_snapshot(self) -> list[dict]:
+        """Point-in-time view of in-flight statements (the
+        sys_active_queries source), longest-running first."""
+        now = _time.monotonic()
+        with self._active_lock:
+            entries = [dict(e) for e in self.active_queries.values()]
+        for e in entries:
+            e["elapsed_seconds"] = now - e.pop("start")
+            e.pop("slow_fired", None)
+        entries.sort(key=lambda e: -e["elapsed_seconds"])
+        return entries
+
+    def check_slow_queries(self) -> int:
+        """Fire the query.slow probe for any in-flight statement past
+        the YDB_TPU_SLOW_QUERY_SECONDS threshold (once per statement).
+        Rides the run_background cadence; callable directly too."""
+        import os as _os
+
+        try:
+            threshold = float(
+                _os.environ.get("YDB_TPU_SLOW_QUERY_SECONDS", "") or 1.0)
+        except ValueError:
+            threshold = 1.0
+        now = _time.monotonic()
+        fired = 0
+        with self._active_lock:
+            for e in self.active_queries.values():
+                if e["slow_fired"] or now - e["start"] < threshold:
+                    continue
+                e["slow_fired"] = True
+                _P_SLOW.fire(
+                    elapsed=round(now - e["start"], 3),
+                    stage=e["stage"], sql=e["sql"][:120])
+                fired += 1
+        return fired
 
     def _auto_reshard(self, stats: dict) -> None:
         """Load-driven splits/merges from table statistics (the
@@ -1285,46 +1402,56 @@ class Session:
             raise ThrottledError("request rate limit exceeded")
         t0 = _time.monotonic()  # BEFORE admission: queue wait is part
         # of the latency operators observe
-        qid = None
-        if c.workload is not None or c.rm is not None:
-            with c._qid_lock:
-                c._query_seq += 1
-                qid = f"q{c._query_seq}"
-        deadline = t0 + 30.0
-        if c.workload is not None:
-            # pool admission: run now or condition-wait our queued turn
-            if not c.workload.admit(qid) and not \
-                    c.workload.wait_admitted(
-                        qid, timeout=deadline - _time.monotonic()):
-                c.workload.finish(qid)
-                from ydb_tpu.kqp.rm import PoolOverloaded
-
-                raise PoolOverloaded("admission wait timed out")
-        if c.rm is not None:
-            # the two planes' limits are independent: a pool-admitted
-            # query still waits (not fails) for a compute slot
-            from ydb_tpu.kqp.rm import ResourceExhausted
-
-            while True:
-                try:
-                    c.rm.acquire(qid, slots=1)
-                    break
-                except ResourceExhausted:
-                    if _time.monotonic() > deadline:
-                        if c.workload is not None:
-                            c.workload.finish(qid)
-                        raise
-                    _time.sleep(0.002)
+        # the statement enters the live registry BEFORE admission so
+        # sys_active_queries shows queued statements too; the finally
+        # guarantees it clears even when execution raises
+        tok = c._register_active(sql, t0)
         try:
-            return self._execute_admitted(sql, trace_id, t0)
-        finally:
-            if c.rm is not None:
-                c.rm.release(qid)
+            qid = None
+            if c.workload is not None or c.rm is not None:
+                with c._qid_lock:
+                    c._query_seq += 1
+                    qid = f"q{c._query_seq}"
+            deadline = t0 + 30.0
             if c.workload is not None:
-                c.workload.finish(qid)
+                # pool admission: run now or condition-wait our queued
+                # turn
+                if not c.workload.admit(qid) and not \
+                        c.workload.wait_admitted(
+                            qid, timeout=deadline - _time.monotonic()):
+                    c.workload.finish(qid)
+                    from ydb_tpu.kqp.rm import PoolOverloaded
+
+                    raise PoolOverloaded("admission wait timed out")
+            if c.rm is not None:
+                # the two planes' limits are independent: a pool-admitted
+                # query still waits (not fails) for a compute slot
+                from ydb_tpu.kqp.rm import ResourceExhausted
+
+                while True:
+                    try:
+                        c.rm.acquire(qid, slots=1)
+                        break
+                    except ResourceExhausted:
+                        if _time.monotonic() > deadline:
+                            if c.workload is not None:
+                                c.workload.finish(qid)
+                            raise
+                        _time.sleep(0.002)
+            try:
+                return self._execute_admitted(sql, trace_id, t0,
+                                              active_tok=tok)
+            finally:
+                if c.rm is not None:
+                    c.rm.release(qid)
+                if c.workload is not None:
+                    c.workload.finish(qid)
+        finally:
+            c._unregister_active(tok)
 
     def _execute_admitted(self, sql: str, trace_id: int | None = None,
-                          t0: float | None = None):
+                          t0: float | None = None,
+                          active_tok: int | None = None):
         import contextlib
         import time as _time
 
@@ -1345,33 +1472,57 @@ class Session:
             return tracing.activate(sp) if prof \
                 else contextlib.nullcontext()
 
-        with c.tracer.trace("query", trace_id) as span:
-            with act(span):
-                with span.child("plan") as plan_span:
-                    with act(plan_span):
-                        planned = c.plan(
-                            sql,
-                            snap=self._tx["snap"] if self._tx else None,
-                            access_check=(self._plan_access_check
-                                          if self.principal is not None
-                                          else None))
-                    if not isinstance(planned, tuple):
-                        kind = type(planned).__name__.lower()
-                    elif planned[0] == "explain":
-                        kind = "explain"
-                    else:
-                        kind = "select"
-                    plan_span.set(kind=kind)
-                span.set(kind=kind)
-                with span.child("execute") as exec_span:
-                    with act(exec_span):
-                        out = self._dispatch(planned)
-            # totals attach BEFORE the root span finishes: a finished
-            # span is visible to exporter threads, whose attrs
-            # iteration must never race a late set()
+        planned = None
+        kind = "error"
+        span = None
+        try:
+            with c.tracer.trace("query", trace_id) as span:
+                c._update_active(active_tok, stage="plan",
+                                 trace_id=span.trace_id)
+                with act(span):
+                    with span.child("plan") as plan_span:
+                        with act(plan_span):
+                            planned = c.plan(
+                                sql,
+                                snap=(self._tx["snap"]
+                                      if self._tx else None),
+                                access_check=(
+                                    self._plan_access_check
+                                    if self.principal is not None
+                                    else None))
+                        if not isinstance(planned, tuple):
+                            kind = type(planned).__name__.lower()
+                        elif planned[0] == "explain":
+                            kind = "explain"
+                        else:
+                            kind = "select"
+                        plan_span.set(kind=kind)
+                    span.set(kind=kind)
+                    c._update_active(active_tok, stage="execute",
+                                     kind=kind)
+                    with span.child("execute") as exec_span:
+                        with act(exec_span):
+                            out = self._dispatch(planned)
+                # totals attach BEFORE the root span finishes: a
+                # finished span is visible to exporter threads, whose
+                # attrs iteration must never race a late set()
+                seconds = _time.monotonic() - t0
+                rows = out.num_rows if isinstance(out, OracleTable) \
+                    else 0
+                span.set(seconds=round(seconds, 6), rows=rows)
+        except BaseException:
+            # statements that fail MID-EXECUTION still land in the
+            # profile ring tagged error=1, so sys_top_queries and the
+            # viewer show them instead of silently dropping the
+            # evidence (the root span finished with its error attr
+            # when the with-block unwound)
             seconds = _time.monotonic() - t0
-            rows = out.num_rows if isinstance(out, OracleTable) else 0
-            span.set(seconds=round(seconds, 6), rows=rows)
+            c.counters.group(kind="error").counter("queries").inc()
+            if prof and span is not None:
+                self._finish_profile(planned, sql, kind, span, seconds,
+                                     0, error=1)
+            raise
+        c._update_active(active_tok, stage="done", rows=rows)
         c.query_log.append({"sql": sql, "kind": kind,
                             "seconds": seconds, "rows": rows})
         if kind != "select":
@@ -1395,7 +1546,8 @@ class Session:
         return out
 
     def _finish_profile(self, planned, sql: str, kind: str, span,
-                        seconds: float, rows: int) -> None:
+                        seconds: float, rows: int,
+                        error: int = 0) -> None:
         """Assemble the statement's QueryProfile from its finished span
         tree; feed last_profile, the profile ring and the per-query-
         class latency histogram (with p50/p99 gauges beside it, the
@@ -1418,8 +1570,14 @@ class Session:
         profile = build_profile(
             scoped, sql=sql, kind=kind,
             query_class=qc, seconds=seconds, rows=rows)
+        profile.error = error
         self.last_profile = profile
         c.profiles.add(profile)
+        if error:
+            # failed statements stay out of the per-class latency
+            # surface (their seconds measure the failure, not the
+            # query class) — the ring entry is the record
+            return
         if profile.compile_cache:
             c.counters.group(kind="compile_cache").counter(
                 profile.compile_cache).inc()

@@ -19,6 +19,7 @@ import threading
 import time
 
 from ydb_tpu.analysis import sanitizer
+from ydb_tpu.obs import timeline
 
 # module-level registry: built at import, before any test could set
 # YDB_TPU_TSAN — so the proxy/lock are always-on variants whose
@@ -124,6 +125,15 @@ class StageTimer:
         self._lock = threading.Lock()
 
     def add(self, name: str, seconds: float) -> None:
+        # every stage charge ALSO lands on the data-movement timeline
+        # (obs.timeline, default off) as an interval ending now — one
+        # funnel, so timeline busy sums per stage equal the EXPLAIN
+        # ANALYZE stage seconds by construction
+        if timeline.timeline_enabled():
+            end = time.perf_counter()
+            timeline.RING.record(
+                f"stage.{name}", name, end - seconds, end,
+                timeline.current_trace_id())
         with self._lock:
             self._t[name] += seconds
 

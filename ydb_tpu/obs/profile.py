@@ -54,6 +54,12 @@ class QueryProfile:
     pruning: dict = dataclasses.field(default_factory=dict)
     device_seconds: float = 0.0
     host_seconds: float = 0.0
+    #: per-stage busy fractions + overlap coefficients from the
+    #: data-movement timeline (obs.timeline); {} when the ring is off
+    stage_occupancy: dict = dataclasses.field(default_factory=dict)
+    #: 1 when the statement failed mid-execution (the profile still
+    #: lands in the ring so slow-then-failing statements stay visible)
+    error: int = 0
     spans: list = dataclasses.field(default_factory=list)
 
     def to_dict(self, include_spans: bool = False) -> dict:
@@ -171,6 +177,11 @@ def build_profile(spans, sql: str = "", kind: str = "",
     p.host_seconds = round(sum(
         v for k, v in p.stages.items() if k != "compute"), 6)
     p.spans = [_span_dict(s) for s in spans]
+    from ydb_tpu.obs import timeline
+
+    if timeline.timeline_enabled() and p.trace_id:
+        p.stage_occupancy = timeline.query_occupancy(
+            p.trace_id, wall=p.seconds or None)
     return p
 
 
@@ -267,6 +278,14 @@ def format_plan_analyzed(plan, profile: QueryProfile) -> str:
     pr = profile.pruning
     lines.append("rows: " + " ".join(
         f"{k}={pr.get(k, 0)}" for k in PRUNING_KEYS))
+    occ = profile.stage_occupancy
+    if occ:
+        frac = occ.get("fraction", {})
+        bits = [f"{k}={frac.get(k, 0.0):.4f}" for k in STAGE_KEYS
+                if k in frac]
+        for pair, coeff in sorted(occ.get("overlap", {}).items()):
+            bits.append(f"{pair}={coeff:.4f}")
+        lines.append("occupancy: " + " ".join(bits))
     for s in profile.spans:
         if s["name"] not in SCAN_SPANS:
             continue

@@ -79,7 +79,8 @@ SYS_SCHEMAS = {
         ("compute_seconds", dtypes.DOUBLE),
         ("portions_skipped", dtypes.INT64),
         ("chunks_read", dtypes.INT64),
-        ("chunks_skipped", dtypes.INT64)),
+        ("chunks_skipped", dtypes.INT64),
+        ("error", dtypes.INT32)),
     # HBM-resident column tier (engine/resident.py): per-shard pinned
     # bytes vs budget plus promotion/eviction/spill lifecycle counters
     # — the "is the hot set actually resident" dashboard
@@ -99,6 +100,14 @@ SYS_SCHEMAS = {
         ("kind", dtypes.STRING), ("query_class", dtypes.STRING),
         ("seconds", dtypes.DOUBLE), ("rows", dtypes.INT64),
         ("trace_id", dtypes.INT64), ("spans", dtypes.INT64)),
+    # live in-flight statements (the reference's .sys running-queries
+    # introspection): fed by the Cluster active-query registry, which
+    # sessions enter before admission and leave on completion/failure
+    "sys_active_queries": dtypes.schema(
+        ("query_text", dtypes.STRING), ("kind", dtypes.STRING),
+        ("stage", dtypes.STRING), ("elapsed_seconds", dtypes.DOUBLE),
+        ("rows", dtypes.INT64), ("queue_position", dtypes.INT32),
+        ("trace_id", dtypes.INT64)),
 }
 
 
@@ -282,7 +291,7 @@ def _scan_pruning_rows(cluster):
 
 
 def _top_queries_rows(cluster):
-    cols: list[list] = [[] for _ in range(17)]
+    cols: list[list] = [[] for _ in range(18)]
     for rank, p in enumerate(cluster.profiles.top(16), start=1):
         st = p.stages
         pr = p.pruning
@@ -292,7 +301,7 @@ def _top_queries_rows(cluster):
                st.get("read", 0.0), st.get("merge", 0.0),
                st.get("stage", 0.0), st.get("compute", 0.0),
                pr.get("portions_skipped", 0), pr.get("chunks_read", 0),
-               pr.get("chunks_skipped", 0)]
+               pr.get("chunks_skipped", 0), getattr(p, "error", 0)]
         for c, v in zip(cols, row):
             c.append(v)
     return cols
@@ -314,6 +323,17 @@ def _resident_store_rows(cluster):
                    snap["inflight"]]
             for c, v in zip(cols, row):
                 c.append(v)
+    return cols
+
+
+def _active_queries_rows(cluster):
+    cols: list[list] = [[] for _ in range(7)]
+    for e in cluster.active_query_snapshot():
+        row = [e["sql"][:256], e["kind"], e["stage"],
+               e["elapsed_seconds"], e["rows"], e["queue_position"],
+               e["trace_id"]]
+        for c, v in zip(cols, row):
+            c.append(v)
     return cols
 
 
@@ -340,6 +360,7 @@ _BUILDERS = {
     "sys_resident_store": _resident_store_rows,
     "sys_top_queries": _top_queries_rows,
     "sys_query_log": _query_log_rows,
+    "sys_active_queries": _active_queries_rows,
 }
 
 

@@ -35,6 +35,7 @@ import threading
 import time
 
 from ydb_tpu.analysis import sanitizer
+from ydb_tpu.obs import timeline
 
 _ids = itertools.count(1)
 
@@ -86,6 +87,15 @@ class Span:
         if self.end is None:
             self.end = self._clock()
             self.tracer._record(self)
+            if timeline.timeline_enabled():
+                # anchor on the duration, not the span's own clock:
+                # spans run on ``clock`` (monotonic by default) while
+                # the timeline axis is perf_counter — re-basing the
+                # interval to end-now keeps one consistent axis
+                now = time.perf_counter()
+                timeline.RING.record(
+                    self.name, "span", now - (self.end - self.start),
+                    now, self.trace_id)
 
     def __enter__(self):
         return self

@@ -20,6 +20,8 @@ Endpoints:
   /viewer/json/whiteboard   per-node live snapshot (uptime, queries,
                             memory, session counts)
   /viewer/json/sysview?name=sys_query_stats   any sys view as rows
+  /viewer/json/timeline     data-movement timeline summary + in-flight
+                            statements; ?trace=1 = Chrome trace JSON
   /counters                 counters snapshot (JSON tree)
   /counters/prometheus      Prometheus text encoding
 """
@@ -141,6 +143,7 @@ class Viewer:
             "/viewer/json/statistics": self._statistics,
             "/viewer/json/resident": self._resident,
             "/viewer/json/query_profile": self._query_profile,
+            "/viewer/json/timeline": self._timeline,
             "/counters": self._counters,
         }
         h = handlers.get(path)
@@ -272,6 +275,20 @@ class Viewer:
             "last": (dict(last.to_dict(), span_tree=last.span_tree())
                      if last is not None else None),
         }
+
+    def _timeline(self, query) -> dict:
+        """Data-movement timeline (obs.timeline): ring summary with
+        per-category busy seconds, movement byte counters and the
+        in-flight statement list; ``?trace=1`` returns the full
+        Chrome/Perfetto trace_event JSON instead (save it and open in
+        chrome://tracing or https://ui.perfetto.dev)."""
+        from ydb_tpu.obs import timeline
+
+        if query.get("trace", ["0"])[0] not in ("", "0"):
+            return timeline.export_chrome_trace()
+        out = timeline.summary()
+        out["active_queries"] = self.cluster.active_query_snapshot()
+        return out
 
     def _tablets(self, query) -> dict:
         """Per-tablet counters + per-type aggregates (the counters-

@@ -49,8 +49,9 @@ PAGE = """<!doctype html>
 <main id="main">loading…</main>
 <script>
 "use strict";
-const TABS = ["overview", "profiles", "tablets", "statistics",
-              "resident", "sysviews", "topics", "counters"];
+const TABS = ["overview", "profiles", "timeline", "tablets",
+              "statistics", "resident", "sysviews", "topics",
+              "counters"];
 const tabOf = h => TABS.includes(h) ? h : "overview";
 let tab = tabOf(location.hash.slice(1));
 let sysviewName = "";
@@ -119,6 +120,27 @@ const VIEWS = {
     return "<h3>top queries (most expensive retained)</h3>"
       + renderTable(top)
       + "<h3>last query span tree</h3>" + spanHtml;
+  },
+  async timeline() {
+    const t = await get("/viewer/json/timeline");
+    const cats = Object.entries(t.categories || {}).map(
+      ([k, v]) => Object.assign({category: k}, v));
+    const mv = Object.entries(t.movement_bytes || {}).map(
+      ([k, v]) => ({counter: k, bytes: v}));
+    const note = t.enabled ? "" :
+      "<p class=muted>timeline ring is OFF (set YDB_TPU_TIMELINE=1" +
+      " to record events; byte counters below are always on)</p>";
+    return "<h3>data-movement timeline</h3>" + note
+      + kv({enabled: t.enabled, events: t.events,
+            recorded: t.recorded, dropped: t.dropped,
+            capacity: t.capacity})
+      + "<h3>per-category busy time</h3>" + renderTable(cats)
+      + "<h3>movement bytes (cumulative)</h3>" + renderTable(mv)
+      + "<h3>active queries</h3>"
+      + renderTable(t.active_queries || [])
+      + `<p><a href="/viewer/json/timeline?trace=1" download=` +
+        `"trace.json">download Chrome trace JSON</a> ` +
+        `<span class=muted>(open in ui.perfetto.dev)</span></p>`;
   },
   async tablets() {
     const t = await get("/viewer/json/tablets");

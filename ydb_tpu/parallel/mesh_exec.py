@@ -42,8 +42,14 @@ from ydb_tpu.parallel.dist import (
     _relocal,
     stack_blocks,
 )
+from ydb_tpu.obs import timeline
 from ydb_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_map
-from ydb_tpu.parallel.shuffle import heavy_bound, repartition, size_buckets
+from ydb_tpu.parallel.shuffle import (
+    exchange_bytes_per_device,
+    heavy_bound,
+    repartition,
+    size_buckets,
+)
 from ydb_tpu.plan.nodes import ExpandJoin, LookupJoin, TableScan, Transform
 from ydb_tpu.ssa import join as join_kernels
 from ydb_tpu.ssa import kernels
@@ -330,6 +336,11 @@ class MeshPlanExecutor:
                 ))
                 self._jit_cache[key] = step
             out, worst = step(stacked)
+            # every attempt (including an overflow retry) was a real
+            # mesh exchange — account its per-device bytes
+            per_dev = exchange_bytes_per_device(stacked.schema, self.n, B)
+            for d in range(self.n):
+                timeline.add_bytes(f"shuffle_bytes_dev{d}", per_dev)
             w = int(np.asarray(worst))
             if w <= B:
                 return self._tighten(out)

@@ -171,15 +171,21 @@ class MeshLowering(PlanLowering):
         self.ndev = int(mesh.shape[SHARD_AXIS])
         self.stats = stats or {}
         self.root = sig.plan
+        # (cap slot, physical row bytes) per shuffle — the dispatch-time
+        # byte accounting reads the CURRENT cap, so bucket grows show
+        self.shuffle_rows: list[tuple[int, int]] = []
 
     # -- stats-sized shuffle slots (grow protocol, kind="shuffle") --
 
-    def shuffle_slot(self, subtree_cap: int, keys) -> int:
+    def shuffle_slot(self, subtree_cap: int, keys, sch=None) -> int:
         heavy = shuffle_mod.heavy_bound(self.stats, keys)
         self.caps.append(shuffle_mod.size_buckets(
             subtree_cap, self.ndev, heavy=heavy))
         self.cap_kinds.append("shuffle")
-        return len(self.caps) - 1
+        slot = len(self.caps) - 1
+        if sch is not None:
+            self.shuffle_rows.append((slot, shuffle_mod.row_bytes(sch)))
+        return slot
 
     def _repart(self, block: TableBlock, keys, slot: int, totals):
         out, worst = shuffle_mod.repartition(
@@ -203,8 +209,8 @@ class MeshLowering(PlanLowering):
         p_emit, p_sch, p_cap = self.lower(node.probe)
         b_emit, b_sch, b_cap = self.lower(node.build)
         sch = lookup_schema(node, p_sch, b_sch)
-        pi = self.shuffle_slot(p_cap, node.probe_keys)
-        bi = self.shuffle_slot(b_cap, node.build_keys)
+        pi = self.shuffle_slot(p_cap, node.probe_keys, p_sch)
+        bi = self.shuffle_slot(b_cap, node.build_keys, b_sch)
         # after the exchange a device holds at most its receive buffer:
         # one stats-sized bucket from every peer
         out_cap = self.ndev * self.caps[pi]
@@ -227,8 +233,8 @@ class MeshLowering(PlanLowering):
         p_emit, p_sch, p_cap = self.lower(node.probe)
         b_emit, b_sch, b_cap = self.lower(node.build)
         sch = expand_schema(node, p_sch, b_sch)
-        pi = self.shuffle_slot(p_cap, node.probe_keys)
-        bi = self.shuffle_slot(b_cap, node.build_keys)
+        pi = self.shuffle_slot(p_cap, node.probe_keys, p_sch)
+        bi = self.shuffle_slot(b_cap, node.build_keys, b_sch)
         ei = self.expand_slot(self.ndev * self.caps[pi],
                               node.fanout_hint)
         caps = self.caps
@@ -312,13 +318,28 @@ class MeshFusedPlan(FusedPlan):
     the shape class of the observed worst destination count."""
 
     def __init__(self, sites, out_schema, aux, run_all, caps, cap_kinds,
-                 fused_stages, donate, mesh, ndev):
+                 fused_stages, donate, mesh, ndev, shuffle_rows=()):
         self.cap_kinds = list(cap_kinds)
         self.mesh = mesh
         self.ndev = ndev
         self.shuffle_grows = 0  # lifetime counter (obs reports deltas)
+        self.shuffle_rows = list(shuffle_rows)
         super().__init__(sites, out_schema, aux, run_all, caps,
                          fused_stages, donate)
+
+    def run(self, inputs):
+        out = super().run(inputs)
+        # host-side movement accounting per dispatch: each shuffle
+        # exchanged ndev buckets of the slot's CURRENT capacity from
+        # every device (static shapes — grown buckets report grown
+        # bytes on later dispatches)
+        from ydb_tpu.obs import timeline
+
+        for slot, rb in self.shuffle_rows:
+            per_dev = self.ndev * self.expand_caps[slot] * rb
+            for d in range(self.ndev):
+                timeline.add_bytes(f"shuffle_bytes_dev{d}", per_dev)
+        return out
 
     def shuffle_capacity(self) -> int:
         caps = [c for c, k in zip(self.expand_caps, self.cap_kinds)
@@ -372,4 +393,5 @@ def _build(sig: PlanSignature, db, mesh, stats=None) -> MeshFusedPlan:
     )
     return MeshFusedPlan(
         sig.sites, out_schema, device_aux(lo.aux_np), run_all, caps,
-        lo.cap_kinds, sig.fused_stages, plan_fuse._DONATE, mesh, lo.ndev)
+        lo.cap_kinds, sig.fused_stages, plan_fuse._DONATE, mesh, lo.ndev,
+        shuffle_rows=lo.shuffle_rows)

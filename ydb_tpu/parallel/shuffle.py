@@ -70,6 +70,24 @@ def size_buckets(local_rows: int, n_shards: int, heavy: int = 0,
     return min(full, shape_class(est))
 
 
+def row_bytes(schema) -> int:
+    """Physical bytes one row ships in an exchange: column payloads
+    plus one validity byte per column (host-side accounting helper —
+    the traced exchange itself never calls this)."""
+    return sum(f.type.physical.itemsize + 1 for f in schema.fields)
+
+
+def exchange_bytes_per_device(schema, n_shards: int,
+                              bucket_rows: int) -> int:
+    """Bytes ONE device sends in one ``repartition`` exchange: a
+    fixed-capacity bucket to every peer (static shapes — the shape of
+    the all_to_all, not the live row count). Callers feed this to
+    ``timeline.add_bytes("shuffle_bytes_dev<i>", ...)`` so per-device
+    movement (and stats-sizing wins / skew grows) shows up as counter
+    rates."""
+    return int(n_shards) * int(bucket_rows) * row_bytes(schema)
+
+
 def heavy_bound(stats, keys) -> int:
     """Heaviest joint-key frequency bound from aggregator statistics.
 

@@ -23,9 +23,15 @@ import heapq
 import itertools
 import os
 import threading
+import time
 
 from ydb_tpu.analysis import sanitizer
-from ydb_tpu.obs import tracing
+from ydb_tpu.obs import timeline, tracing
+
+#: queue-wait samples retained per queue between ``queue_stats``
+#: snapshots; beyond it the extra waits still count in the totals but
+#: are not individually sampled (the histograms are statistical)
+WAIT_SAMPLE_CAP = 512
 
 
 class ConveyorController:
@@ -134,6 +140,15 @@ class Conveyor:
         self._heap_tok = sanitizer.token(f"conveyor.{id(self):x}.heap")
         self._seq = itertools.count()
         self._cv = sanitizer.make_condition(f"conveyor.{id(self):x}.cv")
+        # queue telemetry, all guarded by _cv: lifetime totals, the
+        # depth high-water mark since the last queue_stats() snapshot,
+        # and per-queue wait-time samples drained on that cadence
+        self._submitted = 0
+        self._completed = 0
+        self._rejected = 0
+        self._max_depth = 0
+        self._waits = sanitizer.share(
+            {}, f"conveyor.{id(self):x}.waits")
         self._stopping = False
         self._stop_event = threading.Event()
         self._active = 0
@@ -157,7 +172,10 @@ class Conveyor:
             sanitizer.note(self._heap_tok, "heappush")
             heapq.heappush(
                 self._heap,
-                (priority, next(self._seq), queue, fn, args, kwargs, h))
+                (priority, next(self._seq), queue, fn, args, kwargs, h,
+                 time.perf_counter()))
+            self._submitted += 1
+            self._max_depth = max(self._max_depth, len(self._heap))
             self._cv.notify()
         return h
 
@@ -173,12 +191,16 @@ class Conveyor:
         with self._cv:
             if (self._stopping or self._heap
                     or self._active >= len(self._threads)):
+                self._rejected += 1
                 return None
             h = TaskHandle(queue, threading.Event())
             sanitizer.note(self._heap_tok, "heappush")
             heapq.heappush(
                 self._heap,
-                (10, next(self._seq), queue, fn, args, kwargs, h))
+                (10, next(self._seq), queue, fn, args, kwargs, h,
+                 time.perf_counter()))
+            self._submitted += 1
+            self._max_depth = max(self._max_depth, len(self._heap))
             self._cv.notify()
             return h
 
@@ -192,6 +214,29 @@ class Conveyor:
                 return len(self._heap)
             return sum(1 for item in self._heap if item[2] == queue)
 
+    def queue_stats(self) -> dict:
+        """Telemetry snapshot: lifetime submitted/completed/rejected
+        totals, instantaneous depth/active, the depth high-water mark
+        since the LAST snapshot (reset here), and the per-queue wait
+        seconds sampled since then (drained here — the background
+        cadence folds them into the ``component="conveyor"``
+        histograms)."""
+        with self._cv:
+            waits = {q: list(v) for q, v in self._waits.items()}
+            self._waits.clear()
+            out = {
+                "submitted": self._submitted,
+                "completed": self._completed,
+                "rejected": self._rejected,
+                "depth": len(self._heap),
+                "active": self._active,
+                "workers": len(self._threads),
+                "max_depth": self._max_depth,
+                "waits": waits,
+            }
+            self._max_depth = len(self._heap)
+        return out
+
     def _worker(self) -> None:
         while True:
             with self._cv:
@@ -200,9 +245,20 @@ class Conveyor:
                 if self._stopping and not self._heap:
                     return
                 sanitizer.note(self._heap_tok, "heappop")
-                _, _, queue, fn, args, kwargs, h = heapq.heappop(
+                _, _, queue, fn, args, kwargs, h, t_sub = heapq.heappop(
                     self._heap)
                 self._active += 1
+                t_pop = time.perf_counter()
+                ws = self._waits.get(queue)
+                if ws is None:
+                    ws = self._waits[queue] = []
+                if len(ws) < WAIT_SAMPLE_CAP:
+                    ws.append(t_pop - t_sub)
+            tl = timeline.timeline_enabled()
+            if tl:
+                timeline.RING.record(
+                    f"{queue}.wait", "conveyor.wait", t_sub, t_pop,
+                    args={"queue": queue})
             try:
                 try:
                     # stop-aware gates: shutdown() while the controller
@@ -214,16 +270,23 @@ class Conveyor:
                     h.error = RuntimeError(
                         "conveyor shut down before the task ran")
                     continue
+                t_run = time.perf_counter() if tl else t_pop
                 try:
                     h.result = fn(*args, **kwargs)
                 except BaseException as e:  # surfaced via handle.wait()
                     h.error = e
                 finally:
                     self.broker.release(queue)
+                    if tl:
+                        timeline.RING.record(
+                            f"{queue}.run", "conveyor.run", t_run,
+                            time.perf_counter(),
+                            args={"queue": queue})
             finally:
                 h.done.set()
                 with self._cv:
                     self._active -= 1
+                    self._completed += 1
                     self._cv.notify_all()
 
     def wait_idle(self, timeout: float = 30.0) -> None:

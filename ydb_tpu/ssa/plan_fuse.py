@@ -362,8 +362,19 @@ class FusedPlan:
         split compile from execute; warm dispatches stay async. With
         donation on, ``inputs`` is consumed — callers re-stage to
         retry."""
+        from ydb_tpu.obs import timeline
+
         if self._traced:
-            out, totals = self._jit(inputs, self.aux)
+            if timeline.timeline_enabled():
+                # warm dispatch interval (async enqueue — no forced
+                # sync; the block boundary shows where results landed)
+                t0 = time.perf_counter()
+                out, totals = self._jit(inputs, self.aux)
+                timeline.RING.record(
+                    "plan.dispatch", "dispatch", t0,
+                    time.perf_counter(), timeline.current_trace_id())
+            else:
+                out, totals = self._jit(inputs, self.aux)
         else:
             import warnings
 
