@@ -146,6 +146,16 @@ def _checkpoint(tier: str, extra: dict) -> None:
     if path in ("", "0", "off"):
         return
     _CKPT_TIERS.append(tier)
+    # per-tier chaos provenance: a benchmark number is only comparable
+    # if no fault scenario was armed while it ran — stamp each tier so
+    # a stray YDB_TPU_CHAOS=1 is visible in the artifact
+    try:
+        from ydb_tpu import chaos
+
+        extra.setdefault("chaos", {})[tier] = (
+            "armed" if chaos.armed() else "off")
+    except Exception:  # noqa: BLE001 - provenance is best-effort
+        pass
     tmp = path + ".tmp"
     try:
         with open(tmp, "w") as f:

@@ -1,0 +1,100 @@
+"""Statement deadlines and cooperative cancellation.
+
+``Session.execute(timeout=...)`` activates a :class:`Deadline` on the
+dispatching thread; the execution layers check it cooperatively at
+their natural block boundaries (scan block loop, staging pipeline,
+fused dispatch loop, DQ source pumps) via ``check_current()`` — one
+thread-local read plus a clock compare, nothing when no deadline is
+active. Crossing a thread boundary is explicit: the conveyor composes
+``wrap_current`` into ``submit`` exactly like the tracing span, so a
+statement's prefetch producer observes the same deadline as its
+consumer.
+
+Expiry raises :class:`StatementCancelled`; the raising frame's normal
+unwind (context managers, ``finally`` blocks) is the release path for
+conveyor slots, staging queues and shuffle buffers — cancellation adds
+no second resource-cleanup protocol.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class StatementCancelled(Exception):
+    """The statement exceeded its deadline (or was cancelled); surfaced
+    in ``sys_top_queries`` as ``error=1`` with reason ``cancelled``."""
+
+    reason = "cancelled"
+
+
+class Deadline:
+    """A wall-clock budget: ``Deadline(seconds=0.5)`` or an absolute
+    ``Deadline(at=monotonic_instant)``."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, seconds: float | None = None,
+                 at: float | None = None):
+        if at is None:
+            if seconds is None:
+                raise ValueError("Deadline needs seconds= or at=")
+            at = time.monotonic() + seconds
+        self.at = at
+
+    def remaining(self) -> float:
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.at
+
+    def check(self, what: str = "statement") -> None:
+        if time.monotonic() >= self.at:
+            raise StatementCancelled(f"{what}: deadline exceeded")
+
+
+_tls = threading.local()
+
+
+def current() -> Deadline | None:
+    """The thread's active statement deadline (None when unbounded)."""
+    return getattr(_tls, "deadline", None)
+
+
+@contextlib.contextmanager
+def activate(dl: Deadline | None):
+    """Make ``dl`` the thread's deadline for the block. ``activate(None)``
+    explicitly clears it — background work submitted from inside a
+    statement (resident promotions) uses that to NOT inherit the
+    statement's budget."""
+    prev = getattr(_tls, "deadline", None)
+    _tls.deadline = dl
+    try:
+        yield dl
+    finally:
+        _tls.deadline = prev
+
+
+def check_current(what: str = "statement") -> None:
+    """The cooperative cancellation point: raise ``StatementCancelled``
+    if the thread's deadline has passed. Disabled path = one
+    thread-local read."""
+    dl = getattr(_tls, "deadline", None)
+    if dl is not None and time.monotonic() >= dl.at:
+        raise StatementCancelled(f"{what}: deadline exceeded")
+
+
+def wrap_current(fn):
+    """Bind the caller's deadline to ``fn`` for execution on another
+    thread (the conveyor submit hook, next to tracing.wrap_current)."""
+    dl = getattr(_tls, "deadline", None)
+    if dl is None:
+        return fn
+
+    def bound(*args, **kwargs):
+        with activate(dl):
+            return fn(*args, **kwargs)
+
+    return bound

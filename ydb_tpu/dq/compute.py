@@ -491,6 +491,19 @@ class ComputeActor(Actor):
             self._finish_input()
 
     def _pump_source(self):
+        # block-boundary cancellation: a statement past its deadline
+        # stops pumping and aborts the whole graph (the collector turns
+        # this into a typed StatementCancelled at the executor)
+        from ydb_tpu.chaos import deadline as statement_deadline
+
+        dl = statement_deadline.current()
+        if dl is not None and dl.expired():
+            self._aborted = True
+            if self.abort_target is not None:
+                self.send(self.abort_target, QueryAborted(
+                    f"task {self.task.task_id}: statement deadline "
+                    "exceeded"))
+            return
         blk = next(self._source_iter, None)
         if blk is None:
             if not self.task.input_channels:
@@ -902,6 +915,11 @@ def run_stage_graph(
         runtime.dispatch()
     else:
         runtime.run()
+    err = handle.collector.error
+    if err is not None and "deadline" in err:
+        from ydb_tpu.chaos.deadline import StatementCancelled
+
+        raise StatementCancelled(err)
     if not handle.collector.done:
         raise RuntimeError("stage graph did not complete")
     return handle.collector.table()

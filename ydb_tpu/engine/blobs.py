@@ -22,6 +22,28 @@ import bisect
 import os
 import tempfile
 
+from ydb_tpu import chaos
+
+
+def _chaos_io(op: str, blob_id: str,
+              data: bytes | None = None) -> bytes | None:
+    """Chaos injection on the real IO surface (sites ``blob.put`` /
+    ``blob.get`` / ``blob.get_range``): latency spikes sleep here,
+    ``io_error`` raises :class:`chaos.InjectedIOError` (an OSError, so
+    the retry plane treats it as the real thing), ``torn`` returns a
+    short read whose decode failure exercises the re-fetch path.
+    Disarmed cost: one bool check inside ``chaos.hit``."""
+    f = chaos.hit(op, blob=blob_id)
+    if f is None:
+        return data
+    f.sleep()
+    if f.kind == "io_error":
+        raise chaos.InjectedIOError(
+            f"injected {op} failure on {blob_id!r}")
+    if f.kind == "torn" and data is not None:
+        return data[:len(data) // 2]
+    return data
+
 
 class BlobStore:
     def put(self, blob_id: str, data: bytes) -> None:
@@ -69,13 +91,18 @@ class MemBlobStore(BlobStore):
             return len(self._data[blob_id])
 
     def put(self, blob_id, data):
+        _chaos_io("blob.put", blob_id)
         with self._lock:
             if blob_id not in self._data:
                 bisect.insort(self._keys, blob_id)
             self._data[blob_id] = bytes(data)
 
     def get(self, blob_id):
-        return self._data[blob_id]
+        return _chaos_io("blob.get", blob_id, self._data[blob_id])
+
+    def get_range(self, blob_id, off, length):
+        return _chaos_io("blob.get_range", blob_id,
+                         self._data[blob_id][off:off + length])
 
     def delete(self, blob_id):
         with self._lock:
@@ -111,6 +138,7 @@ class DirBlobStore(BlobStore):
         return os.path.join(self.root, quote(blob_id, safe=""))
 
     def put(self, blob_id, data):
+        _chaos_io("blob.put", blob_id)
         # temp + rename: a crash mid-write never leaves a torn blob
         fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".tmp.")
         try:
@@ -126,12 +154,12 @@ class DirBlobStore(BlobStore):
 
     def get(self, blob_id):
         with open(self._path(blob_id), "rb") as f:
-            return f.read()
+            return _chaos_io("blob.get", blob_id, f.read())
 
     def get_range(self, blob_id, off, length):
         with open(self._path(blob_id), "rb") as f:
             f.seek(off)
-            return f.read(length)
+            return _chaos_io("blob.get_range", blob_id, f.read(length))
 
     def delete(self, blob_id):
         try:

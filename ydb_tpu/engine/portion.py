@@ -23,11 +23,22 @@ import io
 import json
 import struct
 import time
+import zipfile
 
 import numpy as np
 
 from ydb_tpu import dtypes
+from ydb_tpu.chaos.retry import RetryPolicy
 from ydb_tpu.engine.blobs import BlobStore
+
+#: One policy for every portion-blob read. Each retry re-fetches AND
+#: re-decodes, so torn/short reads (decode blows up, not the get) heal
+#: the same way IO errors do. Backoff respects the statement deadline.
+READ_RETRY = RetryPolicy(max_attempts=4, base_delay=0.002)
+#: What a transient blob read looks like: IO failure, or the decode
+#: errors a truncated payload produces (npz blobs are zip containers).
+_TRANSIENT_READ = (OSError, EOFError, ValueError, zipfile.BadZipFile,
+                   struct.error)
 
 
 @dataclasses.dataclass
@@ -160,17 +171,29 @@ class PortionChunkReader:
     def __init__(self, store: BlobStore, blob_id: str):
         self.store = store
         self.blob_id = blob_id
-        head = store.get_range(blob_id, 0, 16)
+        def _head():
+            h = store.get_range(blob_id, 0, 16)
+            if h[:8] == PORTION_MAGIC and len(h) < 16:
+                raise EOFError(f"short header read on {blob_id!r}")
+            return h
+
+        head = READ_RETRY.call(_head, site="blob.get_range",
+                               retry_on=_TRANSIENT_READ)
         if head[:8] != PORTION_MAGIC:
             # legacy single-npz blob: treat as one chunk
-            self._legacy = store.get(blob_id)
+            self._legacy = READ_RETRY.call(
+                lambda: store.get(blob_id),
+                site="blob.get", retry_on=_TRANSIENT_READ)
             self.chunks = [None]
             self._base = 0
             self.version = 0
             return
         self._legacy = None
         (hlen,) = struct.unpack("<Q", head[8:16])
-        header = json.loads(store.get_range(blob_id, 16, hlen).decode())
+        header = READ_RETRY.call(
+            lambda: json.loads(
+                store.get_range(blob_id, 16, hlen).decode()),
+            site="blob.get_range", retry_on=_TRANSIENT_READ)
         self.chunks = header["chunks"]
         # v0 headers predate zone maps: absent "version" reads as 0 and
         # chunk entries simply have no "zones" (scans stay unpruned)
@@ -189,24 +212,30 @@ class PortionChunkReader:
     def read_chunk(self, i: int) -> tuple[dict, dict]:
         from ydb_tpu.obs import timeline
 
-        if self._legacy is not None:
-            data = self._legacy
-        else:
-            c = self.chunks[i]
-            with timeline.event("blob.read", "blob.read",
-                                timeline.current_trace_id(),
-                                bytes=c["len"]):
-                data = self.store.get_range(
-                    self.blob_id, self._base + c["off"], c["len"])
-        timeline.add_bytes("blob_read_bytes", len(data))
-        t0 = time.perf_counter()
-        cols, valid = _unpack_chunk(data)
-        decoded = sum(a.nbytes for a in cols.values()) + sum(
-            v.nbytes for v in valid.values())
-        timeline.add_bytes("decoded_bytes", decoded)
-        timeline.record("decode", "decode", t0, time.perf_counter(),
-                        timeline.current_trace_id(), bytes=decoded)
-        return cols, valid
+        # fetch + decode retried as ONE unit: a torn/short read fails in
+        # the decode, and only re-fetching can heal it
+        def _fetch_decode():
+            if self._legacy is not None:
+                data = self._legacy
+            else:
+                c = self.chunks[i]
+                with timeline.event("blob.read", "blob.read",
+                                    timeline.current_trace_id(),
+                                    bytes=c["len"]):
+                    data = self.store.get_range(
+                        self.blob_id, self._base + c["off"], c["len"])
+            timeline.add_bytes("blob_read_bytes", len(data))
+            t0 = time.perf_counter()
+            cols, valid = _unpack_chunk(data)
+            decoded = sum(a.nbytes for a in cols.values()) + sum(
+                v.nbytes for v in valid.values())
+            timeline.add_bytes("decoded_bytes", decoded)
+            timeline.record("decode", "decode", t0, time.perf_counter(),
+                            timeline.current_trace_id(), bytes=decoded)
+            return cols, valid
+
+        return READ_RETRY.call(_fetch_decode, site="blob.get_range",
+                               retry_on=_TRANSIENT_READ)
 
 
 def read_portion_blob(

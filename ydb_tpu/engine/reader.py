@@ -36,6 +36,7 @@ import numpy as np
 
 from ydb_tpu import dtypes
 from ydb_tpu.blocks.block import TableBlock
+from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.engine.portion import (
     PortionChunkReader,
     PortionMeta,
@@ -520,6 +521,11 @@ def stream_blocks(payloads, names, sch, cap: int,
     def gen():
         emitted = 0
         for cols, valid in pieces:
+            # per-piece cancellation: the conveyor carried the
+            # statement deadline onto the producer thread, so an
+            # expired statement stops staging (the error relays to the
+            # consumer and the worker slot frees)
+            statement_deadline.check_current("stage")
             emitted += 1
             if emitted - 1 < start_block:
                 continue  # checkpoint-resume seek: skips BEFORE staging
@@ -590,6 +596,10 @@ def pump_blocks(blocks, prefetch: bool = True,
         return
     try:
         while True:
+            # consumer-side cancellation: raising here runs the finally
+            # below — stop is set, the queue drains, the producer exits
+            # and its conveyor slot frees (no leaked tasks)
+            statement_deadline.check_current("scan")
             try:
                 kind, payload = q.get(timeout=0.05)
             except queue.Empty:

@@ -45,8 +45,10 @@ import threading
 
 import numpy as np
 
+from ydb_tpu import chaos
 from ydb_tpu.analysis import sanitizer
 from ydb_tpu.blocks.block import Column, TableBlock
+from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.obs import timeline
 from ydb_tpu.obs.probes import probe
 
@@ -207,6 +209,13 @@ class ResidentStore:
         dict (and a heat/LRU touch); any gap -> None (the scan falls
         through to the host path and ``record_miss`` counts the heat)."""
         if not names:
+            return None
+        if chaos.hit("resident.lookup", portion=portion_id) is not None:
+            # injected device-memory fault: served as a miss, so the
+            # scan degrades mid-stream to the staged host path
+            chaos.note_fallback("resident.lookup")
+            with self._lock:
+                self.misses += 1
             return None
         with self._lock:
             self._tick += 1
@@ -374,8 +383,13 @@ class ResidentStore:
         from ydb_tpu.runtime.conveyor import shared_conveyor
 
         try:
-            h = shared_conveyor().submit("resident_promote", task,
-                                         priority=20)
+            # promotions are background work owned by the STORE, not the
+            # statement that triggered them: submit outside the
+            # statement's deadline so a cancelled query can never strand
+            # the _inflight entry (its discard lives in task()'s finally)
+            with statement_deadline.activate(None):
+                h = shared_conveyor().submit("resident_promote", task,
+                                             priority=20)
         except RuntimeError:  # conveyor shut down (tests teardown)
             with self._lock:
                 self._inflight.discard(portion_id)

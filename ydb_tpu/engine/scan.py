@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu import dtypes
+from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.blocks.block import (
     Column,
     TableBlock,
@@ -324,6 +325,9 @@ class ScanExecutor:
                 jax.block_until_ready(window.popleft())
 
         for b in blocks:
+            # block-boundary cancellation point (no-op when the
+            # statement carries no deadline)
+            statement_deadline.check_current("scan")
             with computing():
                 admit(self.run_block(b))
                 if (

@@ -26,7 +26,8 @@ import threading
 
 
 class ResourceExhausted(Exception):
-    pass
+    #: sys_top_queries error_reason tag (admission-plane rejection)
+    reason = "overloaded"
 
 
 class ResourceManager:
@@ -82,7 +83,17 @@ class ResourceManager:
 
 
 class PoolOverloaded(Exception):
-    pass
+    #: sys_top_queries error_reason tag
+    reason = "overloaded"
+
+
+class OverloadedError(Exception):
+    """The cluster shed this statement at admission: past the
+    configured in-flight limit the session layer fails fast with this
+    typed error instead of queueing unboundedly (load shedding — the
+    serving tier's backpressure signal to clients)."""
+
+    reason = "overloaded"
 
 
 class _Pool:

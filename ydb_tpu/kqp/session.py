@@ -214,6 +214,13 @@ class Cluster:
         import threading
 
         self._qid_lock = threading.Lock()
+        # load-shedding limit on concurrently in-flight statements
+        # (0 = unlimited): past it Session.execute fails fast with
+        # OverloadedError instead of queueing unboundedly
+        import os as _os
+
+        self.max_inflight_statements = int(
+            _os.environ.get("YDB_TPU_MAX_INFLIGHT", "0") or 0)
         # registered scalar UDFs: name -> (vectorized fn, result type)
         self.udfs: dict[str, tuple] = {}
         # durable sequence allocator (sequenceshard analog), lazily
@@ -614,6 +621,26 @@ class Cluster:
                     ).counter("shuffle_bytes").set(v)
                 else:
                     g.counter(k).set(v)
+        # chaos telemetry (only when a scenario is armed): per-site
+        # hit/fired counts, fallbacks taken and retry totals, under
+        # component="chaos" so injected faults are auditable on the
+        # same /counters surface as everything else
+        from ydb_tpu import chaos
+
+        cs = chaos.counters_snapshot()
+        if cs:
+            for site, st in cs.get("sites", {}).items():
+                g = self.counters.group(component="chaos", site=site)
+                g.counter("hits").set(st["hits"])
+                g.counter("fired").set(st["fired"])
+            for site, n in cs.get("fallbacks", {}).items():
+                self.counters.group(
+                    component="chaos",
+                    site=site).counter("fallbacks").set(n)
+            for site, n in cs.get("retries", {}).items():
+                self.counters.group(
+                    component="chaos",
+                    site=site).counter("retries").set(n)
         # slow-query watchdog over the in-flight registry
         stats["slow_queries"] = self.check_slow_queries()
         return stats
@@ -1389,9 +1416,24 @@ class Session:
     # disabled — YDB_TPU_PROFILE=0)
     last_profile: object = None
 
-    def execute(self, sql: str, trace_id: int | None = None):
-        """Returns OracleTable for SELECT, TxResult for INSERT, None DDL."""
+    def execute(self, sql: str, trace_id: int | None = None,
+                timeout: float | None = None):
+        """Returns OracleTable for SELECT, TxResult for INSERT, None DDL.
+
+        ``timeout`` is the statement deadline in seconds: it bounds the
+        admission wait AND rides the dispatching thread (and every
+        conveyor task submitted under it) as a
+        :class:`~ydb_tpu.chaos.deadline.Deadline`, so scans, fused
+        dispatches and DQ pumps cancel cooperatively at their block
+        boundaries. Expiry raises ``StatementCancelled`` and the
+        statement lands in ``sys_top_queries`` with ``error=1``,
+        ``error_reason="cancelled"``.
+        """
         import time as _time
+
+        from ydb_tpu import chaos
+        from ydb_tpu.chaos import deadline as _dl
+        from ydb_tpu.kqp.rm import OverloadedError
 
         c = self.cluster
         if c.quoter is not None and not c.quoter.try_acquire(
@@ -1402,6 +1444,25 @@ class Session:
             raise ThrottledError("request rate limit exceeded")
         t0 = _time.monotonic()  # BEFORE admission: queue wait is part
         # of the latency operators observe
+        # load shedding BEFORE the statement enters the registry: past
+        # the configured in-flight limit the cluster fails fast with a
+        # typed error instead of queueing unboundedly. The chaos
+        # "session.admit" site injects the same overload.
+        limit = c.max_inflight_statements
+        shed = limit > 0 and len(c.active_queries) >= limit
+        fault = None if shed else chaos.hit("session.admit")
+        if fault is not None:
+            fault.sleep()
+            shed = shed or fault.kind == "overload"
+        if shed:
+            c.counters.group(kind="overloaded").counter("queries").inc()
+            self._record_rejected(sql, t0, "overloaded")
+            raise OverloadedError(
+                f"statement shed at admission "
+                f"({len(c.active_queries)} in flight, limit {limit})"
+                if limit else "statement shed at admission (injected)")
+        statement_dl = _dl.Deadline(timeout) if timeout is not None \
+            else None
         # the statement enters the live registry BEFORE admission so
         # sys_active_queries shows queued statements too; the finally
         # guarantees it clears even when execution raises
@@ -1413,6 +1474,9 @@ class Session:
                     c._query_seq += 1
                     qid = f"q{c._query_seq}"
             deadline = t0 + 30.0
+            if statement_dl is not None:
+                # the statement deadline caps the admission wait too
+                deadline = min(deadline, statement_dl.at)
             if c.workload is not None:
                 # pool admission: run now or condition-wait our queued
                 # turn
@@ -1422,6 +1486,7 @@ class Session:
                     c.workload.finish(qid)
                     from ydb_tpu.kqp.rm import PoolOverloaded
 
+                    self._record_rejected(sql, t0, "overloaded")
                     raise PoolOverloaded("admission wait timed out")
             if c.rm is not None:
                 # the two planes' limits are independent: a pool-admitted
@@ -1436,11 +1501,13 @@ class Session:
                         if _time.monotonic() > deadline:
                             if c.workload is not None:
                                 c.workload.finish(qid)
+                            self._record_rejected(sql, t0, "overloaded")
                             raise
                         _time.sleep(0.002)
             try:
-                return self._execute_admitted(sql, trace_id, t0,
-                                              active_tok=tok)
+                with _dl.activate(statement_dl):
+                    return self._execute_admitted(sql, trace_id, t0,
+                                                  active_tok=tok)
             finally:
                 if c.rm is not None:
                     c.rm.release(qid)
@@ -1448,6 +1515,24 @@ class Session:
                     c.workload.finish(qid)
         finally:
             c._unregister_active(tok)
+
+    def _record_rejected(self, sql: str, t0: float, reason: str) -> None:
+        """Statements rejected BEFORE execution (shed/admission
+        timeout) still surface in sys_top_queries as typed errors —
+        operators diagnosing an overload need to see WHAT was shed."""
+        import time as _time
+
+        from ydb_tpu.obs import tracing
+
+        if not tracing.profiling_enabled():
+            return
+        from ydb_tpu.obs.profile import QueryProfile
+
+        p = QueryProfile(sql=sql, kind="error", query_class="error",
+                         seconds=_time.monotonic() - t0, error=1,
+                         error_reason=reason)
+        self.last_profile = p
+        self.cluster.profiles.add(p)
 
     def _execute_admitted(self, sql: str, trace_id: int | None = None,
                           t0: float | None = None,
@@ -1510,17 +1595,21 @@ class Session:
                 rows = out.num_rows if isinstance(out, OracleTable) \
                     else 0
                 span.set(seconds=round(seconds, 6), rows=rows)
-        except BaseException:
+        except BaseException as e:
             # statements that fail MID-EXECUTION still land in the
-            # profile ring tagged error=1, so sys_top_queries and the
-            # viewer show them instead of silently dropping the
+            # profile ring tagged error=1 plus a typed reason
+            # ("cancelled" for deadline expiry, "overloaded" for
+            # shedding, else the error type), so sys_top_queries and
+            # the viewer show them instead of silently dropping the
             # evidence (the root span finished with its error attr
             # when the with-block unwound)
             seconds = _time.monotonic() - t0
             c.counters.group(kind="error").counter("queries").inc()
             if prof and span is not None:
+                reason = getattr(type(e), "reason", "") \
+                    or type(e).__name__
                 self._finish_profile(planned, sql, kind, span, seconds,
-                                     0, error=1)
+                                     0, error=1, reason=reason)
             raise
         c._update_active(active_tok, stage="done", rows=rows)
         c.query_log.append({"sql": sql, "kind": kind,
@@ -1547,7 +1636,7 @@ class Session:
 
     def _finish_profile(self, planned, sql: str, kind: str, span,
                         seconds: float, rows: int,
-                        error: int = 0) -> None:
+                        error: int = 0, reason: str = "") -> None:
         """Assemble the statement's QueryProfile from its finished span
         tree; feed last_profile, the profile ring and the per-query-
         class latency histogram (with p50/p99 gauges beside it, the
@@ -1571,6 +1660,7 @@ class Session:
             scoped, sql=sql, kind=kind,
             query_class=qc, seconds=seconds, rows=rows)
         profile.error = error
+        profile.error_reason = reason
         self.last_profile = profile
         c.profiles.add(profile)
         if error:

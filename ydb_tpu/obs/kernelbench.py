@@ -450,6 +450,90 @@ def bench_profile_overhead(sf: float, iters: int, block_rows: int,
     return out
 
 
+def bench_chaos_overhead(sf: float, iters: int, block_rows: int,
+                         assert_within: float | None = None) -> dict:
+    """Warm TPC-H Q1 with the chaos subsystem fully DISARMED (the
+    production state: every injection site is one module-global bool
+    check) vs ARMED with p=0.0 on the hot sites (the dormant-scenario
+    state: per-site lookup + seeded roll, nothing ever fires). The
+    disabled path is the acceptance bound — chaos must be free when
+    off; ``assert_within`` fails the bench when the armed side exceeds
+    disarmed by more than that fraction."""
+    from ydb_tpu import chaos
+    from ydb_tpu.engine.blobs import MemBlobStore
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+    from ydb_tpu.workload import tpch
+
+    data = tpch.TpchData(sf=sf, seed=5)
+    li = data.tables["lineitem"]
+    n = len(li["l_orderkey"])
+    shard = ColumnShard(
+        "chaosov", tpch.LINEITEM_SCHEMA, MemBlobStore(),
+        dicts=data.dicts,
+        config=ShardConfig(compact_portion_threshold=10 ** 9,
+                           scan_block_rows=block_rows,
+                           portion_chunk_rows=1 << 16))
+    shard.commit([shard.write(dict(li))])
+    prog = tpch.q1_program()
+
+    # p=0.0 on every site the Q1 scan crosses: the armed side pays the
+    # full lookup+roll machinery without a single fault firing (a
+    # fired fault would change WHAT runs, not how fast the gate is)
+    dormant = chaos.Scenario(seed=7, sites={
+        "blob.get": {"kind": "io_error", "p": 0.0},
+        "blob.get_range": {"kind": "io_error", "p": 0.0},
+        "conveyor.task": {"kind": "delay", "p": 0.0},
+    })
+
+    def run_off():
+        return shard.scan(prog)
+
+    def run_armed():
+        chaos.install(dormant)
+        try:
+            return shard.scan(prog)
+        finally:
+            chaos.clear()
+
+    prev_force = chaos.CHAOS_FORCE
+    try:
+        chaos.CHAOS_FORCE = None
+        chaos.clear()  # disarm + zero counters from any earlier run
+        run_off()  # warm: compile + scan-cache fill, shared by both
+        if chaos.counters_snapshot().get("sites"):
+            raise AssertionError(
+                "chaos sites counted hits on the disarmed path")
+        chaos.CHAOS_FORCE = True  # open the gate for install()
+        run_armed()
+        best = {"off": float("inf"), "armed": float("inf")}
+        # interleave the sides so host drift hits both equally
+        for _ in range(max(1, iters)):
+            for label, fn in (("off", run_off), ("armed", run_armed)):
+                t0 = time.perf_counter()
+                fn()
+                best[label] = min(best[label],
+                                  time.perf_counter() - t0)
+    finally:
+        chaos.clear()
+        chaos.CHAOS_FORCE = prev_force
+    out = {
+        "rows": n, "sf": sf,
+        "chaos_off_seconds": round(best["off"], 6),
+        "chaos_armed_seconds": round(best["armed"], 6),
+        "chaos_off_rows_per_sec": round(n / best["off"]),
+        "chaos_armed_rows_per_sec": round(n / best["armed"]),
+        "overhead_pct": round(
+            100 * (best["armed"] / best["off"] - 1), 2),
+    }
+    if assert_within is not None:
+        if best["armed"] > best["off"] * (1 + assert_within):
+            raise AssertionError(
+                f"chaos armed overhead {out['overhead_pct']}% exceeds "
+                f"the {assert_within * 100:g}% budget")
+        out["within_budget"] = True
+    return out
+
+
 def bench_fusion(sf: float, iters: int) -> dict:
     """Whole-plan fusion A/B: TPC-H Q3 (semi + inner join feeding a
     grouped two-phase-aggregate top-k) executed fused — one
@@ -696,6 +780,8 @@ def main(argv=None) -> int:
                     help="HBM-resident vs staged warm scan A/B")
     ap.add_argument("--profile-overhead", action="store_true",
                     help="profiling on-vs-off warm Q1 A/B micro-bench")
+    ap.add_argument("--chaos-overhead", action="store_true",
+                    help="chaos disarmed vs armed-dormant warm Q1 A/B")
     ap.add_argument("--fusion", action="store_true",
                     help="whole-plan fused vs per-fragment warm Q3 A/B")
     ap.add_argument("--shuffle", action="store_true",
@@ -738,6 +824,12 @@ def main(argv=None) -> int:
         report["profile_overhead"] = bench_profile_overhead(
             args.sf, max(3, args.iters), args.block_rows,
             assert_within=(0.5 if args.smoke else None))
+    if args.chaos_overhead or args.smoke:
+        # smoke: tiny run, lax bound (machinery + no-catastrophe
+        # guard); real sizes hold the 1% disabled-path budget
+        report["chaos_overhead"] = bench_chaos_overhead(
+            args.sf, max(3, args.iters), args.block_rows,
+            assert_within=(0.5 if args.smoke else 0.01))
     if args.fusion or args.smoke:
         report["fusion"] = bench_fusion(args.sf, max(3, args.iters))
     if args.shuffle or args.smoke:
@@ -781,6 +873,12 @@ def main(argv=None) -> int:
                   f"{po['timeline_overhead_pct']:+.2f}% "
                   f"(disabled events="
                   f"{po['timeline_disabled_events']})")
+        if "chaos_overhead" in report:
+            co = report["chaos_overhead"]
+            print(f"chaos overhead rows={co['rows']}: armed "
+                  f"{co['chaos_armed_rows_per_sec']:,} rows/s vs off "
+                  f"{co['chaos_off_rows_per_sec']:,} rows/s "
+                  f"({co['overhead_pct']:+.2f}%)")
         if "fusion" in report:
             fu = report["fusion"]
             print(f"fusion rows={fu['rows']}: fused "

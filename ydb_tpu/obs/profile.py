@@ -60,6 +60,9 @@ class QueryProfile:
     #: 1 when the statement failed mid-execution (the profile still
     #: lands in the ring so slow-then-failing statements stay visible)
     error: int = 0
+    #: why it failed: "cancelled" (deadline), "overloaded" (admission
+    #: shed), else the error type name; "" on success
+    error_reason: str = ""
     spans: list = dataclasses.field(default_factory=list)
 
     def to_dict(self, include_spans: bool = False) -> dict:

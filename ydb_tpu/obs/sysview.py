@@ -80,7 +80,8 @@ SYS_SCHEMAS = {
         ("portions_skipped", dtypes.INT64),
         ("chunks_read", dtypes.INT64),
         ("chunks_skipped", dtypes.INT64),
-        ("error", dtypes.INT32)),
+        ("error", dtypes.INT32),
+        ("error_reason", dtypes.STRING)),
     # HBM-resident column tier (engine/resident.py): per-shard pinned
     # bytes vs budget plus promotion/eviction/spill lifecycle counters
     # — the "is the hot set actually resident" dashboard
@@ -291,7 +292,7 @@ def _scan_pruning_rows(cluster):
 
 
 def _top_queries_rows(cluster):
-    cols: list[list] = [[] for _ in range(18)]
+    cols: list[list] = [[] for _ in range(19)]
     for rank, p in enumerate(cluster.profiles.top(16), start=1):
         st = p.stages
         pr = p.pruning
@@ -301,7 +302,8 @@ def _top_queries_rows(cluster):
                st.get("read", 0.0), st.get("merge", 0.0),
                st.get("stage", 0.0), st.get("compute", 0.0),
                pr.get("portions_skipped", 0), pr.get("chunks_read", 0),
-               pr.get("chunks_skipped", 0), getattr(p, "error", 0)]
+               pr.get("chunks_skipped", 0), getattr(p, "error", 0),
+               getattr(p, "error_reason", "")]
         for c, v in zip(cols, row):
             c.append(v)
     return cols

@@ -31,8 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ydb_tpu import chaos
 from ydb_tpu.blocks.block import Column, TableBlock
 from ydb_tpu.blocks.dictionary import DictionarySet
+from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.engine.oracle import OracleTable
 from ydb_tpu.engine.scan import ColumnSource, ScanExecutor
 from ydb_tpu.parallel.dist import (
@@ -137,6 +139,18 @@ def device_partitions(sources: list, n: int, schema, dicts) -> list:
     return out
 
 
+def _chaos_dispatch(n_devices: int) -> None:
+    """``mesh.dispatch`` injection site: 'device_lost' raises
+    :class:`chaos.DeviceLostError`, which the plan executor's fallback
+    chain turns into single-chip execution (fused, then the walk)."""
+    fault = chaos.hit("mesh.dispatch", devices=n_devices)
+    if fault is not None:
+        fault.sleep()
+        if fault.kind == "device_lost":
+            raise chaos.DeviceLostError(
+                f"injected device loss on the {n_devices}-device mesh")
+
+
 class MeshPlanExecutor:
     """Executes a logical plan tree SPMD over the mesh."""
 
@@ -149,6 +163,7 @@ class MeshPlanExecutor:
     # ---- node execution (stacked, device-sharded results) ----
 
     def execute(self, plan) -> OracleTable:
+        _chaos_dispatch(self.n)
         out = self._exec(plan, {}, root=True)
         return OracleTable.from_block(out)
 
@@ -188,6 +203,12 @@ class MeshPlanExecutor:
             grows0 = fused.shuffle_grows
             inputs = self._stage_fused(fused)
             while True:
+                # cancellation + device-loss points between dispatches:
+                # a statement past its deadline stops HERE (the fused
+                # computation itself is uninterruptible), and an
+                # injected device loss degrades to the single-chip path
+                statement_deadline.check_current("mesh dispatch")
+                _chaos_dispatch(self.n)
                 out, totals = fused.run(inputs)
                 over = fused.overflowed(totals)
                 if not over:

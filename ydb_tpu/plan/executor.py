@@ -23,8 +23,9 @@ import os
 import jax
 import numpy as np
 
-from ydb_tpu import dtypes
+from ydb_tpu import chaos, dtypes
 from ydb_tpu.analysis.verify import check_program
+from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.blocks.block import TableBlock, concat_blocks, device_aux
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.oracle import OracleTable
@@ -156,14 +157,21 @@ def _execute_plan_mesh(plan: PlanNode, db: Database):
     # sharded whole-plan fusion first (parallel/mesh_fuse): one jitted
     # donated-buffer dispatch over the mesh; the per-node walk remains
     # the fallback for shapes that don't mesh-fuse
-    fused = getattr(mex, "execute_fused", None)
-    if fused is not None:
-        out = fused(plan)
-        if out is not None:
-            return out
     try:
+        fused = getattr(mex, "execute_fused", None)
+        if fused is not None:
+            out = fused(plan)
+            if out is not None:
+                return out
         return mex.execute(plan)
     except NotImplementedError:
+        return None
+    except chaos.DeviceLostError:
+        # graceful degradation: a lost device fails THIS dispatch, not
+        # the statement — single-chip fused execution (then the walk)
+        # picks the plan up, bit-identical
+        chaos.note_fallback("mesh.dispatch")
+        tracing.annotate(mesh_fallback=1)
         return None
 
 
@@ -221,6 +229,11 @@ def _execute_plan_dq(plan: PlanNode, db: Database) -> TableBlock | None:
         sp.set(stages=len(stages), tasks=_DQ_TASKS)
         handle.start()
         rt.run()
+    err = handle.collector.error
+    if err is not None and "deadline" in err:
+        # the graph aborted on statement-deadline expiry: surface the
+        # typed cancellation, not a generic incompletion
+        raise statement_deadline.StatementCancelled(err)
     if not handle.collector.done:
         raise RuntimeError("DQ stage graph did not complete")
     return handle.collector.result_block()
@@ -481,6 +494,9 @@ def _run_fused(fused, db: Database, fsp) -> TableBlock:
         # rows read before the dispatch: donated inputs are dead after
         rows = int(blk.length) if want_stats else 0
         while True:
+            # cooperative cancellation between (uninterruptible) fused
+            # dispatches: a statement past its deadline stops here
+            statement_deadline.check_current("fused dispatch")
             computing = (timer.stage("compute") if timer is not None
                          else contextlib.nullcontext())
             with computing:
@@ -511,6 +527,11 @@ def _execute_plan_fused(plan: PlanNode, db: Database) -> TableBlock | None:
 
     sig = plan_fuse.plan_signature(plan, db)
     if sig is None or not sig.sites:
+        return None
+    if chaos.hit("fuse.trace") is not None:
+        # injected trace failure: the fused path declines the plan and
+        # the per-node walk answers, bit-identical
+        chaos.note_fallback("fuse.trace")
         return None
     key = sig.cache_key(db)
     fused = db._compile_cache.get(key)

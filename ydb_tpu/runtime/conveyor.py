@@ -25,7 +25,9 @@ import os
 import threading
 import time
 
+from ydb_tpu import chaos
 from ydb_tpu.analysis import sanitizer
+from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.obs import timeline, tracing
 
 #: queue-wait samples retained per queue between ``queue_stats``
@@ -71,6 +73,13 @@ class _Cancelled(BaseException):
     """Task admitted during shutdown: surfaced through the handle."""
 
 
+class ConveyorTimeout(TimeoutError):
+    """Typed conveyor timeout: a handle wait that ran out of time, a
+    broker slot not granted within the task's deadline, or wait_idle
+    expiring (with the still-busy queues named). Callers can now tell
+    'timed out' from 'task legitimately returned None'."""
+
+
 class ResourceBroker:
     """Concurrency quotas per task queue under one total (the resource
     broker's queue configuration, resource_broker.h)."""
@@ -82,18 +91,34 @@ class ResourceBroker:
         self._running = sanitizer.share(
             {}, f"broker.{id(self):x}.running")
         self._all = 0
+        self.rejected_deadline = 0  # guarded by _lock
         self._lock = sanitizer.make_lock(f"broker.{id(self):x}.lock")
         # a Condition over the tracked lock: wait/notify release and
         # re-acquire through it, so the held-set stays exact under TSAN
         self._freed = threading.Condition(self._lock)
 
     def acquire(self, queue: str,
-                stop: threading.Event | None = None) -> None:
+                stop: threading.Event | None = None,
+                deadline: "statement_deadline.Deadline | None" = None
+                ) -> None:
+        """Wait for a slot. ``deadline`` bounds the wait: a task whose
+        statement budget expires while queued for admission raises
+        :class:`ConveyorTimeout` instead of holding the admission path
+        (chaos-delayed tasks can otherwise wedge a quota forever)."""
         with self._freed:
             while not self._may_run(queue):
                 if stop is not None and stop.is_set():
                     raise _Cancelled()
-                self._freed.wait(timeout=0.1)
+                if deadline is not None:
+                    remaining = deadline.remaining()
+                    if remaining <= 0.0:
+                        self.rejected_deadline += 1
+                        raise ConveyorTimeout(
+                            f"broker slot for {queue!r} not granted "
+                            "within the task deadline")
+                    self._freed.wait(timeout=min(remaining, 0.1))
+                else:
+                    self._freed.wait(timeout=0.1)
             self._running[queue] = self._running.get(queue, 0) + 1
             self._all += 1
 
@@ -116,10 +141,15 @@ class TaskHandle:
     done: threading.Event
     result: object = None
     error: BaseException | None = None
+    #: statement deadline captured at submit (None = unbounded); bounds
+    #: the broker admission wait on the worker
+    deadline: object = None
 
     def wait(self, timeout: float | None = None):
         if not self.done.wait(timeout):
-            raise TimeoutError(f"background task ({self.queue}) pending")
+            raise ConveyorTimeout(
+                f"background task ({self.queue}) pending after "
+                f"{timeout}s")
         if self.error is not None:
             raise self.error
         return self.result
@@ -152,6 +182,9 @@ class Conveyor:
         self._stopping = False
         self._stop_event = threading.Event()
         self._active = 0
+        # per-queue running-task counts (guarded by _cv): lets wait_idle
+        # name the queues that were still busy when it gave up
+        self._active_q: dict[str, int] = {}
         self._threads = [
             threading.Thread(target=self._worker, daemon=True)
             for _ in range(workers)
@@ -161,11 +194,14 @@ class Conveyor:
 
     def submit(self, queue: str, fn, *args, priority: int = 10,
                **kwargs) -> TaskHandle:
-        # the submitter's active trace span follows the task onto the
-        # worker thread (scan prefetch producers record under the
-        # query's trace id); no-op when no trace is active
+        # the submitter's active trace span AND statement deadline follow
+        # the task onto the worker thread (scan prefetch producers record
+        # under the query's trace id and observe its cancellation);
+        # no-ops when neither is active
         fn = tracing.wrap_current(fn)
-        h = TaskHandle(queue, threading.Event())
+        fn = statement_deadline.wrap_current(fn)
+        h = TaskHandle(queue, threading.Event(),
+                       deadline=statement_deadline.current())
         with self._cv:
             if self._stopping:
                 raise RuntimeError("conveyor is shut down")
@@ -188,12 +224,14 @@ class Conveyor:
         on a queued producer would starve — callers degrade to a
         synchronous path instead."""
         fn = tracing.wrap_current(fn)  # trace follows the producer
+        fn = statement_deadline.wrap_current(fn)  # so does the deadline
         with self._cv:
             if (self._stopping or self._heap
                     or self._active >= len(self._threads)):
                 self._rejected += 1
                 return None
-            h = TaskHandle(queue, threading.Event())
+            h = TaskHandle(queue, threading.Event(),
+                           deadline=statement_deadline.current())
             sanitizer.note(self._heap_tok, "heappush")
             heapq.heappush(
                 self._heap,
@@ -228,6 +266,7 @@ class Conveyor:
                 "submitted": self._submitted,
                 "completed": self._completed,
                 "rejected": self._rejected,
+                "rejected_deadline": self.broker.rejected_deadline,
                 "depth": len(self._heap),
                 "active": self._active,
                 "workers": len(self._threads),
@@ -248,6 +287,7 @@ class Conveyor:
                 _, _, queue, fn, args, kwargs, h, t_sub = heapq.heappop(
                     self._heap)
                 self._active += 1
+                self._active_q[queue] = self._active_q.get(queue, 0) + 1
                 t_pop = time.perf_counter()
                 ws = self._waits.get(queue)
                 if ws is None:
@@ -259,19 +299,35 @@ class Conveyor:
                 timeline.RING.record(
                     f"{queue}.wait", "conveyor.wait", t_sub, t_pop,
                     args={"queue": queue})
+            die = False
             try:
                 try:
                     # stop-aware gates: shutdown() while the controller
                     # is stalled (or a quota is exhausted) cancels the
-                    # popped task instead of wedging the worker
+                    # popped task instead of wedging the worker; an
+                    # expired task deadline bounds the broker wait
                     self.controller._admit(self._stop_event)
-                    self.broker.acquire(queue, self._stop_event)
+                    self.broker.acquire(queue, self._stop_event,
+                                        deadline=h.deadline)
                 except _Cancelled:
                     h.error = RuntimeError(
                         "conveyor shut down before the task ran")
                     continue
+                except ConveyorTimeout as e:
+                    h.error = e  # slot never granted: nothing to release
+                    continue
                 t_run = time.perf_counter() if tl else t_pop
                 try:
+                    fault = chaos.hit("conveyor.task", queue=queue)
+                    if fault is not None:
+                        fault.sleep()  # 'delay' faults are just this
+                        if fault.kind == "drop":
+                            raise chaos.ChaosError(
+                                f"injected task drop (queue={queue})")
+                        if fault.kind == "worker_death":
+                            die = True
+                            raise chaos.ChaosError(
+                                f"injected worker death (queue={queue})")
                     h.result = fn(*args, **kwargs)
                 except BaseException as e:  # surfaced via handle.wait()
                     h.error = e
@@ -286,8 +342,14 @@ class Conveyor:
                 h.done.set()
                 with self._cv:
                     self._active -= 1
+                    self._active_q[queue] -= 1
                     self._completed += 1
                     self._cv.notify_all()
+            if die:
+                # the injected death kills THIS thread; the pool heals
+                # by spawning a replacement before it exits
+                self._respawn()
+                return
 
     def wait_idle(self, timeout: float = 30.0) -> None:
         deadline = threading.Event()
@@ -298,9 +360,31 @@ class Conveyor:
                 while (self._heap or self._active) and not deadline.is_set():
                     self._cv.wait(timeout=0.05)
                 if self._heap or self._active:
-                    raise TimeoutError("conveyor busy")
+                    # name the stuck queues: queued items still in the
+                    # heap plus tasks running right now
+                    busy = sorted(
+                        {item[2] for item in self._heap}
+                        | {q for q, n in self._active_q.items() if n})
+                    raise ConveyorTimeout(
+                        f"conveyor busy after {timeout}s: "
+                        f"queues={busy}")
         finally:
             t.cancel()
+
+    def _respawn(self) -> None:
+        """Replace the calling (dying) worker thread so injected worker
+        deaths never shrink the pool."""
+        cur = threading.current_thread()
+        with self._cv:
+            if self._stopping:
+                return
+            t = threading.Thread(target=self._worker, daemon=True)
+            try:
+                self._threads.remove(cur)
+            except ValueError:
+                pass
+            self._threads.append(t)
+        t.start()
 
     def shutdown(self, wait: bool = True) -> None:
         with self._cv:

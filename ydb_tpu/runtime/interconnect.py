@@ -38,7 +38,9 @@ import threading
 import time
 from typing import Any
 
+from ydb_tpu import chaos
 from ydb_tpu.analysis import sanitizer
+from ydb_tpu.chaos.retry import RetryPolicy
 from ydb_tpu.runtime.actors import ActorSystem, Envelope
 
 _HDR = struct.Struct("!I")
@@ -151,6 +153,15 @@ class _Session:
             if self._closed.is_set():
                 break
             try:
+                # chaos: 'delay' sleeps on THIS sender thread (reorder-
+                # safe — one thread drains the queue in order),
+                # 'disconnect' forces the reconnect+retry path below
+                fault = chaos.hit("interconnect.send",
+                                  peer=self.peer_node)
+                if fault is not None:
+                    fault.sleep()
+                    if fault.kind == "disconnect":
+                        raise OSError("injected peer disconnect")
                 sock = self._ensure_sock()
                 _send_frame(sock, ("env", env.target, env.sender,
                                    env.message))
@@ -167,7 +178,8 @@ class _Session:
                 if attempt >= self.ic.max_retries:
                     self.ic._notify_undelivered(env, str(e))
                     return
-                time.sleep(self.ic.retry_delay * (attempt + 1))
+                chaos.note_retry("interconnect.send", attempt + 1, e)
+                time.sleep(self.ic.retry_policy.delay(attempt))
         self.ic._notify_undelivered(env, "session closed")
 
     def _ensure_sock(self) -> socket.socket:
@@ -247,6 +259,12 @@ class Interconnect:
         self.timeout = timeout
         self.max_retries = max_retries
         self.retry_delay = retry_delay
+        # shared backoff shape (exponential + jitter) for the sender
+        # retry loop; the loop stays hand-rolled because reconnect
+        # state (drop/redial) lives between attempts
+        self.retry_policy = RetryPolicy(
+            max_attempts=max_retries + 1, base_delay=retry_delay,
+            max_delay=max(4 * retry_delay, retry_delay))
         # session map is sanitizer-tracked under YDB_TPU_TSAN=1: the
         # actor loop, reader threads (reverse-route add_peer) and
         # close() all touch it
