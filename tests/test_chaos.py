@@ -463,11 +463,21 @@ def test_acceptance_scenario_q1_q3_q6():
     their deadline — TPC-H Q1/Q3/Q6 complete, surviving queries
     bit-identical to fault-free, every cancelled statement surfacing a
     typed error in sys_top_queries, and no leaked conveyor tasks or
-    resident-promotion flights afterwards."""
+    resident-promotion flights afterwards. The whole scenario runs
+    under the leak sanitizer: every seeded fault + cancellation must
+    ALSO drain every tracked handle kind to zero (PR 13's invariant)."""
     from test_sql import Q1_SQL, Q3_SQL, Q6_SQL
 
+    from ydb_tpu.analysis import leaksan
     from ydb_tpu.engine import resident as resident_mod
 
+    with leaksan.activate():
+        _acceptance_scenario(Q1_SQL, Q3_SQL, Q6_SQL, resident_mod,
+                             leaksan)
+
+
+def _acceptance_scenario(Q1_SQL, Q3_SQL, Q6_SQL, resident_mod,
+                         leaksan):
     c, s = _tpch_cluster()
     queries = {"q1": Q1_SQL, "q3": Q3_SQL, "q6": Q6_SQL}
     want = {name: s.execute(sql) for name, sql in queries.items()}
@@ -528,3 +538,10 @@ def test_acceptance_scenario_q1_q3_q6():
         assert promoted > 0
     finally:
         resident_mod.RESIDENT_FORCE = prev_res
+    # the closing invariant: after faults, cancellations, device loss
+    # and async promotions, EVERY tracked resource kind has drained —
+    # conveyor tasks, broker slots, resident/blockcache flights,
+    # session registry rows, rm grants, spilled blobs
+    shared_conveyor().wait_idle(timeout=30.0)
+    assert leaksan.counts() == {}, leaksan.counts()
+    leaksan.assert_drained(where="chaos acceptance scenario")

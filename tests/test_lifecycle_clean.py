@@ -1,0 +1,30 @@
+"""Tier-1 enforcement: the resource-lifecycle analyzer runs clean over
+the whole ydb_tpu package (the analog of test_concurrency_clean for
+C-rules). A finding here means a code change introduced an
+acquire/release pairing hazard — fix the code or, for a reviewed false
+positive, add a ``# ydb-lint: disable=R00x`` pragma with a comment
+saying why."""
+
+from pathlib import Path
+
+from ydb_tpu.analysis import lifecycle
+from ydb_tpu.analysis.paths import collect_files
+
+PKG = Path(lifecycle.__file__).resolve().parents[1]
+
+
+def test_lifecycle_clean_tree_wide():
+    findings = lifecycle.check_paths(collect_files([PKG]))
+    msg = "\n".join(f.render() for f in findings)
+    assert findings == [], f"lifecycle findings:\n{msg}"
+
+
+def test_unified_entrypoint_clean_tree_wide():
+    """The one-command surface (python -m ydb_tpu.analysis) CI invokes
+    must agree: every stage clean over the package."""
+    from ydb_tpu.analysis.__main__ import run_all
+
+    stages = run_all([PKG])
+    assert set(stages) == {"verify", "lint", "concurrency", "lifecycle"}
+    bad = {k: v for k, v in stages.items() if v}
+    assert not bad, f"unified analyzer findings: {bad}"

@@ -1,6 +1,6 @@
-"""Static analysis: SSA verification + trace-safety lint + concurrency.
+"""Static analysis: SSA verification, lint, concurrency, lifecycle.
 
-Three pillars (README.md in this directory):
+Four pillars (README.md in this directory):
   * ``verify`` — the typed SSA program checker every SQL→SSA lowering
     passes through before any JAX trace (the TProgramContainer::Init
     analog, ydb/core/tx/program/program.cpp:553).
@@ -14,10 +14,19 @@ Three pillars (README.md in this directory):
     Eraser-style runtime race detector for the designated shared
     structures (``YDB_TPU_TSAN=1``).
     ``python -m ydb_tpu.analysis.concurrency``.
+  * ``lifecycle`` + ``leaksan`` — acquire/release pairing discipline
+    over every slot, flight, gauge and handle the runtime hands out
+    (R001-R008: release not in finally, flights stranded across
+    yields/submits, grow-only containers, unreachable stop paths, ...)
+    plus a runtime leak sanitizer (``YDB_TPU_LEAKSAN=1``) whose
+    tracked handles must drain to zero at statement completion and
+    Cluster.stop. ``python -m ydb_tpu.analysis.lifecycle``.
 
-``sanitizer`` keeps a bare dependency set (os + threading) so the
-low-level runtime modules (conveyor, probes, counters, blockcache)
-can import it safely: ``from ydb_tpu.analysis import sanitizer``.
+``python -m ydb_tpu.analysis`` runs all four and exits 1 on any
+finding. ``sanitizer`` and ``leaksan`` keep a bare dependency set
+(os + threading + traceback) so the low-level runtime modules
+(conveyor, probes, counters, blockcache) can import them safely:
+``from ydb_tpu.analysis import leaksan``.
 """
 
 from ydb_tpu.analysis.diagnostics import (  # noqa: F401

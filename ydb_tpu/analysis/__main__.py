@@ -1,0 +1,99 @@
+"""Unified analyzer entrypoint: every pillar, one command.
+
+    python -m ydb_tpu.analysis [path ...] [--json] [--changed]
+
+Runs the four static pillars in order over a single shared CLI surface
+(``paths.py`` collection + ``suppress.py`` pragmas):
+
+  verify       SSA program checker self-test — the one pillar that
+               checks programs, not files, so here it proves the
+               checker itself is alive: a clean program must produce
+               zero diagnostics and a known-bad one must be rejected
+  lint         L-rules (jit hazards)            — lint.py
+  concurrency  C-rules (lock/guard discipline)  — concurrency.py
+  lifecycle    R-rules (acquire/release pairing) — lifecycle.py
+
+Exit status 1 when ANY stage reports findings, so CI and builders
+invoke exactly one command. Per-tool runs stay available
+(``python -m ydb_tpu.analysis.lint`` etc.) for focused iteration.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from ydb_tpu.analysis import concurrency, lifecycle, lint
+from ydb_tpu.analysis.paths import collect_files, parse_cli
+
+
+def _verify_selftest() -> list:
+    """Prove the SSA verifier accepts a clean program and rejects a
+    defective one. Returns findings-shaped dicts (file/line/col/code/
+    name/message) so the JSON surface matches the AST checkers."""
+    from ydb_tpu import dtypes
+    from ydb_tpu.analysis.verify import verify_program
+    from ydb_tpu.ssa import AssignStep, Call, Col, Op, Program
+    from ydb_tpu.ssa.program import lit
+
+    sch = dtypes.schema(("a", dtypes.INT64, False))
+    clean = Program((
+        AssignStep("c", Call(Op.ADD, Col("a"), lit(1))),
+    ))
+    bad = Program((
+        AssignStep("c", Call(Op.ADD, Col("nope"), lit(1))),
+    ))
+    out = []
+    diags = verify_program(clean, sch)
+    if diags:
+        out.append({
+            "file": "<verify-selftest>", "line": 0, "col": 0,
+            "code": "V900", "name": "verify-selftest",
+            "message": "clean program rejected: "
+                       + "; ".join(d.code for d in diags),
+        })
+    if not verify_program(bad, sch):
+        out.append({
+            "file": "<verify-selftest>", "line": 0, "col": 0,
+            "code": "V901", "name": "verify-selftest",
+            "message": "defective program (unknown column) accepted",
+        })
+    return out
+
+
+def run_all(paths=(), changed: bool = False) -> dict:
+    """All four pillars over one collected file list. Returns
+    ``{stage: [finding dict, ...]}`` in run order."""
+    files = collect_files(list(paths), changed=changed)
+    lint_findings: list = []
+    for p in files:
+        lint_findings.extend(
+            lint.lint_source(p.read_text(encoding="utf-8"), str(p)))
+    return {
+        "verify": _verify_selftest(),
+        "lint": [f.to_dict() for f in lint_findings],
+        "concurrency": [f.to_dict()
+                        for f in concurrency.check_paths(files)],
+        "lifecycle": [f.to_dict()
+                      for f in lifecycle.check_paths(files)],
+    }
+
+
+def main(argv=None) -> int:
+    paths, as_json, changed = parse_cli(argv)
+    stages = run_all(paths, changed=changed)
+    total = sum(len(v) for v in stages.values())
+    if as_json:
+        print(json.dumps(stages, indent=2))
+        return 1 if total else 0
+    for stage, findings in stages.items():
+        for f in findings:
+            print(f"{f['file']}:{f['line']}:{f['col']}: "
+                  f"{f['code']} [{f['name']}] {f['message']}")
+        print(f"{stage}: {len(findings)} finding(s)")
+    print(f"total: {total} finding(s)")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -815,6 +815,15 @@ class GraphHandle:
             aid = self.actor_of_task[t.task_id]
             sys_by_node[aid.node].send(aid, StartTask())
 
+    def close(self):
+        """Release per-task resources once the graph is finished or
+        abandoned. Spillers hold blobs that only ``get`` deletes, so a
+        graph torn down with parked/accumulated ids (abort, deadline
+        cancellation) must close them here or the blobs leak for the
+        store's lifetime. Idempotent."""
+        for a in self.actors:
+            a.spiller.close()
+
 
 def build_stage_graph(
     stages: list[StageSpec],
@@ -910,16 +919,19 @@ def run_stage_graph(
         stages, sources, runtime, dicts, key_spaces, spill_quota_bytes,
         window, checkpoint_storage, restore_checkpoint, block_rows,
         compile_cache)
-    handle.start()
-    if hasattr(runtime, "dispatch"):
-        runtime.dispatch()
-    else:
-        runtime.run()
-    err = handle.collector.error
-    if err is not None and "deadline" in err:
-        from ydb_tpu.chaos.deadline import StatementCancelled
+    try:
+        handle.start()
+        if hasattr(runtime, "dispatch"):
+            runtime.dispatch()
+        else:
+            runtime.run()
+        err = handle.collector.error
+        if err is not None and "deadline" in err:
+            from ydb_tpu.chaos.deadline import StatementCancelled
 
-        raise StatementCancelled(err)
-    if not handle.collector.done:
-        raise RuntimeError("stage graph did not complete")
-    return handle.collector.table()
+            raise StatementCancelled(err)
+        if not handle.collector.done:
+            raise RuntimeError("stage graph did not complete")
+        return handle.collector.table()
+    finally:
+        handle.close()

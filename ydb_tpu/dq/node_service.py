@@ -104,7 +104,10 @@ class DqNodeService(Actor):
 
     def __init__(self, interconnect=None):
         super().__init__()
-        self._queries: dict[str, list[ActorId]] = {}
+        # query id -> [(actor id, actor)]: the actor ref is kept so
+        # ReleaseQuery can close each task's spiller — stopping the
+        # actor alone strands its spilled blobs in the store
+        self._queries: dict[str, list[tuple[ActorId, object]]] = {}
         self.interconnect = interconnect
         # compiled stages repeat across queries (prepared statements):
         # memoize like the executer side does
@@ -116,8 +119,9 @@ class DqNodeService(Actor):
         if isinstance(message, StartTasks):
             self._start(message, sender)
         elif isinstance(message, ReleaseQuery):
-            for aid in self._queries.pop(message.query_id, []):
+            for aid, actor in self._queries.pop(message.query_id, []):
                 self.system.stop(aid)
+                actor.spiller.close()
         elif isinstance(message, Ping):
             pass  # liveness: delivery (vs Undelivered) is the signal
         elif isinstance(message, Undelivered):
@@ -138,7 +142,7 @@ class DqNodeService(Actor):
                                   compile_cache=self._compile_cache)
         chan_by_id = {c.channel_id: c for c in req.channels}
         out: dict[int, ActorId] = {}
-        mine: list[ActorId] = []
+        mine: list[tuple[ActorId, object]] = []
         for t in req.tasks:
             srcs = task_partitions(req.sources or {}, t)
             a = ComputeActor(
@@ -150,7 +154,7 @@ class DqNodeService(Actor):
             )
             aid = self.system.register(a)
             out[t.task_id] = aid
-            mine.append(aid)
+            mine.append((aid, a))
         self._queries[req.query_id] = mine
         self.send(req.reply_to if req.reply_to is not None else sender,
                   TasksStarted(req.query_id, out))
@@ -329,6 +333,7 @@ class DistExecuter:
                 self.system.send(self.services[node], ReleaseQuery(qid))
             for a in local_actors:
                 self.system.stop(a.self_id)
+                a.spiller.close()
             self.system.stop(collector_id)
             self.system.stop(gather_id)
             self._pump()

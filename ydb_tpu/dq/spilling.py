@@ -15,6 +15,7 @@ import itertools
 
 import numpy as np
 
+from ydb_tpu.analysis import leaksan
 from ydb_tpu.engine.blobs import BlobStore, MemBlobStore
 
 
@@ -43,6 +44,8 @@ class Spiller:
         self._spilled: set[int] = set()
         self._mem_bytes = 0
         self.spill_count = 0
+        # leak-sanitizer handle per live spilled blob; empty when off
+        self._leaks: dict[int, object] = {}
 
     @staticmethod
     def _size(payload: dict[str, np.ndarray]) -> int:
@@ -55,6 +58,9 @@ class Spiller:
             self.store.put(f"{self.prefix}/{sid}", _encode(payload))
             self._spilled.add(sid)
             self.spill_count += 1
+            lk = leaksan.track("dq.spill", f"{self.prefix}/{sid}")
+            if lk is not None:
+                self._leaks[sid] = lk
         else:
             self._mem[sid] = payload
             self._mem_bytes += size
@@ -78,5 +84,25 @@ class Spiller:
             self._spilled.discard(sid)
             raw = self.store.get(f"{self.prefix}/{sid}")
             self.store.delete(f"{self.prefix}/{sid}")
+            if self._leaks:
+                leaksan.close(self._leaks.pop(sid, None))
             return _decode(raw)
         raise KeyError(sid)
+
+    def close(self) -> None:
+        """Delete every blob still spilled and drop buffered payloads.
+
+        Before this, the spiller had no teardown at all: a query
+        aborted (peer death, deadline cancellation) with parked or
+        accumulated block ids left its spill blobs in the store
+        forever — only ``get`` deleted them (lifecycle R007 / the
+        ``dq.spill`` leak-sanitizer kind). Idempotent; the spiller is
+        unusable for those ids afterwards, which is fine — it is
+        per-task and the task is gone."""
+        for sid in self._spilled:
+            self.store.delete(f"{self.prefix}/{sid}")
+            if self._leaks:
+                leaksan.close(self._leaks.pop(sid, None))
+        self._spilled.clear()
+        self._mem.clear()
+        self._mem_bytes = 0

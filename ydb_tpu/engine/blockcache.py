@@ -19,7 +19,7 @@ from __future__ import annotations
 import collections
 import os
 
-from ydb_tpu.analysis import sanitizer
+from ydb_tpu.analysis import leaksan, sanitizer
 
 #: single-flight wait bound: a filler stuck past this (wedged blob
 #: store, debugger) stops blocking waiters — they fill uncached instead
@@ -168,6 +168,8 @@ class DeviceBlockCache:
                 elif key not in self._flights:
                     # we are the filler
                     self._flights[key] = threading.Event()
+                    fh = leaksan.track("blockcache.flight",
+                                       str(key)[:80])
                     blocks = None
                     ev = None
                 else:
@@ -194,6 +196,7 @@ class DeviceBlockCache:
                 # they re-check and fill (or wait) themselves
                 with self._lock:
                     ev = self._flights.pop(key, None)
+                leaksan.close(fh)
                 if ev is not None:
                     ev.set()
             return

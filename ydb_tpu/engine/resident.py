@@ -46,7 +46,7 @@ import threading
 import numpy as np
 
 from ydb_tpu import chaos
-from ydb_tpu.analysis import sanitizer
+from ydb_tpu.analysis import leaksan, sanitizer
 from ydb_tpu.blocks.block import Column, TableBlock
 from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.obs import timeline
@@ -363,6 +363,8 @@ class ResidentStore:
                     len(self._inflight) >= MAX_INFLIGHT:
                 return False
             self._inflight.add(portion_id)
+            fh = leaksan.track("resident.flight",
+                               f"{self.name}:{portion_id}")
             # compact finished handles while here (drain bookkeeping)
             self._pending = [h for h in self._pending
                              if not h.done.is_set()]
@@ -379,6 +381,7 @@ class ResidentStore:
             finally:
                 with self._lock:
                     self._inflight.discard(portion_id)
+                leaksan.close(fh)
 
         from ydb_tpu.runtime.conveyor import shared_conveyor
 
@@ -393,6 +396,7 @@ class ResidentStore:
         except RuntimeError:  # conveyor shut down (tests teardown)
             with self._lock:
                 self._inflight.discard(portion_id)
+            leaksan.close(fh)
             return False
         with self._lock:
             self._pending.append(h)

@@ -24,6 +24,8 @@ from __future__ import annotations
 import collections
 import threading
 
+from ydb_tpu.analysis import leaksan
+
 
 class ResourceExhausted(Exception):
     #: sys_top_queries error_reason tag (admission-plane rejection)
@@ -39,6 +41,9 @@ class ResourceManager:
         self.compute_slots = compute_slots
         self._lock = threading.Lock()
         self._grants: dict[str, tuple[int, int]] = {}
+        # leak-sanitizer handle per granted query (guarded by _lock);
+        # empty whenever the sanitizer is off
+        self._leaks: dict[str, object] = {}
 
     def used(self) -> tuple[int, int]:
         with self._lock:
@@ -64,11 +69,18 @@ class ResourceManager:
                 raise ResourceExhausted(
                     f"slots: want {slots}, "
                     f"free {self.compute_slots - cur_s + old[1]}")
+            first = query_id not in self._grants
             self._grants[query_id] = (memory, slots)
+            if first:
+                lk = leaksan.track("rm.slot", query_id, owner=query_id)
+                if lk is not None:
+                    self._leaks[query_id] = lk
 
     def release(self, query_id: str) -> None:
         with self._lock:
             self._grants.pop(query_id, None)
+            if self._leaks:
+                leaksan.close(self._leaks.pop(query_id, None))
 
     def snapshot(self) -> dict:
         """Planner feed (resource info exchange analog)."""

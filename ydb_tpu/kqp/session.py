@@ -37,6 +37,7 @@ from ydb_tpu.sql.planner import (
     plan_select,
     plan_select_full,
 )
+from ydb_tpu.analysis import leaksan as _leaksan
 from ydb_tpu.obs.probes import probe as _probe
 from ydb_tpu.tx import Coordinator, ShardedTable
 from ydb_tpu.tx.coordinator import TxResult
@@ -270,6 +271,10 @@ class Cluster:
         self.active_queries = _san.share(
             {}, f"kqp.{id(self):x}.active_queries")
         self._active_seq = 0
+        # leak-sanitizer handle per registry row (guarded by
+        # _active_lock; kept OUT of the row dicts, which snapshot APIs
+        # copy); empty whenever the sanitizer is off
+        self._active_leaks: dict[int, object] = {}
         self._dict_seq = 0
         self._dict_durable: dict[str, int] = {}
         self._replay_dict_journal()
@@ -321,6 +326,23 @@ class Cluster:
         dictionary contents / schema shape into plan-time state."""
         self._plan_cache.clear()
         self._compile_cache.clear()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Orderly node teardown (the driver_lib shutdown analog): stop
+        the statistics cadence thread, wait for queued background work
+        (promotions, prefetch, compaction tasks) to drain off the
+        shared conveyor, then — under YDB_TPU_LEAKSAN — prove every
+        tracked resource handle in the process drained to zero
+        (:class:`~ydb_tpu.analysis.leaksan.LeakError` names survivors).
+        Added for lifecycle rule R005: the cluster held the stoppable
+        ``StatisticsAggregator`` with no stop path reachable at all.
+        The drain check is process-global, so call it with no other
+        cluster mid-statement (tests; single-node serving)."""
+        self.stats.stop()
+        from ydb_tpu.runtime.conveyor import shared_conveyor
+
+        shared_conveyor().wait_idle(timeout=timeout)
+        _leaksan.assert_drained(where="Cluster.stop")
 
     # ---- dict durability (cluster-wide journal) ----
 
@@ -661,6 +683,9 @@ class Cluster:
                 "queue_position": pos, "trace_id": 0, "kind": "",
                 "rows": 0, "slow_fired": False,
             }
+            lk = _leaksan.track("session.active", sql[:60], owner=tok)
+            if lk is not None:
+                self._active_leaks[tok] = lk
         return tok
 
     def _update_active(self, tok: int, **fields) -> None:
@@ -672,6 +697,8 @@ class Cluster:
     def _unregister_active(self, tok: int) -> None:
         with self._active_lock:
             self.active_queries.pop(tok, None)
+            if self._active_leaks:
+                _leaksan.close(self._active_leaks.pop(tok, None))
 
     def active_query_snapshot(self) -> list[dict]:
         """Point-in-time view of in-flight statements (the
@@ -1488,33 +1515,50 @@ class Session:
 
                     self._record_rejected(sql, t0, "overloaded")
                     raise PoolOverloaded("admission wait timed out")
-            if c.rm is not None:
-                # the two planes' limits are independent: a pool-admitted
-                # query still waits (not fails) for a compute slot
-                from ydb_tpu.kqp.rm import ResourceExhausted
-
-                while True:
-                    try:
-                        c.rm.acquire(qid, slots=1)
-                        break
-                    except ResourceExhausted:
-                        if _time.monotonic() > deadline:
-                            if c.workload is not None:
-                                c.workload.finish(qid)
-                            self._record_rejected(sql, t0, "overloaded")
-                            raise
-                        _time.sleep(0.002)
+            # from here the pool admission is HELD: a single try/finally
+            # owns BOTH planes, so any exception between admission and
+            # the compute-slot grant (not just the ResourceExhausted
+            # retry timeout) releases the pool entry — an unexpected
+            # error here used to strand qid in the pool's running set
+            # forever, wedging its admission slot
+            granted = False
             try:
+                if c.rm is not None:
+                    # the two planes' limits are independent: a
+                    # pool-admitted query still waits (not fails) for a
+                    # compute slot
+                    from ydb_tpu.kqp.rm import ResourceExhausted
+
+                    while True:
+                        try:
+                            c.rm.acquire(qid, slots=1)
+                            granted = True
+                            break
+                        except ResourceExhausted:
+                            if _time.monotonic() > deadline:
+                                self._record_rejected(sql, t0,
+                                                      "overloaded")
+                                raise
+                            _time.sleep(0.002)
                 with _dl.activate(statement_dl):
                     return self._execute_admitted(sql, trace_id, t0,
                                                   active_tok=tok)
             finally:
-                if c.rm is not None:
+                if granted:
                     c.rm.release(qid)
                 if c.workload is not None:
                     c.workload.finish(qid)
         finally:
             c._unregister_active(tok)
+            # statement-completion drain check: under YDB_TPU_LEAKSAN
+            # every handle owned by this statement (its registry row,
+            # its compute-slot grant) must be closed by now — one bool
+            # test per hook when the sanitizer is off
+            _leaksan.assert_drained(owner=tok,
+                                    where="statement completion")
+            if qid is not None:
+                _leaksan.assert_drained(owner=qid,
+                                        where="statement completion")
 
     def _record_rejected(self, sql: str, t0: float, reason: str) -> None:
         """Statements rejected BEFORE execution (shed/admission

@@ -534,6 +534,89 @@ def bench_chaos_overhead(sf: float, iters: int, block_rows: int,
     return out
 
 
+def bench_leaksan_overhead(sf: float, iters: int, block_rows: int,
+                           assert_within: float | None = None) -> dict:
+    """Warm TPC-H Q1 with the leak sanitizer DISABLED (the production
+    state: every ``track()`` site is one module-global bool check
+    returning None, every ``close()`` a None test) vs FORCED ON (every
+    acquisition allocates a stack-bearing handle). Two invariants
+    besides the timing: the disabled side must track ZERO handles, and
+    the armed side must drain back to zero once the scan's conveyor
+    work completes — a leak here is a bug in the resource layers, not a
+    bench artifact. ``assert_within`` fails the bench when the armed
+    side exceeds disabled by more than that fraction."""
+    from ydb_tpu.analysis import leaksan
+    from ydb_tpu.engine.blobs import MemBlobStore
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+    from ydb_tpu.runtime.conveyor import shared_conveyor
+    from ydb_tpu.workload import tpch
+
+    data = tpch.TpchData(sf=sf, seed=5)
+    li = data.tables["lineitem"]
+    n = len(li["l_orderkey"])
+    shard = ColumnShard(
+        "leakov", tpch.LINEITEM_SCHEMA, MemBlobStore(),
+        dicts=data.dicts,
+        config=ShardConfig(compact_portion_threshold=10 ** 9,
+                           scan_block_rows=block_rows,
+                           portion_chunk_rows=1 << 16))
+    shard.commit([shard.write(dict(li))])
+    prog = tpch.q1_program()
+
+    def run_off():
+        leaksan.set_force(False)
+        return shard.scan(prog)
+
+    def run_armed():
+        leaksan.set_force(True)
+        try:
+            return shard.scan(prog)
+        finally:
+            leaksan.set_force(False)
+
+    prev_force = leaksan.LEAKSAN_FORCE
+    try:
+        leaksan.reset()
+        run_off()  # warm: compile + scan-cache fill, shared by both
+        if leaksan.counts():
+            raise AssertionError(
+                "leaksan tracked handles on the disabled path: "
+                f"{leaksan.counts()}")
+        run_armed()  # warm the armed side (handle-alloc code paths)
+        shared_conveyor().wait_idle(timeout=30.0)
+        if leaksan.counts():
+            raise AssertionError(
+                f"armed warm Q1 leaked handles: {leaksan.counts()}")
+        best = {"off": float("inf"), "armed": float("inf")}
+        # interleave the sides so host drift hits both equally
+        for _ in range(max(1, iters)):
+            for label, fn in (("off", run_off), ("armed", run_armed)):
+                t0 = time.perf_counter()
+                fn()
+                best[label] = min(best[label],
+                                  time.perf_counter() - t0)
+    finally:
+        leaksan.set_force(prev_force)
+        leaksan.reset()
+    out = {
+        "rows": n, "sf": sf,
+        "leaksan_off_seconds": round(best["off"], 6),
+        "leaksan_armed_seconds": round(best["armed"], 6),
+        "leaksan_off_rows_per_sec": round(n / best["off"]),
+        "leaksan_armed_rows_per_sec": round(n / best["armed"]),
+        "overhead_pct": round(
+            100 * (best["armed"] / best["off"] - 1), 2),
+        "drained": True,
+    }
+    if assert_within is not None:
+        if best["armed"] > best["off"] * (1 + assert_within):
+            raise AssertionError(
+                f"leaksan armed overhead {out['overhead_pct']}% "
+                f"exceeds the {assert_within * 100:g}% budget")
+        out["within_budget"] = True
+    return out
+
+
 def bench_fusion(sf: float, iters: int) -> dict:
     """Whole-plan fusion A/B: TPC-H Q3 (semi + inner join feeding a
     grouped two-phase-aggregate top-k) executed fused — one
@@ -782,6 +865,8 @@ def main(argv=None) -> int:
                     help="profiling on-vs-off warm Q1 A/B micro-bench")
     ap.add_argument("--chaos-overhead", action="store_true",
                     help="chaos disarmed vs armed-dormant warm Q1 A/B")
+    ap.add_argument("--leaksan-overhead", action="store_true",
+                    help="leak sanitizer disabled vs armed warm Q1 A/B")
     ap.add_argument("--fusion", action="store_true",
                     help="whole-plan fused vs per-fragment warm Q3 A/B")
     ap.add_argument("--shuffle", action="store_true",
@@ -828,6 +913,12 @@ def main(argv=None) -> int:
         # smoke: tiny run, lax bound (machinery + no-catastrophe
         # guard); real sizes hold the 1% disabled-path budget
         report["chaos_overhead"] = bench_chaos_overhead(
+            args.sf, max(3, args.iters), args.block_rows,
+            assert_within=(0.5 if args.smoke else 0.01))
+    if args.leaksan_overhead or args.smoke:
+        # smoke: tiny run, lax bound (machinery + no-catastrophe
+        # guard); real sizes hold the 1% disabled-path budget
+        report["leaksan_overhead"] = bench_leaksan_overhead(
             args.sf, max(3, args.iters), args.block_rows,
             assert_within=(0.5 if args.smoke else 0.01))
     if args.fusion or args.smoke:
@@ -879,6 +970,13 @@ def main(argv=None) -> int:
                   f"{co['chaos_armed_rows_per_sec']:,} rows/s vs off "
                   f"{co['chaos_off_rows_per_sec']:,} rows/s "
                   f"({co['overhead_pct']:+.2f}%)")
+        if "leaksan_overhead" in report:
+            lo = report["leaksan_overhead"]
+            print(f"leaksan overhead rows={lo['rows']}: armed "
+                  f"{lo['leaksan_armed_rows_per_sec']:,} rows/s vs off "
+                  f"{lo['leaksan_off_rows_per_sec']:,} rows/s "
+                  f"({lo['overhead_pct']:+.2f}%, "
+                  f"drained={lo['drained']})")
         if "fusion" in report:
             fu = report["fusion"]
             print(f"fusion rows={fu['rows']}: fused "

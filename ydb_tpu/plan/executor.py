@@ -225,18 +225,23 @@ def _execute_plan_dq(plan: PlanNode, db: Database) -> TableBlock | None:
         # plan shapes that do not lower (e.g. a join-rooted plan with no
         # result Transform) keep working through the recursive walk
         return None
-    with tracing.span("dq") as sp:
-        sp.set(stages=len(stages), tasks=_DQ_TASKS)
-        handle.start()
-        rt.run()
-    err = handle.collector.error
-    if err is not None and "deadline" in err:
-        # the graph aborted on statement-deadline expiry: surface the
-        # typed cancellation, not a generic incompletion
-        raise statement_deadline.StatementCancelled(err)
-    if not handle.collector.done:
-        raise RuntimeError("DQ stage graph did not complete")
-    return handle.collector.result_block()
+    try:
+        with tracing.span("dq") as sp:
+            sp.set(stages=len(stages), tasks=_DQ_TASKS)
+            handle.start()
+            rt.run()
+        err = handle.collector.error
+        if err is not None and "deadline" in err:
+            # the graph aborted on statement-deadline expiry: surface
+            # the typed cancellation, not a generic incompletion
+            raise statement_deadline.StatementCancelled(err)
+        if not handle.collector.done:
+            raise RuntimeError("DQ stage graph did not complete")
+        return handle.collector.result_block()
+    finally:
+        # a cancelled/aborted graph still holds spilled blobs for any
+        # parked or accumulated block ids; drop them with the graph
+        handle.close()
 
 
 def execute_plan(plan: PlanNode, db: Database,

@@ -140,10 +140,22 @@ class ConfigsDispatcher:
         self.version = -1
         self._callbacks = []
 
-    def on_change(self, fn) -> None:
+    def on_change(self, fn):
+        """Register a callback; returns an unsubscribe callable so a
+        component torn down before its node (pool reconfig, tests)
+        detaches instead of leaking the callback — and a reference to
+        itself — for the dispatcher's lifetime (lifecycle R007)."""
         self._callbacks.append(fn)
         if self.config is not None:
             fn(self.config)
+
+        def unsubscribe() -> None:
+            try:
+                self._callbacks.remove(fn)
+            except ValueError:  # already detached: idempotent
+                pass
+
+        return unsubscribe
 
     def _deliver(self, console: Console) -> None:
         v = console.version

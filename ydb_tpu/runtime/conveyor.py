@@ -26,7 +26,7 @@ import threading
 import time
 
 from ydb_tpu import chaos
-from ydb_tpu.analysis import sanitizer
+from ydb_tpu.analysis import leaksan, sanitizer
 from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.obs import timeline, tracing
 
@@ -96,6 +96,9 @@ class ResourceBroker:
         # a Condition over the tracked lock: wait/notify release and
         # re-acquire through it, so the held-set stays exact under TSAN
         self._freed = threading.Condition(self._lock)
+        # leak-sanitizer grant handles per queue (guarded by _freed);
+        # empty whenever the sanitizer is off
+        self._leaks: dict[str, list] = {}
 
     def acquire(self, queue: str,
                 stop: threading.Event | None = None,
@@ -121,6 +124,9 @@ class ResourceBroker:
                     self._freed.wait(timeout=0.1)
             self._running[queue] = self._running.get(queue, 0) + 1
             self._all += 1
+            lk = leaksan.track("broker.slot", queue)
+            if lk is not None:
+                self._leaks.setdefault(queue, []).append(lk)
 
     def _may_run(self, queue: str) -> bool:
         if self.total is not None and self._all >= self.total:
@@ -132,6 +138,10 @@ class ResourceBroker:
         with self._freed:
             self._running[queue] -= 1
             self._all -= 1
+            if self._leaks:
+                hs = self._leaks.get(queue)
+                if hs:
+                    hs.pop().close()
             self._freed.notify_all()
 
 
@@ -144,6 +154,9 @@ class TaskHandle:
     #: statement deadline captured at submit (None = unbounded); bounds
     #: the broker admission wait on the worker
     deadline: object = None
+    #: leak-sanitizer handle opened at submit, closed when done is set
+    #: (None whenever the sanitizer is off)
+    leak: object = None
 
     def wait(self, timeout: float | None = None):
         if not self.done.wait(timeout):
@@ -201,9 +214,11 @@ class Conveyor:
         fn = tracing.wrap_current(fn)
         fn = statement_deadline.wrap_current(fn)
         h = TaskHandle(queue, threading.Event(),
-                       deadline=statement_deadline.current())
+                       deadline=statement_deadline.current(),
+                       leak=leaksan.track("conveyor.task", queue))
         with self._cv:
             if self._stopping:
+                leaksan.close(h.leak)
                 raise RuntimeError("conveyor is shut down")
             sanitizer.note(self._heap_tok, "heappush")
             heapq.heappush(
@@ -231,7 +246,8 @@ class Conveyor:
                 self._rejected += 1
                 return None
             h = TaskHandle(queue, threading.Event(),
-                           deadline=statement_deadline.current())
+                           deadline=statement_deadline.current(),
+                           leak=leaksan.track("conveyor.task", queue))
             sanitizer.note(self._heap_tok, "heappush")
             heapq.heappush(
                 self._heap,
@@ -339,6 +355,7 @@ class Conveyor:
                             time.perf_counter(),
                             args={"queue": queue})
             finally:
+                leaksan.close(h.leak)
                 h.done.set()
                 with self._cv:
                     self._active -= 1

@@ -296,6 +296,16 @@ class Interconnect:
                 old.close()  # stop the sender thread; no fd leak
                 del self._sessions[node]
 
+    def remove_peer(self, node: int) -> None:
+        """Forget a peer (dynamic node removal): close its outbound
+        session and drop the address, so nodes coming and going cannot
+        grow the peer map without bound (lifecycle R007)."""
+        with self._slock:
+            self.peers.pop(node, None)
+            sess = self._sessions.pop(node, None)
+            if sess is not None:
+                sess.close()
+
     def _send_remote(self, env: Envelope) -> None:
         addr = self.peers.get(env.target.node)
         if addr is None:
