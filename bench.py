@@ -508,6 +508,156 @@ def run_mesh_tier(extra: dict, iters: int) -> None:
          f"q3 x{extra['mesh_q3_scaling']} vs single chip")
 
 
+def _hist_delta_p(hist, base_buckets, q):
+    """Percentile over the samples a histogram gained SINCE
+    ``base_buckets`` (a list(hist.buckets) snapshot taken while the
+    cluster was quiet) — per-phase p50/p99 off the cumulative
+    query_latency_seconds histograms, same within-bucket interpolation
+    as Histogram.percentile. None under 2 new samples."""
+    buckets = [n - b for n, b in zip(hist.buckets, base_buckets)]
+    count = sum(buckets)
+    if count < 2:
+        return None
+    target = q * count
+    acc = 0
+    for i, n in enumerate(buckets):
+        if not n:
+            continue
+        acc += n
+        if acc >= target:
+            if i >= len(hist.bounds):
+                return hist.bounds[-1]
+            lo = hist.bounds[i - 1] if i else 0.0
+            hi = hist.bounds[i]
+            return lo + (hi - lo) * (target - (acc - n)) / n
+    return hist.bounds[-1]
+
+
+def run_serving_tier(extra: dict, budget: float) -> None:
+    """Serving-throughput tier: N concurrent sessions firing a TPC-H
+    Q1/Q6 statement mix at one cluster, batching off vs on
+    (kqp/batch.py micro-batched fused dispatch + shared scans), QPS
+    from the timed burst and p50/p99 from the PR 6
+    ``query_latency_seconds`` histograms (per-phase bucket deltas).
+    The acceptance bar rides the 100-session level: batching on must
+    hold >= 2x the QPS of batching off on the warm Q1-heavy mix.
+    YDB_TPU_BENCH_SERVING_SF / _SESSIONS / _WINDOW_MS size it."""
+    import threading
+
+    from ydb_tpu.kqp.session import Cluster
+    from ydb_tpu.scheme.model import type_to_str
+    from ydb_tpu.workload import tpch
+    from ydb_tpu.workload.queries import TPCH
+
+    sf = float(os.environ.get("YDB_TPU_BENCH_SERVING_SF", "0.01"))
+    levels = [int(x) for x in os.environ.get(
+        "YDB_TPU_BENCH_SERVING_SESSIONS", "10,100,1000").split(",")
+        if x.strip()]
+    window_ms = float(os.environ.get(
+        "YDB_TPU_BENCH_SERVING_WINDOW_MS", "25"))
+    data = tpch.TpchData(sf=sf, seed=29)
+    extra["serving_sf"] = sf
+    extra["serving_rows"] = len(data.tables["lineitem"]["l_orderkey"])
+    extra["serving_window_ms"] = window_ms
+    statements = (TPCH["q1"], TPCH["q6"])
+
+    def boot():
+        c = Cluster()
+        s = c.session()
+        schema = data.schema("lineitem")
+        cols = ", ".join(f"{f.name} {type_to_str(f.type)}"
+                         for f in schema.fields)
+        s.execute(f"CREATE TABLE lineitem ({cols}, "
+                  f"PRIMARY KEY (l_orderkey)) WITH (shards = 1)")
+        src = data.tables["lineitem"]
+        arrays = {}
+        for f in schema.fields:
+            v = src[f.name]
+            if f.type.is_string:
+                arrays[f.name] = [
+                    bytes(x) for x in data.dicts[f.name].decode(
+                        np.asarray(v, dtype=np.int32))]
+            else:
+                arrays[f.name] = v
+        c.tables["lineitem"].insert(arrays)
+        c._invalidate_plans()
+        for sql in statements:  # warm plan + compile caches
+            s.execute(sql)
+        return c
+
+    def burst(c, concurrency, per_session):
+        sessions = [c.session() for _ in range(concurrency)]
+        errs: list = []
+        gate = threading.Barrier(concurrency + 1)
+
+        def worker(s, i):
+            try:
+                gate.wait()
+                for j in range(per_session):
+                    s.execute(statements[(i + j) % len(statements)])
+            except Exception as e:  # noqa: BLE001 - recorded evidence
+                errs.append(repr(e)[-200:])
+
+        threads = [threading.Thread(target=worker, args=(s, i))
+                   for i, s in enumerate(sessions)]
+        for t in threads:
+            t.start()
+        gate.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, errs
+
+    sides = {}
+    for side in ("off", "on"):
+        _log(f"serving tier: boot (batching {side})")
+        sides[side] = boot()
+        if side == "on":
+            sides[side].batcher.window_ms = window_ms
+    try:
+        hists = {
+            side: c.counters.group(
+                query_class="select_agg").histogram(
+                    "query_latency_seconds")
+            for side, c in sides.items()}
+        for n in levels:
+            if _budget_left(budget) < (30 if n <= 100 else 120):
+                extra[f"serving_{n}_skipped"] = "budget"
+                continue
+            per_session = max(1, 200 // n)
+            total = n * per_session
+            for side, c in sides.items():
+                if side == "on":
+                    # the window closes early once every admitted
+                    # session of the level has joined the group
+                    c.batcher.max_batch = max(2, n)
+                base = list(hists[side].buckets)
+                wall, errs = burst(c, n, per_session)
+                if errs:
+                    extra[f"serving_{n}_{side}_errors"] = errs[:3]
+                extra[f"serving_{n}_qps_{side}"] = round(total / wall, 1)
+                for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                    v = _hist_delta_p(hists[side], base, q)
+                    if v is not None:
+                        extra[f"serving_{n}_{tag}_ms_{side}"] = round(
+                            v * 1e3, 3)
+            off = extra.get(f"serving_{n}_qps_off")
+            on = extra.get(f"serving_{n}_qps_on")
+            if off and on:
+                extra[f"serving_{n}_qps_speedup"] = round(on / off, 2)
+                _log(f"serving tier: {n} sessions "
+                     f"{off} -> {on} qps "
+                     f"(x{extra[f'serving_{n}_qps_speedup']})")
+        snap = sides["on"].batcher.snapshot()
+        for k in ("batches", "batched_statements", "dedup_dispatches",
+                  "stacked_dispatches", "max_batch_size",
+                  "scan_staged", "scan_attached"):
+            extra[f"serving_batch_{k}"] = snap[k]
+    finally:
+        for c in sides.values():
+            c.stop()
+
+
 def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
     """Out-of-core engine-tier run at a LARGE scale factor (SURVEY
     §7.2 item 7): lineitem generates in bounded chunks (the full table
@@ -787,6 +937,20 @@ def main():
             _checkpoint("mesh", extra)
         else:
             skipped.append("mesh_tier:budget")
+
+    # serving-throughput tier: concurrent sessions, batching on-vs-off
+    # (YDB_TPU_BENCH_SERVING=0 skips; fail-soft like the storage tiers)
+    if os.environ.get("YDB_TPU_BENCH_SERVING", "1") not in \
+            ("0", "", "off"):
+        if _budget_left(budget) > 150:
+            _log("serving tier: concurrent-session QPS A/B")
+            try:
+                run_serving_tier(extra, budget)
+            except Exception as e:  # noqa: BLE001 - additive evidence
+                extra["serving_tier_error"] = repr(e)[-300:]
+            _checkpoint("serving", extra)
+        else:
+            skipped.append("serving_tier:budget")
 
     engine_warm_rps = extra["kernel_q1_warm_rows_per_sec"]
     db_iters = min(iters, 2)  # storage tiers stream the table per run

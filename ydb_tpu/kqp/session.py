@@ -211,6 +211,13 @@ class Cluster:
 
         self.scan_block_cache = DeviceBlockCache()
         self._prune_stamp = None  # last pruned (shard, meta_gen) set
+        # cross-query micro-batching dispatcher (the serving tier, see
+        # kqp/batch.py + kqp/README.md): disarmed unless
+        # YDB_TPU_BATCH_WINDOW_MS > 0, in which case compatible
+        # concurrent SELECTs share one fused device dispatch
+        from ydb_tpu.kqp.batch import BatchDispatcher
+
+        self.batcher = BatchDispatcher()
         self._query_seq = 0
         import threading
 
@@ -663,6 +670,15 @@ class Cluster:
                 self.counters.group(
                     component="chaos",
                     site=site).counter("retries").set(n)
+        # batching dispatcher telemetry (serving tier): batch/solo
+        # counts, dedup-vs-stacked dispatch split, scan-share attach
+        # rates and open-group depth, under component="batching"
+        bt = self.batcher
+        if bt.armed() or bt.batches or bt.solo:
+            g = self.counters.group(component="batching")
+            for k, v in bt.snapshot().items():
+                g.counter(k).set(v)
+            stats["batches"] = bt.batches
         # slow-query watchdog over the in-flight registry
         stats["slow_queries"] = self.check_slow_queries()
         return stats
@@ -682,6 +698,7 @@ class Cluster:
                 "sql": sql, "start": t0, "stage": "queued",
                 "queue_position": pos, "trace_id": 0, "kind": "",
                 "rows": 0, "slow_fired": False,
+                "batch_id": 0, "batch_size": 0, "shared_scan": 0,
             }
             lk = _leaksan.track("session.active", sql[:60], owner=tok)
             if lk is not None:
@@ -1604,6 +1621,9 @@ class Session:
         planned = None
         kind = "error"
         span = None
+        # the batching dispatcher stamps batch_id/batch_size onto this
+        # statement's registry row; sessions run one statement at a time
+        self._active_tok = active_tok
         try:
             with c.tracer.trace("query", trace_id) as span:
                 c._update_active(active_tok, stage="plan",
@@ -1846,13 +1866,29 @@ class Session:
         db = self._statement_db(plan_db)
         from ydb_tpu.obs import tracing
 
-        blk = execute_plan(p, db)
+        blk = self._execute_select(p, db)
         with tracing.span("fetch"):
             # device -> host result transfer is its own phase: on a
             # tunneled accelerator it can dominate small results
             out = to_host(blk)
         out.dicts = self.cluster.result_dicts(out.schema, alias_map)
         return out
+
+    def _execute_select(self, p, db) -> "TableBlock":
+        """Plan execution behind the batching dispatcher: when armed
+        (YDB_TPU_BATCH_WINDOW_MS > 0), compatible concurrent statements
+        ride ONE shared fused device dispatch (kqp/batch.py); None from
+        the batcher — disarmed, unbatchable plan, or a window that
+        closed with a single member — falls through to the unchanged
+        serial path (mesh -> DQ -> fused -> walk)."""
+        batcher = self.cluster.batcher
+        if batcher.armed():
+            blk = batcher.execute(
+                p, db, cluster=self.cluster,
+                active_tok=getattr(self, "_active_tok", None))
+            if blk is not None:
+                return blk
+        return execute_plan(p, db)
 
     def _statement_db(self, plan_db) -> Database:
         """The Database a statement executes against — ONE set of
@@ -1886,7 +1922,7 @@ class Session:
         db = self._statement_db(plan_db)
         t0 = _time.monotonic()
         with tracing.span("analyze") as asp:
-            out = to_host(execute_plan(p, db))
+            out = to_host(self._execute_select(p, db))
         seconds = _time.monotonic() - t0
         spans = []
         if asp.recording:

@@ -686,6 +686,100 @@ def bench_fusion(sf: float, iters: int) -> dict:
     return out
 
 
+def bench_batching(sf: float, iters: int, batch: int = 4) -> dict:
+    """Micro-batched fused dispatch A/B (the kqp/batch.py serving
+    tier's two device paths, measured bare):
+
+    * serial — B back-to-back non-donating fused dispatches
+      (``FusedPlan.run_shared``), one per statement, the batching-off
+      baseline;
+    * stacked — the SAME B statements' staged inputs stacked along a
+      leading axis into ONE vmapped dispatch (``run_stacked``), each
+      member sliced off the batched result (``slice_member``);
+    * dedup — the identical-inputs fast path: ONE dispatch whose result
+      every member shares (what the dispatcher runs when all members
+      staged byte-identical blocks).
+
+    Every stacked member and the dedup result are asserted bit-identical
+    to the serial dispatch — the acceptance invariant the serving tier
+    rides on."""
+    import jax
+
+    from ydb_tpu.engine.scan import ColumnSource
+    from ydb_tpu.plan.executor import Database, _stage_fused_site
+    from ydb_tpu.ssa import plan_fuse
+    from ydb_tpu.workload import tpch
+
+    data = tpch.TpchData(sf=sf, seed=5)
+    db = Database(
+        sources={t: ColumnSource(cols, data.schema(t), data.dicts)
+                 for t, cols in data.tables.items()},
+        dicts=data.dicts)
+    plan = tpch.q3_plan()
+    sig = plan_fuse.plan_signature(plan, db)
+    if sig is None:
+        raise AssertionError("q3 plan did not fuse")
+    fused = plan_fuse.build(sig, db)
+    inputs = {s.key: _stage_fused_site(s, db, None, donate=False)[0]
+              for s in sig.sites}
+    n = len(data.tables["lineitem"]["l_orderkey"])
+
+    def run_serial():
+        out = None
+        for _ in range(batch):
+            out, totals = fused.run_shared(inputs)
+            assert not fused.overflowed(totals)
+        return jax.block_until_ready(out)
+
+    def run_stack():
+        out, totals = fused.run_stacked([inputs] * batch)
+        assert not fused.overflowed(totals)
+        return jax.block_until_ready(out)
+
+    def run_dedup():
+        out, totals = fused.run_shared(inputs)
+        assert not fused.overflowed(totals)
+        return jax.block_until_ready(out)
+
+    sides = {"serial": run_serial, "stacked": run_stack,
+             "dedup": run_dedup}
+    results = {k: f() for k, f in sides.items()}  # warm (trace+compile)
+    best = {k: float("inf") for k in sides}
+    for _ in range(max(1, iters)):
+        # interleaved so host drift hits every side equally
+        for k, f in sides.items():
+            t0 = time.perf_counter()
+            f()
+            best[k] = min(best[k], time.perf_counter() - t0)
+
+    ser = results["serial"]
+    sv, sok = ser.to_numpy(), ser.validity_numpy()
+
+    def check(blk, label):
+        bv, bok = blk.to_numpy(), blk.validity_numpy()
+        for name in ser.schema.names:
+            if not np.array_equal(sok[name], bok[name]) \
+                    or not np.array_equal(
+                        np.where(sok[name], sv[name], 0),
+                        np.where(bok[name], bv[name], 0)):
+                raise AssertionError(f"{label} mismatch on {name}")
+
+    for i in range(batch):
+        check(plan_fuse.slice_member(results["stacked"], i),
+              f"stacked[{i}]")
+    check(results["dedup"], "dedup")
+
+    out = {"rows": n, "sf": sf, "batch": batch, "identical": True}
+    for k in sides:
+        out[f"{k}_seconds"] = round(best[k], 6)
+        # every side serves all B statements: serial with B dispatches,
+        # stacked/dedup with one
+        out[f"{k}_seconds_per_statement"] = round(best[k] / batch, 6)
+    out["stacked_speedup"] = round(best["serial"] / best["stacked"], 2)
+    out["dedup_speedup"] = round(best["serial"] / best["dedup"], 2)
+    return out
+
+
 def bench_shuffle(rows_per_dev: int, iters: int,
                   with_skew: bool = True) -> dict:
     """Stats-sized vs full-capacity shuffle A/B on a virtual mesh.
@@ -869,6 +963,10 @@ def main(argv=None) -> int:
                     help="leak sanitizer disabled vs armed warm Q1 A/B")
     ap.add_argument("--fusion", action="store_true",
                     help="whole-plan fused vs per-fragment warm Q3 A/B")
+    ap.add_argument("--batching", action="store_true",
+                    help="stacked/dedup vs serial fused dispatch A/B")
+    ap.add_argument("--batch", type=int, default=4,
+                    help="members per micro-batch for --batching")
     ap.add_argument("--shuffle", action="store_true",
                     help="stats-sized vs full-capacity shuffle A/B")
     ap.add_argument("--shuffle-rows", type=int, default=1 << 15,
@@ -923,6 +1021,10 @@ def main(argv=None) -> int:
             assert_within=(0.5 if args.smoke else 0.01))
     if args.fusion or args.smoke:
         report["fusion"] = bench_fusion(args.sf, max(3, args.iters))
+    if args.batching or args.smoke:
+        report["batching"] = bench_batching(
+            args.sf, max(1, args.iters),
+            batch=(3 if args.smoke else args.batch))
     if args.shuffle or args.smoke:
         report["shuffle"] = bench_shuffle(
             args.shuffle_rows, args.iters, with_skew=args.shuffle)
@@ -986,6 +1088,16 @@ def main(argv=None) -> int:
                   f"{fu['fused_dispatches']} dispatch vs "
                   f"{fu['fragment_dispatches']} fragments, "
                   f"identical={fu['identical']})")
+        if "batching" in report:
+            ba = report["batching"]
+            print(f"batching rows={ba['rows']} batch={ba['batch']}: "
+                  f"serial {ba['serial_seconds_per_statement']}s/stmt "
+                  f"vs stacked "
+                  f"{ba['stacked_seconds_per_statement']}s/stmt "
+                  f"(x{ba['stacked_speedup']}) vs dedup "
+                  f"{ba['dedup_seconds_per_statement']}s/stmt "
+                  f"(x{ba['dedup_speedup']}, "
+                  f"identical={ba['identical']})")
         if "shuffle" in report:
             sh = report["shuffle"]
             if "skipped" in sh:

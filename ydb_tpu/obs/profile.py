@@ -50,6 +50,15 @@ class QueryProfile:
     execute_seconds: float = 0.0   # seconds - compile_seconds
     fused_stages: int = 0     # plan nodes folded into one traced dispatch
     fragments_elided: int = 0  # dispatch boundaries removed by fusion
+    #: cross-query batching (kqp/batch.py): group id + member count of
+    #: the micro-batch that served this statement (0 = unbatched), how
+    #: many of its scan sites were served by a staging shared with
+    #: batchmates, and the wait-for-window vs shared-execute split
+    batch_id: int = 0
+    batch_size: int = 0
+    shared_scan: int = 0
+    batch_wait_seconds: float = 0.0
+    batch_execute_seconds: float = 0.0
     stages: dict = dataclasses.field(default_factory=dict)
     pruning: dict = dataclasses.field(default_factory=dict)
     device_seconds: float = 0.0
@@ -153,6 +162,17 @@ def build_profile(spans, sql: str = "", kind: str = "",
                 p.compile_cache = "hit"
             p.compile_seconds += float(
                 a.get("first_trace_seconds", 0.0))
+            continue
+        if s.name == "dispatch.batch":
+            # cross-query micro-batch seat (kqp/batch.py): one span per
+            # member on its own session thread, so per-statement
+            # profiles attribute window wait vs shared execute
+            p.batch_id = int(a.get("batch_id", 0))
+            p.batch_size = int(a.get("batch_size", 0))
+            p.shared_scan = int(a.get("shared_scan", 0))
+            p.batch_wait_seconds += float(a.get("wait_seconds", 0.0))
+            p.batch_execute_seconds += float(
+                a.get("execute_seconds", 0.0))
             continue
         if s.name == "dq.task":
             # DQ queries run their device dispatches inside compute
@@ -275,6 +295,13 @@ def format_plan_analyzed(plan, profile: QueryProfile) -> str:
         lines.append(
             f"fusion: fused_stages={profile.fused_stages}"
             f" fragments_elided={profile.fragments_elided}")
+    if profile.batch_size:
+        lines.append(
+            f"batching: batch_id={profile.batch_id}"
+            f" batch_size={profile.batch_size}"
+            f" shared_scan={profile.shared_scan}"
+            f" wait_seconds={profile.batch_wait_seconds:.6f}"
+            f" execute_seconds={profile.batch_execute_seconds:.6f}")
     st = profile.stages
     lines.append("stages: " + " ".join(
         f"{k}={st.get(k, 0.0):.6f}" for k in STAGE_KEYS))

@@ -81,7 +81,9 @@ SYS_SCHEMAS = {
         ("chunks_read", dtypes.INT64),
         ("chunks_skipped", dtypes.INT64),
         ("error", dtypes.INT32),
-        ("error_reason", dtypes.STRING)),
+        ("error_reason", dtypes.STRING),
+        ("batch_id", dtypes.INT64), ("batch_size", dtypes.INT32),
+        ("shared_scan", dtypes.INT32)),
     # HBM-resident column tier (engine/resident.py): per-shard pinned
     # bytes vs budget plus promotion/eviction/spill lifecycle counters
     # — the "is the hot set actually resident" dashboard
@@ -108,7 +110,9 @@ SYS_SCHEMAS = {
         ("query_text", dtypes.STRING), ("kind", dtypes.STRING),
         ("stage", dtypes.STRING), ("elapsed_seconds", dtypes.DOUBLE),
         ("rows", dtypes.INT64), ("queue_position", dtypes.INT32),
-        ("trace_id", dtypes.INT64)),
+        ("trace_id", dtypes.INT64),
+        ("batch_id", dtypes.INT64), ("batch_size", dtypes.INT32),
+        ("shared_scan", dtypes.INT32)),
 }
 
 
@@ -292,7 +296,7 @@ def _scan_pruning_rows(cluster):
 
 
 def _top_queries_rows(cluster):
-    cols: list[list] = [[] for _ in range(19)]
+    cols: list[list] = [[] for _ in range(22)]
     for rank, p in enumerate(cluster.profiles.top(16), start=1):
         st = p.stages
         pr = p.pruning
@@ -303,7 +307,9 @@ def _top_queries_rows(cluster):
                st.get("stage", 0.0), st.get("compute", 0.0),
                pr.get("portions_skipped", 0), pr.get("chunks_read", 0),
                pr.get("chunks_skipped", 0), getattr(p, "error", 0),
-               getattr(p, "error_reason", "")]
+               getattr(p, "error_reason", ""),
+               getattr(p, "batch_id", 0), getattr(p, "batch_size", 0),
+               getattr(p, "shared_scan", 0)]
         for c, v in zip(cols, row):
             c.append(v)
     return cols
@@ -329,11 +335,12 @@ def _resident_store_rows(cluster):
 
 
 def _active_queries_rows(cluster):
-    cols: list[list] = [[] for _ in range(7)]
+    cols: list[list] = [[] for _ in range(10)]
     for e in cluster.active_query_snapshot():
         row = [e["sql"][:256], e["kind"], e["stage"],
                e["elapsed_seconds"], e["rows"], e["queue_position"],
-               e["trace_id"]]
+               e["trace_id"], e.get("batch_id", 0),
+               e.get("batch_size", 0), e.get("shared_scan", 0)]
         for c, v in zip(cols, row):
             c.append(v)
     return cols

@@ -340,6 +340,15 @@ class FusedPlan:
         self.first_trace_seconds: float | None = None
         self._traced = False
         self._jit = self._make_jit()
+        # batch-size -> jitted vmapped dispatch (run_stacked); cleared
+        # by grow() with the serial jit — both bake expand capacities
+        self._stacked_jits: dict = {}
+        self._stacked_traced: set = set()
+        # non-donating serial dispatch (run_shared): the batch
+        # dispatcher's dedup path hands SHARED staged blocks (scan-share
+        # attach) that later members must still be able to read
+        self._jit_shared = None
+        self._shared_traced = False
 
     def _make_jit(self):
         # Wrap in a fresh function object per call: jax's tracing cache
@@ -393,6 +402,86 @@ class FusedPlan:
                 + time.perf_counter() - t0)
         return out, [int(t) for t in totals]
 
+    def run_shared(self, inputs: dict) -> tuple[TableBlock, list[int]]:
+        """Serial dispatch over staged blocks that OTHER statements may
+        still read (the batch dispatcher's shared-scan dedup: N queued
+        statements whose staged inputs are identical run the plan once
+        and every member slices... the same result). Identical XLA
+        program to :meth:`run` except donation is off — donating a
+        shared block would let the dispatch scribble over a buffer a
+        batchmate is about to read."""
+        if self._jit_shared is None:
+            run_all = self._run_all
+
+            def _dispatch(inputs, aux):
+                return run_all(inputs, aux)
+
+            self._jit_shared = jax.jit(_dispatch)
+        if self._shared_traced:
+            out, totals = self._jit_shared(inputs, self.aux)
+        else:
+            t0 = time.perf_counter()
+            out, totals = self._jit_shared(inputs, self.aux)
+            jax.block_until_ready(out)
+            self._shared_traced = True
+            self.first_trace_seconds = (
+                (self.first_trace_seconds or 0.0)
+                + time.perf_counter() - t0)
+        return out, [int(t) for t in totals]
+
+    def _make_stacked_jit(self, batch: int):
+        # Fresh wrapper per (batch, capacity generation) for the same
+        # function-equality reason as _make_jit. The vmapped body maps
+        # ONLY over the stacked inputs; aux (dictionary tables, join
+        # constants) is closed over unbatched — every batch member is
+        # the same executable, so aux is genuinely shared.
+        run_all = self._run_all
+
+        def _dispatch(inputs, aux):
+            return jax.vmap(lambda i: run_all(i, aux))(inputs)
+
+        return jax.jit(
+            _dispatch,
+            donate_argnums=(0,) if self.donate else ())
+
+    def run_stacked(self, inputs_list: list[dict]) \
+            -> tuple[TableBlock, list[int]]:
+        """One micro-batched dispatch over B compatible statements'
+        staged inputs: stack each site's member blocks along a new
+        leading axis (TableBlock is a pytree — jnp.stack copies into
+        fresh buffers, so donation of the stacked operand never touches
+        the per-member staged blocks) and run the vmapped plan once.
+        Returns the batched result (leading dim B on every leaf) plus
+        per-expand-slot totals MAXed over members — the overflow/grow
+        protocol is per-capacity, and the widest member governs.
+        Callers slice members off with :func:`slice_member`."""
+        batch = len(inputs_list)
+        stacked = _stack_members(inputs_list)
+        jf = self._stacked_jits.get(batch)
+        if jf is None:
+            jf = self._make_stacked_jit(batch)
+            self._stacked_jits[batch] = jf
+        if batch in self._stacked_traced:
+            out, totals = jf(stacked, self.aux)
+        else:
+            import warnings
+
+            t0 = time.perf_counter()
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                out, totals = jf(stacked, self.aux)
+            jax.block_until_ready(out)
+            self._stacked_traced.add(batch)
+            self.first_trace_seconds = (
+                (self.first_trace_seconds or 0.0)
+                + time.perf_counter() - t0)
+        # totals come back shape (B,); the grow protocol keys on the
+        # worst member (capacities are trace-time constants shared by
+        # the whole batch)
+        return out, [int(max(t)) for t in jax.device_get(totals)]
+
     def overflowed(self, totals: list[int]) -> list[int]:
         """Expand-join indexes whose match total exceeded capacity."""
         return [i for i, t in enumerate(totals)
@@ -407,6 +496,30 @@ class FusedPlan:
         self.expand_caps[idx] = (total + q - 1) // q * q
         self._traced = False
         self._jit = self._make_jit()
+        # stacked/shared dispatches bake the same capacities: drop them
+        # all so the next batch retraces at the grown size
+        self._stacked_jits.clear()
+        self._stacked_traced.clear()
+        self._jit_shared = None
+        self._shared_traced = False
+
+
+def _stack_members(inputs_list: list[dict]):
+    """Stack B members' staged inputs along a new leading axis.
+    ``jnp.stack`` copies into fresh buffers, so donation of the stacked
+    operand never touches the per-member staged blocks (which may be
+    shared with concurrent statements through the scan share)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *inputs_list)
+
+
+def slice_member(out: TableBlock, i: int) -> TableBlock:
+    """Member ``i``'s result out of a :meth:`FusedPlan.run_stacked`
+    batched block: index the leading batch axis off every leaf (lazy
+    device gathers — each waiting session materializes only its own
+    slice). The static treedef (names, schema) carries through, so the
+    slice is a plain TableBlock indistinguishable from a serial run's."""
+    return jax.tree_util.tree_map(lambda x: x[i], out)
 
 
 def build(sig: PlanSignature, db) -> FusedPlan:
