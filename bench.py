@@ -689,11 +689,16 @@ def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
     with tempfile.TemporaryDirectory(
             prefix="ydbtpu_ooc_", dir=root) as tmp:
         dicts = DictionarySet()
+        # streaming slabs stay modest on the OOC tier: double-buffered
+        # H2D works at block granularity, so giant in-memory-tier
+        # blocks (1<<21 default) would leave compute waiting on one
+        # huge transfer instead of overlapping many small ones
+        ooc_block_rows = min(block_rows, 1 << 18)
         shard = ColumnShard(
             "ooc", tpch.LINEITEM_SCHEMA, DirBlobStore(tmp),
             dicts=dicts,
             config=ShardConfig(compact_portion_threshold=10 ** 9,
-                               scan_block_rows=block_rows,
+                               scan_block_rows=ooc_block_rows,
                                portion_chunk_rows=1 << 18))
         # incremental Q1/Q6 baselines: accumulated per chunk, O(1) state
         q1_acc: dict[str, np.ndarray] = {}
@@ -729,6 +734,8 @@ def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
 
         c1, w1, out1 = timed_cold_warm(run(tpch.q1_program()),
                                        max(1, iters // 2))
+        if shard.last_scan_pipeline:
+            ooc["pipeline"] = shard.last_scan_pipeline
         c6, w6, out6 = timed_cold_warm(run(tpch.q6_program()),
                                        max(1, iters // 2))
         # verify against the incrementally-accumulated baselines
@@ -745,6 +752,36 @@ def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
         ooc["q1_cold_rows_per_sec"] = round(rows / c1)
         ooc["q1_warm_rows_per_sec"] = round(rows / w1)
         ooc["q6_warm_rows_per_sec"] = round(rows / w6)
+        # streaming-pipeline A/B (same scan, morsel pipeline OFF):
+        # verified bit-identical against the pipelined result, speedup
+        # recorded; then ONE profiled pipelined run embeds the stage
+        # occupancy (incl. the movement|compute overlap coefficient)
+        # and the movement byte rates — the OOC overlap acceptance gate
+        from ydb_tpu.engine import stream_sched
+
+        prev_force = stream_sched.PIPELINE_FORCE
+        stream_sched.PIPELINE_FORCE = False
+        try:
+            _cs, ws, outs = timed_cold_warm(run(tpch.q1_program()),
+                                            max(1, iters // 2))
+            _cs6, ws6, outs6 = timed_cold_warm(run(tpch.q6_program()),
+                                               max(1, iters // 2))
+        finally:
+            stream_sched.PIPELINE_FORCE = prev_force
+        for pipe, ser, q in ((out1, outs, "q1"), (out6, outs6, "q6")):
+            for n, v in ser.cols.items():
+                a = np.asarray(v[0])
+                b = np.asarray(pipe.cols[n][0])
+                assert (a.dtype == b.dtype and a.shape == b.shape
+                        and a.tobytes() == b.tobytes()), \
+                    f"ooc {q} serialized/pipelined mismatch on {n}"
+        ooc["q1_serialized_rows_per_sec"] = round(rows / ws)
+        ooc["pipeline_speedup_q1"] = round(ws / w1, 2)
+        ooc["q6_serialized_rows_per_sec"] = round(rows / ws6)
+        ooc["pipeline_speedup_q6"] = round(ws6 / w6, 2)
+        _profiled_with_movement("ooc_q1_pipelined",
+                                run(tpch.q1_program()), ooc, "q1",
+                                query_class="ooc")
         peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
         ooc["peak_rss_gb"] = round(peak, 2)
         ooc["within_budget"] = peak <= budget_gb

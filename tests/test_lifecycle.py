@@ -455,6 +455,53 @@ def test_kind_resident_flight():
         resident_mod.RESIDENT_FORCE = prev
 
 
+def test_kind_stream_morsel():
+    """stream.morsel handles open at flight admission and close at
+    retire: live while prefetched flights wait behind the consumer,
+    zero once the scan drains."""
+    from ydb_tpu import dtypes
+    from ydb_tpu.engine import stream_sched
+    from ydb_tpu.engine.blobs import MemBlobStore
+    from ydb_tpu.engine.reader import PortionStreamSource
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+    from ydb_tpu.runtime.conveyor import stream_conveyor
+
+    schema = dtypes.schema(("id", dtypes.INT64, False),
+                           ("v", dtypes.INT64))
+    prev = stream_sched.PIPELINE_FORCE
+    stream_sched.PIPELINE_FORCE = True
+    try:
+        with leaksan.activate():
+            shard = ColumnShard(
+                "s1", schema, MemBlobStore(), pk_column="id",
+                upsert=False,
+                config=ShardConfig(compact_portion_threshold=10**6))
+            for off in range(6):
+                base = off * 200
+                wid = shard.write({
+                    "id": np.arange(base, base + 200, dtype=np.int64),
+                    "v": np.arange(base, base + 200, dtype=np.int64)})
+                shard.commit([wid])
+            src = PortionStreamSource(shard,
+                                      shard.visible_portions(None))
+            it = src.blocks(64)
+            next(it)  # later morsels are admitted ahead, uncollected
+            assert leaksan.live("stream.morsel")
+            for _ in it:
+                pass
+            deadline = time.monotonic() + 5.0
+            while leaksan.live("stream.morsel") and \
+                    time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert leaksan.live("stream.morsel") == []
+            stream_conveyor().wait_idle(timeout=10.0)
+            while leaksan.counts() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert leaksan.counts() == {}
+    finally:
+        stream_sched.PIPELINE_FORCE = prev
+
+
 class _FakeCol:
     def __init__(self):
         self.data = np.zeros(4, dtype=np.int64)

@@ -301,6 +301,38 @@ class PortionStreamSource:
         # (engine.resident; sys_resident_store + shard.scan spans)
         self.resident_hits = 0
         self.resident_rows = 0
+        # morsel-pipeline attribution (engine.stream_sched): the live
+        # scheduler while a pipelined stream runs, kept after it ends
+        # for the stat snapshot (shard.scan spans / bench extras)
+        self._pipeline = None
+        self._finished_pipeline = None
+
+    # ---- morsel-pipeline hooks (engine.stream_sched owner surface) ----
+
+    def attach_pipeline(self, sched) -> None:
+        self._pipeline = sched
+
+    def finish_pipeline(self, sched) -> None:
+        self._pipeline = None
+        self._finished_pipeline = sched
+
+    @property
+    def last_pipeline(self) -> "dict | None":
+        """Stat snapshot of the last pipelined stream, taken lazily —
+        the producer finishes the pipeline while the consumer is still
+        draining queued blocks, so an eager snapshot would undercount
+        ``blocks_consumed``."""
+        s = self._finished_pipeline
+        return None if s is None else s.snapshot()
+
+    def note_block_consumed(self) -> None:
+        """In-order consumption credit from the executor (run_stream):
+        forwarded to the scheduler's slab accounting (live or finished
+        — the tail blocks outlive the producer); a no-op on the
+        serialized path."""
+        p = self._pipeline or self._finished_pipeline
+        if p is not None:
+            p.note_consumed()
 
     @property
     def num_rows(self) -> int:
@@ -447,6 +479,20 @@ class PortionStreamSource:
         sch = self.shard.schema.select(names)
         cap = min(block_rows, max(self.num_rows, 1))
         clusters = plan_clusters(self.metas, self.dedup)
+        if start_block == 0:
+            from ydb_tpu.engine import stream_sched
+
+            if stream_sched.pipeline_enabled():
+                # morsel-driven pipeline: out-of-order IO/decode on the
+                # stream conveyor, in-order assembly, double-buffered
+                # slabs — resident-tier placement folded in. Count-based
+                # resume (start_block) keeps the serialized path: its
+                # block arithmetic must not depend on pipeline state.
+                yield from stream_sched.stream_pipeline(
+                    [(self, clusters)], names, sch, cap,
+                    timer=self.timer, prefetch=self.prefetch,
+                    owner=self)
+                return
         res = getattr(self.shard, "resident", None)
         if start_block == 0 and res is not None and res.enabled():
             # HBM-resident fast path: portions with pinned decoded
@@ -472,14 +518,36 @@ class PortionStreamSource:
     # (DQ checkpoint seek) must count actual emissions, not estimate.
 
 
+#: test/bench override for the staging lookahead: an int forces the
+#: depth, None reads the (cached) environment — the FUSE_FORCE pattern
+PREFETCH_DEPTH_FORCE: "int | None" = None
+
+#: cached YDB_TPU_PREFETCH_DEPTH: the env var is configuration, not a
+#: per-stream knob, and re-reading the environment on every stream put
+#: a getenv on the hot scan path. None = not read yet.
+_prefetch_depth_env: "int | None" = None
+_prefetch_depth_lock = threading.Lock()
+
+
 def _prefetch_depth() -> int:
     """Staging lookahead (device blocks buffered ahead of the consumer).
     Depth 2 keeps one block in transfer while one waits, without pinning
-    unbounded host/device memory."""
-    try:
-        return int(os.environ.get("YDB_TPU_PREFETCH_DEPTH", "2"))
-    except ValueError:
-        return 2
+    unbounded host/device memory. Read from the environment ONCE;
+    ``PREFETCH_DEPTH_FORCE`` is the in-process override seam."""
+    global _prefetch_depth_env
+    if PREFETCH_DEPTH_FORCE is not None:
+        return PREFETCH_DEPTH_FORCE
+    depth = _prefetch_depth_env
+    if depth is None:
+        with _prefetch_depth_lock:
+            if _prefetch_depth_env is None:
+                try:
+                    _prefetch_depth_env = int(
+                        os.environ.get("YDB_TPU_PREFETCH_DEPTH", "2"))
+                except ValueError:
+                    _prefetch_depth_env = 2
+            depth = _prefetch_depth_env
+    return depth
 
 
 def stream_blocks(payloads, names, sch, cap: int,
@@ -586,6 +654,14 @@ def pump_blocks(blocks, prefetch: bool = True,
             put(("end", emitted))
         except BaseException as e:  # noqa: BLE001 - relayed to consumer
             put(("err", e))
+        finally:
+            # abandoned consumer (stop set): a bare return here would
+            # strand the generator's finally blocks — the morsel
+            # scheduler's teardown (stream_sched.close) lives there, so
+            # close it on THIS thread, the one iterating it
+            close = getattr(blocks, "close", None)
+            if close is not None:
+                close()
 
     # atomic free-worker admission: a producer must never QUEUE behind
     # other parked producers (its consumer would starve waiting on a
@@ -642,6 +718,8 @@ class MultiShardStreamSource:
         self._shards = list(shards)
         self._snap = snap
         self.preds: tuple = ()
+        self._pipeline = None
+        self._finished_pipeline = None
         self.subs = [
             PortionStreamSource(s, s.visible_portions(snap),
                                 columns=self.columns_read, timer=timer)
@@ -656,6 +734,25 @@ class MultiShardStreamSource:
         for sub in self.subs:
             sub.timer = timer
         return self
+
+    # ---- morsel-pipeline hooks (engine.stream_sched owner surface) ----
+
+    def attach_pipeline(self, sched) -> None:
+        self._pipeline = sched
+
+    def finish_pipeline(self, sched) -> None:
+        self._pipeline = None
+        self._finished_pipeline = sched
+
+    @property
+    def last_pipeline(self) -> "dict | None":
+        s = self._finished_pipeline
+        return None if s is None else s.snapshot()
+
+    def note_block_consumed(self) -> None:
+        p = self._pipeline or self._finished_pipeline
+        if p is not None:
+            p.note_consumed()
 
     def with_predicates(self, preds) -> "MultiShardStreamSource":
         """A pruned VIEW of this source for one program's conjunctive
@@ -740,6 +837,19 @@ class MultiShardStreamSource:
         names = columns if columns is not None else self.columns_read
         sch = self._base_schema.select(names)
         cap = min(block_rows, max(self.num_rows, 1))
+        if start_block == 0:
+            from ydb_tpu.engine import stream_sched
+
+            if stream_sched.pipeline_enabled():
+                # one scheduler spans ALL shards: IO morsels of shard
+                # k+1 fly while shard k's blocks are consumed, under a
+                # single byte budget and one block capacity (one
+                # compiled program)
+                yield from stream_sched.stream_pipeline(
+                    [(sub, plan_clusters(sub.metas, sub.dedup))
+                     for sub in self.subs],
+                    names, sch, cap, timer=self.timer, owner=self)
+                return
         if start_block == 0 and any(
                 getattr(sub.shard, "resident", None) is not None
                 and sub.shard.resident.enabled() for sub in self.subs):

@@ -300,9 +300,18 @@ class ScanExecutor:
         return self._timed_first("_finalize_traced", self._finalize_jit,
                                  tuple(partials), self._final_aux)
 
-    def run_stream(self, blocks, timer=None) -> TableBlock:
+    def run_stream(self, blocks, timer=None,
+                   consumed_cb=None) -> TableBlock:
         """Drive a block stream with bounded in-flight work; returns the
         result block (merged partials finalized, or concatenated rows).
+
+        The stream contract admits out-of-order-READY production: a
+        morsel pipeline (engine.stream_sched) may complete blocks in
+        any order underneath, as long as the iterator delivers them in
+        order — this loop consumes strictly in order and, via
+        ``consumed_cb`` (called once per admitted block), returns the
+        in-order consumption credit that lets the producer account its
+        double-buffered slabs.
 
         ``timer`` (obs.probes.StageTimer) charges device dispatch +
         backpressure waits to the "compute" stage; time spent PULLING
@@ -339,6 +348,8 @@ class ScanExecutor:
                         tuple(partials), self._combine_aux)
                     partials = []
                     admit(merged)
+            if consumed_cb is not None:
+                consumed_cb()
         with computing():
             if self.final is None:
                 # pure filter/project program: block outputs concatenate
@@ -346,6 +357,14 @@ class ScanExecutor:
                        else concat_blocks(partials))
             else:
                 out = self.finalize(partials)
+            from ydb_tpu.obs import timeline
+            if timeline.timeline_enabled():
+                # movement observatory runs materialize here so the
+                # async tail lands on the compute stage interval, not
+                # on whichever caller first touches the arrays —
+                # occupancy attribution stays exact. Default path
+                # stays lazy (cross-query dispatch pipelining).
+                jax.block_until_ready(out.columns)
             return self._retype(out)
 
     def _stamp_nullability(self, sch: dtypes.Schema) -> dtypes.Schema:

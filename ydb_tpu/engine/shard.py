@@ -166,6 +166,9 @@ class ColumnShard:
         # stage snapshot of the most recent scan (read/merge/stage/
         # compute seconds) — obs surface for bench + the viewer
         self.last_scan_stages: dict = {}
+        # morsel-pipeline stat snapshot of the most recent scan
+        # (engine.stream_sched); None when the serialized path ran
+        self.last_scan_pipeline: "dict | None" = None
         # pruning effectiveness of the most recent scan plus cumulative
         # totals (obs: columnshard.scan.pruning probe, sys_scan_pruning
         # view). Guarded by _stats_lock: concurrent scans update both.
@@ -660,10 +663,14 @@ class ColumnShard:
                 cache_key,
                 lambda: src.blocks(self.config.scan_block_rows,
                                    ex.read_cols)),
-            timer=timer))
+            timer=timer, consumed_cb=src.note_block_consumed))
         # per-scan stage attribution (read/merge/stage/compute seconds);
         # bench.py surfaces this as metric extras
         self.last_scan_stages = timer.snapshot()
+        # morsel-pipeline attribution (engine.stream_sched): stats are
+        # set when the pipelined stream finishes; None on the
+        # serialized path (YDB_TPU_STREAM_PIPELINE=0) and cache replays
+        self.last_scan_pipeline = src.last_pipeline
         pruning = {
             "portions_total": len(visible),
             "portions_skipped": src.portions_skipped,
@@ -703,6 +710,9 @@ class ColumnShard:
                    **{f"stage_{k}": v
                       for k, v in self.last_scan_stages.items()},
                    **pruning)
+            if self.last_scan_pipeline is not None:
+                sp.set(**{f"pipe_{k}": v
+                          for k, v in self.last_scan_pipeline.items()})
             if fresh and ex.first_trace_seconds:
                 sp.set(first_trace_seconds=round(
                     ex.first_trace_seconds, 6))

@@ -20,6 +20,11 @@
     executed as ONE whole-plan fused dispatch (ssa.plan_fuse) vs the
     per-node fragment walk, bit-identity asserted, with per-query
     dispatch counts;
+  * streaming (``--streaming``) — morsel-driven scan pipeline
+    (engine.stream_sched) vs the serialized path over a COLD
+    DirBlobStore scan: rows/s both sides, the measured
+    ``movement|compute`` overlap coefficient of one pipelined run, and
+    results asserted bit-identical between the two sides;
   * shuffle (``--shuffle``) — all_to_all repartition on a virtual
     8-device mesh with stats-sized send buckets (count-min heavy-hitter
     bound, parallel.shuffle.size_buckets) vs always-sufficient
@@ -29,7 +34,8 @@
     asserted equal throughout.
 
 Flags: ``--rows`` ``--groups`` ``--aggs`` ``--iters`` ``--block-rows``
-``--pruning`` ``--profile-overhead`` ``--fusion`` ``--shuffle``
+``--pruning`` ``--streaming`` ``--profile-overhead`` ``--fusion``
+``--shuffle``
 ``--shuffle-rows`` ``--sf`` (scale
 factor for the overhead/fusion benches) ``--json`` (report on stdout) and
 ``--smoke`` (tiny sizes, correctness-only; wired into tier-1 as a
@@ -328,6 +334,113 @@ def bench_resident(rows: int, chunk_rows: int, iters: int,
                 f"resident on/off mismatch on {name}")
     out["identical"] = True
     shard.resident.clear()
+    return out
+
+
+def bench_streaming(rows: int, chunk_rows: int, iters: int) -> dict:
+    """Morsel-pipeline A/B (equality-asserted): the same COLD
+    DirBlobStore scan serialized (stream_sched.PIPELINE_FORCE=False,
+    the YDB_TPU_STREAM_PIPELINE=0 path) vs morsel-pipelined, rows/s
+    both sides, plus ONE profiled pipelined run whose data-movement
+    timeline yields the measured ``movement|compute`` overlap
+    coefficient. The blob store is on disk and the OS page cache is the
+    only warmth, so both sides pay real read+decode per scan — the
+    pipeline's overlap is what separates them."""
+    import tempfile
+
+    from ydb_tpu import dtypes
+    from ydb_tpu.engine import stream_sched
+    from ydb_tpu.engine.blobs import DirBlobStore
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+    from ydb_tpu.obs import profile as profile_mod
+    from ydb_tpu.obs import timeline
+    from ydb_tpu.ssa import Agg, AggSpec, GroupByStep, Program
+
+    schema = dtypes.schema(
+        ("event_id", dtypes.INT64, False),
+        ("user", dtypes.INT32, False),
+        ("val", dtypes.decimal(2)),
+    )
+    prog = Program((
+        GroupByStep(("user",), (
+            AggSpec(Agg.COUNT_ALL, None, "n"),
+            AggSpec(Agg.SUM, "val", "s"),
+        )),
+    ))
+    out: dict = {"rows": rows, "chunk_rows": chunk_rows}
+    rng = np.random.default_rng(23)
+    with tempfile.TemporaryDirectory(prefix="ydbtpu_kb_stream_") as tmp:
+        shard = ColumnShard(
+            "stream", schema, DirBlobStore(tmp), pk_column="event_id",
+            # several blocks per scan: compute on block k must have
+            # movement for k+1.. to overlap with, or the coefficient is
+            # structurally zero
+            config=ShardConfig(compact_portion_threshold=10 ** 9,
+                               portion_chunk_rows=chunk_rows,
+                               scan_block_rows=max(1024, rows // 8)))
+        commits = 6
+        per = rows // commits
+        for c in range(commits):
+            n = per if c < commits - 1 else rows - per * (commits - 1)
+            base = c * per
+            cols = {
+                "event_id": (base + np.arange(n)).astype(np.int64),
+                "user": rng.integers(0, 64, n).astype(np.int32),
+                "val": rng.integers(0, 10 ** 6, n).astype(np.int64),
+            }
+            validity = {"val": rng.random(n) > 0.03}
+            shard.commit([shard.write(cols, validity)])
+        shard.scan(prog)  # compile + page-cache warmup, both sides
+        results = {}
+        for label, force in (("serialized", False),
+                             ("pipelined", True)):
+            stream_sched.PIPELINE_FORCE = force
+            try:
+                best = float("inf")
+                res = None
+                for _ in range(max(1, iters)):
+                    t0 = time.perf_counter()
+                    res = shard.scan(prog)
+                    best = min(best, time.perf_counter() - t0)
+                results[label] = res
+                out[f"{label}_seconds"] = round(best, 5)
+                out[f"{label}_rows_per_sec"] = round(
+                    rows / max(best, 1e-9))
+            finally:
+                stream_sched.PIPELINE_FORCE = None
+        out["pipeline_speedup"] = round(
+            out["serialized_seconds"]
+            / max(out["pipelined_seconds"], 1e-9), 2)
+        # overlap coefficient of ONE pipelined run, timeline forced on
+        stream_sched.PIPELINE_FORCE = True
+        prev = timeline.TIMELINE_FORCE
+        timeline.TIMELINE_FORCE = True
+        try:
+            with profile_mod.profiled("kb_streaming") as ph:
+                shard.scan(prog)
+        finally:
+            timeline.TIMELINE_FORCE = prev
+            stream_sched.PIPELINE_FORCE = None
+        occ = ph.profile.stage_occupancy or {}
+        ov = (occ.get("overlap") or {}).get("movement|compute")
+        if ov is not None:
+            out["movement_compute_overlap"] = ov
+        if shard.last_scan_pipeline:
+            out["pipeline"] = dict(shard.last_scan_pipeline)
+        # bit-identity between the two sides (group keys sort-aligned;
+        # NULL slots compare by validity, not their garbage payload)
+        a, b = results["serialized"], results["pipelined"]
+        oa = np.argsort(np.asarray(a.column("user")))
+        ob = np.argsort(np.asarray(b.column("user")))
+        for name in a.cols:
+            av, aok = (np.asarray(x) for x in a.cols[name])
+            bv, bok = (np.asarray(x) for x in b.cols[name])
+            if not np.array_equal(aok[oa], bok[ob]) \
+                    or not np.array_equal(np.where(aok, av, 0)[oa],
+                                          np.where(bok, bv, 0)[ob]):
+                raise AssertionError(
+                    f"streaming on/off mismatch on {name}")
+        out["identical"] = True
     return out
 
 
@@ -953,6 +1066,8 @@ def main(argv=None) -> int:
                     help="zone-map scan-pruning A/B micro-bench")
     ap.add_argument("--chunk-rows", type=int, default=1 << 14,
                     help="portion chunk size for --pruning")
+    ap.add_argument("--streaming", action="store_true",
+                    help="morsel-pipeline vs serialized cold-scan A/B")
     ap.add_argument("--resident", action="store_true",
                     help="HBM-resident vs staged warm scan A/B")
     ap.add_argument("--profile-overhead", action="store_true",
@@ -1000,6 +1115,9 @@ def main(argv=None) -> int:
             args.rows, args.chunk_rows, args.iters)
     if args.resident or args.smoke:
         report["resident"] = bench_resident(
+            args.rows, args.chunk_rows, args.iters)
+    if args.streaming or args.smoke:
+        report["streaming"] = bench_streaming(
             args.rows, args.chunk_rows, args.iters)
     if args.profile_overhead or args.smoke:
         # smoke: tiny run, lax bound (machinery + no-catastrophe
@@ -1057,6 +1175,17 @@ def main(argv=None) -> int:
                   f"{rr['resident_portions']} portions / "
                   f"{rr['resident_bytes']:,} B pinned, "
                   f"identical={rr['identical']})")
+        if "streaming" in report:
+            sm = report["streaming"]
+            pl = sm.get("pipeline") or {}
+            print(f"streaming rows={sm['rows']}: pipelined "
+                  f"{sm['pipelined_rows_per_sec']:,} rows/s vs "
+                  f"serialized {sm['serialized_rows_per_sec']:,} "
+                  f"rows/s (x{sm['pipeline_speedup']}, overlap="
+                  f"{sm.get('movement_compute_overlap')}, "
+                  f"{pl.get('morsels_io')} flights / "
+                  f"{pl.get('stolen')} stolen, "
+                  f"identical={sm['identical']})")
         if "profile_overhead" in report:
             po = report["profile_overhead"]
             print(f"profile overhead rows={po['rows']}: "

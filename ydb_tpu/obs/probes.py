@@ -124,26 +124,29 @@ class StageTimer:
         self._t: collections.defaultdict = collections.defaultdict(float)
         self._lock = threading.Lock()
 
-    def add(self, name: str, seconds: float) -> None:
+    def add(self, name: str, seconds: float, **args) -> None:
         # every stage charge ALSO lands on the data-movement timeline
         # (obs.timeline, default off) as an interval ending now — one
         # funnel, so timeline busy sums per stage equal the EXPLAIN
-        # ANALYZE stage seconds by construction
+        # ANALYZE stage seconds by construction. ``args`` attach to the
+        # ring interval (morsel ids from the streaming pipeline), never
+        # to the stage accumulator — occupancy attribution stays exact
+        # while each interval stays traceable to the work unit.
         if timeline.timeline_enabled():
             end = time.perf_counter()
             timeline.RING.record(
                 f"stage.{name}", name, end - seconds, end,
-                timeline.current_trace_id())
+                timeline.current_trace_id(), args or None)
         with self._lock:
             self._t[name] += seconds
 
     @contextlib.contextmanager
-    def stage(self, name: str):
+    def stage(self, name: str, **args):
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.add(name, time.perf_counter() - t0)
+            self.add(name, time.perf_counter() - t0, **args)
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -415,6 +415,8 @@ class Conveyor:
 
 _shared: Conveyor | None = None
 _shared_lock = threading.Lock()
+_stream: Conveyor | None = None
+_stream_lock = threading.Lock()
 
 
 def shared_conveyor() -> Conveyor:
@@ -440,3 +442,28 @@ def shared_conveyor() -> Conveyor:
             workers = int(os.environ.get("YDB_TPU_CONVEYOR_WORKERS", "4"))
             _shared = Conveyor(workers=max(1, workers))
         return _shared
+
+
+def stream_conveyor() -> Conveyor:
+    """Process-wide pool for morsel IO/decode tasks
+    (engine.stream_sched) — deliberately SEPARATE from
+    ``shared_conveyor``.
+
+    The shared pool's workers host long-lived scan staging PRODUCERS
+    that park for a scan's whole lifetime; short morsel tasks queued
+    behind them could wait on workers that never free while the
+    producers themselves wait on those morsels — a cycle. A dedicated
+    pool breaks it structurally, and the scheduler's work stealing
+    (engine.stream_sched) keeps even THIS pool's saturation from ever
+    blocking a consumer: an unstarted head morsel runs inline instead.
+    Unlike the shared pool, tasks here queue freely (they are finite,
+    not scan-lifetime), so ``submit`` is the right admission, not
+    ``submit_if_free``. YDB_TPU_STREAM_WORKERS sizes it (default 4).
+    Never shut this instance down — its threads are daemons and die
+    with the process."""
+    global _stream
+    with _stream_lock:
+        if _stream is None:
+            workers = int(os.environ.get("YDB_TPU_STREAM_WORKERS", "4"))
+            _stream = Conveyor(workers=max(1, workers))
+        return _stream
