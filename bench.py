@@ -533,6 +533,22 @@ def _hist_delta_p(hist, base_buckets, q):
     return hist.bounds[-1]
 
 
+def _syncsan_warm(label: str, fn, extra: dict, key: str) -> None:
+    """One warm statement under the sync sanitizer
+    (analysis/syncsan): record the host-boundary counters the
+    statement actually crossed — H2D/D2H transfers, blocking syncs,
+    XLA compiles — in the bench JSON. The dispatch-purity scoreboard
+    (ROADMAP item 1): warm compiles must be 0, syncs bounded."""
+    from ydb_tpu.analysis import syncsan
+
+    with syncsan.activate():
+        st = syncsan.begin_statement(label)
+        fn()
+        snap = syncsan.end_statement(st)
+    if snap is not None:
+        extra[key] = snap
+
+
 def run_serving_tier(extra: dict, budget: float) -> None:
     """Serving-throughput tier: N concurrent sessions firing a TPC-H
     Q1/Q6 statement mix at one cluster, batching off vs on
@@ -653,6 +669,21 @@ def run_serving_tier(extra: dict, budget: float) -> None:
                   "stacked_dispatches", "max_batch_size",
                   "scan_staged", "scan_attached"):
             extra[f"serving_batch_{k}"] = snap[k]
+        # warm per-statement host-boundary counters through the full
+        # session path (syncsan windows open in _execute_admitted, the
+        # counters ride the statement's profile): the serving-tier
+        # dispatch-purity evidence next to the QPS numbers
+        if _budget_left(budget) > 20:
+            from ydb_tpu.analysis import syncsan
+
+            with syncsan.activate():
+                s = sides["off"].session()
+                for name, sql in (("q1", TPCH["q1"]),
+                                  ("q6", TPCH["q6"])):
+                    s.execute(sql)
+                    p = s.last_profile
+                    if p is not None and p.syncsan:
+                        extra[f"serving_{name}_syncsan"] = p.syncsan
     finally:
         for c in sides.values():
             c.stop()
@@ -1082,6 +1113,9 @@ def main():
                     "q1", lambda: shard.scan(tpch.q1_program()),
                     extra, "engine_q1", "engine")
                 extra["engine_q1_profile"] = ph.profile.to_dict()
+                _syncsan_warm("q1",
+                              lambda: shard.scan(tpch.q1_program()),
+                              extra, "engine_q1_syncsan")
             engine_warm_rps = round(e_rows / ewarm1)
             _checkpoint("engine_q1", extra)
             if _budget_left(budget) < 45:
@@ -1103,6 +1137,9 @@ def main():
                     "q6", lambda: shard.scan(tpch.q6_program()),
                     extra, "engine_q6", "engine")
                 extra["engine_q6_profile"] = ph.profile.to_dict()
+                _syncsan_warm("q6",
+                              lambda: shard.scan(tpch.q6_program()),
+                              extra, "engine_q6_syncsan")
             _checkpoint("engine_q6", extra)
 
             # ---- resident tier: HBM-pinned columns vs the staged

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Pre-commit / CI analysis gate: run every static-analysis pillar
+# (verify self-test, lint, concurrency, lifecycle, hotpath) over the
+# files git reports changed, exiting with the analyzer's status.
+#
+#   scripts/analysis-gate.sh            # changed .py files only
+#   scripts/analysis-gate.sh --full     # the whole tree
+#
+# Prints per-stage finding counts; on failure the findings themselves
+# (file:line:col: CODE [name] message) so the breakage is actionable
+# without re-running anything. Documented in ydb_tpu/analysis/README.md.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+SCOPE=(--changed)
+if [[ "${1:-}" == "--full" ]]; then
+    SCOPE=()
+fi
+
+out=$(JAX_PLATFORMS=cpu python -m ydb_tpu.analysis "${SCOPE[@]}" --json) \
+    && rc=0 || rc=$?
+
+python - "$rc" <<'PY' "$out"
+import json
+import sys
+
+rc = int(sys.argv[1])
+stages = json.loads(sys.argv[2])
+total = 0
+for stage, findings in stages.items():
+    print(f"{stage}: {len(findings)} finding(s)")
+    total += len(findings)
+    for f in findings:
+        print(f"  {f['file']}:{f['line']}:{f['col']}: "
+              f"{f['code']} [{f['name']}] {f['message']}")
+if total == 0 and rc == 0:
+    print("analysis gate: clean")
+else:
+    print(f"analysis gate: {total} finding(s) — fix, mark "
+          "@analysis.host_ok(reason), or suppress with a justified "
+          "'# ydb-lint: disable=<code>' pragma")
+sys.exit(rc)
+PY

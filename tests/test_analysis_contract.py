@@ -1,0 +1,123 @@
+"""Unified-analyzer contract: ``python -m ydb_tpu.analysis --json``
+emits one stable schema across all five pillars — a dict of stage ->
+finding list, every finding carrying exactly
+``{file, line, col, code, name, message}``. CI tooling and the
+analysis gate parse this shape; a pillar drifting to its own schema is
+a silent gate break."""
+
+import json
+import textwrap
+
+from ydb_tpu.analysis import concurrency, hotpath, lifecycle, lint
+from ydb_tpu.analysis.__main__ import (
+    _verify_selftest,
+    format_findings,
+    main,
+    run_all,
+)
+
+STAGES = ("verify", "lint", "concurrency", "lifecycle", "hotpath")
+FIELDS = {"file", "line", "col", "code", "name", "message"}
+
+#: one seeded violation per AST pillar, chosen from each pillar's
+#: documented rule set (L005 / C005 / R001 / H001)
+_SEEDS = {
+    "lint": """
+        def f(x=[]):
+            return x
+    """,
+    "concurrency": """
+        _cache = {}
+
+        def put(k, v):
+            _cache[k] = v
+    """,
+    "lifecycle": """
+        class C:
+            def f(self):
+                self.lock.acquire()
+                self.work()
+                self.lock.release()
+    """,
+    "hotpath": """
+        class Session:
+            def _execute_admitted(self, sql):
+                return out.item()
+    """,
+}
+
+
+def _seeded(stage):
+    src = textwrap.dedent(_SEEDS[stage])
+    if stage == "lint":
+        return lint.lint_source(src, "seed.py")
+    if stage == "concurrency":
+        return concurrency.check_source(src, "seed.py")
+    if stage == "lifecycle":
+        return lifecycle.check_source(src, "seed.py")
+    return hotpath.check_source(src, "seed.py", modname="kqp.session")
+
+
+def test_every_pillar_emits_the_unified_schema():
+    for stage in ("lint", "concurrency", "lifecycle", "hotpath"):
+        findings = _seeded(stage)
+        assert findings, f"{stage} seed fired nothing"
+        for f in findings:
+            d = f.to_dict()
+            assert set(d) == FIELDS, \
+                f"{stage} finding schema drifted: {sorted(d)}"
+            assert isinstance(d["line"], int)
+            assert isinstance(d["col"], int)
+            assert d["code"][0] in "LCRH"
+            # the JSON surface round-trips
+            assert json.loads(json.dumps(d)) == d
+
+
+def test_verify_selftest_dicts_match_the_schema():
+    """The verify stage reports ready-made dicts (it checks programs,
+    not files); on a healthy tree it reports none — force its failure
+    shape by inspecting the synthesized payloads directly."""
+    from ydb_tpu.analysis.__main__ import _verify_selftest
+
+    assert _verify_selftest() == []  # healthy checker
+    # schema of the synthesized failure payloads is pinned in source:
+    # any drift would break this stage's JSON vs the other four
+    import inspect
+
+    src = inspect.getsource(_verify_selftest)
+    for field in sorted(FIELDS):
+        assert f'"{field}"' in src
+
+
+def test_run_all_stage_order_and_shape(tmp_path):
+    f = tmp_path / "ydb_tpu" / "kqp"
+    f.mkdir(parents=True)
+    (f / "session.py").write_text(textwrap.dedent(_SEEDS["hotpath"]))
+    stages = run_all([tmp_path])
+    assert tuple(stages) == STAGES
+    assert [d["code"] for d in stages["hotpath"]] == ["H001"]
+    for findings in stages.values():
+        for d in findings:
+            assert set(d) == FIELDS
+
+
+def test_json_cli_round_trip(tmp_path, capsys):
+    f = tmp_path / "ydb_tpu" / "kqp"
+    f.mkdir(parents=True)
+    (f / "session.py").write_text(textwrap.dedent(_SEEDS["hotpath"]))
+    rc = main([str(tmp_path), "--json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert tuple(payload) == STAGES
+    assert payload["hotpath"][0]["code"] == "H001"
+    assert set(payload["hotpath"][0]) == FIELDS
+
+
+def test_format_findings_is_readable():
+    stages = {s: [] for s in STAGES}
+    assert format_findings(stages) == "no findings"
+    stages["hotpath"] = [d.to_dict() for d in _seeded("hotpath")]
+    text = format_findings(stages)
+    assert "hotpath: 1 finding(s)" in text
+    assert "seed.py:4:" in text and "H001" in text
+    assert "{" not in text  # never a raw dict dump

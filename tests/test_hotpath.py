@@ -1,0 +1,380 @@
+"""Dispatch-purity analyzer (H001-H006): seeded warm-path fixtures
+firing every rule, the @host_ok escape hatch, pragma suppression, and
+the interprocedural walk (descent, chain breadcrumbs, cold-body
+boundaries at compile/plan calls, constructors not followed)."""
+
+import ast
+import textwrap
+
+from ydb_tpu.analysis import hotpath
+from ydb_tpu.analysis.hotpath import HOT_ROOTS, RULES
+
+
+ROOT = (("kqp.session", "Session._execute_admitted"),)
+
+
+def _findings(src, modname="kqp.session", extra=()):
+    sources = [(textwrap.dedent(src), f"<{modname}>", modname)]
+    for s, m in extra:
+        sources.append((textwrap.dedent(s), f"<{m}>", m))
+    return hotpath.check_sources(sources, roots=ROOT)
+
+
+def _codes(src, **kw):
+    return [f.code for f in _findings(src, **kw)]
+
+
+# ---------------- per-rule firing fixtures ----------------
+
+
+def test_h000_syntax_error():
+    assert _codes("def f(:\n") == ["H000"]
+
+
+def test_h001_item_sync():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            return total.item()
+    """
+    fs = _findings(src)
+    assert [f.code for f in fs] == ["H001"]
+    assert "warm path: Session._execute_admitted" in fs[0].message
+
+
+def test_h001_sync_roots_and_fetch_methods():
+    src = """
+    import numpy as np
+
+    class Session:
+        def _execute_admitted(self, sql):
+            a = np.asarray(out)
+            b = jax.device_get(out)
+            c = block.to_numpy()
+            jax.block_until_ready(out)
+            return a, b, c
+    """
+    assert _codes(src) == ["H001"] * 4
+
+
+def test_h002_formatted_cache_key():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            self._plan_cache[f"{sql}:{shape}"] = plan
+            hit = self._plan_cache.get("%s" % sql)
+            self._exec_cache[id(plan)] = fn
+            return hit
+    """
+    assert _codes(src) == ["H002"] * 3
+
+
+def test_h002_structured_key_is_clean():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            self._plan_cache[(sql, tuple(shape))] = plan
+            return self._plan_cache.get((sql, dialect))
+    """
+    assert _codes(src) == []
+
+
+def test_h003_compile_calls_flagged_and_body_cold():
+    src = """
+    import jax
+
+    class Session:
+        def _execute_admitted(self, sql):
+            fn = jax.jit(kern)
+            return compile_program(prog, sch)
+
+    def compile_program(prog, sch):
+        return arr.item()  # cold compile body: never reported
+    """
+    assert _codes(src) == ["H003", "H003"]
+
+
+def test_h003_str_lower_not_confused_with_jax_lower():
+    src = """
+    import re
+
+    class Session:
+        def _execute_admitted(self, sql):
+            pat = re.compile("x")
+            return sql.lower()
+    """
+    assert _codes(src) == []
+
+
+def test_h004_plan_calls_flagged_and_body_cold():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            plan = parse(sql)
+            return plan_signature(plan, db)
+
+    def parse(sql):
+        return np.asarray(sql)  # cold planner body: never reported
+    """
+    assert _codes(src) == ["H004", "H004"]
+
+
+def test_h005_host_alloc():
+    src = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    class Session:
+        def _execute_admitted(self, sql):
+            pad = np.zeros(128)
+            staged = jnp.asarray(aux)
+            return pad, staged
+    """
+    assert _codes(src) == ["H005", "H005"]
+
+
+def test_h006_row_loops():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            for r in rows:
+                use(r)
+            for i in range(len(xs)):
+                use(i)
+            for v in vals.tolist():
+                use(v)
+    """
+    assert _codes(src) == ["H006"] * 3
+
+
+def test_h006_bounded_non_row_loop_clean():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            for shard in self.shards:
+                use(shard)
+    """
+    assert _codes(src) == []
+
+
+# ---------------- path scoping ----------------
+
+
+def test_cold_code_is_not_judged():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            return run(sql)
+
+        def boot(self):
+            return huge.item()  # unreachable from the root: fine
+    """
+    assert _codes(src) == []
+
+
+def test_interprocedural_descent_with_chain():
+    src = """
+    import numpy as np
+
+    class Session:
+        def _execute_admitted(self, sql):
+            return helper(sql)
+
+    def helper(sql):
+        return stage(sql)
+
+    def stage(sql):
+        return np.asarray(sql)
+    """
+    fs = _findings(src)
+    assert [f.code for f in fs] == ["H001"]
+    assert ("Session._execute_admitted -> helper -> stage"
+            in fs[0].message)
+
+
+def test_self_method_descent():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            return self._finish(sql)
+
+        def _finish(self, sql):
+            return out.item()
+    """
+    assert _codes(src) == ["H001"]
+
+
+def test_cross_module_import_descent():
+    session = """
+    from ydb_tpu.plan.executor import run_plan
+
+    class Session:
+        def _execute_admitted(self, sql):
+            return run_plan(sql)
+    """
+    executor = """
+    import numpy as np
+
+    def run_plan(sql):
+        return np.asarray(sql)
+    """
+    fs = _findings(session, extra=((executor, "plan.executor"),))
+    assert [f.code for f in fs] == ["H001"]
+    assert "run_plan" in fs[0].message
+
+
+def test_constructor_calls_not_followed():
+    src = """
+    class Cursor:
+        def __init__(self, x):
+            self.v = x.item()  # setup, not dispatch
+
+    class Session:
+        def _execute_admitted(self, sql):
+            return Cursor(sql)
+    """
+    assert _codes(src) == []
+
+
+def test_generic_method_names_not_wired_across_classes():
+    src = """
+    class StreamScheduler:
+        def items(self):
+            return buf.item()
+
+    class Session:
+        def _execute_admitted(self, sql):
+            return self.aux.items()
+    """
+    assert _codes(src) == []
+
+
+# ---------------- escapes ----------------
+
+
+def test_host_ok_callee_not_reported_or_descended():
+    src = """
+    from ydb_tpu.analysis import host_ok
+
+    class Session:
+        def _execute_admitted(self, sql):
+            return self._fetch()
+
+        @host_ok("deliberate result fetch")
+        def _fetch(self):
+            return self.block.to_numpy()
+    """
+    assert _codes(src) == []
+
+
+def test_host_ok_underscore_alias_matches():
+    src = """
+    from ydb_tpu.analysis import host_ok as _host_ok
+
+    class Session:
+        def _execute_admitted(self, sql):
+            return self._fetch()
+
+        @_host_ok("row DML readback")
+        def _fetch(self):
+            return self.block.to_numpy()
+    """
+    assert _codes(src) == []
+
+
+def test_pragma_suppresses_on_line_and_line_above():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            a = out.item()  # ydb-lint: disable=H001
+            # ydb-lint: disable=H001 deliberate: result boundary
+            b = out2.item()
+            return a, b
+    """
+    assert _codes(src) == []
+
+
+def test_pragma_is_code_specific():
+    src = """
+    class Session:
+        def _execute_admitted(self, sql):
+            return out.item()  # ydb-lint: disable=H006
+    """
+    assert _codes(src) == ["H001"]
+
+
+# ---------------- driver surface ----------------
+
+
+def test_rule_table_complete():
+    assert sorted(RULES) == \
+        ["H001", "H002", "H003", "H004", "H005", "H006"]
+    assert len(HOT_ROOTS) == 5
+
+
+def test_cli_exit_code_clean_and_dirty(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f():\n    return 1\n")
+    assert hotpath.main([str(clean)]) == 0
+    bad = tmp_path / "ydb_tpu" / "kqp"
+    bad.mkdir(parents=True)
+    (bad / "session.py").write_text(
+        "class Session:\n"
+        "    def _execute_admitted(self, sql):\n"
+        "        return out.item()\n")
+    assert hotpath.main([str(bad / "session.py")]) == 1
+    out = capsys.readouterr().out
+    assert "H001" in out
+
+
+def test_report_files_narrow_reporting_not_the_index():
+    """--changed must not shrink the call-graph index (a file subset
+    makes ambiguous methods look unique and the walk enters cold
+    code) — it only filters which files findings are reported for."""
+    session = textwrap.dedent("""
+    from ydb_tpu.plan.executor import run_plan
+
+    class Session:
+        def _execute_admitted(self, sql):
+            return run_plan(sql)
+    """)
+    executor = textwrap.dedent("""
+    import numpy as np
+
+    def run_plan(sql):
+        return np.asarray(sql)
+    """)
+    sources = [(session, "<kqp.session>", "kqp.session"),
+               (executor, "<plan.executor>", "plan.executor")]
+    full = hotpath.check_sources(sources, roots=ROOT)
+    assert [f.code for f in full] == ["H001"]
+    only_session = hotpath.check_sources(
+        sources, roots=ROOT, report_files={"<kqp.session>"})
+    assert only_session == []  # the hazard file is out of scope
+    only_exec = hotpath.check_sources(
+        sources, roots=ROOT, report_files={"<plan.executor>"})
+    assert [f.code for f in only_exec] == ["H001"]
+
+
+def test_modname_derived_from_package_path():
+    assert hotpath._modname_for(
+        "/x/y/ydb_tpu/kqp/session.py") == "kqp.session"
+    assert hotpath._modname_for("plain.py") == "plain"
+
+
+def test_findings_sorted_and_json_shaped():
+    src = """
+    import numpy as np
+
+    class Session:
+        def _execute_admitted(self, sql):
+            b = np.zeros(4)
+            a = out.item()
+            return a, b
+    """
+    fs = _findings(src)
+    assert [(f.line, f.code) for f in fs] == \
+        sorted((f.line, f.code) for f in fs)
+    for f in fs:
+        assert set(f.to_dict()) == \
+            {"file", "line", "col", "code", "name", "message"}

@@ -1,0 +1,42 @@
+"""Tier-1 enforcement: the dispatch-purity analyzer runs clean over
+the whole ydb_tpu package (the H-rule analog of test_lint_clean /
+test_concurrency_clean / test_lifecycle_clean). A finding here means a
+code change put host work on the warm statement corridor — fix the
+code, mark a deliberate boundary ``@analysis.host_ok("reason")``, or
+justify a reviewed site with a ``# ydb-lint: disable=H00x`` pragma."""
+
+import ast
+from pathlib import Path
+
+from ydb_tpu.analysis import hotpath
+from ydb_tpu.analysis.paths import collect_files
+
+PKG = Path(hotpath.__file__).resolve().parents[1]
+
+
+def test_hotpath_clean_tree_wide():
+    findings = hotpath.check_paths(collect_files([PKG]))
+    msg = "\n".join(f.render() for f in findings)
+    assert findings == [], \
+        f"{len(findings)} hot-path finding(s):\n{msg}"
+
+
+def test_every_declared_root_resolves():
+    """Each HOT_ROOT must name a real function — a rename would
+    otherwise silently shrink the corridor and the clean test above
+    would pass vacuously."""
+    modules = []
+    for f in collect_files([PKG]):
+        try:
+            tree = ast.parse(f.read_text(encoding="utf-8"),
+                             filename=str(f))
+        except SyntaxError:
+            continue
+        modules.append(hotpath._Module(
+            hotpath._modname_for(str(f)), str(f), tree))
+    index = hotpath._Index(modules)
+    for suffix, qual in hotpath.HOT_ROOTS:
+        m = index.by_suffix(suffix)
+        assert m is not None, f"root module {suffix!r} not found"
+        assert qual in m.fns, \
+            f"root {qual!r} missing from {suffix!r} — renamed?"

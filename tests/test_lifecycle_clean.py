@@ -21,10 +21,14 @@ def test_lifecycle_clean_tree_wide():
 
 def test_unified_entrypoint_clean_tree_wide():
     """The one-command surface (python -m ydb_tpu.analysis) CI invokes
-    must agree: every stage clean over the package."""
-    from ydb_tpu.analysis.__main__ import run_all
+    must agree: every stage clean over the package. On failure the
+    message is the per-stage summary (file:line: code message), not a
+    raw dict dump."""
+    from ydb_tpu.analysis.__main__ import format_findings, run_all
 
     stages = run_all([PKG])
-    assert set(stages) == {"verify", "lint", "concurrency", "lifecycle"}
+    assert set(stages) == {"verify", "lint", "concurrency",
+                           "lifecycle", "hotpath"}
     bad = {k: v for k, v in stages.items() if v}
-    assert not bad, f"unified analyzer findings: {bad}"
+    assert not bad, \
+        f"unified analyzer findings:\n{format_findings(stages)}"

@@ -146,6 +146,48 @@ def test_unknown_window_function():
     assert "ntile" in d.message
 
 
+def test_duplicate_projection_column():
+    prog = Program((ProjectStep(("a", "b", "a")),))
+    d = _only(verify_program(prog, SCH), "V010")
+    assert d.name == "duplicate-output-column"
+    assert d.step == 0
+    assert d.path == "steps[0].names[2]"
+    assert "'a'" in d.message
+    with pytest.raises(VerificationError):
+        check_program(prog, SCH)
+
+
+def test_duplicate_group_by_key():
+    prog = Program((
+        GroupByStep(("a", "a"), (AggSpec(Agg.COUNT_ALL, None, "n"),)),
+    ))
+    d = _only(verify_program(prog, SCH), "V010")
+    assert d.path == "steps[0].keys[1]"
+
+
+def test_aggregate_output_shadows_key():
+    prog = Program((
+        GroupByStep(("a",), (
+            AggSpec(Agg.COUNT_ALL, None, "a"),   # collides with key
+            AggSpec(Agg.SUM, "b", "t"),
+            AggSpec(Agg.COUNT_ALL, None, "t"),   # collides with agg
+        )),
+    ))
+    hits = [d for d in verify_program(prog, SCH) if d.code == "V010"]
+    assert [d.path for d in hits] == \
+        ["steps[0].aggs[0]", "steps[0].aggs[2]"]
+    assert all(d.hint for d in hits)
+
+
+def test_distinct_outputs_stay_clean():
+    prog = Program((
+        GroupByStep(("a",), (AggSpec(Agg.SUM, "b", "t"),)),
+        ProjectStep(("a", "t")),
+    ))
+    assert not [d for d in verify_program(prog, SCH)
+                if d.code == "V010"]
+
+
 def test_multiple_diagnostics_accumulate():
     prog = Program((
         FilterStep(Col("a")),            # V002

@@ -1,0 +1,297 @@
+"""Sync sanitizer (analysis/syncsan, YDB_TPU_SYNCSAN=1): seam
+counters, statement attribution (thread-local + trace-id), warm
+budget enforcement, profile / EXPLAIN ANALYZE surfacing, and the
+tier-1 acceptance run — warm TPC-H Q1/Q6 through the engine-tier
+scan executor must show ZERO XLA compilations and a bounded sync
+count per statement."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ydb_tpu.analysis import syncsan
+from ydb_tpu.obs.tracing import Tracer
+from ydb_tpu.obs.tracing import activate as span_activate
+
+#: the documented warm-statement sync budget for engine-tier scans:
+#: one batched device_get at the deliberate result fetch, plus one
+#: admission sync allowance for the morsel window on deep streams
+#: (measured warm Q1/Q6: exactly 1 sync per statement)
+WARM_SYNC_BUDGET = 2
+
+
+@pytest.fixture(autouse=True)
+def _syncsan_off_after():
+    """Every test leaves the sanitizer unpinned, unbudgeted, empty."""
+    yield
+    syncsan.clear_budget()
+    syncsan.set_force(None)
+    syncsan.reset()
+
+
+# ---------------- gates / None-safety ----------------
+
+
+def test_disabled_is_none_safe():
+    assert not syncsan.enabled()
+    assert syncsan.begin_statement("q") is None
+    assert syncsan.end_statement(None) is None
+    syncsan.discard(None)  # no-op, no raise
+
+
+def test_env_gate(monkeypatch):
+    monkeypatch.setenv("YDB_TPU_SYNCSAN", "1")
+    assert syncsan.enabled()
+    monkeypatch.setenv("YDB_TPU_SYNCSAN", "0")
+    assert not syncsan.enabled()
+    syncsan.set_force(True)
+    assert syncsan.enabled()  # pin beats env
+
+
+def test_seams_restored_on_disarm():
+    import jax
+    import jax.numpy as jnp
+
+    before = (jax.block_until_ready, jax.device_get, jnp.asarray,
+              np.asarray)
+    with syncsan.activate():
+        assert jax.device_get is not before[1]
+        assert np.asarray is not before[3]
+    after = (jax.block_until_ready, jax.device_get, jnp.asarray,
+             np.asarray)
+    assert after == before
+
+
+# ---------------- counters + attribution ----------------
+
+
+def test_seam_counters_attribute_to_statement():
+    import jax
+    import jax.numpy as jnp
+
+    host = np.arange(8)
+    with syncsan.activate():
+        st = syncsan.begin_statement("q")
+        dev = jnp.asarray(host)         # H2D
+        jax.block_until_ready(dev)      # sync
+        back = jax.device_get(dev)      # D2H + sync
+        again = np.asarray(dev)         # D2H + sync
+        snap = syncsan.end_statement(st)
+    np.testing.assert_array_equal(back, host)
+    np.testing.assert_array_equal(again, host)
+    assert snap["h2d"] >= 1
+    assert snap["d2h"] >= 2
+    assert snap["syncs"] >= 3
+    assert snap["compiles"] == 0
+
+
+def test_np_asarray_on_host_data_not_counted():
+    with syncsan.activate():
+        st = syncsan.begin_statement("q")
+        np.asarray([1, 2, 3])  # host->host: free
+        snap = syncsan.end_statement(st)
+    assert snap == {"h2d": 0, "d2h": 0, "syncs": 0, "compiles": 0}
+
+
+def test_trace_id_attribution_across_threads():
+    """Conveyor workers carry no thread-local window; they resolve
+    through the obs span they inherited and the trace-id registry."""
+    import jax
+    import jax.numpy as jnp
+
+    with syncsan.activate():
+        tr = Tracer()
+        root = tr.trace("query")
+        st = syncsan.begin_statement("q", trace_id=root.trace_id)
+        dev = jnp.asarray(np.arange(4))
+
+        def worker():
+            with span_activate(root):
+                jax.block_until_ready(dev)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        snap = syncsan.end_statement(st)
+        root.finish()
+    assert snap["syncs"] >= 1
+
+
+def test_unattributed_counts_land_in_orphans():
+    import jax
+    import jax.numpy as jnp
+
+    with syncsan.activate():
+        jax.block_until_ready(jnp.asarray(np.arange(4)))
+        tot = syncsan.totals()
+    assert tot["h2d"] >= 1 and tot["syncs"] >= 1
+
+
+def test_compile_listener_counts_cold_compile_only():
+    import jax
+    import jax.numpy as jnp
+
+    with syncsan.activate():
+        @jax.jit
+        def f(x):
+            return x * 2 + 1
+
+        st = syncsan.begin_statement("cold")
+        f(jnp.asarray(np.arange(6)))
+        cold = syncsan.end_statement(st)
+        st = syncsan.begin_statement("warm")
+        f(jnp.asarray(np.arange(6)))
+        warm = syncsan.end_statement(st)
+    assert cold["compiles"] >= 1
+    assert warm["compiles"] == 0
+
+
+# ---------------- budget enforcement ----------------
+
+
+def test_warm_budget_enforced_past_warmup():
+    import jax
+    import jax.numpy as jnp
+
+    budget = syncsan.Budget(compiles=0, syncs=0, warmup=1)
+    with syncsan.activate(budget=budget):
+        st = syncsan.begin_statement("q")
+        jax.block_until_ready(jnp.asarray(np.arange(4)))
+        syncsan.end_statement(st)  # warmup statement: free pass
+        st = syncsan.begin_statement("q")
+        jax.block_until_ready(jnp.asarray(np.arange(4)))
+        with pytest.raises(syncsan.SyncBudgetError, match="blocked"):
+            syncsan.end_statement(st)
+        # a different label gets its own warmup window
+        st = syncsan.begin_statement("other")
+        jax.block_until_ready(jnp.asarray(np.arange(4)))
+        syncsan.end_statement(st)
+
+
+def test_compile_budget_message_names_the_cache():
+    with syncsan.activate(
+            budget=syncsan.Budget(compiles=0, warmup=0)):
+        st = syncsan.begin_statement("q")
+        st.note(compiles=1)
+        with pytest.raises(syncsan.SyncBudgetError,
+                           match="compile cache"):
+            syncsan.end_statement(st)
+
+
+def test_discard_skips_enforcement():
+    with syncsan.activate(
+            budget=syncsan.Budget(compiles=0, syncs=0, warmup=0)):
+        st = syncsan.begin_statement("q")
+        st.note(syncs=5, compiles=5)
+        syncsan.discard(st)  # error path: no budget raise
+
+
+# ---------------- obs surfacing ----------------
+
+
+def test_end_statement_annotates_span_and_profile():
+    from ydb_tpu.obs.profile import build_profile
+
+    with syncsan.activate():
+        tr = Tracer()
+        root = tr.trace("query")
+        with span_activate(root):
+            st = syncsan.begin_statement("q",
+                                         trace_id=root.trace_id)
+            st.note(h2d=2, d2h=1, syncs=3)
+            syncsan.end_statement(st)
+        root.finish()
+        spans = tr.spans_for(root.trace_id)
+    attrs = spans[0].attrs
+    assert attrs["syncsan_h2d"] == 2
+    assert attrs["syncsan_syncs"] == 3
+    p = build_profile(spans, sql="q")
+    assert p.syncsan == {"h2d": 2, "d2h": 1, "syncs": 3,
+                         "compiles": 0}
+    assert "syncsan" in p.to_dict()
+
+
+def test_session_execute_populates_profile_syncsan():
+    """The plain execute path: begin_statement runs BEFORE the root
+    span is activated, so the session must pin the span explicitly —
+    last_profile.syncsan carrying this statement's counters is the
+    serving-tier bench's data source."""
+    from ydb_tpu.kqp.session import Cluster
+
+    with syncsan.activate():
+        c = Cluster()
+        s = c.session()
+        s.execute("CREATE TABLE ev (id int64, v int64, "
+                  "PRIMARY KEY (id))")
+        s.execute("INSERT INTO ev VALUES (1, 2), (2, 4)")
+        s.execute("SELECT sum(v) AS sv FROM ev")
+        p = s.last_profile
+    assert p is not None and p.syncsan, \
+        "statement counters missing from the profile"
+    assert set(p.syncsan) == {"h2d", "d2h", "syncs", "compiles"}
+
+
+def test_explain_analyze_shows_syncsan_line():
+    from ydb_tpu.kqp.session import Cluster
+
+    with syncsan.activate():
+        c = Cluster()
+        s = c.session()
+        s.execute("CREATE TABLE ev (id int64, v int64, "
+                  "PRIMARY KEY (id))")
+        s.execute("INSERT INTO ev VALUES (1, 2), (2, 4)")
+        txt = s.execute("EXPLAIN ANALYZE SELECT sum(v) AS sv FROM ev")
+    assert "syncsan:" in txt
+    assert "compiles=" in txt
+
+
+# ---------------- tier-1 acceptance: warm Q1/Q6 engine tier ----------
+
+
+@pytest.fixture(scope="module")
+def lineitem():
+    from ydb_tpu.engine.scan import ColumnSource
+    from ydb_tpu.workload import tpch
+
+    data = tpch.TpchData(sf=0.002, seed=7)
+    return data, ColumnSource(
+        columns=data.tables["lineitem"],
+        schema=data.schema("lineitem"),
+        dicts=data.dicts,
+    )
+
+
+def test_warm_q1_q6_zero_compiles_bounded_syncs(lineitem):
+    """The acceptance budget from the dispatch-purity work: a warm
+    statement through the engine tier (ScanExecutor.run_stream, the
+    declared hot root) performs ZERO XLA compilations and at most
+    WARM_SYNC_BUDGET blocking syncs — enforced by the sanitizer's own
+    budget machinery, so a regression raises SyncBudgetError here."""
+    from ydb_tpu.engine.scan import ScanExecutor
+    from ydb_tpu.workload import tpch
+
+    data, src = lineitem
+    budget = syncsan.Budget(compiles=0, syncs=WARM_SYNC_BUDGET,
+                            warmup=1)
+    with syncsan.activate(budget=budget):
+        for name, prog in (("q1", tpch.q1_program()),
+                           ("q6", tpch.q6_program())):
+            ex = ScanExecutor(prog, src, block_rows=4096)
+            snaps = []
+            for _ in range(3):
+                st = syncsan.begin_statement(name)
+                out = ex.run_stream(
+                    src.blocks(4096, ex.read_cols))
+                out.host_columns()  # the ONE deliberate fetch
+                # end_statement enforces the budget past warmup —
+                # a warm compile or sync regression raises here
+                snaps.append(syncsan.end_statement(st))
+            cold, warm = snaps[0], snaps[1:]
+            assert cold["compiles"] >= 1, \
+                f"{name}: cold run saw no compile — listener dead?"
+            for snap in warm:
+                assert snap["compiles"] == 0, (name, snap)
+                assert 1 <= snap["syncs"] <= WARM_SYNC_BUDGET, \
+                    (name, snap)
+                assert snap["d2h"] == 1, (name, snap)  # batched fetch

@@ -1,6 +1,6 @@
-"""Static analysis: SSA verification, lint, concurrency, lifecycle.
+"""Static analysis: SSA verify, lint, concurrency, lifecycle, hotpath.
 
-Four pillars (README.md in this directory):
+Five pillars (README.md in this directory):
   * ``verify`` — the typed SSA program checker every SQL→SSA lowering
     passes through before any JAX trace (the TProgramContainer::Init
     analog, ydb/core/tx/program/program.cpp:553).
@@ -21,20 +21,53 @@ Four pillars (README.md in this directory):
     plus a runtime leak sanitizer (``YDB_TPU_LEAKSAN=1``) whose
     tracked handles must drain to zero at statement completion and
     Cluster.stop. ``python -m ydb_tpu.analysis.lifecycle``.
+  * ``hotpath`` + ``syncsan`` — dispatch purity. The static half
+    walks an interprocedural call graph from the declared warm
+    statement roots (session execute, batch dispatch, cached
+    executable call, streamed scan, resident lookup) and flags
+    per-statement host work (H001-H006: device syncs, unstable cache
+    keys, per-dispatch compile/plan calls, host allocation, Python
+    row loops); the runtime half (``YDB_TPU_SYNCSAN=1``) counts
+    transfers/syncs/compiles per statement at the JAX seams,
+    attributes them to obs spans and enforces a warm budget of zero
+    compilations. ``python -m ydb_tpu.analysis.hotpath``.
 
-``python -m ydb_tpu.analysis`` runs all four and exits 1 on any
-finding. ``sanitizer`` and ``leaksan`` keep a bare dependency set
-(os + threading + traceback) so the low-level runtime modules
-(conveyor, probes, counters, blockcache) can import them safely:
-``from ydb_tpu.analysis import leaksan``.
+``python -m ydb_tpu.analysis`` runs all five and exits 1 on any
+finding. ``sanitizer``, ``leaksan`` and ``syncsan`` keep a bare
+import-time dependency set (os + threading + obs.tracing) so the
+low-level runtime modules (conveyor, probes, counters, blockcache)
+can import them safely: ``from ydb_tpu.analysis import leaksan``.
+
+``host_ok`` is the hotpath escape hatch: decorating a function
+declares its host work deliberate (the lazy result fetch, a guarded
+compile-cache miss path) — the analyzer neither reports nor descends
+into it, and the reason string documents why at the site.
 """
 
-from ydb_tpu.analysis.diagnostics import (  # noqa: F401
+# host_ok is defined BEFORE the verify import: modules inside the
+# verify->ssa import chain (ssa.compiler) resolve
+# ``from ydb_tpu.analysis import host_ok`` against this partially
+# initialized package, so the name must already be bound when the
+# chain re-enters here.
+def host_ok(reason: str):
+    """Mark a function's host work as deliberate for the dispatch-
+    purity analyzer (``hotpath.py``). The decorated function is
+    excluded from the warm-path walk; ``reason`` says why the host
+    boundary crossing is intentional (e.g. "lazy result fetch")."""
+
+    def mark(fn):
+        fn.__host_ok__ = reason
+        return fn
+
+    return mark
+
+
+from ydb_tpu.analysis.diagnostics import (  # noqa: F401,E402
     Diagnostic,
     PlanError,
     VerificationError,
 )
-from ydb_tpu.analysis.verify import (  # noqa: F401
+from ydb_tpu.analysis.verify import (  # noqa: F401,E402
     ProgramAnalysis,
     analyze_program,
     check_program,

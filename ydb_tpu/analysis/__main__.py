@@ -2,7 +2,7 @@
 
     python -m ydb_tpu.analysis [path ...] [--json] [--changed]
 
-Runs the four static pillars in order over a single shared CLI surface
+Runs the five static pillars in order over a single shared CLI surface
 (``paths.py`` collection + ``suppress.py`` pragmas):
 
   verify       SSA program checker self-test — the one pillar that
@@ -12,6 +12,7 @@ Runs the four static pillars in order over a single shared CLI surface
   lint         L-rules (jit hazards)            — lint.py
   concurrency  C-rules (lock/guard discipline)  — concurrency.py
   lifecycle    R-rules (acquire/release pairing) — lifecycle.py
+  hotpath      H-rules (dispatch purity)        — hotpath.py
 
 Exit status 1 when ANY stage reports findings, so CI and builders
 invoke exactly one command. Per-tool runs stay available
@@ -23,7 +24,7 @@ from __future__ import annotations
 import json
 import sys
 
-from ydb_tpu.analysis import concurrency, lifecycle, lint
+from ydb_tpu.analysis import concurrency, hotpath, lifecycle, lint
 from ydb_tpu.analysis.paths import collect_files, parse_cli
 
 
@@ -62,13 +63,22 @@ def _verify_selftest() -> list:
 
 
 def run_all(paths=(), changed: bool = False) -> dict:
-    """All four pillars over one collected file list. Returns
+    """All five pillars over one collected file list. Returns
     ``{stage: [finding dict, ...]}`` in run order."""
     files = collect_files(list(paths), changed=changed)
     lint_findings: list = []
     for p in files:
         lint_findings.extend(
             lint.lint_source(p.read_text(encoding="utf-8"), str(p)))
+    # the hotpath walker is path-scoped: its call-graph index must
+    # always cover the full roots — under --changed it only narrows
+    # which files findings are REPORTED for, else a file subset makes
+    # ambiguous methods look unique and the walk enters cold code
+    hot_files = files
+    hot_report = None
+    if changed:
+        hot_files = collect_files(list(paths))
+        hot_report = {str(f) for f in files}
     return {
         "verify": _verify_selftest(),
         "lint": [f.to_dict() for f in lint_findings],
@@ -76,7 +86,24 @@ def run_all(paths=(), changed: bool = False) -> dict:
                         for f in concurrency.check_paths(files)],
         "lifecycle": [f.to_dict()
                       for f in lifecycle.check_paths(files)],
+        "hotpath": [f.to_dict() for f in hotpath.check_paths(
+            hot_files, report_files=hot_report)],
     }
+
+
+def format_findings(stages: dict) -> str:
+    """Readable multi-finding summary for clean-tree assertions: every
+    finding on its own ``file:line:col: CODE [name] message`` line,
+    grouped by stage, instead of one opaque repr of the whole dict."""
+    out = []
+    for stage, findings in stages.items():
+        if not findings:
+            continue
+        out.append(f"{stage}: {len(findings)} finding(s)")
+        for f in findings:
+            out.append(f"  {f['file']}:{f['line']}:{f['col']}: "
+                       f"{f['code']} [{f['name']}] {f['message']}")
+    return "\n".join(out) if out else "no findings"
 
 
 def main(argv=None) -> int:

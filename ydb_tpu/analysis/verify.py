@@ -40,6 +40,9 @@ Error codes (see ydb_tpu/analysis/README.md):
   V007 expr-type               expression cannot be typed (bad operands)
   V008 sort-desc-arity         descending flags do not match sort keys
   V009 unknown-window-function window function is not rank-family
+  V010 duplicate-output-column projection/group-by emits one output
+                               name twice (later write would silently
+                               shadow the earlier column)
 """
 
 from __future__ import annotations
@@ -248,6 +251,15 @@ class _Verifier:
         elif isinstance(s, ProjectStep):
             kept: list = []
             for j, n in enumerate(s.names):
+                if n in kept:
+                    self.diag(
+                        "V010", "duplicate-output-column",
+                        f"projection lists column {n!r} twice — the"
+                        " output would carry one physical column under"
+                        " a repeated name", i, f"steps[{i}].names[{j}]",
+                        hint="drop the repeated name or alias it via"
+                             " an assign first")
+                    continue
                 if n not in self.types:
                     self.diag(
                         "V004", "dead-projection",
@@ -285,13 +297,29 @@ class _Verifier:
                 hint="omit max_groups to size groups to the block")
         out_types: dict = {}
         out_nullable: dict = {}
+        seen: set = set()
         for j, k in enumerate(s.keys):
+            if k in seen:
+                self.diag(
+                    "V010", "duplicate-output-column",
+                    f"group-by key {k!r} appears twice", i,
+                    f"steps[{i}].keys[{j}]",
+                    hint="drop the repeated key")
+            seen.add(k)
             t, null = self.expr(Col(k), i, f"steps[{i}].keys[{j}]")
             out_types[k] = t if t is not None else dtypes.INT64
             out_nullable[k] = null
         keyed = bool(s.keys)
         for j, spec in enumerate(s.aggs):
             path = f"steps[{i}].aggs[{j}]"
+            if spec.out_name in seen:
+                self.diag(
+                    "V010", "duplicate-output-column",
+                    f"aggregate output {spec.out_name!r} collides with"
+                    " an earlier key or aggregate — the later column"
+                    " would silently shadow the earlier one", i, path,
+                    hint="rename the aggregate output")
+            seen.add(spec.out_name)
             out_types[spec.out_name] = dtypes.INT64
             out_nullable[spec.out_name] = False
             if spec.func is Agg.COUNT_ALL:

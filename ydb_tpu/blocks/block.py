@@ -32,6 +32,20 @@ def _round_up(n: int, mult: int) -> int:
     return ((n + mult - 1) // mult) * mult
 
 
+def host_ok(reason: str):
+    """Dispatch-purity marker, same shape as ``analysis.host_ok`` —
+    redeclared here because this module sits below the analysis
+    package in the import graph (analysis.verify -> ssa -> blocks) and
+    cannot import it. The hotpath analyzer matches the decorator by
+    name; the runtime attribute is identical."""
+
+    def mark(fn):
+        fn.__host_ok__ = reason
+        return fn
+
+    return mark
+
+
 # Pad capacities to a lane-friendly multiple; keeps layouts tileable on the
 # VPU (8x128 lanes) and stabilizes jit cache keys across slightly different
 # batch sizes.
@@ -90,6 +104,8 @@ class TableBlock:
     # ---- construction ----
 
     @staticmethod
+    @host_ok("host->device ingest boundary: stages already-materialized"
+             " host arrays (tail-padding them is part of the transfer)")
     def from_numpy(
         arrays: Mapping[str, np.ndarray],
         schema: dtypes.Schema,
@@ -180,6 +196,8 @@ class TableBlock:
             arr = arr[:min(cap, m)]
         return arr
 
+    @host_ok("deliberate result fetch: every column rides ONE batched"
+             " device_get (one link round trip per statement)")
     def host_columns(
         self, validity: bool = True
     ) -> "tuple[dict[str, np.ndarray], dict[str, np.ndarray]]":
@@ -201,10 +219,12 @@ class TableBlock:
                  else {})
         return data, valid
 
+    @host_ok("deliberate result fetch (delegates to host_columns)")
     def to_numpy(self) -> dict[str, np.ndarray]:
         """Live rows only, as physical numpy arrays (nulls not decoded)."""
         return self.host_columns(validity=False)[0]
 
+    @host_ok("deliberate result fetch: one batched validity device_get")
     def validity_numpy(self) -> dict[str, np.ndarray]:
         n = int(self.length)
         got = jax.device_get(
@@ -213,6 +233,8 @@ class TableBlock:
         return {k: v[:n] for k, v in got.items()}
 
 
+@host_ok("one-time aux staging at compile/first-dispatch time; values"
+         " already device-resident are passed through untouched")
 def device_aux(aux: Mapping[str, object]) -> dict:
     """Stage a compiled program's aux tables (dict masks, gather tables)
     on the device, skipping values that already live there — the aux
@@ -224,6 +246,8 @@ def device_aux(aux: Mapping[str, object]) -> dict:
     }
 
 
+@host_ok("host-side concat for readers/tests; the warm scan path"
+         " merges on device (merge_blocks_device) instead")
 def concat_blocks(blocks: list[TableBlock], capacity: int | None = None) -> TableBlock:
     """Host-side concat of live rows into one block (used by readers/tests)."""
     if not blocks:

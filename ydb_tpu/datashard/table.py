@@ -16,6 +16,7 @@ from __future__ import annotations
 import numpy as np
 
 from ydb_tpu import dtypes
+from ydb_tpu.analysis import host_ok
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.blobs import BlobStore
 from ydb_tpu.engine.scan import ColumnSource
@@ -193,6 +194,9 @@ class RowTable:
 
     # ---- writes (2PC across shards) ----
 
+    @host_ok("row-store DML: routing, index maintenance and 2PC"
+             " staging operate on host rows by design (the row table"
+             " is the OLTP side; the analytic path never enters here)")
     def propose_ops(self, per_row_ops: list[RowOp],
                     lock_ids: dict[int, int] | None = None
                     ) -> tuple[list, list]:

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu import dtypes
+from ydb_tpu.analysis import host_ok
 from ydb_tpu.blocks.block import TableBlock, concat_blocks, device_aux
 from ydb_tpu.dq.graph import (
     Broadcast,
@@ -670,6 +671,8 @@ def _join_out_schema(j, probe_schema: dtypes.Schema,
     return dtypes.Schema(tuple(fields))
 
 
+@host_ok("zero-row result block: one bounded 0-byte alloc per column,"
+         " only when a stage produced no rows")
 def _empty_block(schema: dtypes.Schema) -> TableBlock:
     cols = {
         f.name: np.empty(0, dtype=f.type.physical) for f in schema.fields

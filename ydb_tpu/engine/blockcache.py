@@ -113,6 +113,9 @@ class DeviceBlockCache:
         budget = self.budget()
         collected: "list | None" = []
         nbytes = 0
+        # pass-through collection loop: bounded by BLOCK count (the
+        # morsel stream), device refs only — no per-row work, no copy
+        # ydb-lint: disable=H006
         for b in blocks:
             if collected is not None:
                 nbytes += sum(

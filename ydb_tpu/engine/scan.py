@@ -281,6 +281,9 @@ class ScanExecutor:
             return fn(*args)
         t0 = time.perf_counter()
         out = fn(*args)
+        # one-off sync: times the first dispatch's trace+compile (the
+        # warm arm above stays async)
+        # ydb-lint: disable=H001
         jax.block_until_ready(out)
         setattr(self, flag, True)
         self.first_trace_seconds = (
@@ -331,8 +334,16 @@ class ScanExecutor:
             partials.append(out)
             window.append(out)
             if len(window) > self.inflight_blocks:
+                # deliberate backpressure: sync ONLY the oldest
+                # in-flight block once the window fills — bounded by
+                # inflight_blocks, not rows
+                # ydb-lint: disable=H001
                 jax.block_until_ready(window.popleft())
 
+        # the morsel driver loop: iterations are bounded by block
+        # count (capacity-quantized morsels), never by rows; each
+        # iteration is one async device dispatch
+        # ydb-lint: disable=H006
         for b in blocks:
             # block-boundary cancellation point (no-op when the
             # statement carries no deadline)
@@ -364,6 +375,7 @@ class ScanExecutor:
                 # on whichever caller first touches the arrays —
                 # occupancy attribution stays exact. Default path
                 # stays lazy (cross-query dispatch pipelining).
+                # ydb-lint: disable=H001
                 jax.block_until_ready(out.columns)
             return self._retype(out)
 

@@ -157,7 +157,7 @@ class BatchDispatcher:
 
         if not plan_fuse.fusion_enabled() or isinstance(plan, TableScan):
             return None
-        sig = plan_fuse.plan_signature(plan, db)
+        sig = plan_fuse.plan_signature_cached(plan, db)
         if sig is None or not sig.sites:
             return None
         key = sig.cache_key(db)

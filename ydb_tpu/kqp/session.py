@@ -37,7 +37,9 @@ from ydb_tpu.sql.planner import (
     plan_select,
     plan_select_full,
 )
+from ydb_tpu.analysis import host_ok as _host_ok
 from ydb_tpu.analysis import leaksan as _leaksan
+from ydb_tpu.analysis import syncsan as _syncsan
 from ydb_tpu.obs.probes import probe as _probe
 from ydb_tpu.tx import Coordinator, ShardedTable
 from ydb_tpu.tx.coordinator import TxResult
@@ -809,6 +811,9 @@ class Cluster:
                 f"row table (CREATE TABLE ... WITH (store = row))")
         return t
 
+    @_host_ok("row DML readback: plans an uncached derived SELECT and"
+              " fetches matching rows to host — the row store operates"
+              " on host rows by design")
     def _select_rows(self, table, extra_items, where, snap):
         """Run SELECT pk..., extra... FROM table WHERE ... through the
         normal plan/execute path at the given snapshot."""
@@ -861,6 +866,8 @@ class Cluster:
             return TxResult(0, snap, True)
         return t._commit_ops(ops, lock_ids=locks)
 
+    @_host_ok("row DML read-modify-write: per-row SET application and"
+              " dictionary re-encoding are host row work by design")
     def _update_rows(self, t, stmt: ast.Update, snap: int):
         """Rows with the SET effects applied, read at ``snap``."""
         # constant SET values evaluate directly (string literals cannot
@@ -1621,11 +1628,17 @@ class Session:
         planned = None
         kind = "error"
         span = None
+        _ss = None
         # the batching dispatcher stamps batch_id/batch_size onto this
         # statement's registry row; sessions run one statement at a time
         self._active_tok = active_tok
         try:
             with c.tracer.trace("query", trace_id) as span:
+                # syncsan window covers plan+execute+fetch: transfers,
+                # blocking syncs and XLA compiles attribute to THIS
+                # statement (conveyor workers resolve via the trace id)
+                _ss = _syncsan.begin_statement(
+                    sql, trace_id=span.trace_id, span=span)
                 c._update_active(active_tok, stage="plan",
                                  trace_id=span.trace_id)
                 with act(span):
@@ -1659,7 +1672,13 @@ class Session:
                 rows = out.num_rows if isinstance(out, OracleTable) \
                     else 0
                 span.set(seconds=round(seconds, 6), rows=rows)
+                # close BEFORE the root span finishes so the syncsan_*
+                # attrs land on a live span (same exporter-race rule as
+                # the totals above); a budget breach raises here and
+                # surfaces as a statement error
+                _syncsan.end_statement(_ss)
         except BaseException as e:
+            _syncsan.discard(_ss)
             # statements that fail MID-EXECUTION still land in the
             # profile ring tagged error=1 plus a typed reason
             # ("cancelled" for deadline expiry, "overloaded" for
@@ -1921,8 +1940,22 @@ class Session:
         _, p, _aliases, plan_db, _an = planned
         db = self._statement_db(plan_db)
         t0 = _time.monotonic()
-        with tracing.span("analyze") as asp:
-            out = to_host(self._execute_select(p, db))
+        snap = None
+        _ss = None
+        try:
+            with tracing.span("analyze") as asp:
+                # nested syncsan window (thread-local attribution only
+                # — the outer statement keeps the trace-id registry
+                # entry) so the rendered actuals carry THIS run's
+                # host-boundary counters; measurement never enforces
+                # the warm budget, the outer statement window does
+                _ss = _syncsan.begin_statement("<analyze>")
+                out = to_host(self._execute_select(p, db))
+                snap = _syncsan.end_statement(_ss, enforce=False)
+                _ss = None
+        finally:
+            if _ss is not None:
+                _syncsan.discard(_ss)
         seconds = _time.monotonic() - t0
         spans = []
         if asp.recording:
@@ -1932,6 +1965,8 @@ class Session:
         profile = build_profile(
             spans, kind="explain", query_class=classify_plan(p),
             seconds=seconds, rows=out.num_rows)
+        if snap is not None:
+            profile.syncsan = snap
         return format_plan_analyzed(p, profile)
 
     # -- interactive transaction plumbing --

@@ -61,6 +61,11 @@ class QueryProfile:
     batch_execute_seconds: float = 0.0
     stages: dict = dataclasses.field(default_factory=dict)
     pruning: dict = dataclasses.field(default_factory=dict)
+    #: host-boundary counters from the sync sanitizer
+    #: (analysis.syncsan, YDB_TPU_SYNCSAN=1): h2d/d2h transfers,
+    #: blocking syncs and XLA compiles this statement crossed; {} when
+    #: the sanitizer is off
+    syncsan: dict = dataclasses.field(default_factory=dict)
     device_seconds: float = 0.0
     host_seconds: float = 0.0
     #: per-stage busy fractions + overlap coefficients from the
@@ -147,6 +152,10 @@ def build_profile(spans, sql: str = "", kind: str = "",
         a = s.attrs
         if a.get("plan_cache") and not p.plan_cache:
             p.plan_cache = str(a["plan_cache"])
+        if "syncsan_compiles" in a and not p.syncsan:
+            p.syncsan = {
+                k[len("syncsan_"):]: int(v) for k, v in a.items()
+                if k.startswith("syncsan_")}
         if s.name == "ssa.compile":
             p.compile_seconds += s.seconds
         if s.name == "plan.fuse":
@@ -291,6 +300,11 @@ def format_plan_analyzed(plan, profile: QueryProfile) -> str:
         "compile: compile_cache=" + (profile.compile_cache or "none")
         + f" compile_seconds={profile.compile_seconds:.6f}"
         + f" execute_seconds={profile.execute_seconds:.6f}")
+    if profile.syncsan:
+        ss = profile.syncsan
+        lines.append("syncsan: " + " ".join(
+            f"{k}={ss.get(k, 0)}"
+            for k in ("h2d", "d2h", "syncs", "compiles")))
     if profile.fused_stages:
         lines.append(
             f"fusion: fused_stages={profile.fused_stages}"

@@ -32,6 +32,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ydb_tpu import chaos
+from ydb_tpu.analysis import host_ok
 from ydb_tpu.blocks.block import Column, TableBlock
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.chaos import deadline as statement_deadline
@@ -121,6 +122,8 @@ class _ChainSource:
                                  start_block=start_block)
 
 
+@host_ok("mesh partition grouping: bounded by device count; only"
+         " EMPTY mesh slots allocate (0-row placeholder sources)")
 def device_partitions(sources: list, n: int, schema, dicts) -> list:
     """Group a table's per-shard sources onto exactly ``n`` mesh devices
     (round-robin; empty devices get an empty source) — the seam that

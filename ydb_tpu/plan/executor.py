@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from ydb_tpu import chaos, dtypes
+from ydb_tpu.analysis import host_ok
 from ydb_tpu.analysis.verify import check_program
 from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.blocks.block import TableBlock, concat_blocks, device_aux
@@ -373,6 +374,9 @@ def _scan_node(plan: TableScan, db: Database, sp) -> TableBlock:
     return out
 
 
+@host_ok("scan staging boundary: host source arrays cross to the"
+         " device here by design (block cache / resident tier absorb"
+         " repeat crossings; donate-safety copies are part of it)")
 def _stage_fused_site(site, db: Database, timer, donate: bool):
     """Stage one fused scan site to its shape-class capacity.
 
@@ -530,7 +534,7 @@ def _execute_plan_fused(plan: PlanNode, db: Database) -> TableBlock | None:
     (the caller falls back to the per-node walk)."""
     from ydb_tpu.ssa import plan_fuse
 
-    sig = plan_fuse.plan_signature(plan, db)
+    sig = plan_fuse.plan_signature_cached(plan, db)
     if sig is None or not sig.sites:
         return None
     if chaos.hit("fuse.trace") is not None:
@@ -562,6 +566,8 @@ def _execute_plan_fused(plan: PlanNode, db: Database) -> TableBlock | None:
     return out
 
 
+@host_ok("compile-cache miss path: compiles the Transform once; the"
+         " (run, aux) pair is cached by (program, aliases, schema)")
 def _compiled_transform(plan: Transform, schema, db: Database):
     """Compile a Transform program (jit + device aux); split out so the
     executor walk stays free of trace-time constructs."""
@@ -623,6 +629,8 @@ def _execute_node(plan: PlanNode, db: Database, _memo: dict) -> TableBlock:
     raise NotImplementedError(plan)
 
 
+@host_ok("lazy result fetch: the ONE deliberate device->host boundary"
+         " per statement (under the session's 'fetch' span)")
 def to_host(block) -> OracleTable:
     if isinstance(block, OracleTable):  # mesh results are already host
         return block

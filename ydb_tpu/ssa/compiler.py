@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu import dtypes
-from ydb_tpu.blocks.block import Column, TableBlock
+from ydb_tpu.blocks.block import Column, TableBlock, device_aux
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.ssa import kernels
 from ydb_tpu.ssa.ops import Agg, Op
@@ -65,10 +65,18 @@ class CompiledProgram:
     out_schema: dtypes.Schema
     in_schema: dtypes.Schema
     group_layout: tuple = (None, None)
+    # aux staged to the device once, on first dispatch — restaging the
+    # whole dict per call cost an H2D transfer per statement. Staleness
+    # is impossible: the compile caches key on the dict contents and
+    # drop the whole CompiledProgram when plan-time tables change. A
+    # first-dispatch race double-stages idempotently (last write wins).
+    _staged: "dict | None" = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     def __call__(self, block: TableBlock) -> TableBlock:
-        aux = {k: jnp.asarray(v) for k, v in self.aux.items()}
-        return self.run(block, aux)
+        if self._staged is None:
+            self._staged = device_aux(self.aux)
+        return self.run(block, self._staged)
 
 
 class _Lowering:

@@ -54,9 +54,11 @@ block is dead after the dispatch).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import os
+import threading
 import time
 from typing import Callable
 
@@ -64,6 +66,7 @@ import jax
 import jax.numpy as jnp
 
 from ydb_tpu import dtypes
+from ydb_tpu.analysis import host_ok
 from ydb_tpu.blocks.block import (
     DEFAULT_CAPACITY_QUANTUM,
     Column,
@@ -283,6 +286,63 @@ def plan_signature(plan: PlanNode, db) -> PlanSignature | None:
     return PlanSignature(plan=plan, sites=sites, fused_stages=stages)
 
 
+# plan_signature memo: the classification walk is O(plan nodes) of
+# Python per statement, and plans on the warm path come out of the
+# cluster plan cache with stable identity — so the walk result is
+# recomputed for the same tree thousands of times per second on the
+# serving tier. Keyed by id(plan): safe because the memo value holds
+# sig.plan (a strong ref), so the id cannot be recycled while the
+# entry lives; an ``is`` check guards the lookup anyway. Validators
+# re-check the db-dependent inputs (source identity, row count,
+# schema identity) in O(sites); any drift recomputes. Only fusible
+# results memoize — a None verdict may hinge on sources the walk
+# never recorded.
+_SIG_CACHE_ENTRIES = 256
+_sig_cache: "collections.OrderedDict" = collections.OrderedDict()
+_sig_lock = threading.Lock()
+
+
+def plan_signature_cached(plan: PlanNode, db) -> PlanSignature | None:
+    """``plan_signature`` behind an identity-keyed memo with O(sites)
+    revalidation — the per-statement entry point for dispatchers."""
+    key = id(plan)
+    with _sig_lock:
+        hit = _sig_cache.get(key)
+        if hit is not None:
+            sig, validators = hit
+            if sig.plan is plan and _sig_valid(validators, db):
+                _sig_cache.move_to_end(key)
+                return sig
+            del _sig_cache[key]
+    # signature-cache miss: one classification walk, then memoized
+    # ydb-lint: disable=H004
+    sig = plan_signature(plan, db)
+    if sig is None:
+        return None
+    # .get throughout: bracket access on lazy source maps can
+    # materialize sys views (same contract as the walk above)
+    validators = tuple(
+        (s.table, id(src), int(src.num_rows), id(src.schema))
+        for s in sig.sites
+        for src in (db.sources.get(s.table),))
+    with _sig_lock:
+        _sig_cache[key] = (sig, validators)
+        _sig_cache.move_to_end(key)
+        while len(_sig_cache) > _SIG_CACHE_ENTRIES:
+            _sig_cache.popitem(last=False)
+    return sig
+
+
+def _sig_valid(validators, db) -> bool:
+    for table, src_id, n, sch_id in validators:
+        src = db.sources.get(table)
+        if src is None or id(src) != src_id:
+            return False
+        if int(src.num_rows) != n or id(src.schema) != sch_id:
+            return False
+    return True
+
+
 def _union_nullability(schemas: list[dtypes.Schema]) -> dtypes.Schema:
     """Concat's output schema: a column is nullable as soon as ANY
     branch's is (mirrors blocks.concat_blocks)."""
@@ -416,12 +476,17 @@ class FusedPlan:
             def _dispatch(inputs, aux):
                 return run_all(inputs, aux)
 
+            # one-time lazy wrapper creation, cached on the plan (the
+            # trace/compile happens on first call, counted there)
+            # ydb-lint: disable=H003
             self._jit_shared = jax.jit(_dispatch)
         if self._shared_traced:
             out, totals = self._jit_shared(inputs, self.aux)
         else:
             t0 = time.perf_counter()
             out, totals = self._jit_shared(inputs, self.aux)
+            # first-trace timing sync only; warm dispatches stay async
+            # ydb-lint: disable=H001
             jax.block_until_ready(out)
             self._shared_traced = True
             self.first_trace_seconds = (
@@ -722,6 +787,8 @@ class PlanLowering:
         return emit, sch, cap
 
 
+@host_ok("fused-plan compile: reached only on a compile-cache miss;"
+         " the built FusedPlan is cached by plan fingerprint")
 def _build(sig: PlanSignature, db) -> FusedPlan:
     lo = PlanLowering(sig, db)
     root, out_schema, _ = lo.lower(sig.plan)

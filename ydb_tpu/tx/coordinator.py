@@ -195,6 +195,8 @@ class Coordinator:
                         failed = e
                         break
                 if failed is not None:
+                    # abort cleanup, bounded by participant SHARDS
+                    # ydb-lint: disable=H006
                     for p, args, i in zip(participants, prepare_args,
                                           range(len(participants))):
                         try:
