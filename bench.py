@@ -689,6 +689,236 @@ def run_serving_tier(extra: dict, budget: float) -> None:
             c.stop()
 
 
+def run_net_tier(extra: dict, budget: float) -> None:
+    """Network serving tier: N loopback pgwire CLIENTS (real sockets,
+    real protocol framing, one connection each) firing a mixed TPC-H
+    Q1/Q6 + point-INSERT ingest workload at one cluster behind the
+    multi-tenant front door (ydb_tpu/serving/), batching off vs on.
+    Clients alternate between two weighted tenants ("gold" w=3,
+    "bronze" w=1) via the `tenant` startup parameter, so the numbers
+    exercise tenant resolution, per-pool admission, and the
+    cross-connection batch grouping that PR 17 unlocked (reads run
+    outside the pgwire server lock). Latency is measured CLIENT-side
+    (send-Query to ReadyForQuery) and reported per tenant as p50/p99.
+    YDB_TPU_BENCH_NET_SF / _CLIENTS / _WINDOW_MS size it."""
+    import socket
+    import struct
+    import threading
+
+    from ydb_tpu import serving
+    from ydb_tpu.api.pgwire import PgWireServer
+    from ydb_tpu.kqp.session import Cluster
+    from ydb_tpu.scheme.model import type_to_str
+    from ydb_tpu.workload import tpch
+    from ydb_tpu.workload.queries import TPCH
+
+    sf = float(os.environ.get("YDB_TPU_BENCH_NET_SF", "0.01"))
+    levels = [int(x) for x in os.environ.get(
+        "YDB_TPU_BENCH_NET_CLIENTS", "100,1000").split(",")
+        if x.strip()]
+    window_ms = float(os.environ.get(
+        "YDB_TPU_BENCH_NET_WINDOW_MS", "25"))
+    data = tpch.TpchData(sf=sf, seed=29)
+    extra["net_sf"] = sf
+    extra["net_window_ms"] = window_ms
+    statements = (TPCH["q1"], TPCH["q6"])
+    tenants = ("gold", "bronze")
+
+    class _Wire:
+        """Minimal pg frontend: startup (with tenant param) + simple
+        query, independent of the server code like tests' MiniPgClient
+        but trimmed to what the bench times."""
+
+        def __init__(self, port, tenant):
+            for attempt in range(5):  # connect storms vs listen backlog
+                try:
+                    self.sock = socket.create_connection(
+                        ("127.0.0.1", port), timeout=120)
+                    break
+                except OSError:
+                    if attempt == 4:
+                        raise
+                    time.sleep(0.05 * (attempt + 1))
+            params = (b"user\x00bench\x00database\x00postgres\x00"
+                      b"tenant\x00" + tenant.encode() + b"\x00\x00")
+            self.sock.sendall(
+                struct.pack("!II", len(params) + 8, 196608) + params)
+            while self._msg()[0] != b"Z":
+                pass
+
+        def _recv(self, n):
+            buf = b""
+            while len(buf) < n:
+                c = self.sock.recv(n - len(buf))
+                if not c:
+                    raise ConnectionError("server closed")
+                buf += c
+            return buf
+
+        def _msg(self):
+            t = self._recv(1)
+            (ln,) = struct.unpack("!I", self._recv(4))
+            return t, self._recv(ln - 4)
+
+        def query(self, sql):
+            q = sql.encode() + b"\x00"
+            self.sock.sendall(
+                b"Q" + struct.pack("!I", len(q) + 4) + q)
+            err = None
+            while True:
+                t, body = self._msg()
+                if t == b"E":
+                    err = body
+                elif t == b"Z":
+                    return err
+
+        def close(self):
+            try:
+                self.sock.sendall(b"X" + struct.pack("!I", 4))
+            finally:
+                self.sock.close()
+
+    def boot():
+        c = Cluster()
+        # the front door's per-pool caps are the shed boundary here;
+        # keep the legacy global valve out of the way of the burst
+        c.max_inflight_statements = max(
+            c.max_inflight_statements, 1 << 14)
+        reg = serving.TenantRegistry()
+        reg.register("gold", weight=3.0, max_inflight=32,
+                     queue_size=4096)
+        reg.register("bronze", weight=1.0, max_inflight=16,
+                     queue_size=4096)
+        serving.install(c, reg)
+        s = c.session()
+        schema = data.schema("lineitem")
+        cols = ", ".join(f"{f.name} {type_to_str(f.type)}"
+                         for f in schema.fields)
+        s.execute(f"CREATE TABLE lineitem ({cols}, "
+                  f"PRIMARY KEY (l_orderkey)) WITH (shards = 1)")
+        src = data.tables["lineitem"]
+        arrays = {}
+        for f in schema.fields:
+            v = src[f.name]
+            if f.type.is_string:
+                arrays[f.name] = [
+                    bytes(x) for x in data.dicts[f.name].decode(
+                        np.asarray(v, dtype=np.int32))]
+            else:
+                arrays[f.name] = v
+        c.tables["lineitem"].insert(arrays)
+        s.execute("CREATE TABLE net_ingest (k int64, v int64, "
+                  "PRIMARY KEY (k))")
+        c._invalidate_plans()
+        for sql in statements:  # warm plan + compile caches
+            s.execute(sql)
+        return c, PgWireServer(c).start()
+
+    def burst(port, n, per_client):
+        lat = {t: [] for t in tenants}
+        errs: list = []
+        rec = threading.Lock()
+        gate = threading.Barrier(n + 1)
+
+        def worker(i):
+            tenant = tenants[i % len(tenants)]
+            cl, mine = None, []
+            try:
+                cl = _Wire(port, tenant)
+            except Exception as e:  # noqa: BLE001 - recorded evidence
+                with rec:
+                    errs.append("connect: " + repr(e)[-160:])
+            try:
+                gate.wait()
+                if cl is None:
+                    return
+                for j in range(per_client):
+                    if i % 4 == 3:  # ingest rider on every 4th client
+                        sql = (f"INSERT INTO net_ingest VALUES "
+                               f"({i * 1000000 + j}, {j})")
+                    else:
+                        sql = statements[(i + j) % len(statements)]
+                    t0 = time.perf_counter()
+                    err = cl.query(sql)
+                    mine.append(time.perf_counter() - t0)
+                    if err is not None:
+                        with rec:
+                            errs.append(err[:160].decode("latin-1"))
+            except Exception as e:  # noqa: BLE001 - recorded evidence
+                with rec:
+                    errs.append(repr(e)[-160:])
+            finally:
+                if cl is not None:
+                    try:
+                        cl.close()
+                    except Exception:  # noqa: BLE001 - teardown
+                        pass
+                with rec:
+                    lat[tenant].extend(mine)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        gate.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, lat, errs
+
+    def _pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    sides = {}
+    for side in ("off", "on"):
+        _log(f"net tier: boot (batching {side})")
+        sides[side] = boot()
+        if side == "on":
+            sides[side][0].batcher.window_ms = window_ms
+    try:
+        for n in levels:
+            if _budget_left(budget) < (60 if n <= 100 else 240):
+                extra[f"net_{n}_skipped"] = "budget"
+                continue
+            per_client = max(1, 400 // n)
+            for side, (c, srv) in sides.items():
+                if side == "on":
+                    c.batcher.max_batch = max(2, n)
+                wall, lat, errs = burst(srv.port, n, per_client)
+                done = sum(len(v) for v in lat.values())
+                if errs:
+                    extra[f"net_{n}_{side}_errors"] = len(errs)
+                    extra[f"net_{n}_{side}_error_sample"] = errs[:3]
+                extra[f"net_{n}_qps_{side}"] = round(done / wall, 1)
+                for tname, xs in lat.items():
+                    for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                        v = _pct(xs, q)
+                        if v is not None:
+                            extra[f"net_{n}_{tname}_{tag}_ms_{side}"] \
+                                = round(v * 1e3, 3)
+            off = extra.get(f"net_{n}_qps_off")
+            on = extra.get(f"net_{n}_qps_on")
+            if off and on:
+                extra[f"net_{n}_qps_speedup"] = round(on / off, 2)
+                _log(f"net tier: {n} clients {off} -> {on} qps "
+                     f"(x{extra[f'net_{n}_qps_speedup']})")
+        snap = sides["on"][0].batcher.snapshot()
+        for k in ("batches", "batched_statements", "dedup_dispatches",
+                  "max_batch_size"):
+            extra[f"net_batch_{k}"] = snap[k]
+        door = sides["on"][0].front_door.snapshot()
+        for tname, st in door.items():
+            extra[f"net_pool_{tname}_admitted"] = st["admitted"]
+            extra[f"net_pool_{tname}_shed"] = st["shed"]
+    finally:
+        for c, srv in sides.values():
+            srv.stop()
+            c.stop()
+
+
 def run_ooc(extra: dict, iters: int, block_rows: int) -> None:
     """Out-of-core engine-tier run at a LARGE scale factor (SURVEY
     §7.2 item 7): lineitem generates in bounded chunks (the full table
@@ -1019,6 +1249,20 @@ def main():
             _checkpoint("serving", extra)
         else:
             skipped.append("serving_tier:budget")
+
+    # network serving tier: loopback pgwire clients, two weighted
+    # tenants, batching on-vs-off (YDB_TPU_BENCH_NET=0 skips)
+    if os.environ.get("YDB_TPU_BENCH_NET", "1") not in \
+            ("0", "", "off"):
+        if _budget_left(budget) > 150:
+            _log("net tier: loopback pgwire multi-tenant QPS A/B")
+            try:
+                run_net_tier(extra, budget)
+            except Exception as e:  # noqa: BLE001 - additive evidence
+                extra["net_tier_error"] = repr(e)[-300:]
+            _checkpoint("net", extra)
+        else:
+            skipped.append("net_tier:budget")
 
     engine_warm_rps = extra["kernel_q1_warm_rows_per_sec"]
     db_iters = min(iters, 2)  # storage tiers stream the table per run

@@ -3,8 +3,9 @@
 # (verify self-test, lint, concurrency, lifecycle, hotpath) over the
 # files git reports changed, exiting with the analyzer's status.
 #
-#   scripts/analysis-gate.sh            # changed .py files only
-#   scripts/analysis-gate.sh --full     # the whole tree
+#   scripts/analysis-gate.sh                    # changed .py files only
+#   scripts/analysis-gate.sh --full             # the whole tree
+#   scripts/analysis-gate.sh ydb_tpu/serving …  # explicit paths/dirs
 #
 # Prints per-stage finding counts; on failure the findings themselves
 # (file:line:col: CODE [name] message) so the breakage is actionable
@@ -16,6 +17,8 @@ cd "$(dirname "$0")/.."
 SCOPE=(--changed)
 if [[ "${1:-}" == "--full" ]]; then
     SCOPE=()
+elif [[ $# -gt 0 ]]; then
+    SCOPE=("$@")  # gate a subsystem: scripts/analysis-gate.sh ydb_tpu/serving
 fi
 
 out=$(JAX_PLATFORMS=cpu python -m ydb_tpu.analysis "${SCOPE[@]}" --json) \

@@ -16,14 +16,17 @@ class MiniPgClient:
     """Just enough of the frontend side of PostgreSQL protocol 3.0."""
 
     def __init__(self, port, user="tester", password=None,
-                 try_ssl=False):
+                 try_ssl=False, startup=None):
         self.sock = socket.create_connection(("127.0.0.1", port),
                                              timeout=10)
         if try_ssl:
             self.sock.sendall(struct.pack("!II", 8, 80877103))
             assert self._recv_exact(1) == b"N"
         params = (b"user\x00" + user.encode() + b"\x00"
-                  + b"database\x00postgres\x00\x00")
+                  + b"database\x00postgres\x00")
+        for k, v in (startup or {}).items():  # e.g. tenant=gold
+            params += k.encode() + b"\x00" + v.encode() + b"\x00"
+        params += b"\x00"
         self.sock.sendall(
             struct.pack("!II", len(params) + 8, 196608) + params)
         self.params = {}

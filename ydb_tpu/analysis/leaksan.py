@@ -12,6 +12,10 @@ acquire/release sites in :func:`track` handles:
   * blockcache.flight   — a DeviceBlockCache single-flight fill
   * session.active      — a statement's in-flight registry row
   * rm.slot             — a ResourceManager compute-slot grant
+  * serving.conn        — a protocol-front connection/session (pgwire
+                          socket, RequestProxy server-side session)
+  * serving.seat        — a front-door admission seat or a
+                          RequestProxy operation-thread handoff
 
 Each live handle retains its creation-site stack, so
 :func:`assert_drained` — hooked at statement completion (per-owner) and

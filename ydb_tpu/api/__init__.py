@@ -1,4 +1,22 @@
-from ydb_tpu.api.client import ApiError, Driver
-from ydb_tpu.api.server import make_server
+"""api/ — protocol fronts (gRPC-style proxy, pgwire, kafka, sqs).
+
+The gRPC surface (client.py / server.py) needs protoc-generated
+messages; the pure-Python fronts (pgwire.py) do not. Import lazily so
+``ydb_tpu.api.pgwire`` works in environments without protoc — the
+gRPC pieces still raise at first use there.
+"""
+
+
+def __getattr__(name):
+    if name in ("Driver", "ApiError"):
+        from ydb_tpu.api import client
+
+        return getattr(client, name)
+    if name == "make_server":
+        from ydb_tpu.api.server import make_server
+
+        return make_server
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = ["Driver", "ApiError", "make_server"]

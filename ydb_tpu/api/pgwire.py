@@ -30,9 +30,16 @@ Supported flow:
     rejected with clear errors,
   * CancelRequest (connection-level no-op), Terminate ('X').
 
-Every connection owns one session; cluster state is single-writer, so
-statement execution serializes on the shared lock (the same contract as
-api/server.RequestProxy.lock).
+Every connection owns one session. Cluster state is single-writer, so
+DDL/DML/transaction statements serialize on the shared lock (the same
+contract as api/server.RequestProxy.lock) — but read statements
+(SELECT/EXPLAIN, outside an open transaction) execute WITHOUT it, so
+concurrent connections co-occupy the cross-query batch window
+(kqp/batch.py) and compatible SELECTs from different sockets share one
+device dispatch. Tenancy: a ``tenant`` startup parameter (or the
+authenticated principal's binding) resolves through the cluster front
+door (serving/), and every live connection is a ``serving.conn``
+leak-sanitizer handle asserted drained on disconnect.
 """
 
 from __future__ import annotations
@@ -45,7 +52,8 @@ import threading
 
 import numpy as np
 
-from ydb_tpu import dtypes
+from ydb_tpu import dtypes, serving
+from ydb_tpu.analysis import leaksan
 from ydb_tpu.engine.oracle import OracleTable
 from ydb_tpu.tx.coordinator import TxResult
 
@@ -290,6 +298,9 @@ class _Handler(socketserver.BaseRequestHandler):
             params = payload[4:].split(b"\x00")
             kv = dict(zip(params[0::2], params[1::2]))
             self.user = kv.get(b"user", b"").decode()
+            # arbitrary startup parameters ride here; "tenant" routes
+            # the connection to its workload pool (serving/tenants.py)
+            self.startup_kv = kv
             break
         self.principal = None
         if srv.auth_tokens is not None:
@@ -325,6 +336,18 @@ class _Handler(socketserver.BaseRequestHandler):
     def _session_loop(self, srv, sock):
         session = srv.cluster.session()
         session.principal = getattr(self, "principal", None)
+        kv = getattr(self, "startup_kv", {})
+        hint = kv.get(b"tenant", b"").decode() or None
+        session.tenant = serving.resolve_tenant(
+            srv.cluster, tenant=hint, principal=session.principal)
+        conn = leaksan.track(
+            "serving.conn", f"pgwire:{session.tenant}")
+        try:
+            self._message_loop(srv, sock, session)
+        finally:
+            leaksan.close(conn)
+
+    def _message_loop(self, srv, sock, session):
         skip_to_sync = False
         statements: dict[str, dict] = {}  # Parse'd prepared statements
         portals: dict[str, dict] = {}     # Bind'd portals
@@ -422,11 +445,22 @@ class _Handler(socketserver.BaseRequestHandler):
                            "sent": 0, "complete": False,
                            "res_fmts": res_fmts}
 
+    def _exec_stmt(self, srv, session, sql: str):
+        """Run one statement with the right concurrency contract:
+        reads (outside an open transaction) execute without the
+        server's write lock so concurrent connections can co-occupy
+        the batch window; everything that can mutate cluster state
+        keeps the single-writer lock."""
+        if getattr(session, "_tx", None) is None \
+                and serving.is_read_statement(sql):
+            return session.execute(sql)
+        with srv.lock:
+            return session.execute(sql)
+
     def _run_portal(self, srv, session, portal: dict) -> None:
         if portal["done"]:
             return
-        with srv.lock:
-            portal["result"] = session.execute(portal["sql"])
+        portal["result"] = self._exec_stmt(srv, session, portal["sql"])
         portal["done"] = True
         # reject unsupported binary columns NOW — a clean ErrorResponse
         # before any RowDescription/DataRow reaches the wire
@@ -563,8 +597,7 @@ class _Handler(socketserver.BaseRequestHandler):
             return
         for stmt in statements:
             try:
-                with srv.lock:
-                    out = session.execute(stmt)
+                out = self._exec_stmt(srv, session, stmt)
             except Exception as e:  # noqa: BLE001 - wire it to client
                 sock.sendall(_error(str(e), "42601"))
                 return  # abort rest of the query string (pg semantics)

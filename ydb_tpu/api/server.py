@@ -18,6 +18,8 @@ from concurrent import futures
 import grpc
 import numpy as np
 
+from ydb_tpu import serving
+from ydb_tpu.analysis import leaksan
 from ydb_tpu.api.build import ensure_protos
 from ydb_tpu.api.arrow_io import oracle_to_ipc
 from ydb_tpu.engine.oracle import OracleTable
@@ -41,6 +43,10 @@ class RequestProxy:
         self.sessions: "OrderedDict[str, object]" = OrderedDict()
         self.max_sessions = 1024
         self._next_session = itertools.count(1)
+        # leak-sanitizer handle per server-side session (serving.conn):
+        # closed by _drop_session, so an eviction/delete/close path
+        # that forgets a session fails the drain assertion
+        self._conn_leaks: dict[str, object] = {}
         # Cluster/tablet state is not thread-safe: every mutating entry
         # point (RPC handlers AND the serve loop's run_background)
         # serializes on this lock
@@ -67,13 +73,30 @@ class RequestProxy:
 
     # ---- Query ----
 
+    def _resolve_tenant(self, context, principal):
+        """Connection metadata -> workload pool: an explicit
+        'x-ydb-tenant' header wins, else the principal's registry
+        binding, else the default pool (serving/tenants.py)."""
+        try:
+            md = dict(context.invocation_metadata())
+        except Exception:  # noqa: BLE001 - metadata-less test contexts
+            md = {}
+        return serving.resolve_tenant(
+            self.cluster, tenant=md.get("x-ydb-tenant"),
+            principal=principal)
+
     def create_session(self, request, context):
         principal = self.check_auth(context)
+        tenant = self._resolve_tenant(context, principal)
         with self.lock:
             sid = f"session-{next(self._next_session)}"
             session = self.cluster.session()
             session.principal = principal
+            session.tenant = tenant
             self.sessions[sid] = session
+            lk = leaksan.track("serving.conn", f"grpc:{tenant}")
+            if lk is not None:
+                self._conn_leaks[sid] = lk
             while len(self.sessions) > self.max_sessions:
                 old_sid, _ = next(iter(self.sessions.items()))
                 self._drop_session(old_sid)
@@ -84,6 +107,8 @@ class RequestProxy:
         back first so its shard locks never leak (the hazard
         execute_script's finally block guards against)."""
         s = self.sessions.pop(session_id, None)
+        if self._conn_leaks:
+            leaksan.close(self._conn_leaks.pop(session_id, None))
         if s is not None and getattr(s, "_tx", None) is not None:
             s._tx_release()
             s._api_tx_id = None
@@ -112,9 +137,17 @@ class RequestProxy:
         if session is None:
             session = self.cluster.session()  # sessionless query
             session.principal = principal
+            session.tenant = self._resolve_tenant(context, principal)
         try:
-            with self.lock:
+            # reads outside an open transaction skip the single-writer
+            # lock: concurrent clients' SELECTs co-occupy the batch
+            # window (kqp/batch.py) instead of serializing here
+            if getattr(session, "_tx", None) is None \
+                    and serving.is_read_statement(request.sql):
                 out = session.execute(request.sql)
+            else:
+                with self.lock:
+                    out = session.execute(request.sql)
         except Exception as e:  # noqa: BLE001 - surface to the client
             return pb.ExecuteQueryResponse(
                 status=pb.ExecuteQueryResponse.ERROR, error=str(e))
@@ -410,30 +443,55 @@ class RequestProxy:
                                               - 1024]:
                     del self._operations[old_id]
 
+        seat = leaksan.track("serving.seat", f"op:{kind}")
+
         def run():
             try:
                 st["result"] = fn(*args)
             except Exception as e:  # noqa: BLE001 - surfaced on poll
                 st["error"] = str(e)
-            st["ready"] = True
+            finally:
+                # the handoff ends HERE: drop the thread object and
+                # the seat before publishing ready, so finished op
+                # records never strand a Thread (they used to pin one
+                # each until the record aged past the 1024 bound) and
+                # the sanitizer sees the seat drain when the work
+                # drains — even if fn dies on a BaseException
+                with self._op_lock:
+                    st.pop("thread", None)
+                leaksan.close(seat)
+                st["ready"] = True
 
         # the handle rides in the op record so close() can join
         # stragglers instead of abandoning them at process exit
         t = threading.Thread(target=run, daemon=True,
                              name=f"op-{kind}")
         st["thread"] = t
-        t.start()
+        try:
+            t.start()
+        except BaseException:
+            # the seat's owner is the thread; if it never launched,
+            # the spawn path must drain what it tracked
+            with self._op_lock:
+                st.pop("thread", None)
+            leaksan.close(seat)
+            raise
         return op_id
 
     def close(self, timeout: float = 10.0) -> None:
-        """Join outstanding operation threads (orderly shutdown path:
-        serve() callers should close the proxy after stopping gRPC)."""
+        """Join outstanding operation threads and drop every
+        server-side session (orderly shutdown path: serve() callers
+        should close the proxy after stopping gRPC, before
+        Cluster.stop — which asserts all serving.* handles drained)."""
         with self._op_lock:
             threads = [st.get("thread") for st in
                        self._operations.values()]
         for t in threads:
             if t is not None and t.is_alive():
                 t.join(timeout=timeout)
+        with self.lock:
+            for sid in list(self.sessions):
+                self._drop_session(sid)
 
     def _op_status(self, st) -> "pb.OperationStatus":
         rows = 0

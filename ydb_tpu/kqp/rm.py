@@ -219,3 +219,12 @@ class WorkloadService:
             p = self._pool(pool)
             return dict(p.stats, running=len(p.running),
                         queued=len(p.queue), limit=p.limit)
+
+    def pools(self) -> dict[str, dict]:
+        """All pools' stats in one locked pass (the front door's
+        ``sys_tenant_pools`` view joins these against its seat
+        counters)."""
+        with self._lock:
+            return {name: dict(p.stats, running=len(p.running),
+                               queued=len(p.queue), limit=p.limit)
+                    for name, p in self._pools.items()}

@@ -204,6 +204,12 @@ class Cluster:
         # compute slot for its duration
         self.workload = None
         self.rm = None
+        # multi-tenant front door (serving/admission.py): when
+        # installed via serving.install(cluster), every statement
+        # acquires a per-tenant admission seat before the workload
+        # pool, and shedding happens per tenant instead of through the
+        # global max_inflight_statements valve
+        self.front_door = None
         # optional SPMD mesh execution (enable_mesh)
         self._mesh_exec = None
         # HBM device block cache shared by every statement's scans (the
@@ -681,13 +687,23 @@ class Cluster:
             for k, v in bt.snapshot().items():
                 g.counter(k).set(v)
             stats["batches"] = bt.batches
+        # front-door tenancy telemetry: per-pool inflight/queued/
+        # admitted/shed gauges under component="serving" (the admitted/
+        # shed counters themselves are bumped inline at admission)
+        if self.front_door is not None:
+            for tname, row in self.front_door.snapshot().items():
+                g = self.counters.group(component="serving",
+                                        tenant=tname)
+                for k in ("inflight", "queued"):
+                    g.counter(k).set(row[k])
         # slow-query watchdog over the in-flight registry
         stats["slow_queries"] = self.check_slow_queries()
         return stats
 
     # ---- live query introspection ----
 
-    def _register_active(self, sql: str, t0: float) -> int:
+    def _register_active(self, sql: str, t0: float,
+                         tenant: str = "") -> int:
         """Enter a statement into the in-flight registry (before
         admission, so queued statements are visible). Returns the token
         the caller must hand to _unregister_active in a finally."""
@@ -701,6 +717,7 @@ class Cluster:
                 "queue_position": pos, "trace_id": 0, "kind": "",
                 "rows": 0, "slow_fired": False,
                 "batch_id": 0, "batch_size": 0, "shared_scan": 0,
+                "tenant": tenant,
             }
             lk = _leaksan.track("session.active", sql[:60], owner=tok)
             if lk is not None:
@@ -1463,6 +1480,10 @@ class Session:
     # authenticated principal (the auth token); None = internal
     # session, exempt from ACL checks
     principal: str | None = None
+    # workload pool this session's statements admit under (serving/
+    # tenants.py); None = resolve through the front door registry
+    # (principal binding or the default pool)
+    tenant: str | None = None
     # QueryProfile of the most recent statement (None with profiling
     # disabled — YDB_TPU_PROFILE=0)
     last_profile: object = None
@@ -1498,7 +1519,9 @@ class Session:
         # load shedding BEFORE the statement enters the registry: past
         # the configured in-flight limit the cluster fails fast with a
         # typed error instead of queueing unboundedly. The chaos
-        # "session.admit" site injects the same overload.
+        # "session.admit" site injects the same overload. With a front
+        # door installed the per-tenant caps are the shedding boundary
+        # and this global valve is only a legacy backstop.
         limit = c.max_inflight_statements
         shed = limit > 0 and len(c.active_queries) >= limit
         fault = None if shed else chaos.hit("session.admit")
@@ -1514,10 +1537,15 @@ class Session:
                 if limit else "statement shed at admission (injected)")
         statement_dl = _dl.Deadline(timeout) if timeout is not None \
             else None
+        fd = c.front_door
+        tenant = fd.registry.resolve(tenant=self.tenant,
+                                     principal=self.principal) \
+            if fd is not None else (self.tenant or "")
         # the statement enters the live registry BEFORE admission so
         # sys_active_queries shows queued statements too; the finally
         # guarantees it clears even when execution raises
-        tok = c._register_active(sql, t0)
+        tok = c._register_active(sql, t0, tenant=tenant)
+        seat = None
         try:
             qid = None
             if c.workload is not None or c.rm is not None:
@@ -1528,13 +1556,33 @@ class Session:
             if statement_dl is not None:
                 # the statement deadline caps the admission wait too
                 deadline = min(deadline, statement_dl.at)
+            if fd is not None:
+                # per-tenant seat: the front door queues (deadline-
+                # ordered) against THIS tenant's cap and sheds with the
+                # pool named, so one tenant's backlog never starves
+                # another's admission
+                try:
+                    seat = fd.admit(
+                        tenant,
+                        deadline_at=(statement_dl.at
+                                     if statement_dl is not None
+                                     else None),
+                        timeout=max(0.0, deadline - _time.monotonic()),
+                        owner=tok)
+                except OverloadedError:
+                    c.counters.group(
+                        kind="overloaded").counter("queries").inc()
+                    self._record_rejected(sql, t0, "overloaded")
+                    raise
+            pool = tenant if fd is not None else "default"
             if c.workload is not None:
                 # pool admission: run now or condition-wait our queued
                 # turn
-                if not c.workload.admit(qid) and not \
+                if not c.workload.admit(qid, pool=pool) and not \
                         c.workload.wait_admitted(
-                            qid, timeout=deadline - _time.monotonic()):
-                    c.workload.finish(qid)
+                            qid, pool=pool,
+                            timeout=deadline - _time.monotonic()):
+                    c.workload.finish(qid, pool=pool)
                     from ydb_tpu.kqp.rm import PoolOverloaded
 
                     self._record_rejected(sql, t0, "overloaded")
@@ -1571,8 +1619,10 @@ class Session:
                 if granted:
                     c.rm.release(qid)
                 if c.workload is not None:
-                    c.workload.finish(qid)
+                    c.workload.finish(qid, pool=pool)
         finally:
+            if seat is not None:
+                seat.release()
             c._unregister_active(tok)
             # statement-completion drain check: under YDB_TPU_LEAKSAN
             # every handle owned by this statement (its registry row,
@@ -1742,6 +1792,11 @@ class Session:
         profile = build_profile(
             scoped, sql=sql, kind=kind,
             query_class=qc, seconds=seconds, rows=rows)
+        fd = c.front_door
+        tenant = fd.registry.resolve(tenant=self.tenant,
+                                     principal=self.principal) \
+            if fd is not None else (self.tenant or "")
+        profile.tenant = tenant
         profile.error = error
         profile.error_reason = reason
         self.last_profile = profile
@@ -1761,6 +1816,18 @@ class Session:
         # histogram_quantile support (and the bench) read these directly
         g.counter("query_latency_p50").set(round(h.percentile(0.5), 9))
         g.counter("query_latency_p99").set(round(h.percentile(0.99), 9))
+        if tenant:
+            # the per-tenant SLO surface: same histogram + percentile
+            # gauges, labeled by pool, so /counters/prometheus exposes
+            # each tenant's p50/p99 and the isolation tests read the
+            # victim's percentiles directly
+            tg = c.counters.group(tenant=tenant, query_class=qc)
+            th = tg.histogram("query_latency_seconds")
+            th.observe(seconds)
+            tg.counter("query_latency_p50").set(
+                round(th.percentile(0.5), 9))
+            tg.counter("query_latency_p99").set(
+                round(th.percentile(0.99), 9))
 
     def _check_access(self, perm: str, *paths: str) -> None:
         """ACL gate (scheme ACEs with subtree inheritance): enforced

@@ -34,7 +34,9 @@
     asserted equal throughout.
 
 Flags: ``--rows`` ``--groups`` ``--aggs`` ``--iters`` ``--block-rows``
-``--pruning`` ``--streaming`` ``--profile-overhead`` ``--fusion``
+``--pruning`` ``--streaming`` ``--profile-overhead``
+``--admission-overhead`` (multi-tenant front door absent vs installed
+through the full session path) ``--fusion``
 ``--shuffle``
 ``--shuffle-rows`` ``--sf`` (scale
 factor for the overhead/fusion benches) ``--json`` (report on stdout) and
@@ -730,6 +732,93 @@ def bench_leaksan_overhead(sf: float, iters: int, block_rows: int,
     return out
 
 
+def bench_admission_overhead(sf: float, iters: int,
+                             assert_within: float | None = None,
+                             ) -> dict:
+    """Warm TPC-H Q1 through the full ``Session.execute`` path with NO
+    front door installed (the default state: one ``cluster.front_door
+    is None`` attribute test per statement) vs the multi-tenant
+    admission plane INSTALLED (``serving.install``) serving a single
+    default-pool client — the uncontended fast path: tenant resolve,
+    seat grant + release under the door lock, per-tenant SLO counters.
+    The front door must be near-free for the single-tenant case or it
+    cannot sit on every statement; ``assert_within`` fails the bench
+    when the armed side exceeds the bare path by more than that
+    fraction (the serving README's bar: <3% warm Q1)."""
+    from ydb_tpu import serving
+    from ydb_tpu.kqp.session import Cluster
+    from ydb_tpu.scheme.model import type_to_str
+    from ydb_tpu.workload import tpch
+    from ydb_tpu.workload.queries import TPCH
+
+    data = tpch.TpchData(sf=sf, seed=5)
+    n = len(data.tables["lineitem"]["l_orderkey"])
+    q1 = TPCH["q1"]
+
+    def boot(with_door):
+        c = Cluster()
+        if with_door:
+            serving.install(c)
+        s = c.session()
+        schema = data.schema("lineitem")
+        cols = ", ".join(f"{f.name} {type_to_str(f.type)}"
+                         for f in schema.fields)
+        s.execute(f"CREATE TABLE lineitem ({cols}, "
+                  f"PRIMARY KEY (l_orderkey)) WITH (shards = 1)")
+        src = data.tables["lineitem"]
+        arrays = {}
+        for f in schema.fields:
+            v = src[f.name]
+            if f.type.is_string:
+                arrays[f.name] = [
+                    bytes(x) for x in data.dicts[f.name].decode(
+                        np.asarray(v, dtype=np.int32))]
+            else:
+                arrays[f.name] = v
+        c.tables["lineitem"].insert(arrays)
+        c._invalidate_plans()
+        s.execute(q1)  # warm plan + compile caches
+        return c, s
+
+    sides = {"off": boot(False), "on": boot(True)}
+    try:
+        best = {"off": float("inf"), "on": float("inf")}
+        # interleave the sides so host drift hits both equally
+        for _ in range(max(1, iters)):
+            for label, (_, s) in sides.items():
+                t0 = time.perf_counter()
+                s.execute(q1)
+                best[label] = min(best[label],
+                                  time.perf_counter() - t0)
+        snap = sides["on"][0].front_door.snapshot()
+        pool = snap.get(serving.DEFAULT_TENANT, {})
+        if not pool.get("admitted"):
+            raise AssertionError(
+                "front door counted no admissions on the armed side — "
+                "the bench did not exercise the admission plane")
+    finally:
+        for c, _ in sides.values():
+            c.stop()
+    out = {
+        "rows": n, "sf": sf,
+        "admission_off_seconds": round(best["off"], 6),
+        "admission_on_seconds": round(best["on"], 6),
+        "admission_off_rows_per_sec": round(n / best["off"]),
+        "admission_on_rows_per_sec": round(n / best["on"]),
+        "overhead_pct": round(
+            100 * (best["on"] / best["off"] - 1), 2),
+        "admitted": pool.get("admitted"),
+        "shed": pool.get("shed"),
+    }
+    if assert_within is not None:
+        if best["on"] > best["off"] * (1 + assert_within):
+            raise AssertionError(
+                f"front-door admission overhead {out['overhead_pct']}% "
+                f"exceeds the {assert_within * 100:g}% budget")
+        out["within_budget"] = True
+    return out
+
+
 def bench_fusion(sf: float, iters: int) -> dict:
     """Whole-plan fusion A/B: TPC-H Q3 (semi + inner join feeding a
     grouped two-phase-aggregate top-k) executed fused — one
@@ -1076,6 +1165,8 @@ def main(argv=None) -> int:
                     help="chaos disarmed vs armed-dormant warm Q1 A/B")
     ap.add_argument("--leaksan-overhead", action="store_true",
                     help="leak sanitizer disabled vs armed warm Q1 A/B")
+    ap.add_argument("--admission-overhead", action="store_true",
+                    help="front door absent vs installed warm Q1 A/B")
     ap.add_argument("--fusion", action="store_true",
                     help="whole-plan fused vs per-fragment warm Q3 A/B")
     ap.add_argument("--batching", action="store_true",
@@ -1137,6 +1228,12 @@ def main(argv=None) -> int:
         report["leaksan_overhead"] = bench_leaksan_overhead(
             args.sf, max(3, args.iters), args.block_rows,
             assert_within=(0.5 if args.smoke else 0.01))
+    if args.admission_overhead or args.smoke:
+        # smoke: tiny run, lax bound (machinery + no-catastrophe
+        # guard); real sizes hold the 3% front-door budget
+        report["admission_overhead"] = bench_admission_overhead(
+            args.sf, max(3, args.iters),
+            assert_within=(0.5 if args.smoke else 0.03))
     if args.fusion or args.smoke:
         report["fusion"] = bench_fusion(args.sf, max(3, args.iters))
     if args.batching or args.smoke:
@@ -1208,6 +1305,13 @@ def main(argv=None) -> int:
                   f"{lo['leaksan_off_rows_per_sec']:,} rows/s "
                   f"({lo['overhead_pct']:+.2f}%, "
                   f"drained={lo['drained']})")
+        if "admission_overhead" in report:
+            ao = report["admission_overhead"]
+            print(f"admission overhead rows={ao['rows']}: door "
+                  f"{ao['admission_on_rows_per_sec']:,} rows/s vs off "
+                  f"{ao['admission_off_rows_per_sec']:,} rows/s "
+                  f"({ao['overhead_pct']:+.2f}%, "
+                  f"admitted={ao['admitted']})")
         if "fusion" in report:
             fu = report["fusion"]
             print(f"fusion rows={fu['rows']}: fused "

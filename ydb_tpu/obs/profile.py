@@ -77,6 +77,9 @@ class QueryProfile:
     #: why it failed: "cancelled" (deadline), "overloaded" (admission
     #: shed), else the error type name; "" on success
     error_reason: str = ""
+    #: workload pool the statement admitted under (serving/tenants.py);
+    #: "" for sessions on clusters without a front door
+    tenant: str = ""
     spans: list = dataclasses.field(default_factory=list)
 
     def to_dict(self, include_spans: bool = False) -> dict:

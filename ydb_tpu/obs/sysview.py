@@ -83,7 +83,7 @@ SYS_SCHEMAS = {
         ("error", dtypes.INT32),
         ("error_reason", dtypes.STRING),
         ("batch_id", dtypes.INT64), ("batch_size", dtypes.INT32),
-        ("shared_scan", dtypes.INT32)),
+        ("shared_scan", dtypes.INT32), ("tenant", dtypes.STRING)),
     # HBM-resident column tier (engine/resident.py): per-shard pinned
     # bytes vs budget plus promotion/eviction/spill lifecycle counters
     # — the "is the hot set actually resident" dashboard
@@ -112,7 +112,18 @@ SYS_SCHEMAS = {
         ("rows", dtypes.INT64), ("queue_position", dtypes.INT32),
         ("trace_id", dtypes.INT64),
         ("batch_id", dtypes.INT64), ("batch_size", dtypes.INT32),
-        ("shared_scan", dtypes.INT32)),
+        ("shared_scan", dtypes.INT32), ("tenant", dtypes.STRING)),
+    # the front door's workload pools (serving/): per-tenant weights,
+    # budget shares and admission counters — the ".sys resource pools"
+    # dashboard an operator reads during an overload
+    "sys_tenant_pools": dtypes.schema(
+        ("tenant", dtypes.STRING), ("weight", dtypes.DOUBLE),
+        ("inflight", dtypes.INT32), ("max_inflight", dtypes.INT32),
+        ("queued", dtypes.INT32), ("queue_size", dtypes.INT32),
+        ("admitted", dtypes.INT64), ("shed", dtypes.INT64),
+        ("pool_limit", dtypes.INT32),
+        ("conveyor_workers", dtypes.INT32),
+        ("resident_bytes", dtypes.INT64)),
 }
 
 
@@ -296,7 +307,7 @@ def _scan_pruning_rows(cluster):
 
 
 def _top_queries_rows(cluster):
-    cols: list[list] = [[] for _ in range(22)]
+    cols: list[list] = [[] for _ in range(23)]
     for rank, p in enumerate(cluster.profiles.top(16), start=1):
         st = p.stages
         pr = p.pruning
@@ -309,7 +320,7 @@ def _top_queries_rows(cluster):
                pr.get("chunks_skipped", 0), getattr(p, "error", 0),
                getattr(p, "error_reason", ""),
                getattr(p, "batch_id", 0), getattr(p, "batch_size", 0),
-               getattr(p, "shared_scan", 0)]
+               getattr(p, "shared_scan", 0), getattr(p, "tenant", "")]
         for c, v in zip(cols, row):
             c.append(v)
     return cols
@@ -335,13 +346,29 @@ def _resident_store_rows(cluster):
 
 
 def _active_queries_rows(cluster):
-    cols: list[list] = [[] for _ in range(10)]
+    cols: list[list] = [[] for _ in range(11)]
     for e in cluster.active_query_snapshot():
         row = [e["sql"][:256], e["kind"], e["stage"],
                e["elapsed_seconds"], e["rows"], e["queue_position"],
                e["trace_id"], e.get("batch_id", 0),
-               e.get("batch_size", 0), e.get("shared_scan", 0)]
+               e.get("batch_size", 0), e.get("shared_scan", 0),
+               e.get("tenant", "")]
         for c, v in zip(cols, row):
+            c.append(v)
+    return cols
+
+
+def _tenant_pools_rows(cluster):
+    cols: list[list] = [[] for _ in range(11)]
+    fd = getattr(cluster, "front_door", None)
+    if fd is None:
+        return cols  # no front door: the view exists but is empty
+    for name, row in fd.snapshot().items():
+        vals = [name, row["weight"], row["inflight"],
+                row["max_inflight"], row["queued"], row["queue_size"],
+                row["admitted"], row["shed"], row["pool_limit"],
+                row["conveyor_workers"], row["resident_bytes"]]
+        for c, v in zip(cols, vals):
             c.append(v)
     return cols
 
@@ -370,6 +397,7 @@ _BUILDERS = {
     "sys_top_queries": _top_queries_rows,
     "sys_query_log": _query_log_rows,
     "sys_active_queries": _active_queries_rows,
+    "sys_tenant_pools": _tenant_pools_rows,
 }
 
 
