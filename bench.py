@@ -549,6 +549,25 @@ def _syncsan_warm(label: str, fn, extra: dict, key: str) -> None:
         extra[key] = snap
 
 
+def _memsan_warm(label: str, fn, extra: dict, key: str) -> None:
+    """One warm statement under the memory sanitizer
+    (analysis/memsan): record the device-byte ledger the statement
+    actually accumulated — peak/live HBM bytes, charge count, and the
+    unbudgeted-allocation count that must stay 0 (devmem M001's
+    runtime shadow). The HBM-footprint scoreboard (ROADMAP item 1):
+    warm peak bytes per statement, expected 0 on the cached engine
+    path."""
+    from ydb_tpu.analysis import memsan
+
+    with memsan.activate():
+        st = memsan.begin_statement(label)
+        fn()
+        snap = memsan.end_statement(st, enforce=False)
+    if snap is not None:
+        snap.pop("by_component", None)
+        extra[key] = snap
+
+
 def run_serving_tier(extra: dict, budget: float) -> None:
     """Serving-throughput tier: N concurrent sessions firing a TPC-H
     Q1/Q6 statement mix at one cluster, batching off vs on
@@ -684,6 +703,20 @@ def run_serving_tier(extra: dict, budget: float) -> None:
                     p = s.last_profile
                     if p is not None and p.syncsan:
                         extra[f"serving_{name}_syncsan"] = p.syncsan
+        # warm per-statement device-byte ledger through the same full
+        # session path (memsan windows ride the statement bounds): the
+        # serving-tier HBM-footprint evidence next to the QPS numbers
+        if _budget_left(budget) > 20:
+            from ydb_tpu.analysis import memsan
+
+            with memsan.activate():
+                s = sides["off"].session()
+                for name, sql in (("q1", TPCH["q1"]),
+                                  ("q6", TPCH["q6"])):
+                    s.execute(sql)
+                    p = s.last_profile
+                    if p is not None and p.memsan:
+                        extra[f"serving_{name}_memsan"] = p.memsan
     finally:
         for c in sides.values():
             c.stop()
@@ -1360,6 +1393,9 @@ def main():
                 _syncsan_warm("q1",
                               lambda: shard.scan(tpch.q1_program()),
                               extra, "engine_q1_syncsan")
+                _memsan_warm("q1",
+                             lambda: shard.scan(tpch.q1_program()),
+                             extra, "engine_q1_memsan")
             engine_warm_rps = round(e_rows / ewarm1)
             _checkpoint("engine_q1", extra)
             if _budget_left(budget) < 45:
@@ -1384,6 +1420,9 @@ def main():
                 _syncsan_warm("q6",
                               lambda: shard.scan(tpch.q6_program()),
                               extra, "engine_q6_syncsan")
+                _memsan_warm("q6",
+                             lambda: shard.scan(tpch.q6_program()),
+                             extra, "engine_q6_memsan")
             _checkpoint("engine_q6", extra)
 
             # ---- resident tier: HBM-pinned columns vs the staged

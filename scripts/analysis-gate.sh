@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Pre-commit / CI analysis gate: run every static-analysis pillar
-# (verify self-test, lint, concurrency, lifecycle, hotpath) over the
-# files git reports changed, exiting with the analyzer's status.
+# (verify self-test, lint, concurrency, lifecycle, hotpath, devmem)
+# over the files git reports changed, exiting with the analyzer's
+# status.
 #
 #   scripts/analysis-gate.sh                    # changed .py files only
 #   scripts/analysis-gate.sh --full             # the whole tree

@@ -1,5 +1,5 @@
 """Unified-analyzer contract: ``python -m ydb_tpu.analysis --json``
-emits one stable schema across all five pillars — a dict of stage ->
+emits one stable schema across all six pillars — a dict of stage ->
 finding list, every finding carrying exactly
 ``{file, line, col, code, name, message}``. CI tooling and the
 analysis gate parse this shape; a pillar drifting to its own schema is
@@ -8,7 +8,8 @@ a silent gate break."""
 import json
 import textwrap
 
-from ydb_tpu.analysis import concurrency, hotpath, lifecycle, lint
+from ydb_tpu.analysis import concurrency, devmem, hotpath, lifecycle, \
+    lint
 from ydb_tpu.analysis.__main__ import (
     _verify_selftest,
     format_findings,
@@ -16,11 +17,12 @@ from ydb_tpu.analysis.__main__ import (
     run_all,
 )
 
-STAGES = ("verify", "lint", "concurrency", "lifecycle", "hotpath")
+STAGES = ("verify", "lint", "concurrency", "lifecycle", "hotpath",
+          "devmem")
 FIELDS = {"file", "line", "col", "code", "name", "message"}
 
 #: one seeded violation per AST pillar, chosen from each pillar's
-#: documented rule set (L005 / C005 / R001 / H001)
+#: documented rule set (L005 / C005 / R001 / H001 / M001)
 _SEEDS = {
     "lint": """
         def f(x=[]):
@@ -44,6 +46,12 @@ _SEEDS = {
             def _execute_admitted(self, sql):
                 return out.item()
     """,
+    "devmem": """
+        import jax.numpy as jnp
+
+        def stage(n):
+            return jnp.zeros(n)
+    """,
 }
 
 
@@ -55,11 +63,14 @@ def _seeded(stage):
         return concurrency.check_source(src, "seed.py")
     if stage == "lifecycle":
         return lifecycle.check_source(src, "seed.py")
+    if stage == "devmem":
+        return devmem.check_source(src, "seed.py")
     return hotpath.check_source(src, "seed.py", modname="kqp.session")
 
 
 def test_every_pillar_emits_the_unified_schema():
-    for stage in ("lint", "concurrency", "lifecycle", "hotpath"):
+    for stage in ("lint", "concurrency", "lifecycle", "hotpath",
+                  "devmem"):
         findings = _seeded(stage)
         assert findings, f"{stage} seed fired nothing"
         for f in findings:
@@ -68,7 +79,7 @@ def test_every_pillar_emits_the_unified_schema():
                 f"{stage} finding schema drifted: {sorted(d)}"
             assert isinstance(d["line"], int)
             assert isinstance(d["col"], int)
-            assert d["code"][0] in "LCRH"
+            assert d["code"][0] in "LCRHM"
             # the JSON surface round-trips
             assert json.loads(json.dumps(d)) == d
 

@@ -1,0 +1,379 @@
+"""Device-memory analyzer (analysis/devmem): one firing and one clean
+fixture per M-rule, plus the suppression surfaces (``@budget_ok``
+decorator, ``# ydb-lint: disable=M00x`` pragma, ``# ydb-devmem:
+device-module`` trace-context declaration) and the interprocedural
+charge-coverage fixpoint."""
+
+import textwrap
+
+from ydb_tpu.analysis import devmem
+
+
+def _check(src: str, filename: str = "seed.py"):
+    return devmem.check_source(textwrap.dedent(src), filename)
+
+
+def _codes(src: str):
+    return [f.code for f in _check(src)]
+
+
+# ---------------- M001: unbudgeted device alloc ----------------
+
+
+def test_m001_fires_on_bare_creator():
+    codes = _codes("""
+        import jax.numpy as jnp
+
+        def stage(n):
+            return jnp.zeros(n)
+    """)
+    assert "M001" in codes
+
+
+def test_m001_clean_when_function_charges():
+    assert _codes("""
+        import jax.numpy as jnp
+        from ydb_tpu.analysis import memsan
+
+        def stage(n):
+            with memsan.seam("staging"):
+                out = jnp.zeros(n)
+            memsan.charge(memsan.nbytes_of(out), "staging")
+            return out
+    """) == []
+
+
+def test_m001_clean_under_budget_ok():
+    assert _codes("""
+        import jax.numpy as jnp
+        from ydb_tpu.analysis import budget_ok
+
+        @budget_ok("bounded scratch: one int32[8] vector")
+        def stage(n):
+            return jnp.zeros(n)
+    """) == []
+
+
+def test_m001_clean_under_jit():
+    assert _codes("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            return jnp.zeros(x.shape) + x
+    """) == []
+
+
+def test_m001_clean_for_nested_def_handed_to_jit():
+    assert _codes("""
+        import jax
+        import jax.numpy as jnp
+
+        def build(cap):
+            def dispatch(x):
+                return jnp.zeros(cap) + x
+            return jax.jit(dispatch)
+    """) == []
+
+
+def test_m001_from_numpy_call_counts_as_creator():
+    codes = _codes("""
+        def ingest(arrays, schema):
+            return TableBlock.from_numpy(arrays, schema)
+    """)
+    assert "M001" in codes
+
+
+def test_m001_charging_caller_covers_helper():
+    """The interprocedural fixpoint: a helper whose every caller
+    charges inherits the charge."""
+    assert _codes("""
+        import jax.numpy as jnp
+        from ydb_tpu.analysis import memsan
+
+        def _helper(n):
+            return jnp.zeros(n)
+
+        def stage(n):
+            with memsan.seam("staging"):
+                out = _helper(n)
+            memsan.charge(memsan.nbytes_of(out), "staging")
+            return out
+    """) == []
+
+
+def test_m001_pragma_suppresses_site():
+    assert _codes("""
+        import jax.numpy as jnp
+
+        def stage(n):
+            return jnp.zeros(n)  # ydb-lint: disable=M001
+    """) == []
+
+
+def test_device_module_pragma_declares_trace_context():
+    assert _codes("""
+        # ydb-devmem: device-module
+        import jax.numpy as jnp
+
+        def kernel(x):
+            return jnp.zeros(x.shape)
+    """) == []
+
+
+# ---------------- M002: use after donation ----------------
+
+
+def test_m002_fires_on_use_after_donating_call():
+    codes = _codes("""
+        import jax
+
+        def run(self, block):
+            fn = jax.jit(_fresh(), donate_argnums=(0,))
+            out = fn(block)
+            return block.length
+    """)
+    assert "M002" in codes
+
+
+def test_m002_clean_when_donated_input_dropped():
+    assert _codes("""
+        import jax
+
+        def run(self, block):
+            fn = jax.jit(_fresh(), donate_argnums=(0,))
+            out = fn(block)
+            return out
+    """) == []
+
+
+# ---------------- M003: donated-jit rebuild hazard ----------------
+
+
+def test_m003_fires_on_bound_method_jit_on_grow_path():
+    codes = _codes("""
+        import jax
+
+        class Plan:
+            def grow(self, cap):
+                self._fn = jax.jit(self._dispatch)
+    """)
+    assert "M003" in codes
+
+
+def test_m003_fires_on_donating_reused_function_object():
+    codes = _codes("""
+        import jax
+
+        class Plan:
+            def build(self, fn):
+                self._fn = jax.jit(fn, donate_argnums=(0,))
+    """)
+    assert "M003" in codes
+
+
+def test_m003_clean_for_one_time_init_jit():
+    assert _codes("""
+        import jax
+
+        class Plan:
+            def __init__(self):
+                self._fn = jax.jit(self._dispatch)
+    """) == []
+
+
+def test_m003_clean_for_fresh_local_wrapper():
+    assert _codes("""
+        import jax
+
+        class Plan:
+            def grow(self, cap):
+                def _dispatch(x):
+                    return self._step(x, cap)
+                self._fn = jax.jit(_dispatch, donate_argnums=(0,))
+    """) == []
+
+
+# ---------------- M004: unrounded data-dependent shape ----------------
+
+
+def test_m004_fires_on_len_sized_alloc():
+    codes = _codes("""
+        import jax.numpy as jnp
+        from ydb_tpu.analysis import memsan
+
+        def stage(xs):
+            with memsan.seam("staging"):
+                out = jnp.zeros(len(xs))
+            memsan.charge(memsan.nbytes_of(out), "staging")
+            return out
+    """)
+    assert "M004" in codes
+
+
+def test_m004_clean_through_shape_class():
+    assert _codes("""
+        import jax.numpy as jnp
+        from ydb_tpu.analysis import memsan
+
+        def stage(xs):
+            with memsan.seam("staging"):
+                out = jnp.zeros(shape_class(len(xs)))
+            memsan.charge(memsan.nbytes_of(out), "staging")
+            return out
+    """) == []
+
+
+# ---------------- M005: device closure into a pool ----------------
+
+
+def test_m005_fires_on_lambda_capturing_device_array():
+    codes = _codes("""
+        import jax.numpy as jnp
+        from ydb_tpu.analysis import memsan
+
+        def submit_work(pool, host):
+            with memsan.seam("staging"):
+                dev = jnp.asarray(host)
+            memsan.charge(memsan.nbytes_of(dev), "staging")
+            pool.submit(lambda: dev + 1)
+    """)
+    assert "M005" in codes
+
+
+def test_m005_clean_when_task_stages_inside():
+    assert _codes("""
+        def submit_work(pool, host):
+            pool.submit(lambda: stage_and_run(host))
+    """) == []
+
+
+# ---------------- M006: grow-only device container ----------------
+
+
+def test_m006_fires_on_valveless_device_cache():
+    codes = _codes("""
+        import jax.numpy as jnp
+
+        class Cache:
+            def __init__(self):
+                self._store = {}
+
+            def put(self, key, host):
+                self._store[key] = jnp.asarray(host)  # ydb-lint: disable=M001
+    """)
+    assert "M006" in codes
+
+
+def test_m006_clean_with_eviction_valve():
+    assert _codes("""
+        import jax.numpy as jnp
+
+        class Cache:
+            def __init__(self):
+                self._store = {}
+
+            def put(self, key, host):
+                self._store[key] = jnp.asarray(host)  # ydb-lint: disable=M001
+
+            def evict(self, key):
+                del self._store[key]
+    """) == []
+
+
+# ---------------- M007: per-dispatch aux staging ----------------
+
+
+def test_m007_fires_on_inline_aux_staging():
+    codes = _codes("""
+        import jax.numpy as jnp
+
+        def dispatch(self, cp):
+            staged = {}
+            for k in cp.aux:
+                staged[k] = jnp.asarray(cp.aux[k])
+            return self._fn(staged)
+    """)
+    assert "M007" in codes
+
+
+def test_m007_clean_inside_device_aux_itself():
+    assert _codes("""
+        import jax.numpy as jnp
+        from ydb_tpu.analysis import memsan
+
+        def device_aux(aux):
+            out = {}
+            with memsan.seam("staging"):
+                for k in aux:
+                    out[k] = jnp.asarray(aux[k])
+            memsan.charge(memsan.nbytes_of(out), "staging")
+            return out
+    """) == []
+
+
+# ---------------- M008: device buffer across yield ----------------
+
+
+def test_m008_fires_on_buffer_held_across_yield():
+    codes = _codes("""
+        import jax.numpy as jnp
+        from ydb_tpu.analysis import memsan
+
+        def stream(host_blocks):
+            with memsan.seam("staging"):
+                dev = jnp.asarray(host_blocks[0])
+            memsan.charge(memsan.nbytes_of(dev), "staging")
+            yield "header"
+            yield dev
+    """)
+    assert "M008" in codes
+
+
+def test_m008_clean_when_staged_per_iteration():
+    assert _codes("""
+        import jax.numpy as jnp
+        from ydb_tpu.analysis import memsan
+
+        def stream(host_blocks):
+            for b in host_blocks:
+                with memsan.seam("staging"):
+                    dev = jnp.asarray(b)
+                memsan.charge(memsan.nbytes_of(dev), "staging")
+                yield dev
+    """) == []
+
+
+# ---------------- shared surfaces ----------------
+
+
+def test_syntax_error_reported_as_m000():
+    findings = _check("def broken(:\n")
+    assert [f.code for f in findings] == ["M000"]
+
+
+def test_runtime_scope_keeps_runtime_packages_only(tmp_path):
+    inside = tmp_path / "ydb_tpu" / "engine" / "scan.py"
+    outside = tmp_path / "ydb_tpu" / "workload" / "gen.py"
+    fixture = tmp_path / "fixtures" / "seed.py"
+    for p in (inside, outside, fixture):
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("x = 1\n")
+    kept = {str(p) for p in devmem.runtime_scope(
+        [inside, outside, fixture])}
+    assert str(inside) in kept
+    assert str(fixture) in kept       # non-tree paths pass through
+    assert str(outside) not in kept   # non-runtime package dropped
+
+
+def test_findings_carry_the_unified_schema():
+    (finding,) = [f for f in _check("""
+        import jax.numpy as jnp
+
+        def stage(n):
+            return jnp.zeros(n)
+    """) if f.code == "M001"]
+    d = finding.to_dict()
+    assert set(d) == {"file", "line", "col", "code", "name", "message"}
+    assert d["name"] == devmem.RULES["M001"]

@@ -1,0 +1,39 @@
+"""Tier-1 enforcement: the device-memory analyzer runs clean over the
+runtime packages (the M-rule analog of test_hotpath_clean). A finding
+here means a code change created a device array outside a
+budget-charging seam, re-jitted on a grow path, or grew a device
+container without a valve — fix the code, charge the bytes via
+``memsan.seam()/charge()``, mark a bounded site
+``@analysis.budget_ok("reason")``, or justify a reviewed site with a
+``# ydb-lint: disable=M00x`` pragma."""
+
+from pathlib import Path
+
+from ydb_tpu.analysis import devmem
+from ydb_tpu.analysis.paths import collect_files
+
+PKG = Path(devmem.__file__).resolve().parents[1]
+
+
+def test_devmem_clean_tree_wide():
+    findings = devmem.check_paths(collect_files([PKG]))
+    msg = "\n".join(f.render() for f in findings)
+    assert findings == [], \
+        f"{len(findings)} device-memory finding(s):\n{msg}"
+
+
+def test_runtime_scope_covers_every_declared_package():
+    """Each RUNTIME_PACKAGES entry must exist on disk — a package
+    rename would otherwise silently shrink the scanned set and the
+    clean test above would pass vacuously."""
+    for pkg in devmem.RUNTIME_PACKAGES:
+        assert (PKG / pkg).is_dir(), \
+            f"runtime package {pkg!r} missing from {PKG} — renamed?"
+
+
+def test_scope_actually_collects_runtime_files():
+    files = devmem.runtime_scope(collect_files([PKG]))
+    # every runtime package contributes at least one scanned module
+    for pkg in devmem.RUNTIME_PACKAGES:
+        assert any(pkg in Path(f).parts for f in files), \
+            f"no files collected from runtime package {pkg!r}"

@@ -28,7 +28,7 @@ def test_unified_entrypoint_clean_tree_wide():
 
     stages = run_all([PKG])
     assert set(stages) == {"verify", "lint", "concurrency",
-                           "lifecycle", "hotpath"}
+                           "lifecycle", "hotpath", "devmem"}
     bad = {k: v for k, v in stages.items() if v}
     assert not bad, \
         f"unified analyzer findings:\n{format_findings(stages)}"

@@ -1,6 +1,7 @@
-"""Static analysis: SSA verify, lint, concurrency, lifecycle, hotpath.
+"""Static analysis: SSA verify, lint, concurrency, lifecycle, hotpath,
+devmem.
 
-Five pillars (README.md in this directory):
+Six pillars (README.md in this directory):
   * ``verify`` — the typed SSA program checker every SQL→SSA lowering
     passes through before any JAX trace (the TProgramContainer::Init
     analog, ydb/core/tx/program/program.cpp:553).
@@ -31,17 +32,30 @@ Five pillars (README.md in this directory):
     transfers/syncs/compiles per statement at the JAX seams,
     attributes them to obs spans and enforces a warm budget of zero
     compilations. ``python -m ydb_tpu.analysis.hotpath``.
+  * ``devmem`` + ``memsan`` — device-memory discipline. The static
+    half walks the runtime packages (engine, ssa, kqp, parallel,
+    blocks, serving) and flags HBM provenance hazards (M001-M008:
+    unbudgeted device allocation, use-after-donation, donated-jit
+    rebuild hazards, unrounded jit shapes, device arrays pinned in
+    pool closures, grow-only device containers, per-dispatch aux
+    staging, buffers held across generator yields); the runtime half
+    (``YDB_TPU_MEMSAN=1``) tracks live/peak device bytes per
+    statement at the allocation seams and enforces a warm peak-bytes
+    budget with zero unbudgeted allocations.
+    ``python -m ydb_tpu.analysis.devmem``.
 
-``python -m ydb_tpu.analysis`` runs all five and exits 1 on any
-finding. ``sanitizer``, ``leaksan`` and ``syncsan`` keep a bare
-import-time dependency set (os + threading + obs.tracing) so the
+``python -m ydb_tpu.analysis`` runs all six and exits 1 on any
+finding. ``sanitizer``, ``leaksan``, ``syncsan`` and ``memsan`` keep a
+bare import-time dependency set (os + threading + obs.tracing) so the
 low-level runtime modules (conveyor, probes, counters, blockcache)
 can import them safely: ``from ydb_tpu.analysis import leaksan``.
 
 ``host_ok`` is the hotpath escape hatch: decorating a function
 declares its host work deliberate (the lazy result fetch, a guarded
 compile-cache miss path) — the analyzer neither reports nor descends
-into it, and the reason string documents why at the site.
+into it, and the reason string documents why at the site. ``budget_ok``
+is the devmem analog: the decorated function's device allocations are
+declared budgeted/bounded and the analyzer skips it.
 """
 
 # host_ok is defined BEFORE the verify import: modules inside the
@@ -57,6 +71,23 @@ def host_ok(reason: str):
 
     def mark(fn):
         fn.__host_ok__ = reason
+        return fn
+
+    return mark
+
+
+# budget_ok sits beside host_ok (before the verify import) for the
+# same import-cycle reason: runtime modules inside the verify->ssa
+# chain resolve it against the partially initialized package.
+def budget_ok(reason: str):
+    """Mark a function's device allocations as deliberately budgeted
+    or bounded for the device-memory analyzer (``devmem.py``). The
+    decorated function is excluded from the M-rule scan; ``reason``
+    names the budget that covers it (e.g. "charged to the resident
+    ledger")."""
+
+    def mark(fn):
+        fn.__budget_ok__ = reason
         return fn
 
     return mark

@@ -2,7 +2,7 @@
 
     python -m ydb_tpu.analysis [path ...] [--json] [--changed]
 
-Runs the five static pillars in order over a single shared CLI surface
+Runs the six static pillars in order over a single shared CLI surface
 (``paths.py`` collection + ``suppress.py`` pragmas):
 
   verify       SSA program checker self-test — the one pillar that
@@ -13,6 +13,7 @@ Runs the five static pillars in order over a single shared CLI surface
   concurrency  C-rules (lock/guard discipline)  — concurrency.py
   lifecycle    R-rules (acquire/release pairing) — lifecycle.py
   hotpath      H-rules (dispatch purity)        — hotpath.py
+  devmem       M-rules (HBM provenance/budget)  — devmem.py
 
 Exit status 1 when ANY stage reports findings, so CI and builders
 invoke exactly one command. Per-tool runs stay available
@@ -24,7 +25,8 @@ from __future__ import annotations
 import json
 import sys
 
-from ydb_tpu.analysis import concurrency, hotpath, lifecycle, lint
+from ydb_tpu.analysis import (concurrency, devmem, hotpath, lifecycle,
+                              lint)
 from ydb_tpu.analysis.paths import collect_files, parse_cli
 
 
@@ -63,7 +65,7 @@ def _verify_selftest() -> list:
 
 
 def run_all(paths=(), changed: bool = False) -> dict:
-    """All five pillars over one collected file list. Returns
+    """All six pillars over one collected file list. Returns
     ``{stage: [finding dict, ...]}`` in run order."""
     files = collect_files(list(paths), changed=changed)
     lint_findings: list = []
@@ -87,6 +89,11 @@ def run_all(paths=(), changed: bool = False) -> dict:
         "lifecycle": [f.to_dict()
                       for f in lifecycle.check_paths(files)],
         "hotpath": [f.to_dict() for f in hotpath.check_paths(
+            hot_files, report_files=hot_report)],
+        # devmem is interprocedural like hotpath: same full-index /
+        # narrowed-reporting split under --changed, else a charging
+        # caller outside the changed set can't cover its helper
+        "devmem": [f.to_dict() for f in devmem.check_paths(
             hot_files, report_files=hot_report)],
     }
 

@@ -1,0 +1,548 @@
+"""Memory sanitizer: per-statement device-byte footprint (YDB_TPU_MEMSAN=1).
+
+The runtime half of the device-memory pillar. ``devmem.py`` proves
+statically that device arrays are only *created* inside budget-charging
+seams; this sanitizer measures what those seams actually *allocate* per
+statement — live and peak HBM bytes, attributed to the owning statement
+(span trace-id) and to the allocation component — and enforces a
+warm-statement budget: after warmup, peak device bytes within
+``Budget.peak_bytes`` and **zero unbudgeted allocations**, or the
+statement raises ``MemBudgetError``.
+
+Instrumented seams (each charges its bytes explicitly):
+
+  ``staging``   TableBlock.from_numpy / device_aux — host->device ingest
+  ``resident``  ResidentStore.promote (released on eviction/clear)
+  ``stack``     FusedPlan.run_stacked member stacking (released after
+                the batched dispatch returns)
+  ``shuffle``   repartition send/recv bucket capacity
+  ``dispatch``  fused-plan output blocks
+
+Seams wrap their device work in :func:`seam` and account the result via
+:func:`charge` / :func:`release`. While armed, the raw jax allocators
+(``jnp.zeros/ones/full/stack``, ``jax.device_put``) are patched to
+catch CONCRETE device allocations outside any seam — those count as
+*unbudgeted* (the runtime shadow of devmem rule M001). Allocations
+under an active trace (tracers) are XLA temporaries, not HBM buffers,
+and are ignored. ``jnp.asarray`` is syncsan's patch point (the two
+sanitizers must not fight over one seam's restore order); asarray-based
+staging is charged by the staging seams themselves.
+
+Charges attribute to the active statement exactly like syncsan: the
+beginning thread via a thread-local, conveyor workers via the inherited
+obs span's trace id, anything else to the orphan window.
+``end_statement`` annotates the obs span (``memsan_*`` attributes,
+surfaced by EXPLAIN ANALYZE and ``QueryProfile.memsan``) and enforces
+the budget. Component totals persist process-wide for the
+``sys_device_memory`` sysview and the ``/counters/prometheus`` gauges.
+
+Gates mirror ``leaksan.py``: ``YDB_TPU_MEMSAN=1`` env, ``set_force()``
+pin, ``activate()`` context manager for tests and bench. Every entry
+point is a single module-global bool check while disabled.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ydb_tpu.obs import tracing
+
+#: tri-state pin: None -> follow the env var; True/False -> forced
+_FORCE: "bool | None" = None
+
+_meta_lock = threading.Lock()
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    if _FORCE is not None:
+        return _FORCE
+    return os.environ.get("YDB_TPU_MEMSAN", "") not in ("", "0")
+
+
+_ON = enabled()
+
+
+def armed() -> bool:
+    """Cheap inline gate for charge sites: guard ``nbytes_of`` walks
+    with ``if memsan.armed():`` so the disarmed path costs one
+    module-global read."""
+    return _ON
+
+
+class MemBudgetError(AssertionError):
+    """A warm statement exceeded its device-memory budget."""
+
+
+class Budget:
+    __slots__ = ("peak_bytes", "warmup")
+
+    def __init__(self, peak_bytes: "int | None" = None,
+                 warmup: int = 1):
+        self.peak_bytes = peak_bytes
+        self.warmup = warmup
+
+
+_budget: "Budget | None" = None
+_warm_seen: dict = {}  # label -> statements ended (warmup tracking)
+
+
+class Statement:
+    """Byte ledger for one statement (one ``begin``/``end`` pair)."""
+
+    __slots__ = ("label", "trace_id", "span", "live", "peak",
+                 "charges", "unbudgeted", "unbudgeted_bytes",
+                 "by_component", "_lock")
+
+    def __init__(self, label: str, trace_id: "str | None"):
+        self.label = label
+        self.trace_id = trace_id
+        self.span = tracing.current_span()
+        self.live = 0
+        self.peak = 0
+        self.charges = 0
+        self.unbudgeted = 0
+        self.unbudgeted_bytes = 0
+        self.by_component: dict = {}
+        self._lock = threading.Lock()
+
+    def note_charge(self, nbytes: int, component: str,
+                    budgeted: bool = True) -> None:
+        with self._lock:
+            self.live += nbytes
+            self.peak = max(self.peak, self.live)
+            self.charges += 1
+            self.by_component[component] = \
+                self.by_component.get(component, 0) + nbytes
+            if not budgeted:
+                self.unbudgeted += 1
+                self.unbudgeted_bytes += nbytes
+
+    def note_release(self, nbytes: int) -> None:
+        with self._lock:
+            self.live -= nbytes
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"live": self.live, "peak": self.peak,
+                    "charges": self.charges,
+                    "unbudgeted": self.unbudgeted,
+                    "unbudgeted_bytes": self.unbudgeted_bytes,
+                    "by_component": dict(self.by_component)}
+
+
+_by_trace: dict = {}       # trace_id -> Statement
+_orphans = Statement("<orphan>", None)
+
+#: process-wide per-component ledger (sys_device_memory + prometheus):
+#: component -> {live, peak, charges, releases, evictions}
+_components: dict = {}
+_global_live = 0
+_global_peak = 0
+
+
+def _resolve() -> "Statement | None":
+    st = getattr(_tls, "stat", None)
+    if st is not None:
+        return st
+    span = tracing.current_span()
+    if span is not None:
+        st = _by_trace.get(span.trace_id)
+        if st is not None:
+            return st
+    return _orphans
+
+
+def _component_note(component: str, *, nbytes: int = 0,
+                    release: int = 0, evicted: bool = False) -> None:
+    global _global_live, _global_peak
+    with _meta_lock:
+        c = _components.get(component)
+        if c is None:
+            c = _components[component] = {
+                "live": 0, "peak": 0, "charges": 0, "releases": 0,
+                "evictions": 0}
+        if nbytes:
+            c["live"] += nbytes
+            c["peak"] = max(c["peak"], c["live"])
+            c["charges"] += 1
+            _global_live += nbytes
+            _global_peak = max(_global_peak, _global_live)
+        if release:
+            c["live"] -= release
+            c["releases"] += 1
+            _global_live -= release
+            if evicted:
+                c["evictions"] += 1
+
+
+# ---------------- charge / release ----------------
+
+
+class Ticket:
+    """One live charge; :func:`release` returns its bytes."""
+
+    __slots__ = ("nbytes", "component", "owner", "stat", "closed")
+
+    def __init__(self, nbytes: int, component: str, owner, stat):
+        self.nbytes = nbytes
+        self.component = component
+        self.owner = owner
+        self.stat = stat
+        self.closed = False
+
+
+def nbytes_of(tree) -> int:
+    """Total device bytes across a pytree of arrays (0 for leaves
+    without ``nbytes`` — lengths, treedef constants)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def charge(nbytes: int, component: str,
+           owner=None) -> "Ticket | None":
+    """Account ``nbytes`` of device memory to the active statement and
+    the process-wide component ledger. Returns None (and counts
+    nothing) while the sanitizer is off; the matching free calls
+    :func:`release` on whatever this returned (sites whose buffers are
+    GC-owned simply never release — the bytes stay counted as the
+    statement's allocation footprint, which is the budgeted quantity)."""
+    if not _ON:
+        return None
+    nbytes = int(nbytes)
+    st = _resolve()
+    st.note_charge(nbytes, component, budgeted=True)
+    _component_note(component, nbytes=nbytes)
+    return Ticket(nbytes, component, owner, st)
+
+
+def release(ticket: "Ticket | None", *, evicted: bool = False) -> None:
+    """Return a charge's bytes (None-safe and idempotent, so
+    disabled-path and retry call sites stay branch-free). ``evicted``
+    marks budget-valve frees (the eviction column of
+    ``sys_device_memory``)."""
+    if ticket is None or ticket.closed:
+        return
+    ticket.closed = True
+    ticket.stat.note_release(ticket.nbytes)
+    _component_note(ticket.component, release=ticket.nbytes,
+                    evicted=evicted)
+
+
+# ---------------- seams + allocator patches ----------------
+
+
+class _Seam:
+    """Marks "inside a budget-charging seam" on this thread: patched
+    allocators under it stay silent (the seam charges the authoritative
+    total; wrapper-counting the constituent allocations would double
+    count)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        _tls.seam_depth = getattr(_tls, "seam_depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.seam_depth -= 1
+        return False
+
+
+class _NoopSeam:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_SEAM = _Seam()
+_NOOP = _NoopSeam()
+
+
+def seam(component: str = "") -> "object":
+    """``with memsan.seam("staging"):`` — the enclosed device-array
+    construction belongs to a charging seam. One bool when off."""
+    return _SEAM if _ON else _NOOP
+
+
+def in_seam() -> bool:
+    return getattr(_tls, "seam_depth", 0) > 0
+
+
+def _note_raw(result) -> None:
+    """A patched allocator produced ``result`` outside any seam: a
+    concrete device allocation no budget charged — the runtime shadow
+    of devmem M001."""
+    if not _ON or in_seam():
+        return
+    try:
+        import jax
+
+        if isinstance(result, jax.core.Tracer):
+            return  # abstract value under trace: not an HBM buffer
+        nbytes = int(getattr(result, "nbytes", 0) or 0)
+    except Exception:
+        return
+    if not nbytes:
+        return
+    st = _resolve()
+    st.note_charge(nbytes, "unbudgeted", budgeted=False)
+    _component_note("unbudgeted", nbytes=nbytes)
+
+
+_patched = False
+_orig: dict = {}
+
+#: patched allocator set — deliberately DISJOINT from syncsan's patch
+#: set (jnp.asarray / np.asarray / device_get / block_until_ready):
+#: overlapping patches restore in undefined order when both sanitizers
+#: disarm, leaving a stale wrapper installed
+_PATCH = ("zeros", "ones", "full", "stack")
+
+
+def _install() -> None:
+    global _patched
+    try:
+        import jax
+        import jax.numpy as jnp
+    except Exception:
+        return
+
+    def _wrap(orig):
+        def alloc(*args, **kwargs):
+            r = orig(*args, **kwargs)
+            _note_raw(r)
+            return r
+        return alloc
+
+    def device_put(x, *args, **kwargs):
+        r = _orig["device_put"](x, *args, **kwargs)
+        _note_raw(r)
+        return r
+
+    with _meta_lock:
+        if _patched:
+            return
+        for name in _PATCH:
+            _orig[name] = getattr(jnp, name)
+            setattr(jnp, name, _wrap(_orig[name]))
+        _orig["device_put"] = jax.device_put
+        jax.device_put = device_put
+        _patched = True
+
+
+def _uninstall() -> None:
+    global _patched
+    import jax
+    import jax.numpy as jnp
+
+    with _meta_lock:
+        if not _patched:
+            return
+        for name in _PATCH:
+            setattr(jnp, name, _orig[name])
+        jax.device_put = _orig["device_put"]
+        _patched = False
+
+
+# ---------------- gates (leaksan idiom) ----------------
+
+
+def refresh() -> None:
+    """Re-read the gate; arm or disarm the allocator patches to match."""
+    global _ON
+    with _meta_lock:
+        _ON = enabled()
+        on = _ON
+    if on:
+        _install()
+    else:
+        _uninstall()
+
+
+def set_force(value: "bool | None") -> None:
+    """Pin the sanitizer on/off regardless of the env (tests, bench);
+    ``None`` returns control to ``YDB_TPU_MEMSAN``."""
+    global _FORCE
+    with _meta_lock:
+        _FORCE = value
+    refresh()
+
+
+# honor an env set before import
+if _ON:
+    refresh()
+
+
+# ---------------- statement lifecycle ----------------
+
+
+def begin_statement(label: str,
+                    trace_id: "str | None" = None,
+                    span=None) -> "Statement | None":
+    """Open a byte ledger for one statement. Returns None (and counts
+    nothing) while the sanitizer is off. ``span`` pins the obs span the
+    ledger annotates at close — callers opening the window BEFORE
+    activating their root span (the session statement path) must pass
+    it (the syncsan rule)."""
+    if not _ON:
+        return None
+    st = Statement(label, trace_id)
+    if span is not None:
+        st.span = span
+    _tls.stat = st
+    if trace_id is not None:
+        with _meta_lock:
+            _by_trace[trace_id] = st
+    return st
+
+
+def _close(st: "Statement | None") -> None:
+    if getattr(_tls, "stat", None) is st:
+        _tls.stat = None
+    if st is not None and st.trace_id is not None:
+        with _meta_lock:
+            _by_trace.pop(st.trace_id, None)
+
+
+def discard(st: "Statement | None") -> None:
+    """Drop a window without budget enforcement (error paths)."""
+    _close(st)
+
+
+def end_statement(st: "Statement | None", *,
+                  enforce: bool = True) -> "dict | None":
+    """Close the ledger: annotate the obs span with ``memsan_*``
+    attributes and enforce the warm budget. Returns the byte snapshot
+    (None while disabled)."""
+    if st is None:
+        return None
+    _close(st)
+    snap = st.snapshot()
+    if st.span is not None:
+        st.span.set(memsan_peak=snap["peak"], memsan_live=snap["live"],
+                    memsan_charges=snap["charges"],
+                    memsan_unbudgeted=snap["unbudgeted"])
+    if enforce and _budget is not None:
+        with _meta_lock:
+            seen = _warm_seen.get(st.label, 0)
+            _warm_seen[st.label] = seen + 1
+        if seen >= _budget.warmup:
+            if snap["unbudgeted"]:
+                raise MemBudgetError(
+                    f"statement {st.label!r} made"
+                    f" {snap['unbudgeted']} device allocation(s)"
+                    f" ({snap['unbudgeted_bytes']} bytes) outside any"
+                    " budget-charging seam on the warm path; route the"
+                    " allocation through a memsan seam or annotate the"
+                    " site @analysis.budget_ok (devmem M001)")
+            if _budget.peak_bytes is not None and \
+                    snap["peak"] > _budget.peak_bytes:
+                raise MemBudgetError(
+                    f"statement {st.label!r} peaked at"
+                    f" {snap['peak']} device bytes"
+                    f" (budget {_budget.peak_bytes}); per-component:"
+                    f" {snap['by_component']}")
+    return snap
+
+
+def set_budget(peak_bytes: "int | None" = None,
+               warmup: int = 1) -> None:
+    """Arm the warm-statement budget: statements past ``warmup`` (per
+    label) must stay within ``peak_bytes`` and make zero unbudgeted
+    allocations."""
+    global _budget
+    with _meta_lock:
+        _budget = (peak_bytes if isinstance(peak_bytes, Budget)
+                   else Budget(peak_bytes=peak_bytes, warmup=warmup))
+        _warm_seen.clear()
+
+
+def clear_budget() -> None:
+    global _budget
+    with _meta_lock:
+        _budget = None
+        _warm_seen.clear()
+
+
+# ---------------- surfaces ----------------
+
+
+def totals() -> dict:
+    """Aggregate ledger across live windows + orphans (bench)."""
+    agg = _orphans.snapshot()
+    agg.pop("by_component", None)
+    with _meta_lock:
+        stats = list(_by_trace.values())
+    for st in stats:
+        snap = st.snapshot()
+        for k in ("live", "peak", "charges", "unbudgeted",
+                  "unbudgeted_bytes"):
+            agg[k] += snap[k]
+    return agg
+
+
+def component_totals() -> dict:
+    """Process-wide per-component byte ledger (the sys_device_memory
+    rows and the run_background devmem counters). Empty when nothing
+    was ever charged."""
+    with _meta_lock:
+        return {k: dict(v) for k, v in _components.items()}
+
+
+def global_peak() -> int:
+    """Process-wide peak live device bytes across all components (the
+    /counters/prometheus gauge)."""
+    with _meta_lock:
+        return _global_peak
+
+
+def budget_bytes() -> "int | None":
+    """The armed per-statement peak budget, if any (sysview column)."""
+    b = _budget
+    return b.peak_bytes if b is not None else None
+
+
+def reset() -> None:
+    """Drop all windows, budgets, component ledgers and orphan counts
+    (tests)."""
+    global _orphans, _global_live, _global_peak
+    with _meta_lock:
+        _by_trace.clear()
+        _warm_seen.clear()
+        _components.clear()
+        _global_live = 0
+        _global_peak = 0
+        _orphans = Statement("<orphan>", None)
+    _tls.stat = None
+
+
+class activate:
+    """``with memsan.activate():`` — force the sanitizer on for a scope
+    regardless of the env var, starting from a clean ledger."""
+
+    def __init__(self, budget: "Budget | None" = None):
+        self._budget = budget
+
+    def __enter__(self):
+        reset()
+        set_force(True)
+        if self._budget is not None:
+            set_budget(peak_bytes=self._budget.peak_bytes,
+                       warmup=self._budget.warmup)
+        return self
+
+    def __exit__(self, *exc):
+        clear_budget()
+        set_force(None)
+        reset()
+        return False

@@ -46,6 +46,19 @@ def host_ok(reason: str):
     return mark
 
 
+def budget_ok(reason: str):
+    """Device-memory marker, same shape as ``analysis.budget_ok`` —
+    redeclared here for the same import-graph reason as ``host_ok``
+    above. The devmem analyzer matches the decorator by name; the
+    runtime attribute is identical."""
+
+    def mark(fn):
+        fn.__budget_ok__ = reason
+        return fn
+
+    return mark
+
+
 # Pad capacities to a lane-friendly multiple; keeps layouts tileable on the
 # VPU (8x128 lanes) and stabilizes jit cache keys across slightly different
 # batch sizes.
@@ -122,6 +135,9 @@ class TableBlock:
         mutate ``arrays``/``validity`` after handing them over — the
         scan pipeline's payloads are single-owner by construction.
         """
+        # deferred import: blocks sits below the analysis package in
+        # the import graph (analysis.verify -> ssa -> blocks)
+        from ydb_tpu.analysis import memsan
         names = schema.names
         n = len(next(iter(arrays.values()))) if arrays else 0
         cap = capacity if capacity is not None else _round_up(
@@ -130,22 +146,29 @@ class TableBlock:
         if cap < n:
             raise ValueError(f"capacity {cap} < rows {n}")
         cols = {}
-        for name in names:
-            f = schema.field(name)
-            a = np.asarray(arrays[name], dtype=f.type.physical)
-            v = None if validity is None else validity.get(name)
-            if v is None:
-                v = np.ones(n, dtype=np.bool_)
-            else:
-                v = np.asarray(v, dtype=np.bool_)
-            if cap != n:
-                # tail-only padding; padding validity stays False so it
-                # can never leak live rows
-                a = np.concatenate(
-                    [a, np.zeros(cap - n, dtype=f.type.physical)])
-                v = np.concatenate([v, np.zeros(cap - n, dtype=np.bool_)])
-            cols[name] = Column(jnp.asarray(a), jnp.asarray(v))
-        return TableBlock(cols, jnp.asarray(n, dtype=jnp.int32), schema)
+        with memsan.seam("staging"):
+            for name in names:
+                f = schema.field(name)
+                a = np.asarray(arrays[name], dtype=f.type.physical)
+                v = None if validity is None else validity.get(name)
+                if v is None:
+                    v = np.ones(n, dtype=np.bool_)
+                else:
+                    v = np.asarray(v, dtype=np.bool_)
+                if cap != n:
+                    # tail-only padding; padding validity stays False so
+                    # it can never leak live rows
+                    a = np.concatenate(
+                        [a, np.zeros(cap - n, dtype=f.type.physical)])
+                    v = np.concatenate(
+                        [v, np.zeros(cap - n, dtype=np.bool_)])
+                cols[name] = Column(jnp.asarray(a), jnp.asarray(v))
+            blk = TableBlock(cols, jnp.asarray(n, dtype=jnp.int32),
+                             schema)
+        if memsan.armed():
+            memsan.charge(memsan.nbytes_of(blk), "staging",
+                          owner="from_numpy")
+        return blk
 
     # ---- views ----
 
@@ -153,6 +176,8 @@ class TableBlock:
     def capacity(self) -> int:
         return next(iter(self.columns.values())).capacity if self.columns else 0
 
+    @budget_ok("capacity-length index mask: fused away under jit;"
+               " eager use is one bounded int32[capacity] vector")
     def row_mask(self) -> jax.Array:
         """bool[capacity]: True for live (non-padding) rows."""
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.length
@@ -240,10 +265,19 @@ def device_aux(aux: Mapping[str, object]) -> dict:
     on the device, skipping values that already live there — the aux
     dict crosses every fragment boundary, and re-staging device-resident
     arrays on each hop costs a transfer for nothing."""
-    return {
-        k: v if isinstance(v, jax.Array) else jnp.asarray(v)
-        for k, v in aux.items()
-    }
+    from ydb_tpu.analysis import memsan  # deferred: import graph
+    out = {}
+    staged = 0
+    with memsan.seam("staging"):
+        for k, v in aux.items():
+            if isinstance(v, jax.Array):
+                out[k] = v
+            else:
+                out[k] = jnp.asarray(v)
+                staged += int(getattr(out[k], "nbytes", 0) or 0)
+    if staged and memsan.armed():
+        memsan.charge(staged, "staging", owner="device_aux")
+    return out
 
 
 @host_ok("host-side concat for readers/tests; the warm scan path"

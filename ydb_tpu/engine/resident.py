@@ -46,7 +46,7 @@ import threading
 import numpy as np
 
 from ydb_tpu import chaos
-from ydb_tpu.analysis import leaksan, sanitizer
+from ydb_tpu.analysis import leaksan, memsan, sanitizer
 from ydb_tpu.blocks.block import Column, TableBlock
 from ydb_tpu.chaos import deadline as statement_deadline
 from ydb_tpu.obs import timeline
@@ -271,21 +271,22 @@ class ResidentStore:
         entries = {}
         total = 0
         valid = valid or {}
-        for n, a in cols.items():
-            v = valid.get(n)
-            if v is None:
-                v = np.ones(len(a), dtype=np.bool_)
-            if dev is not None:
-                import jax
+        with memsan.seam("resident"):
+            for n, a in cols.items():
+                v = valid.get(n)
+                if v is None:
+                    v = np.ones(len(a), dtype=np.bool_)
+                if dev is not None:
+                    import jax
 
-                e = _Entry(jax.device_put(np.asarray(a), dev),
-                           jax.device_put(
-                               np.asarray(v, dtype=np.bool_), dev))
-            else:
-                e = _Entry(jnp.asarray(a),
-                           jnp.asarray(v, dtype=jnp.bool_))
-            entries[n] = e
-            total += e.nbytes
+                    e = _Entry(jax.device_put(np.asarray(a), dev),
+                               jax.device_put(
+                                   np.asarray(v, dtype=np.bool_), dev))
+                else:
+                    e = _Entry(jnp.asarray(a),
+                               jnp.asarray(v, dtype=jnp.bool_))
+                entries[n] = e
+                total += e.nbytes
         if total > budget:
             # a single portion larger than the whole valve can never be
             # resident: spill — the host path keeps serving it
@@ -310,6 +311,10 @@ class ResidentStore:
             self._nbytes += added
             if added:
                 self.promotions += 1
+                if memsan.armed():
+                    info.setdefault("tickets", []).append(
+                        memsan.charge(added, "resident",
+                                      owner=portion_id))
             evicted = self._evict_to_budget_locked(budget,
                                                    keep=portion_id)
         if _P_PROMOTE and added:
@@ -348,6 +353,8 @@ class ResidentStore:
             e = self._cols.pop((portion_id, n), None)
             if e is not None:
                 self._nbytes -= e.nbytes
+        for t in info.get("tickets", ()):
+            memsan.release(t, evicted=True)
 
     def promote_async(self, portion_id: int, rows: int, loader) -> bool:
         """Queue a promotion on the shared conveyor. ``loader()`` runs

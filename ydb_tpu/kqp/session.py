@@ -39,6 +39,7 @@ from ydb_tpu.sql.planner import (
 )
 from ydb_tpu.analysis import host_ok as _host_ok
 from ydb_tpu.analysis import leaksan as _leaksan
+from ydb_tpu.analysis import memsan as _memsan
 from ydb_tpu.analysis import syncsan as _syncsan
 from ydb_tpu.obs.probes import probe as _probe
 from ydb_tpu.tx import Coordinator, ShardedTable
@@ -696,6 +697,22 @@ class Cluster:
                                         tenant=tname)
                 for k in ("inflight", "queued"):
                     g.counter(k).set(row[k])
+        # device-memory ledger (only when the footprint sanitizer is
+        # armed): per-component live/peak bytes plus the process-wide
+        # peak gauge under component="devmem" — the /counters twin of
+        # sys_device_memory
+        if _memsan.armed():
+            for comp, t in _memsan.component_totals().items():
+                g = self.counters.group(component="devmem",
+                                        pool=comp)
+                g.counter("live_bytes").set(t["live"])
+                g.counter("peak_bytes").set(t["peak"])
+                g.counter("charges").set(t["charges"])
+                g.counter("releases").set(t["releases"])
+                g.counter("evictions").set(t["evictions"])
+            self.counters.group(component="devmem").counter(
+                "global_peak_bytes").set(_memsan.global_peak())
+            stats["devmem_peak_bytes"] = _memsan.global_peak()
         # slow-query watchdog over the in-flight registry
         stats["slow_queries"] = self.check_slow_queries()
         return stats
@@ -1679,6 +1696,7 @@ class Session:
         kind = "error"
         span = None
         _ss = None
+        _ms = None
         # the batching dispatcher stamps batch_id/batch_size onto this
         # statement's registry row; sessions run one statement at a time
         self._active_tok = active_tok
@@ -1688,6 +1706,12 @@ class Session:
                 # blocking syncs and XLA compiles attribute to THIS
                 # statement (conveyor workers resolve via the trace id)
                 _ss = _syncsan.begin_statement(
+                    sql, trace_id=span.trace_id, span=span)
+                # memsan window rides the same bounds: device-byte
+                # charges (staging/stack/dispatch/shuffle/resident)
+                # attribute to THIS statement, and its warm budget
+                # enforces on close just like syncsan's
+                _ms = _memsan.begin_statement(
                     sql, trace_id=span.trace_id, span=span)
                 c._update_active(active_tok, stage="plan",
                                  trace_id=span.trace_id)
@@ -1727,8 +1751,10 @@ class Session:
                 # the totals above); a budget breach raises here and
                 # surfaces as a statement error
                 _syncsan.end_statement(_ss)
+                _memsan.end_statement(_ms)
         except BaseException as e:
             _syncsan.discard(_ss)
+            _memsan.discard(_ms)
             # statements that fail MID-EXECUTION still land in the
             # profile ring tagged error=1 plus a typed reason
             # ("cancelled" for deadline expiry, "overloaded" for
@@ -2008,21 +2034,29 @@ class Session:
         db = self._statement_db(plan_db)
         t0 = _time.monotonic()
         snap = None
+        msnap = None
         _ss = None
+        _ms = None
         try:
             with tracing.span("analyze") as asp:
-                # nested syncsan window (thread-local attribution only
-                # — the outer statement keeps the trace-id registry
-                # entry) so the rendered actuals carry THIS run's
-                # host-boundary counters; measurement never enforces
-                # the warm budget, the outer statement window does
+                # nested syncsan/memsan windows (thread-local
+                # attribution only — the outer statement keeps the
+                # trace-id registry entry) so the rendered actuals
+                # carry THIS run's host-boundary and device-byte
+                # counters; measurement never enforces the warm
+                # budget, the outer statement window does
                 _ss = _syncsan.begin_statement("<analyze>")
+                _ms = _memsan.begin_statement("<analyze>")
                 out = to_host(self._execute_select(p, db))
                 snap = _syncsan.end_statement(_ss, enforce=False)
                 _ss = None
+                msnap = _memsan.end_statement(_ms, enforce=False)
+                _ms = None
         finally:
             if _ss is not None:
                 _syncsan.discard(_ss)
+            if _ms is not None:
+                _memsan.discard(_ms)
         seconds = _time.monotonic() - t0
         spans = []
         if asp.recording:
@@ -2034,6 +2068,8 @@ class Session:
             seconds=seconds, rows=out.num_rows)
         if snap is not None:
             profile.syncsan = snap
+        if msnap is not None:
+            profile.memsan = msnap
         return format_plan_analyzed(p, profile)
 
     # -- interactive transaction plumbing --

@@ -36,7 +36,9 @@
 Flags: ``--rows`` ``--groups`` ``--aggs`` ``--iters`` ``--block-rows``
 ``--pruning`` ``--streaming`` ``--profile-overhead``
 ``--admission-overhead`` (multi-tenant front door absent vs installed
-through the full session path) ``--fusion``
+through the full session path) ``--memsan-overhead`` (memory
+sanitizer disarmed vs armed warm Q1, zero unbudgeted allocations
+asserted on the armed side) ``--fusion``
 ``--shuffle``
 ``--shuffle-rows`` ``--sf`` (scale
 factor for the overhead/fusion benches) ``--json`` (report on stdout) and
@@ -555,12 +557,15 @@ def bench_profile_overhead(sf: float, iters: int, block_rows: int,
         # timer jitter; at real scale the relative bound dominates.
         # The hard <3% acceptance bound is the DISABLED path, held by
         # the on/off budget above plus the zero-event gate check; this
-        # enabled-ring bound is a regression tripwire.
-        if best["tl"] > best["on"] * 1.03 + 2e-3:
+        # enabled-ring bound is a regression tripwire. Like every
+        # other bench here it widens to the caller's smoke fraction
+        # (the 3% floor still binds any tighter caller).
+        tl_frac = max(0.03, assert_within)
+        if best["tl"] > best["on"] * (1 + tl_frac) + 2e-3:
             raise AssertionError(
                 f"timeline ring overhead "
-                f"{out['timeline_overhead_pct']}% exceeds the 3% "
-                f"budget")
+                f"{out['timeline_overhead_pct']}% exceeds the "
+                f"{tl_frac * 100:g}% budget")
         out["timeline_within_budget"] = True
     return out
 
@@ -727,6 +732,97 @@ def bench_leaksan_overhead(sf: float, iters: int, block_rows: int,
         if best["armed"] > best["off"] * (1 + assert_within):
             raise AssertionError(
                 f"leaksan armed overhead {out['overhead_pct']}% "
+                f"exceeds the {assert_within * 100:g}% budget")
+        out["within_budget"] = True
+    return out
+
+
+def bench_memsan_overhead(sf: float, iters: int, block_rows: int,
+                          assert_within: float | None = None) -> dict:
+    """Warm TPC-H Q1 with the memory sanitizer DISARMED (the
+    production state: every ``armed()`` check is one module-global bool
+    read, the raw allocators unpatched) vs FORCED ON (allocator
+    wrappers installed, every charging seam walking ``nbytes_of`` over
+    its pytree). Two invariants besides the timing: the armed warm
+    statement must charge at least once (the seams are alive) and make
+    ZERO unbudgeted device allocations — the runtime acceptance of
+    devmem M001 on the engine tier. ``assert_within`` fails the bench
+    when the armed side exceeds disarmed by more than that fraction
+    (the <3% warm-Q1 tripwire)."""
+    from ydb_tpu.analysis import memsan
+    from ydb_tpu.engine.blobs import MemBlobStore
+    from ydb_tpu.engine.shard import ColumnShard, ShardConfig
+    from ydb_tpu.workload import tpch
+
+    data = tpch.TpchData(sf=sf, seed=5)
+    li = data.tables["lineitem"]
+    n = len(li["l_orderkey"])
+    shard = ColumnShard(
+        "memov", tpch.LINEITEM_SCHEMA, MemBlobStore(),
+        dicts=data.dicts,
+        config=ShardConfig(compact_portion_threshold=10 ** 9,
+                           scan_block_rows=block_rows,
+                           portion_chunk_rows=1 << 16))
+    shard.commit([shard.write(dict(li))])
+    prog = tpch.q1_program()
+
+    def run_off():
+        memsan.set_force(False)
+        return shard.scan(prog)
+
+    def run_armed():
+        memsan.set_force(True)
+        st = memsan.begin_statement("q1")
+        try:
+            return shard.scan(prog)
+        finally:
+            memsan.end_statement(st, enforce=False)
+            memsan.set_force(False)
+
+    warm_snap = None
+    try:
+        memsan.reset()
+        run_off()  # warm: compile + scan-cache fill, shared by both
+        run_armed()  # warm the armed side (wrapper + charge paths)
+        # one measured warm armed statement: the byte-ledger acceptance
+        memsan.set_force(True)
+        st = memsan.begin_statement("q1")
+        try:
+            shard.scan(prog)
+        finally:
+            warm_snap = memsan.end_statement(st, enforce=False)
+            memsan.set_force(False)
+        if warm_snap["unbudgeted"]:
+            raise AssertionError(
+                "armed warm Q1 made unbudgeted device allocations: "
+                f"{warm_snap}")
+        best = {"off": float("inf"), "armed": float("inf")}
+        # interleave the sides so host drift hits both equally
+        for _ in range(max(1, iters)):
+            for label, fn in (("off", run_off), ("armed", run_armed)):
+                t0 = time.perf_counter()
+                fn()
+                best[label] = min(best[label],
+                                  time.perf_counter() - t0)
+    finally:
+        memsan.set_force(None)
+        memsan.reset()
+    out = {
+        "rows": n, "sf": sf,
+        "memsan_off_seconds": round(best["off"], 6),
+        "memsan_armed_seconds": round(best["armed"], 6),
+        "memsan_off_rows_per_sec": round(n / best["off"]),
+        "memsan_armed_rows_per_sec": round(n / best["armed"]),
+        "warm_peak_bytes": warm_snap["peak"],
+        "warm_charges": warm_snap["charges"],
+        "warm_unbudgeted": 0,
+        "overhead_pct": round(
+            100 * (best["armed"] / best["off"] - 1), 2),
+    }
+    if assert_within is not None:
+        if best["armed"] > best["off"] * (1 + assert_within):
+            raise AssertionError(
+                f"memsan armed overhead {out['overhead_pct']}% "
                 f"exceeds the {assert_within * 100:g}% budget")
         out["within_budget"] = True
     return out
@@ -1167,6 +1263,9 @@ def main(argv=None) -> int:
                     help="leak sanitizer disabled vs armed warm Q1 A/B")
     ap.add_argument("--admission-overhead", action="store_true",
                     help="front door absent vs installed warm Q1 A/B")
+    ap.add_argument("--memsan-overhead", action="store_true",
+                    help="memory sanitizer disarmed vs armed warm Q1"
+                         " A/B")
     ap.add_argument("--fusion", action="store_true",
                     help="whole-plan fused vs per-fragment warm Q3 A/B")
     ap.add_argument("--batching", action="store_true",
@@ -1233,6 +1332,12 @@ def main(argv=None) -> int:
         # guard); real sizes hold the 3% front-door budget
         report["admission_overhead"] = bench_admission_overhead(
             args.sf, max(3, args.iters),
+            assert_within=(0.5 if args.smoke else 0.03))
+    if args.memsan_overhead or args.smoke:
+        # smoke: tiny run, lax bound (machinery + no-catastrophe
+        # guard); real sizes hold the 3% warm-Q1 tripwire
+        report["memsan_overhead"] = bench_memsan_overhead(
+            args.sf, max(3, args.iters), args.block_rows,
             assert_within=(0.5 if args.smoke else 0.03))
     if args.fusion or args.smoke:
         report["fusion"] = bench_fusion(args.sf, max(3, args.iters))
@@ -1312,6 +1417,14 @@ def main(argv=None) -> int:
                   f"{ao['admission_off_rows_per_sec']:,} rows/s "
                   f"({ao['overhead_pct']:+.2f}%, "
                   f"admitted={ao['admitted']})")
+        if "memsan_overhead" in report:
+            mo = report["memsan_overhead"]
+            print(f"memsan overhead rows={mo['rows']}: armed "
+                  f"{mo['memsan_armed_rows_per_sec']:,} rows/s vs off "
+                  f"{mo['memsan_off_rows_per_sec']:,} rows/s "
+                  f"({mo['overhead_pct']:+.2f}%, warm peak "
+                  f"{mo['warm_peak_bytes']:,} bytes, "
+                  f"unbudgeted={mo['warm_unbudgeted']})")
         if "fusion" in report:
             fu = report["fusion"]
             print(f"fusion rows={fu['rows']}: fused "

@@ -66,6 +66,11 @@ class QueryProfile:
     #: blocking syncs and XLA compiles this statement crossed; {} when
     #: the sanitizer is off
     syncsan: dict = dataclasses.field(default_factory=dict)
+    #: device-byte counters from the footprint sanitizer
+    #: (analysis.memsan, YDB_TPU_MEMSAN=1): peak/live HBM bytes, charge
+    #: count and unbudgeted allocations this statement made; {} when
+    #: the sanitizer is off
+    memsan: dict = dataclasses.field(default_factory=dict)
     device_seconds: float = 0.0
     host_seconds: float = 0.0
     #: per-stage busy fractions + overlap coefficients from the
@@ -159,6 +164,10 @@ def build_profile(spans, sql: str = "", kind: str = "",
             p.syncsan = {
                 k[len("syncsan_"):]: int(v) for k, v in a.items()
                 if k.startswith("syncsan_")}
+        if "memsan_peak" in a and not p.memsan:
+            p.memsan = {
+                k[len("memsan_"):]: int(v) for k, v in a.items()
+                if k.startswith("memsan_")}
         if s.name == "ssa.compile":
             p.compile_seconds += s.seconds
         if s.name == "plan.fuse":
@@ -308,6 +317,11 @@ def format_plan_analyzed(plan, profile: QueryProfile) -> str:
         lines.append("syncsan: " + " ".join(
             f"{k}={ss.get(k, 0)}"
             for k in ("h2d", "d2h", "syncs", "compiles")))
+    if profile.memsan:
+        ms = profile.memsan
+        lines.append("memsan: " + " ".join(
+            f"{k}={ms.get(k, 0)}"
+            for k in ("peak", "live", "charges", "unbudgeted")))
     if profile.fused_stages:
         lines.append(
             f"fusion: fused_stages={profile.fused_stages}"

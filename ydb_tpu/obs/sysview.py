@@ -113,6 +113,15 @@ SYS_SCHEMAS = {
         ("trace_id", dtypes.INT64),
         ("batch_id", dtypes.INT64), ("batch_size", dtypes.INT32),
         ("shared_scan", dtypes.INT32), ("tenant", dtypes.STRING)),
+    # device-memory footprint ledger (analysis.memsan,
+    # YDB_TPU_MEMSAN=1): per-component live/peak HBM bytes plus
+    # charge/release/eviction lifecycle counters, with a "<global>"
+    # row carrying the process-wide peak and armed budget — the "where
+    # did the HBM go" dashboard; empty while the sanitizer is off
+    "sys_device_memory": dtypes.schema(
+        ("component", dtypes.STRING), ("live_bytes", dtypes.INT64),
+        ("peak_bytes", dtypes.INT64), ("charges", dtypes.INT64),
+        ("releases", dtypes.INT64), ("evictions", dtypes.INT64)),
     # the front door's workload pools (serving/): per-tenant weights,
     # budget shares and admission counters — the ".sys resource pools"
     # dashboard an operator reads during an overload
@@ -373,6 +382,30 @@ def _tenant_pools_rows(cluster):
     return cols
 
 
+def _device_memory_rows(cluster):
+    from ydb_tpu.analysis import memsan
+
+    cols: list[list] = [[] for _ in range(6)]
+    if not memsan.armed():
+        return cols  # sanitizer off: the view exists but is empty
+    totals = memsan.component_totals()
+    for comp in sorted(totals):
+        t = totals[comp]
+        row = [comp, t["live"], t["peak"], t["charges"],
+               t["releases"], t["evictions"]]
+        for c, v in zip(cols, row):
+            c.append(v)
+    live = sum(t["live"] for t in totals.values())
+    charges = sum(t["charges"] for t in totals.values())
+    releases = sum(t["releases"] for t in totals.values())
+    evictions = sum(t["evictions"] for t in totals.values())
+    row = ["<global>", live, memsan.global_peak(), charges, releases,
+           evictions]
+    for c, v in zip(cols, row):
+        c.append(v)
+    return cols
+
+
 def _query_log_rows(cluster):
     cols: list[list] = [[] for _ in range(8)]
     for p in cluster.profiles.recent():
@@ -394,6 +427,7 @@ _BUILDERS = {
     "sys_statistics": _statistics_rows,
     "sys_scan_pruning": _scan_pruning_rows,
     "sys_resident_store": _resident_store_rows,
+    "sys_device_memory": _device_memory_rows,
     "sys_top_queries": _top_queries_rows,
     "sys_query_log": _query_log_rows,
     "sys_active_queries": _active_queries_rows,

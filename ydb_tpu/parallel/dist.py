@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ydb_tpu import dtypes
+from ydb_tpu.analysis import budget_ok, memsan
 from ydb_tpu.blocks.block import Column, TableBlock
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.engine.oracle import OracleTable
@@ -41,13 +42,18 @@ def stack_blocks(blocks: list[TableBlock]) -> TableBlock:
     """Stack per-shard blocks along a leading device axis."""
     sch = blocks[0].schema
     cols = {}
-    for n in sch.names:
-        cols[n] = Column(
-            jnp.stack([b.columns[n].data for b in blocks]),
-            jnp.stack([b.columns[n].validity for b in blocks]),
-        )
-    length = jnp.stack([b.length for b in blocks])
-    return TableBlock(cols, length, sch)
+    with memsan.seam("stack"):
+        for n in sch.names:
+            cols[n] = Column(
+                jnp.stack([b.columns[n].data for b in blocks]),
+                jnp.stack([b.columns[n].validity for b in blocks]),
+            )
+        length = jnp.stack([b.length for b in blocks])
+    out = TableBlock(cols, length, sch)
+    if memsan.armed():
+        memsan.charge(memsan.nbytes_of(out), "stack",
+                      owner="stack_blocks")
+    return out
 
 
 def _local(stacked: TableBlock) -> TableBlock:
@@ -139,6 +145,9 @@ def _concat_states(parts: list) -> TableBlock:
     return TableBlock.from_numpy(arrays, sch, validity)
 
 
+@budget_ok("transient pad-to-capacity copy: every call site feeds the"
+           " result straight into a charging stack_blocks seam, which"
+           " accounts the stacked footprint")
 def _pad_state(block: TableBlock, capacity: int) -> TableBlock:
     if block.capacity == capacity:
         return block
@@ -201,9 +210,11 @@ def merge_spec(partial_prog: Program, partial_out_schema, dicts):
                 and spec.column is not None
                 and partial_out_schema.field(spec.out_name).type.is_string
             ):
-                rank_tables[spec.out_name] = jnp.asarray(
-                    dicts[spec.column].sort_rank()
-                )
+                rt = jnp.asarray(dicts[spec.column].sort_rank())
+                if memsan.armed():
+                    memsan.charge(memsan.nbytes_of(rt), "staging",
+                                  owner="rank_tables")
+                rank_tables[spec.out_name] = rt
     return merge_kinds, rank_tables
 
 
@@ -269,12 +280,17 @@ class MeshScan:
         self._merge_kinds = merge_kinds
         self._rank_tables = rank_tables
 
-        paux = {k: jnp.asarray(v) for k, v in self.partial.aux.items()}
-        faux = (
-            {k: jnp.asarray(v) for k, v in self.final.aux.items()}
-            if self.final
-            else {}
-        )
+        with memsan.seam("staging"):
+            paux = {k: jnp.asarray(v)
+                    for k, v in self.partial.aux.items()}
+            faux = (
+                {k: jnp.asarray(v) for k, v in self.final.aux.items()}
+                if self.final
+                else {}
+            )
+        if memsan.armed():
+            memsan.charge(memsan.nbytes_of((paux, faux)), "staging",
+                          owner="mesh_aux")
 
         def merge_final(part: TableBlock) -> TableBlock:
             if self.final is None:
@@ -333,7 +349,11 @@ class MeshScan:
     def run_stacked(self, stacked: TableBlock) -> TableBlock:
         """stacked: leading device axis == mesh shard count."""
         sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
-        stacked = jax.device_put(stacked, sharding)
+        with memsan.seam("staging"):
+            stacked = jax.device_put(stacked, sharding)
+        if memsan.armed():
+            memsan.charge(memsan.nbytes_of(stacked), "staging",
+                          owner="mesh_place")
         return self._step(stacked)
 
     def execute_sources(self, sources, block_rows: int = 1 << 20
@@ -374,9 +394,14 @@ class MeshScan:
             # compact states vary in size shard-to-shard: pad to common
             cap = max(s.capacity for s in states)
             states = [_pad_state(s, cap) for s in states]
-        out = self._merge_final_step(
-            jax.device_put(stack_blocks(states),
-                           NamedSharding(self.mesh, P(SHARD_AXIS))))
+        with memsan.seam("staging"):
+            placed = jax.device_put(
+                stack_blocks(states),
+                NamedSharding(self.mesh, P(SHARD_AXIS)))
+        if memsan.armed():
+            memsan.charge(memsan.nbytes_of(placed), "staging",
+                          owner="mesh_place")
+        out = self._merge_final_step(placed)
         return OracleTable.from_block(out)
 
     def execute(self, source: ColumnSource) -> OracleTable:

@@ -32,7 +32,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ydb_tpu import chaos
-from ydb_tpu.analysis import host_ok
+from ydb_tpu.analysis import host_ok, memsan
 from ydb_tpu.blocks.block import Column, TableBlock
 from ydb_tpu.blocks.dictionary import DictionarySet
 from ydb_tpu.chaos import deadline as statement_deadline
@@ -260,8 +260,12 @@ class MeshPlanExecutor:
                          for f in site.in_schema.fields},
                         site.in_schema),)
                 devs.append(fit_blocks(blocks, site.capacity))
-            inputs[site.key] = jax.device_put(
-                stack_blocks(devs), sharding)
+            with memsan.seam("staging"):
+                inputs[site.key] = jax.device_put(
+                    stack_blocks(devs), sharding)
+        if memsan.armed():
+            memsan.charge(memsan.nbytes_of(inputs), "staging",
+                          owner="stage_fused")
         return inputs
 
     def _exec(self, plan, memo: dict, root: bool = False):
@@ -283,7 +287,12 @@ class MeshPlanExecutor:
 
     def _shard_it(self, stacked: TableBlock) -> TableBlock:
         sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
-        return jax.device_put(stacked, sharding)
+        with memsan.seam("staging"):
+            placed = jax.device_put(stacked, sharding)
+        if memsan.armed():
+            memsan.charge(memsan.nbytes_of(placed), "staging",
+                          owner="mesh_place")
+        return placed
 
     def _scan(self, plan: TableScan) -> TableBlock:
         """Per-shard scan: pushdown program runs in each shard's scan
@@ -361,10 +370,16 @@ class MeshPlanExecutor:
                 self._jit_cache[key] = step
             out, worst = step(stacked)
             # every attempt (including an overflow retry) was a real
-            # mesh exchange — account its per-device bytes
+            # mesh exchange — account its per-device bytes, and charge
+            # the send/recv bucket capacity to the shuffle budget (an
+            # overflow retry re-allocates GROWN buckets: each attempt
+            # charges its own footprint)
             per_dev = exchange_bytes_per_device(stacked.schema, self.n, B)
             for d in range(self.n):
                 timeline.add_bytes(f"shuffle_bytes_dev{d}", per_dev)
+            if memsan.armed():
+                memsan.charge(per_dev * self.n, "shuffle",
+                              owner="repartition")
             w = int(np.asarray(worst))
             if w <= B:
                 return self._tighten(out)
@@ -462,7 +477,12 @@ class MeshPlanExecutor:
                     plan.program, stacked.schema, self.db.dicts,
                     self.db.key_spaces,
                     dict_aliases=dict(plan.dict_aliases))
-                aux = {k: jnp.asarray(v) for k, v in cp.aux.items()}
+                with memsan.seam("staging"):
+                    aux = {k: jnp.asarray(v)
+                           for k, v in cp.aux.items()}
+                if memsan.armed():
+                    memsan.charge(memsan.nbytes_of(aux), "staging",
+                                  owner="xform_aux")
 
                 def go(st):
                     return _relocal(cp.run(_local(st), aux))

@@ -333,12 +333,16 @@ class MeshFusedPlan(FusedPlan):
         # exchanged ndev buckets of the slot's CURRENT capacity from
         # every device (static shapes — grown buckets report grown
         # bytes on later dispatches)
+        from ydb_tpu.analysis import memsan
         from ydb_tpu.obs import timeline
 
         for slot, rb in self.shuffle_rows:
             per_dev = self.ndev * self.expand_caps[slot] * rb
             for d in range(self.ndev):
                 timeline.add_bytes(f"shuffle_bytes_dev{d}", per_dev)
+            if memsan.armed():
+                memsan.charge(per_dev * self.ndev, "shuffle",
+                              owner="mesh_fused_dispatch")
         return out
 
     def shuffle_capacity(self) -> int:

@@ -1,3 +1,5 @@
+# ydb-devmem: device-module — pure jnp kernels: every body runs under
+# the compiled program trace (XLA temporaries, not HBM residents)
 """Device kernel primitives for SSA programs (pure jnp — XLA fuses these).
 
 TPU analog of the reference's block operators:

@@ -66,7 +66,7 @@ import jax
 import jax.numpy as jnp
 
 from ydb_tpu import dtypes
-from ydb_tpu.analysis import host_ok
+from ydb_tpu.analysis import host_ok, memsan
 from ydb_tpu.blocks.block import (
     DEFAULT_CAPACITY_QUANTUM,
     Column,
@@ -460,6 +460,11 @@ class FusedPlan:
             self.first_trace_seconds = (
                 (self.first_trace_seconds or 0.0)
                 + time.perf_counter() - t0)
+        if memsan.armed():
+            # result-block footprint (nbytes is shape metadata — no
+            # device sync on the warm async path)
+            memsan.charge(memsan.nbytes_of(out), "dispatch",
+                          owner="run")
         return out, [int(t) for t in totals]
 
     def run_shared(self, inputs: dict) -> tuple[TableBlock, list[int]]:
@@ -492,6 +497,9 @@ class FusedPlan:
             self.first_trace_seconds = (
                 (self.first_trace_seconds or 0.0)
                 + time.perf_counter() - t0)
+        if memsan.armed():
+            memsan.charge(memsan.nbytes_of(out), "dispatch",
+                          owner="run_shared")
         return out, [int(t) for t in totals]
 
     def _make_stacked_jit(self, batch: int):
@@ -521,27 +529,40 @@ class FusedPlan:
         protocol is per-capacity, and the widest member governs.
         Callers slice members off with :func:`slice_member`."""
         batch = len(inputs_list)
-        stacked = _stack_members(inputs_list)
-        jf = self._stacked_jits.get(batch)
-        if jf is None:
-            jf = self._make_stacked_jit(batch)
-            self._stacked_jits[batch] = jf
-        if batch in self._stacked_traced:
-            out, totals = jf(stacked, self.aux)
-        else:
-            import warnings
-
-            t0 = time.perf_counter()
-            with warnings.catch_warnings():
-                warnings.filterwarnings(
-                    "ignore",
-                    message="Some donated buffers were not usable")
+        with memsan.seam("stack"):
+            stacked = _stack_members(inputs_list)
+        # the stack copy is transient: donated into the dispatch (or
+        # dropped right after it), so its bytes release once the
+        # batched result exists
+        ticket = memsan.charge(
+            memsan.nbytes_of(stacked), "stack",
+            owner="run_stacked") if memsan.armed() else None
+        try:
+            jf = self._stacked_jits.get(batch)
+            if jf is None:
+                jf = self._make_stacked_jit(batch)
+                self._stacked_jits[batch] = jf
+            if batch in self._stacked_traced:
                 out, totals = jf(stacked, self.aux)
-            jax.block_until_ready(out)
-            self._stacked_traced.add(batch)
-            self.first_trace_seconds = (
-                (self.first_trace_seconds or 0.0)
-                + time.perf_counter() - t0)
+            else:
+                import warnings
+
+                t0 = time.perf_counter()
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable")
+                    out, totals = jf(stacked, self.aux)
+                jax.block_until_ready(out)
+                self._stacked_traced.add(batch)
+                self.first_trace_seconds = (
+                    (self.first_trace_seconds or 0.0)
+                    + time.perf_counter() - t0)
+        finally:
+            memsan.release(ticket)
+        if memsan.armed():
+            memsan.charge(memsan.nbytes_of(out), "dispatch",
+                          owner="run_stacked")
         # totals come back shape (B,); the grow protocol keys on the
         # worst member (capacities are trace-time constants shared by
         # the whole batch)
